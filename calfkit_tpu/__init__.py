@@ -1,0 +1,66 @@
+"""calfkit_tpu — a TPU-native decentralized multi-agent framework.
+
+Agents run as independent event-driven services over a Kafka-compatible mesh;
+model turns execute on a local JAX/XLA/Pallas inference backend instead of a
+remote HTTPS API.  See SURVEY.md at the repo root for the full design map.
+
+Public API (lazy — importing :mod:`calfkit_tpu` never pulls in JAX):
+
+- ``Client`` / ``Worker`` — caller surface and serving host
+- ``Agent`` / ``StatelessAgent`` / ``agent_tool`` / ``consumer`` — node kinds
+- ``Tools`` / ``Toolboxes`` / ``Messaging`` / ``Handoff`` — selectors/peers
+- ``models`` — the wire vocabulary
+- ``JaxLocalModelClient`` — the local TPU inference provider
+"""
+
+from __future__ import annotations
+
+from importlib import import_module
+from typing import TYPE_CHECKING, Any
+
+__version__ = "0.1.0"
+
+_LAZY: dict[str, str] = {
+    "Client": "calfkit_tpu.client",
+    "Worker": "calfkit_tpu.worker",
+    "Agent": "calfkit_tpu.nodes",
+    "StatelessAgent": "calfkit_tpu.nodes",
+    "agent_tool": "calfkit_tpu.nodes",
+    "consumer": "calfkit_tpu.nodes",
+    "ConsumerNode": "calfkit_tpu.nodes",
+    "Tools": "calfkit_tpu.nodes",
+    "Toolboxes": "calfkit_tpu.nodes",
+    "MCPToolboxNode": "calfkit_tpu.nodes",
+    "Messaging": "calfkit_tpu.peers",
+    "Handoff": "calfkit_tpu.peers",
+    "NodeFaultError": "calfkit_tpu.exceptions",
+    "FaultTypes": "calfkit_tpu.models",
+    "InMemoryMesh": "calfkit_tpu.mesh",
+    "JaxLocalModelClient": "calfkit_tpu.inference",
+    "EchoModelClient": "calfkit_tpu.engine",
+    "FunctionModelClient": "calfkit_tpu.engine",
+}
+
+if TYPE_CHECKING:  # pragma: no cover
+    from calfkit_tpu.client import Client
+    from calfkit_tpu.exceptions import NodeFaultError
+    from calfkit_tpu.worker import Worker
+
+
+def __getattr__(name: str) -> Any:
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    try:
+        return getattr(import_module(module), name)
+    except ModuleNotFoundError as exc:
+        # only mask the *target* module being absent, never its dependencies
+        if exc.name == module:
+            raise AttributeError(
+                f"{name!r} requires {module!r}, which is not available in this build"
+            ) from exc
+        raise
+
+
+def __dir__() -> list[str]:
+    return sorted(set(globals()) | set(_LAZY))

@@ -1,0 +1,62 @@
+"""Public exception types (reference: calfkit/exceptions.py:1-233)."""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from calfkit_tpu.models.error_report import ErrorReport
+
+
+class CalfkitError(Exception):
+    """Base for all framework exceptions."""
+
+
+class NodeFaultError(CalfkitError):
+    """The typed-fault mint gesture.
+
+    User code raises this (or the kernel mints it) to produce a typed
+    ``FaultMessage``; catching it at the client surfaces the ErrorReport.
+    """
+
+    def __init__(self, report: "ErrorReport"):
+        self.report = report
+        super().__init__(report.describe())
+
+
+class ClientTimeoutError(CalfkitError, TimeoutError):
+    pass
+
+
+class ClientClosedError(CalfkitError):
+    pass
+
+
+class DeserializationError(CalfkitError):
+    pass
+
+
+class MeshUnavailableError(CalfkitError):
+    def __init__(self, message: str, *, reason: str = "unavailable"):
+        self.reason = reason
+        super().__init__(message)
+
+
+class RegistryConfigError(CalfkitError):
+    """Bad handler registration (duplicate route, invalid pattern, ...)."""
+
+
+class SeamContractError(CalfkitError):
+    """A policy seam had the wrong arity or returned an illegal value."""
+
+
+class LifecycleConfigError(CalfkitError):
+    """Worker lifecycle hook/resource misconfiguration."""
+
+
+class ProvisioningError(CalfkitError):
+    pass
+
+
+class InferenceError(CalfkitError):
+    """Local inference backend failure."""

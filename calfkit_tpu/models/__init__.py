@@ -1,0 +1,128 @@
+"""Wire body models: everything that travels inside a mesh record.
+
+Layering (reference: SURVEY.md §1 layer 1): these are pure pydantic models
+with no transport or node dependencies.
+"""
+
+from calfkit_tpu.models.payload import (
+    ContentPart,
+    DataPart,
+    FilePart,
+    TextPart,
+    ToolCallPart,
+    is_retry,
+    render_parts_as_text,
+    retry_text_part,
+)
+from calfkit_tpu.models.messages import (
+    ModelMessage,
+    ModelRequest,
+    ModelResponse,
+    RetryPart,
+    SystemPart,
+    TextOutput,
+    ThinkingOutput,
+    ToolCallOutput,
+    ToolReturnPart,
+    Usage,
+    UserPart,
+)
+from calfkit_tpu.models.marker import CallMarker, Marker, ToolCallMarker
+from calfkit_tpu.models.error_report import ErrorReport, ExceptionInfo, FaultTypes
+from calfkit_tpu.models.state import State
+from calfkit_tpu.models.reply import FaultMessage, Reply, ReturnMessage
+from calfkit_tpu.models.session_context import (
+    CallFrame,
+    Envelope,
+    SessionContext,
+    WorkflowState,
+)
+from calfkit_tpu.models.actions import Call, Next, NodeResult, ReturnCall, TailCall
+from calfkit_tpu.models.step import (
+    AgentMessageStep,
+    HandoffStep,
+    InferenceStep,
+    Step,
+    StepEvent,
+    StepMessage,
+    ThinkingStep,
+    TokenStep,
+    ToolCallStep,
+    ToolResultStep,
+)
+from calfkit_tpu.models.fanout import (
+    EnvelopeSnapshot,
+    FanoutOpen,
+    FanoutOutcome,
+    FanoutState,
+    SlotRef,
+)
+from calfkit_tpu.models.capability import CapabilityRecord, ToolDef, resolve_capability
+from calfkit_tpu.models.agents import AgentCard
+from calfkit_tpu.models.records import ControlPlaneRecord, ControlPlaneStamp
+from calfkit_tpu.models.tool_dispatch import ToolBinding, ToolCallRef
+from calfkit_tpu.models.node_result import InvocationResult
+
+__all__ = [
+    "AgentCard",
+    "AgentMessageStep",
+    "Call",
+    "CallFrame",
+    "CallMarker",
+    "CapabilityRecord",
+    "ContentPart",
+    "ControlPlaneRecord",
+    "ControlPlaneStamp",
+    "DataPart",
+    "Envelope",
+    "EnvelopeSnapshot",
+    "ErrorReport",
+    "ExceptionInfo",
+    "FanoutOpen",
+    "FanoutOutcome",
+    "FanoutState",
+    "FaultMessage",
+    "FaultTypes",
+    "FilePart",
+    "HandoffStep",
+    "InferenceStep",
+    "InvocationResult",
+    "Marker",
+    "ModelMessage",
+    "ModelRequest",
+    "ModelResponse",
+    "Next",
+    "NodeResult",
+    "Reply",
+    "RetryPart",
+    "ReturnCall",
+    "ReturnMessage",
+    "SessionContext",
+    "SlotRef",
+    "State",
+    "Step",
+    "StepEvent",
+    "StepMessage",
+    "SystemPart",
+    "TailCall",
+    "TextOutput",
+    "TextPart",
+    "ThinkingOutput",
+    "ThinkingStep",
+    "TokenStep",
+    "ToolBinding",
+    "ToolCallOutput",
+    "ToolCallPart",
+    "ToolCallRef",
+    "ToolCallStep",
+    "ToolDef",
+    "ToolResultStep",
+    "ToolReturnPart",
+    "Usage",
+    "UserPart",
+    "WorkflowState",
+    "is_retry",
+    "render_parts_as_text",
+    "resolve_capability",
+    "retry_text_part",
+]

@@ -1,0 +1,41 @@
+"""Agent directory records (the ``mesh.agents`` compacted topic).
+
+Reference: calfkit/models/agents.py:29-87 (AgentCard is name-keyed, carries a
+bounded human description, and derives the agent's input topic so callers can
+dispatch by name alone).
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, Field, field_validator
+
+from calfkit_tpu import protocol
+
+MAX_DESCRIPTION = 512
+
+
+class AgentCard(BaseModel):
+
+    name: str
+    description: str = ""
+    structured_output: bool = False
+    tools: list[str] = Field(default_factory=list)  # advertised tool names, directory only
+
+    @field_validator("name")
+    @classmethod
+    def _name_topic_safe(cls, v: str) -> str:
+        protocol.require_topic_safe(v, what="agent name")
+        return v
+
+    @field_validator("description")
+    @classmethod
+    def _bounded(cls, v: str) -> str:
+        if len(v) > MAX_DESCRIPTION:
+            raise ValueError(f"description exceeds {MAX_DESCRIPTION} chars")
+        return v
+
+    def derive_input_topic(self) -> str:
+        return protocol.agent_input_topic(self.name)
+
+    def derive_publish_topic(self) -> str:
+        return protocol.agent_publish_topic(self.name)

@@ -1,0 +1,84 @@
+"""Capability records (the ``mesh.capabilities`` compacted topic) and the
+capability-resolution kernel.
+
+A capability is "this dispatch topic executes these tools".  Agents resolve
+tool selectors against the live capability view each turn (reference:
+calfkit/models/capability.py:49-219).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, Field
+
+
+class ToolDef(BaseModel):
+    """A model-facing tool definition (name + JSON-schema parameters)."""
+
+
+    name: str
+    description: str = ""
+    parameters_schema: dict[str, Any] = Field(
+        default_factory=lambda: {"type": "object", "properties": {}}
+    )
+
+
+class CapabilityRecord(BaseModel):
+
+    node_id: str
+    node_kind: str = "tool"
+    dispatch_topic: str
+    tools: list[ToolDef] = Field(default_factory=list)
+
+    def tool_names(self) -> list[str]:
+        return [t.name for t in self.tools]
+
+
+class ResolvedTool(BaseModel):
+    """A tool def bound to the topic that executes it."""
+
+
+    tool: ToolDef
+    dispatch_topic: str
+    provider_node_id: str
+
+
+class CapabilityResolutionError(LookupError):
+    pass
+
+
+def resolve_capability(
+    records: list[CapabilityRecord], tool_name: str
+) -> ResolvedTool:
+    """Find the one live provider of ``tool_name``.
+
+    Ambiguity (two live providers) is an error, not a coin flip — the caller
+    must disambiguate via selectors (reference: capability.py:138).
+    """
+    matches = [
+        ResolvedTool(tool=t, dispatch_topic=r.dispatch_topic, provider_node_id=r.node_id)
+        for r in records
+        for t in r.tools
+        if t.name == tool_name
+    ]
+    if not matches:
+        raise CapabilityResolutionError(f"no live provider for tool {tool_name!r}")
+    providers = {m.provider_node_id for m in matches}
+    if len(providers) > 1:
+        raise CapabilityResolutionError(
+            f"tool {tool_name!r} offered by multiple providers: {sorted(providers)}"
+        )
+    return matches[0]
+
+
+def resolve_all_capabilities(records: list[CapabilityRecord]) -> list[ResolvedTool]:
+    """Every live tool, one entry per (provider, tool) — discovery mode.
+
+    Reference: capability.py:198 (``resolve_all_capabilities``).
+    """
+    return [
+        ResolvedTool(tool=t, dispatch_topic=r.dispatch_topic, provider_node_id=r.node_id)
+        for r in records
+        for t in r.tools
+    ]

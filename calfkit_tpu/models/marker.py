@@ -1,0 +1,27 @@
+"""The marker rail: typed correlation context echoed verbatim on replies.
+
+A caller stamps a marker on the call frame; the callee's kernel echoes it on
+the reply (return OR fault) without ever inspecting it.  This is how the agent
+re-associates a reply with the model tool call that caused it
+(reference: calfkit/models/marker.py).
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Any, Literal, Union
+
+from pydantic import BaseModel, Field
+
+
+class CallMarker(BaseModel):
+    kind: Literal["call"] = "call"
+    data: dict[str, Any] = Field(default_factory=dict)
+
+
+class ToolCallMarker(BaseModel):
+    kind: Literal["tool_call"] = "tool_call"
+    tool_call_id: str
+    tool_name: str
+
+
+Marker = Annotated[Union[CallMarker, ToolCallMarker], Field(discriminator="kind")]

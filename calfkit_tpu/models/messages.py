@@ -1,0 +1,139 @@
+"""Model-turn message vocabulary — the conversation state that rides the wire.
+
+This replaces the reference's use of vendored pydantic-ai messages
+(reference: calfkit/models/state.py:8-15 importing ModelRequest/ModelResponse
+etc. from the vendor tree).  We own the vocabulary: it is both the wire format
+of conversation state AND the input/output contract of the model-client ABC
+(:mod:`calfkit_tpu.engine.model_client`).
+
+Attribution: requests and responses carry an optional ``author`` (the agent
+name) so multi-agent histories can be re-projected per point-of-view — the
+reference patched its vendor copy to add exactly this (vendor.txt note in
+SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Annotated, Any, Literal, Union
+
+from pydantic import BaseModel, Field
+
+from calfkit_tpu.models.payload import ContentPart
+
+
+class Usage(BaseModel):
+    input_tokens: int = 0
+    output_tokens: int = 0
+    cache_read_tokens: int = 0
+
+    def __add__(self, other: "Usage") -> "Usage":
+        return Usage(
+            input_tokens=self.input_tokens + other.input_tokens,
+            output_tokens=self.output_tokens + other.output_tokens,
+            cache_read_tokens=self.cache_read_tokens + other.cache_read_tokens,
+        )
+
+
+# --------------------------------------------------------------------------- #
+# request parts (caller -> model)
+# --------------------------------------------------------------------------- #
+
+
+class SystemPart(BaseModel):
+    kind: Literal["system"] = "system"
+    content: str
+
+
+class UserPart(BaseModel):
+    kind: Literal["user"] = "user"
+    content: Union[str, list[ContentPart]]
+    author: str | None = None  # attribution for POV projection
+
+
+class ToolReturnPart(BaseModel):
+    kind: Literal["tool_return"] = "tool_return"
+    tool_call_id: str
+    tool_name: str
+    content: Any = None
+
+
+class RetryPart(BaseModel):
+    """Ask the model to retry: validation failure or tool-requested retry."""
+    kind: Literal["retry"] = "retry"
+    content: str
+    tool_call_id: str | None = None
+    tool_name: str | None = None
+
+
+RequestPart = Annotated[
+    Union[SystemPart, UserPart, ToolReturnPart, RetryPart],
+    Field(discriminator="kind"),
+]
+
+
+class ModelRequest(BaseModel):
+    role: Literal["request"] = "request"
+    parts: list[RequestPart] = Field(default_factory=list)
+    instructions: str | None = None
+
+
+# --------------------------------------------------------------------------- #
+# response parts (model -> caller)
+# --------------------------------------------------------------------------- #
+
+
+class TextOutput(BaseModel):
+    kind: Literal["text"] = "text"
+    text: str
+
+
+class ThinkingOutput(BaseModel):
+    kind: Literal["thinking"] = "thinking"
+    text: str
+
+
+class ToolCallOutput(BaseModel):
+    kind: Literal["tool_call"] = "tool_call"
+    tool_call_id: str
+    tool_name: str
+    args: Union[str, dict[str, Any]] = Field(default_factory=dict)
+
+    def args_dict(self) -> dict[str, Any]:
+        """Parse args to a dict; raises ``ValueError`` on malformed JSON."""
+        if isinstance(self.args, dict):
+            return self.args
+        if not self.args.strip():
+            return {}
+        parsed = json.loads(self.args)
+        if not isinstance(parsed, dict):
+            raise ValueError(f"tool args must be a JSON object, got {type(parsed)}")
+        return parsed
+
+
+ResponsePart = Annotated[
+    Union[TextOutput, ThinkingOutput, ToolCallOutput], Field(discriminator="kind")
+]
+
+
+class ModelResponse(BaseModel):
+    role: Literal["response"] = "response"
+    parts: list[ResponsePart] = Field(default_factory=list)
+    usage: Usage = Field(default_factory=Usage)
+    model_name: str | None = None
+    author: str | None = None  # attribution for POV projection
+
+    def text(self) -> str:
+        return "".join(p.text for p in self.parts if isinstance(p, TextOutput))
+
+    def tool_calls(self) -> list[ToolCallOutput]:
+        return [p for p in self.parts if isinstance(p, ToolCallOutput)]
+
+
+ModelMessage = Annotated[
+    Union[ModelRequest, ModelResponse], Field(discriminator="role")
+]
+
+
+def user_message(content: str, *, author: str | None = None) -> ModelRequest:
+    return ModelRequest(parts=[UserPart(content=content, author=author)])

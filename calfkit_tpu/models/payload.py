@@ -1,0 +1,95 @@
+"""A2A-style content-part vocabulary — the unit of user-visible payloads.
+
+Everything a node returns to its caller, and everything a caller sends to a
+node, is a list of these parts (reference: calfkit/models/payload.py:37-93).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Annotated, Any, Literal, Union
+
+from pydantic import BaseModel, Field, model_validator
+
+RETRY_KEY = "mesh.retry"
+
+
+class _Part(BaseModel):
+
+    metadata: dict[str, Any] | None = None
+
+
+class TextPart(_Part):
+    kind: Literal["text"] = "text"
+    text: str
+
+
+class FilePart(_Part):
+    """A file by inline base64 payload or by URI (exactly one must be set)."""
+
+    kind: Literal["file"] = "file"
+    name: str | None = None
+    media_type: str | None = None
+    data_base64: str | None = None
+    uri: str | None = None
+
+    @model_validator(mode="after")
+    def _exactly_one_source(self) -> "FilePart":
+        if (self.data_base64 is None) == (self.uri is None):
+            raise ValueError("FilePart requires exactly one of data_base64 or uri")
+        return self
+
+
+class DataPart(_Part):
+    kind: Literal["data"] = "data"
+    data: Any = None
+
+
+class ToolCallPart(_Part):
+    """A surfaced (not dispatched) tool call, for telemetry payloads."""
+
+    kind: Literal["tool_call"] = "tool_call"
+    tool_call_id: str
+    tool_name: str
+    args: dict[str, Any] = Field(default_factory=dict)
+
+
+ContentPart = Annotated[
+    Union[TextPart, FilePart, DataPart, ToolCallPart], Field(discriminator="kind")
+]
+
+
+def render_parts_as_text(parts: list[ContentPart]) -> str:
+    """Collapse parts to a single text blob (model-facing rendering).
+
+    Reference: calfkit/models/payload.py:40.
+    """
+    chunks: list[str] = []
+    for part in parts:
+        if isinstance(part, TextPart):
+            chunks.append(part.text)
+        elif isinstance(part, DataPart):
+            try:
+                chunks.append(json.dumps(part.data, ensure_ascii=False, default=str))
+            except (TypeError, ValueError):
+                chunks.append(str(part.data))
+        elif isinstance(part, FilePart):
+            label = part.name or part.uri or "file"
+            chunks.append(f"[file: {label}]")
+        elif isinstance(part, ToolCallPart):
+            chunks.append(f"[tool call: {part.tool_name}]")
+    return "\n".join(chunks)
+
+
+def retry_text_part(text: str) -> TextPart:
+    """A text part marked as a model-retry request (tool asked the model to
+    try again, e.g. bad arguments).  Reference: calfkit/models/payload.py:80."""
+    return TextPart(text=text, metadata={RETRY_KEY: True})
+
+
+def is_retry(part: ContentPart) -> bool:
+    return bool(part.metadata and part.metadata.get(RETRY_KEY))
+
+
+def text_parts(*texts: str) -> list[ContentPart]:
+    return [TextPart(text=t) for t in texts]

@@ -1,0 +1,42 @@
+"""Control-plane record envelope: liveness stamp + schema version + payload.
+
+Every control-plane table value is a :class:`ControlPlaneRecord` keyed by
+``<node_name>@<instance_id>``; readers collapse instances to one live record
+per node and filter by staleness and schema version (reference:
+calfkit/controlplane/records.py:54, view at controlplane/view.py:116-123).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+from pydantic import BaseModel, Field
+
+SCHEMA_VERSION = 1
+
+
+class ControlPlaneStamp(BaseModel):
+
+    node_name: str
+    node_kind: str
+    instance_id: str
+    started_at: float = Field(default_factory=time.time)
+    heartbeat_at: float = Field(default_factory=time.time)
+
+    def key(self) -> str:
+        return f"{self.node_name}@{self.instance_id}"
+
+
+class ControlPlaneRecord(BaseModel):
+
+    schema_version: int = SCHEMA_VERSION
+    stamp: ControlPlaneStamp
+    record: dict[str, Any] = Field(default_factory=dict)  # AgentCard / CapabilityRecord dump
+
+    def to_wire(self) -> bytes:
+        return self.model_dump_json().encode("utf-8")
+
+    @classmethod
+    def from_wire(cls, data: bytes | str) -> "ControlPlaneRecord":
+        return cls.model_validate_json(data)

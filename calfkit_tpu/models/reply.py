@@ -1,0 +1,36 @@
+"""The envelope's reply slot: ReturnMessage XOR FaultMessage.
+
+``frame_id`` is the id of the frame the callee unwound to produce this reply;
+the caller classifies the reply (pending slot vs fan-out sibling vs stray)
+against it before any user code runs (reference: calfkit/models/reply.py:41-82).
+``tag`` and ``marker`` are echoed verbatim from the call frame.
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Literal, Union
+
+from pydantic import BaseModel, Field
+
+from calfkit_tpu.models.error_report import ErrorReport
+from calfkit_tpu.models.marker import Marker
+from calfkit_tpu.models.payload import ContentPart
+
+
+class ReturnMessage(BaseModel):
+    kind: Literal["return"] = "return"
+    parts: list[ContentPart] = Field(default_factory=list)
+    frame_id: str | None = None
+    tag: str | None = None
+    marker: Marker | None = None
+
+
+class FaultMessage(BaseModel):
+    kind: Literal["fault"] = "fault"
+    report: ErrorReport
+    frame_id: str | None = None
+    tag: str | None = None
+    marker: Marker | None = None
+
+
+Reply = Annotated[Union[ReturnMessage, FaultMessage], Field(discriminator="kind")]

@@ -1,0 +1,122 @@
+"""Step (telemetry) wire models — per-hop *semantic* events, not spans.
+
+Two frozen families (reference: calfkit/models/step.py:96-186):
+
+- wire ``*Step`` — identity-free facts minted by the node's step ledger and
+  shipped in a :class:`StepMessage` to the run's root callback topic;
+- surface :class:`StepEvent` — the caller-side projection with identity
+  (correlation/task/node) stamped on, fed to ``handle.stream()`` and the
+  client firehose.
+
+Only the hop ledger may mint wire steps (single-authority rule); nodes return
+facts, the ledger turns them into steps.  ``InferenceStep`` is new to the TPU
+build: per-turn prefill/decode metrics from the local backend (SURVEY.md §5
+tracing note).
+"""
+
+from __future__ import annotations
+
+from typing import Annotated, Any, Literal, Union
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class AgentMessageStep(BaseModel):
+    model_config = ConfigDict(frozen=True)
+    kind: Literal["agent_message"] = "agent_message"
+    author: str | None = None
+    text: str = ""
+
+
+class ThinkingStep(BaseModel):
+    """Defined but not emitted by default (parity with the reference)."""
+
+    model_config = ConfigDict(frozen=True)
+    kind: Literal["thinking"] = "thinking"
+    author: str | None = None
+    text: str = ""
+
+
+class ToolCallStep(BaseModel):
+    model_config = ConfigDict(frozen=True)
+    kind: Literal["tool_call"] = "tool_call"
+    tool_call_id: str
+    tool_name: str
+    args: dict[str, Any] = Field(default_factory=dict)
+    denied: bool = False  # born-closed pair for calls denied before dispatch
+
+
+class ToolResultStep(BaseModel):
+    model_config = ConfigDict(frozen=True)
+    kind: Literal["tool_result"] = "tool_result"
+    tool_call_id: str
+    tool_name: str
+    ok: bool = True
+    content: str = ""
+
+
+class HandoffStep(BaseModel):
+    model_config = ConfigDict(frozen=True)
+    kind: Literal["handoff"] = "handoff"
+    from_agent: str | None = None
+    to_agent: str = ""
+
+
+class TokenStep(BaseModel):
+    """Incremental generated text from a streaming model turn."""
+
+    model_config = ConfigDict(frozen=True)
+    kind: Literal["token"] = "token"
+    author: str | None = None
+    text: str = ""
+
+
+class InferenceStep(BaseModel):
+    """Local-backend metrics for one model turn (TPU-build extension)."""
+
+    model_config = ConfigDict(frozen=True)
+    kind: Literal["inference"] = "inference"
+    model_name: str = ""
+    prefill_ms: float = 0.0
+    decode_ms: float = 0.0
+    prompt_tokens: int = 0
+    generated_tokens: int = 0
+    batch_occupancy: float = 0.0
+    tokens_per_second: float = 0.0
+
+
+Step = Annotated[
+    Union[
+        AgentMessageStep,
+        ThinkingStep,
+        ToolCallStep,
+        ToolResultStep,
+        HandoffStep,
+        TokenStep,
+        InferenceStep,
+    ],
+    Field(discriminator="kind"),
+]
+
+
+class StepMessage(BaseModel):
+    """Wire batch: every step minted during one hop, flushed once at hop exit."""
+    steps: list[Step] = Field(default_factory=list)
+    emitter: str = ""  # "<kind>/<name>" of the minting node
+
+    def to_wire(self) -> bytes:
+        return self.model_dump_json(exclude_none=True).encode("utf-8")
+
+    @classmethod
+    def from_wire(cls, data: bytes | str) -> "StepMessage":
+        return cls.model_validate_json(data)
+
+
+class StepEvent(BaseModel):
+    """Surface event: a wire step with run identity stamped caller-side."""
+
+    model_config = ConfigDict(frozen=True)
+    correlation_id: str
+    task_id: str | None = None
+    node: str | None = None
+    step: Step

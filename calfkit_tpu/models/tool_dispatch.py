@@ -1,0 +1,41 @@
+"""Over-the-wire tool invocation body and call-side binding models.
+
+Reference: calfkit/models/tool_dispatch.py:26-147.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, runtime_checkable
+
+from pydantic import BaseModel, Field
+
+from calfkit_tpu.models.capability import ToolDef
+
+
+class ToolCallRef(BaseModel):
+    """The wire body of a dispatched tool invocation (carried as a DataPart)."""
+
+
+    tool_call_id: str
+    tool_name: str
+    args: dict[str, Any] = Field(default_factory=dict)
+
+
+class ToolBinding(BaseModel):
+    """A tool def bound to its dispatch topic, ready for a model turn."""
+
+
+    tool: ToolDef
+    dispatch_topic: str
+
+
+@runtime_checkable
+class ToolSelector(Protocol):
+    """Call-side selection of which live tools a model turn may see.
+
+    Implementations: ``Tools`` (named XOR discover), ``Toolboxes``,
+    ``Messaging``, ``Handoff`` — each resolves against the live capability /
+    agents views at turn time.
+    """
+
+    def resolve(self, view: Any) -> list[ToolBinding]: ...

@@ -1,0 +1,197 @@
+"""Wire-protocol constants: header names, kind vocabularies, header decode.
+
+This module is the single authority for what travels in Kafka record *headers*
+(bodies are defined in :mod:`calfkit_tpu.models`).  It depends on nothing else
+in the package by design, mirroring the reference's dependency-free protocol
+module (reference: calfkit/_protocol.py:1-118).
+
+Header model
+------------
+Every envelope-bearing record carries:
+
+- ``x-mesh-emitter``   — ``<node_kind>/<node_name>`` of the publishing node
+- ``x-mesh-kind``      — :data:`MessageKind`: ``call`` | ``return`` | ``fault``
+- ``x-mesh-wire``      — :data:`WireKind`: body schema discriminator
+                         (``envelope`` | ``step``)
+- ``x-mesh-route``     — the route string the publisher addressed
+- ``x-mesh-task``      — task id (uuid); equals the partition key's source
+- ``x-mesh-correlation`` — correlation id of the whole run (client-minted)
+- ``x-mesh-error-type`` — fault records only: the typed fault code
+
+Headers are advisory routing/telemetry metadata; the envelope body is always
+authoritative.  Consumers must tolerate missing headers (a ``None`` decode).
+"""
+
+from __future__ import annotations
+
+from typing import Final, Literal
+
+# --------------------------------------------------------------------------- #
+# header names
+# --------------------------------------------------------------------------- #
+
+HDR_EMITTER: Final = "x-mesh-emitter"
+HDR_KIND: Final = "x-mesh-kind"
+HDR_WIRE: Final = "x-mesh-wire"
+HDR_ROUTE: Final = "x-mesh-route"
+HDR_TASK: Final = "x-mesh-task"
+HDR_CORRELATION: Final = "x-mesh-correlation"
+HDR_ERROR_TYPE: Final = "x-mesh-error-type"
+
+ALL_HEADERS: Final = (
+    HDR_EMITTER,
+    HDR_KIND,
+    HDR_WIRE,
+    HDR_ROUTE,
+    HDR_TASK,
+    HDR_CORRELATION,
+    HDR_ERROR_TYPE,
+)
+
+# --------------------------------------------------------------------------- #
+# kind vocabularies
+# --------------------------------------------------------------------------- #
+
+NodeKind = Literal["agent", "tool", "consumer", "toolbox", "client", "worker"]
+MessageKind = Literal["call", "return", "fault"]
+WireKind = Literal["envelope", "step"]
+
+MESSAGE_KINDS: Final = ("call", "return", "fault")
+WIRE_KINDS: Final = ("envelope", "step")
+
+# --------------------------------------------------------------------------- #
+# decode helpers
+# --------------------------------------------------------------------------- #
+
+
+def decode_header_str(value: bytes | str | None) -> str | None:
+    """Decode a raw header value to ``str`` (headers may arrive as bytes)."""
+    if value is None:
+        return None
+    if isinstance(value, bytes):
+        try:
+            return value.decode("utf-8")
+        except UnicodeDecodeError:
+            return None
+    return value
+
+
+def header_map(raw: dict[str, bytes | str] | None) -> dict[str, str]:
+    """Normalize a raw header mapping to ``str -> str``, dropping undecodables."""
+    out: dict[str, str] = {}
+    for k, v in (raw or {}).items():
+        s = decode_header_str(v)
+        if s is not None:
+            out[k] = s
+    return out
+
+
+def emitter_header(node_kind: str, node_name: str) -> str:
+    return f"{node_kind}/{node_name}"
+
+
+def parse_emitter(value: str | None) -> tuple[str | None, str | None]:
+    """Split ``<kind>/<name>`` (name may itself contain ``/``-free chars only)."""
+    if not value or "/" not in value:
+        return None, None
+    kind, _, name = value.partition("/")
+    return (kind or None), (name or None)
+
+
+def wire_kind_of(headers: dict[str, str]) -> str | None:
+    return headers.get(HDR_WIRE)
+
+
+def is_envelope(headers: dict[str, str]) -> bool:
+    """Subscriber filter: does this record carry an Envelope body?
+
+    Records without a wire header are treated as envelopes for lenient
+    interop; ``step`` records are explicitly not (reference: the
+    ``wire_filter`` subscriber filter, calfkit/_protocol.py:89).
+    """
+    wk = headers.get(HDR_WIRE)
+    return wk is None or wk == "envelope"
+
+
+# --------------------------------------------------------------------------- #
+# topic-name validation (Kafka legal-name rules)
+# --------------------------------------------------------------------------- #
+
+_TOPIC_LEGAL = set(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._-"
+)
+MAX_TOPIC_LEN: Final = 249
+
+
+def is_topic_safe(name: str) -> bool:
+    """True iff ``name`` is a legal Kafka topic name.
+
+    Reference: calfkit/_protocol.py:110 (same rules: charset, length, and the
+    reserved ``.``/``..`` names).
+    """
+    if not name or len(name) > MAX_TOPIC_LEN:
+        return False
+    if name in (".", ".."):
+        return False
+    return all(c in _TOPIC_LEGAL for c in name)
+
+
+def require_topic_safe(name: str, *, what: str = "topic") -> str:
+    if not is_topic_safe(name):
+        raise ValueError(
+            f"{what} {name!r} is not a legal topic name "
+            f"(allowed: [a-zA-Z0-9._-], max {MAX_TOPIC_LEN} chars)"
+        )
+    return name
+
+
+# --------------------------------------------------------------------------- #
+# framework topic layout
+# --------------------------------------------------------------------------- #
+# One place computes every per-node topic name so that provisioning, workers,
+# clients and the control plane all agree (reference spreads this across
+# nodes/base.py and provisioning/provisioner.py; centralizing it is deliberate).
+
+
+def agent_input_topic(name: str) -> str:
+    return require_topic_safe(f"agent.{name}.private.input")
+
+
+def agent_return_topic(name: str) -> str:
+    return require_topic_safe(f"agent.{name}.private.return")
+
+
+def agent_publish_topic(name: str) -> str:
+    return require_topic_safe(f"agent.{name}.events")
+
+
+def tool_input_topic(name: str) -> str:
+    return require_topic_safe(f"tool.{name}.input")
+
+
+def tool_publish_topic(name: str) -> str:
+    return require_topic_safe(f"tool.{name}.output")
+
+
+def toolbox_input_topic(name: str) -> str:
+    return require_topic_safe(f"mcp_server.{name}.input")
+
+
+def toolbox_publish_topic(name: str) -> str:
+    return require_topic_safe(f"mcp_server.{name}.output")
+
+
+def client_inbox_topic(client_id: str) -> str:
+    return require_topic_safe(f"mesh.client.{client_id}.inbox")
+
+
+AGENTS_TOPIC: Final = "mesh.agents"
+CAPABILITIES_TOPIC: Final = "mesh.capabilities"
+
+
+def fanout_state_topic(node_id: str) -> str:
+    return require_topic_safe(f"mesh.fanout.{node_id}.state")
+
+
+def fanout_basestate_topic(node_id: str) -> str:
+    return require_topic_safe(f"mesh.fanout.{node_id}.basestate")

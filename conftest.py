@@ -1,0 +1,19 @@
+"""Root conftest: paths + JAX virtual-device environment.
+
+Must run before anything imports jax: tests exercise multi-chip sharding on a
+virtual 8-device CPU mesh (``xla_force_host_platform_device_count``), per the
+repo build contract.  Real-TPU tests opt out via the ``tpu`` marker and are
+deselected by default.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,29 @@
+"""Test-suite conftest: minimal async-test support (no pytest-asyncio in the
+image) plus shared fixtures for the offline lane."""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+
+import pytest
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem: pytest.Function):
+    """Run ``async def`` tests on a fresh event loop per test."""
+    fn = pyfuncitem.obj
+    if not inspect.iscoroutinefunction(fn):
+        return None
+    sig = inspect.signature(fn)
+    kwargs = {
+        name: pyfuncitem.funcargs[name]
+        for name in sig.parameters
+        if name in pyfuncitem.funcargs
+    }
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(asyncio.wait_for(fn(**kwargs), timeout=60))
+    finally:
+        loop.close()
+    return True

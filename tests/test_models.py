@@ -1,0 +1,221 @@
+"""Wire models: envelope round-trips, stack verbs, error reports, projection."""
+
+import pytest
+
+from calfkit_tpu.models import (
+    AgentCard,
+    Call,
+    CallFrame,
+    CapabilityRecord,
+    DataPart,
+    Envelope,
+    ErrorReport,
+    FaultMessage,
+    FaultTypes,
+    ModelRequest,
+    ModelResponse,
+    ReturnMessage,
+    SessionContext,
+    State,
+    StepMessage,
+    TextOutput,
+    TextPart,
+    ToolCallOutput,
+    ToolCallStep,
+    ToolDef,
+    UserPart,
+    WorkflowState,
+    is_retry,
+    render_parts_as_text,
+    resolve_capability,
+    retry_text_part,
+)
+from calfkit_tpu.models.capability import CapabilityResolutionError
+from calfkit_tpu.models.node_result import InvocationResult, project_output
+from pydantic import BaseModel
+
+
+class TestParts:
+    def test_render(self):
+        parts = [TextPart(text="hi"), DataPart(data={"a": 1})]
+        assert render_parts_as_text(parts) == 'hi\n{"a": 1}'
+
+    def test_retry_marker(self):
+        p = retry_text_part("try again")
+        assert is_retry(p)
+        assert not is_retry(TextPart(text="x"))
+
+
+class TestWorkflowState:
+    def test_invoke_unwind(self):
+        wf = WorkflowState()
+        f1 = CallFrame(target_topic="t1", callback_topic="cb1")
+        f2 = CallFrame(target_topic="t2", callback_topic="cb2")
+        wf.invoke_frame(f1)
+        wf.invoke_frame(f2)
+        assert wf.depth == 2
+        assert wf.current() is f2
+        assert wf.root_callback_topic() == "cb1"
+        popped = wf.unwind_frame()
+        assert popped.frame_id == f2.frame_id
+        assert wf.current() is f1
+        with pytest.raises(ValueError):
+            WorkflowState().unwind_frame()
+
+    def test_mark_fanout(self):
+        wf = WorkflowState(frames=[CallFrame(target_topic="t", callback_topic="c")])
+        wf.mark_fanout("fx")
+        assert wf.current().fanout_id == "fx"
+        wf.mark_fanout(None)
+        assert wf.current().fanout_id is None
+
+
+class TestEnvelope:
+    def test_wire_roundtrip(self):
+        env = Envelope(
+            context=SessionContext(state=State(message_history=[
+                ModelRequest(parts=[UserPart(content="hello")]),
+                ModelResponse(parts=[TextOutput(text="hi")]),
+            ])),
+            workflow=WorkflowState(
+                frames=[CallFrame(target_topic="t", callback_topic="c")]
+            ),
+            reply=ReturnMessage(parts=[TextPart(text="done")], frame_id="f1"),
+        )
+        again = Envelope.from_wire(env.to_wire())
+        assert again == env
+
+    def test_fault_reply_discriminated(self):
+        env = Envelope(reply=FaultMessage(report=ErrorReport(message="boom")))
+        again = Envelope.from_wire(env.to_wire())
+        assert isinstance(again.reply, FaultMessage)
+        assert again.reply.report.message == "boom"
+
+
+class _Hostile:
+    def __str__(self):  # pragma: no cover - exercised via build_safe
+        raise RuntimeError("hostile str")
+
+    def __repr__(self):
+        raise RuntimeError("hostile repr")
+
+
+class TestErrorReport:
+    def test_build_safe_hostile(self):
+        rep = ErrorReport.build_safe(FaultTypes.NODE_ERROR, _Hostile())
+        assert rep.error_type == FaultTypes.NODE_ERROR
+        assert "_Hostile" in rep.message  # fell back to object.__repr__
+
+    def test_build_safe_exception_harvest(self):
+        try:
+            raise ValueError("inner")
+        except ValueError as exc:
+            rep = ErrorReport.build_safe(FaultTypes.TOOL_ERROR, exc=exc, node="n")
+        assert rep.exception.type == "ValueError"
+        assert "inner" in rep.message
+        assert rep.exception.traceback and "ValueError" in rep.exception.traceback
+
+    def test_cause_chain_flattens(self):
+        a = ErrorReport.build_safe(FaultTypes.TOOL_ERROR, "leaf", frame_id="f1")
+        b = ErrorReport.build_safe(FaultTypes.CALLEE_FAULT, "mid", cause=a, frame_id="f2")
+        c = ErrorReport.build_safe(FaultTypes.CALLEE_FAULT, "top", cause=b, frame_id="f3")
+        assert [r.message for r in c.causes] == ["mid", "leaf"]
+        assert c.root_cause().message == "leaf"
+        assert c.frame_chain[:3] == ["f3", "f2", "f1"]
+
+    def test_elision_ladder(self):
+        try:
+            raise ValueError("x")
+        except ValueError as exc:
+            rep = ErrorReport.build_safe(FaultTypes.NODE_ERROR, exc=exc)
+        no_tb = rep.without_tracebacks()
+        assert no_tb.exception.traceback is None
+        minimal = rep.to_minimal()
+        assert minimal.exception is None and minimal.error_type == rep.error_type
+
+
+class TestState:
+    def test_latest_tool_calls(self):
+        st = State(message_history=[
+            ModelResponse(parts=[ToolCallOutput(tool_call_id="1", tool_name="a")]),
+            ModelRequest(parts=[UserPart(content="x")]),
+            ModelResponse(parts=[
+                ToolCallOutput(tool_call_id="2", tool_name="b"),
+                ToolCallOutput(tool_call_id="3", tool_name="c"),
+            ]),
+        ])
+        assert [c.tool_call_id for c in st.latest_tool_calls()] == ["2", "3"]
+
+    def test_args_dict(self):
+        assert ToolCallOutput(tool_call_id="1", tool_name="t", args='{"a": 1}').args_dict() == {"a": 1}
+        assert ToolCallOutput(tool_call_id="1", tool_name="t", args="").args_dict() == {}
+        with pytest.raises(ValueError):
+            ToolCallOutput(tool_call_id="1", tool_name="t", args="[1]").args_dict()
+
+
+class TestCapability:
+    def _records(self):
+        return [
+            CapabilityRecord(node_id="t1", dispatch_topic="tool.t1.input",
+                             tools=[ToolDef(name="get_weather")]),
+            CapabilityRecord(node_id="t2", dispatch_topic="tool.t2.input",
+                             tools=[ToolDef(name="get_time")]),
+        ]
+
+    def test_resolve(self):
+        r = resolve_capability(self._records(), "get_weather")
+        assert r.dispatch_topic == "tool.t1.input"
+
+    def test_missing_and_ambiguous(self):
+        with pytest.raises(CapabilityResolutionError):
+            resolve_capability(self._records(), "nope")
+        dup = self._records() + [
+            CapabilityRecord(node_id="t3", dispatch_topic="tool.t3.input",
+                             tools=[ToolDef(name="get_weather")])
+        ]
+        with pytest.raises(CapabilityResolutionError):
+            resolve_capability(dup, "get_weather")
+
+    def test_agent_card(self):
+        card = AgentCard(name="weather", description="d")
+        assert card.derive_input_topic() == "agent.weather.private.input"
+        with pytest.raises(ValueError):
+            AgentCard(name="bad name")
+        with pytest.raises(ValueError):
+            AgentCard(name="x", description="d" * 513)
+
+
+class _Out(BaseModel):
+    city: str
+    temp_c: float
+
+
+class TestProjection:
+    def test_str_output(self):
+        assert project_output([TextPart(text="a"), TextPart(text="b")], str) == "a\nb"
+
+    def test_typed_from_datapart(self):
+        out = project_output([DataPart(data={"city": "SF", "temp_c": 18.0})], _Out)
+        assert out.city == "SF"
+
+    def test_typed_from_text_lenient(self):
+        out = project_output(
+            [TextPart(text='Sure: ```json\n{"city": "SF", "temp_c": 1.0}\n``` done')],
+            _Out,
+        )
+        assert out.city == "SF"
+
+    def test_from_envelope(self):
+        env = Envelope(reply=ReturnMessage(parts=[TextPart(text="ok")]))
+        res = InvocationResult.from_envelope(env, str, correlation_id="c1")
+        assert res.output == "ok" and res.correlation_id == "c1"
+        with pytest.raises(ValueError):
+            InvocationResult.from_envelope(Envelope(), str)
+
+
+class TestSteps:
+    def test_step_message_roundtrip(self):
+        sm = StepMessage(steps=[ToolCallStep(tool_call_id="1", tool_name="t", args={"a": 1})],
+                         emitter="agent/w")
+        again = StepMessage.from_wire(sm.to_wire())
+        assert again == sm

@@ -1,0 +1,79 @@
+"""Wire-protocol constants, routing grammar, keying."""
+
+import pytest
+
+from calfkit_tpu import protocol, routing
+from calfkit_tpu.keying import partition_key
+
+
+class TestHeaders:
+    def test_decode_bytes_and_str(self):
+        assert protocol.decode_header_str(b"call") == "call"
+        assert protocol.decode_header_str("call") == "call"
+        assert protocol.decode_header_str(None) is None
+        assert protocol.decode_header_str(b"\xff\xfe") is None
+
+    def test_header_map_drops_undecodable(self):
+        out = protocol.header_map({"a": b"x", "b": b"\xff\xfe", "c": "y"})
+        assert out == {"a": "x", "c": "y"}
+
+    def test_emitter_roundtrip(self):
+        hdr = protocol.emitter_header("agent", "weather")
+        assert protocol.parse_emitter(hdr) == ("agent", "weather")
+        assert protocol.parse_emitter(None) == (None, None)
+        assert protocol.parse_emitter("nope") == (None, None)
+
+    def test_envelope_filter(self):
+        assert protocol.is_envelope({})
+        assert protocol.is_envelope({protocol.HDR_WIRE: "envelope"})
+        assert not protocol.is_envelope({protocol.HDR_WIRE: "step"})
+
+
+class TestTopics:
+    def test_topic_safety(self):
+        assert protocol.is_topic_safe("agent.weather.private.input")
+        assert not protocol.is_topic_safe("")
+        assert not protocol.is_topic_safe(".")
+        assert not protocol.is_topic_safe("..")
+        assert not protocol.is_topic_safe("has space")
+        assert not protocol.is_topic_safe("x" * 250)
+
+    def test_layout(self):
+        assert protocol.agent_input_topic("w") == "agent.w.private.input"
+        assert protocol.agent_return_topic("w") == "agent.w.private.return"
+        assert protocol.tool_input_topic("t") == "tool.t.input"
+        with pytest.raises(ValueError):
+            protocol.agent_input_topic("bad name")
+
+
+class TestRouting:
+    def test_validate(self):
+        routing.validate_route_pattern("a.b.c")
+        routing.validate_route_pattern("a.b.*")
+        routing.validate_route_pattern("*")
+        with pytest.raises(routing.RouteError):
+            routing.validate_route_pattern("a.*.c")
+        with pytest.raises(routing.RouteError):
+            routing.validate_route_pattern("a..b")
+        with pytest.raises(routing.RouteError):
+            routing.validate_route("a.*")
+
+    def test_matching(self):
+        assert routing.route_matches("a.b", "a.b")
+        assert not routing.route_matches("a.b", "a.b.c")
+        assert routing.route_matches("a.*", "a.b.c")
+        assert routing.route_matches("a.*", "a")
+        assert not routing.route_matches("a.*", "ab")
+        assert routing.route_matches("*", "anything.at.all")
+
+    def test_chain_order_most_specific_first(self):
+        patterns = ["*", "run.*", "run.step", "run"]
+        assert routing.match_chain(patterns, "run.step") == ["run.step", "run.*", "*"]
+        assert routing.match_chain(patterns, "run") == ["run", "run.*", "*"]
+
+
+class TestKeying:
+    def test_partition_key(self):
+        assert partition_key("abc") == b"abc"
+        with pytest.raises(ValueError):
+            partition_key("")
