@@ -1,0 +1,3 @@
+"""The ``ck`` command-line interface (reference: calfkit/cli/, SURVEY.md §1
+layer 10).  Subcommands land as their subsystems do: ``run``, ``dev``,
+``chat``, ``topics``."""

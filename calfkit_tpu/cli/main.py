@@ -1,0 +1,48 @@
+"""``ck`` entry point."""
+
+from __future__ import annotations
+
+import click
+
+import calfkit_tpu
+
+
+@click.group(help="calfkit-tpu: TPU-native agent-mesh framework CLI")
+@click.version_option(calfkit_tpu.__version__, prog_name="ck")
+def main() -> None:
+    pass
+
+
+def _register() -> None:
+    """Attach subcommand groups; each is optional while subsystems land."""
+    try:
+        from calfkit_tpu.cli.run import run_command
+
+        main.add_command(run_command)
+    except ImportError:
+        pass
+    try:
+        from calfkit_tpu.cli.dev import dev_group
+
+        main.add_command(dev_group)
+    except ImportError:
+        pass
+    try:
+        from calfkit_tpu.cli.chat import chat_command
+
+        main.add_command(chat_command)
+    except ImportError:
+        pass
+    try:
+        from calfkit_tpu.cli.topics import topics_group
+
+        main.add_command(topics_group)
+    except ImportError:
+        pass
+
+
+_register()
+
+
+if __name__ == "__main__":
+    main()

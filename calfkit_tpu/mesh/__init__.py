@@ -1,0 +1,30 @@
+"""Mesh transport: the Kafka-compatible substrate abstraction.
+
+Three tiers ride one interface (reference: SURVEY.md §5 "distributed
+communication backend"):
+
+1. pub/sub of envelopes + steps (:class:`MeshTransport.publish` /
+   ``subscribe`` with key-ordered dispatch),
+2. compacted tables for control-plane and fan-out state
+   (:class:`TableReader` / :class:`TableWriter`),
+3. topic admin (``ensure_topics``).
+
+``InMemoryMesh`` is a full single-process implementation — it is both the
+offline test substrate and the ``ck dev`` zero-setup mesh.  ``KafkaMesh``
+(gated on aiokafka) is the production adapter.
+"""
+
+from calfkit_tpu.mesh.transport import MeshTransport, Record, Subscription
+from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
+from calfkit_tpu.mesh.memory import InMemoryMesh
+from calfkit_tpu.mesh.tables import TableReader, TableWriter
+
+__all__ = [
+    "InMemoryMesh",
+    "KeyOrderedDispatcher",
+    "MeshTransport",
+    "Record",
+    "Subscription",
+    "TableReader",
+    "TableWriter",
+]

@@ -1,0 +1,155 @@
+"""The key-ordered dispatcher — the framework's concurrency model.
+
+Invariants (reference: calfkit/_faststream_ext/_subscriber.py:102-350):
+
+- N lanes; a record's lane is ``crc32(key) % N`` → strictly serial per key,
+  parallel across keys.  Combined with task-keyed publishing this yields the
+  single-writer-per-run property (see :mod:`calfkit_tpu.keying`).
+- ONE global semaphore with bound ``2 × N`` is the sole backpressure:
+  ``submit()`` blocks when 2N records are in flight, which stalls the
+  consumer pull loop (broker-side flow control takes over from there).
+- ACK-first: the caller acks/commits *before* ``submit()`` — crash-abandoned
+  in-flight records are documented at-most-once.
+- Graceful drain: ``stop()`` stops intake, then acquires every permit, which
+  can only succeed once all in-flight handlers have finished.
+- A permit-accounting bug must be loud, not a slow leak: releasing beyond the
+  bound raises (the semaphore tripwire, reference :336-350).
+- Keyless records are legal but warn once per dispatcher and serialize on
+  lane 0 (they have no ordering contract to honor).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import zlib
+from typing import Awaitable, Callable
+
+from calfkit_tpu.mesh.transport import Record
+
+logger = logging.getLogger(__name__)
+
+
+class _TripwireSemaphore(asyncio.Semaphore):
+    """A semaphore whose value may never exceed its initial bound."""
+
+    def __init__(self, value: int):
+        super().__init__(value)
+        self._bound = value
+
+    def release(self) -> None:
+        if self._value >= self._bound:
+            raise RuntimeError(
+                "key-ordered dispatcher permit over-release: accounting bug"
+            )
+        super().release()
+
+
+class KeyOrderedDispatcher:
+    def __init__(
+        self,
+        handler: Callable[[Record], Awaitable[None]],
+        *,
+        max_workers: int = 8,
+        name: str = "dispatcher",
+    ):
+        if max_workers < 1:
+            raise ValueError("max_workers must be >= 1")
+        self._handler = handler
+        self._lanes = max_workers
+        self._name = name
+        self._queues: list[asyncio.Queue[Record | None]] = [
+            asyncio.Queue() for _ in range(max_workers)
+        ]
+        self._permits = _TripwireSemaphore(2 * max_workers)
+        self._workers: list[asyncio.Task[None]] = []
+        self._started = False
+        self._stopping = False
+        self._warned_keyless = False
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self._started:
+            return
+        self._started = True
+        self._workers = [
+            asyncio.get_running_loop().create_task(
+                self._serve_lane(i), name=f"{self._name}-lane-{i}"
+            )
+            for i in range(self._lanes)
+        ]
+
+    async def stop(self, *, drain_timeout: float = 5.0) -> None:
+        """Stop intake and drain; wedged handlers are cancelled after
+        ``drain_timeout`` so shutdown always terminates."""
+        self._stopping = True
+        drained = True
+        try:
+            # owning every permit proves no handler is still running
+            async with asyncio.timeout(drain_timeout):
+                for _ in range(2 * self._lanes):
+                    await self._permits.acquire()
+        except TimeoutError:
+            drained = False
+            logger.warning(
+                "[%s] graceful drain timed out after %.1fs; cancelling in-flight handlers",
+                self._name,
+                drain_timeout,
+            )
+        for q in self._queues:
+            q.put_nowait(None)
+        for w in self._workers:
+            if not drained:
+                w.cancel()
+        for w in self._workers:
+            try:
+                await asyncio.wait_for(w, timeout=1)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
+                w.cancel()
+        self._workers = []
+        self._started = False
+
+    # -------------------------------------------------------------- intake
+    def lane_of(self, key: bytes | None) -> int:
+        if key is None:
+            return 0
+        return zlib.crc32(key) % self._lanes
+
+    async def submit(self, record: Record) -> None:
+        """Enqueue for ordered dispatch; blocks at the 2N in-flight bound."""
+        if not self._started:
+            raise RuntimeError("dispatcher not started")
+        if self._stopping:
+            return
+        if record.key is None and not self._warned_keyless:
+            self._warned_keyless = True
+            logger.warning(
+                "[%s] keyless record on %s: no ordering contract, using lane 0",
+                self._name,
+                record.topic,
+            )
+        await self._permits.acquire()
+        self._queues[self.lane_of(record.key)].put_nowait(record)
+
+    # -------------------------------------------------------------- lanes
+    async def _serve_lane(self, lane: int) -> None:
+        queue = self._queues[lane]
+        while True:
+            record = await queue.get()
+            if record is None:
+                return
+            try:
+                await self._handler(record)
+            except asyncio.CancelledError:
+                raise
+            except BaseException:
+                # the handler owns its fault rail; anything escaping it is a
+                # floor-level bug — log loudly, never kill the lane
+                logger.exception(
+                    "[%s] handler escaped its fault rail on %s (lane %d)",
+                    self._name,
+                    record.topic,
+                    lane,
+                )
+            finally:
+                self._permits.release()

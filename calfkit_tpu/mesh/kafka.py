@@ -1,0 +1,349 @@
+"""Kafka adapter: the production MeshTransport over aiokafka.
+
+Import-gated: aiokafka is an optional extra (``pip install calfkit-tpu[kafka]``).
+The adapter maps the transport contract onto real Kafka:
+
+- ``subscribe(group_id=...)`` → an ``AIOKafkaConsumer`` in that group with
+  auto-commit ("ACK-first": commit cadence is independent of handler
+  completion — at-most-once for crash-abandoned in-flight records, matching
+  the reference's documented stance, _faststream_ext/_subscriber.py:214-221),
+  feeding the same :class:`KeyOrderedDispatcher` used by the in-memory mesh.
+- ``subscribe(group_id=None)`` → a groupless consumer from latest offsets.
+- tables → a compacted-topic consumer maintaining a local dict view with
+  catch-up (end-offsets gate) and barrier (produce-stamp + wait) semantics.
+
+Untested in the offline lane; exercised by ``-m kafka`` integration tests
+against a real broker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from typing import Awaitable, Callable
+
+from calfkit_tpu.exceptions import MeshUnavailableError
+from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
+from calfkit_tpu.mesh.tables import TableReader, TableWriter
+from calfkit_tpu.mesh.transport import MeshTransport, Record, RecordHandler, Subscription
+
+logger = logging.getLogger(__name__)
+
+
+def _aiokafka():
+    try:
+        import aiokafka  # type: ignore
+
+        return aiokafka
+    except ImportError as exc:  # pragma: no cover - depends on environment
+        raise MeshUnavailableError(
+            "KafkaMesh requires aiokafka (install the 'kafka' extra); "
+            "use InMemoryMesh for local development",
+            reason="missing-dependency",
+        ) from exc
+
+
+class _KafkaSubscription(Subscription):
+    def __init__(self, stop_fn: Callable[[], Awaitable[None]]):
+        self._stop_fn = stop_fn
+
+    async def stop(self) -> None:
+        await self._stop_fn()
+
+
+class KafkaMesh(MeshTransport):
+    """MeshTransport over a Kafka-compatible cluster."""
+
+    def __init__(
+        self,
+        bootstrap_servers: str,
+        *,
+        max_message_bytes: int = 5 * 1024 * 1024,
+        enable_idempotence: bool | None = None,
+        security: dict | None = None,
+        client_id: str | None = None,
+    ):
+        _aiokafka()
+        self._bootstrap = bootstrap_servers
+        self._max_bytes = max_message_bytes
+        self._idempotence = enable_idempotence
+        self._security = dict(security or {})
+        self._client_id = client_id or f"calfkit-{uuid.uuid4().hex[:8]}"
+        self._producer = None
+        self._tasks: list[asyncio.Task[None]] = []
+        self._consumers: list = []
+        self._dispatchers: list[KeyOrderedDispatcher] = []
+        self._started = False
+
+    @property
+    def max_message_bytes(self) -> int:
+        return self._max_bytes
+
+    def _common_kwargs(self) -> dict:
+        return {"bootstrap_servers": self._bootstrap, **self._security}
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        if self._started:
+            return
+        aiokafka = _aiokafka()
+        producer_kwargs = dict(
+            self._common_kwargs(),
+            client_id=self._client_id,
+            max_request_size=self._max_bytes,
+            acks="all",
+        )
+        if self._idempotence is not None:
+            producer_kwargs["enable_idempotence"] = self._idempotence
+        self._producer = aiokafka.AIOKafkaProducer(**producer_kwargs)
+        await self._producer.start()
+        self._started = True
+
+    async def stop(self) -> None:
+        self._started = False
+        for task in self._tasks:
+            task.cancel()
+        for task in self._tasks:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        self._tasks = []
+        for consumer in self._consumers:
+            try:
+                await consumer.stop()
+            except Exception:  # noqa: BLE001
+                logger.exception("consumer stop failed")
+        self._consumers = []
+        for d in self._dispatchers:
+            try:
+                await d.stop()
+            except Exception:  # noqa: BLE001
+                logger.exception("dispatcher drain failed")
+        self._dispatchers = []
+        if self._producer is not None:
+            await self._producer.stop()
+            self._producer = None
+
+    # ---------------------------------------------------------------- admin
+    async def ensure_topics(self, names: list[str], *, compacted: bool = False) -> None:
+        from aiokafka.admin import AIOKafkaAdminClient, NewTopic  # type: ignore
+
+        admin = AIOKafkaAdminClient(**self._common_kwargs())
+        await admin.start()
+        try:
+            configs = {"cleanup.policy": "compact"} if compacted else {}
+            topics = [
+                NewTopic(name=n, num_partitions=16, replication_factor=-1, topic_configs=configs)
+                for n in names
+            ]
+            try:
+                await admin.create_topics(topics, validate_only=False)
+            except Exception as exc:  # noqa: BLE001 - existing topics are fine
+                if "TopicAlreadyExists" not in type(exc).__name__ and "exists" not in str(exc).lower():
+                    raise
+        finally:
+            await admin.close()
+
+    # -------------------------------------------------------------- produce
+    async def publish(
+        self,
+        topic: str,
+        value: bytes,
+        *,
+        key: bytes | None = None,
+        headers: dict[str, str] | None = None,
+    ) -> None:
+        if len(value) > self._max_bytes:
+            raise ValueError(
+                f"message of {len(value)} bytes exceeds max_message_bytes={self._max_bytes}"
+            )
+        if self._producer is None:
+            raise RuntimeError("mesh not started")
+        hdrs = [(k, v.encode("utf-8")) for k, v in (headers or {}).items()]
+        await self._producer.send_and_wait(topic, value=value, key=key, headers=hdrs)
+
+    # -------------------------------------------------------------- consume
+    async def subscribe(
+        self,
+        topics: list[str],
+        handler: RecordHandler,
+        *,
+        group_id: str | None,
+        from_latest: bool | None = None,
+        max_workers: int = 8,
+        ordered: bool = True,
+    ) -> Subscription:
+        aiokafka = _aiokafka()
+        if from_latest is None:
+            from_latest = group_id is None
+        consumer = aiokafka.AIOKafkaConsumer(
+            *topics,
+            **self._common_kwargs(),
+            group_id=group_id,
+            auto_offset_reset="latest" if from_latest else "earliest",
+            enable_auto_commit=group_id is not None,
+            max_partition_fetch_bytes=self._max_bytes,
+        )
+        await consumer.start()
+        self._consumers.append(consumer)
+
+        deliver = handler
+        dispatcher: KeyOrderedDispatcher | None = None
+        if ordered:
+            dispatcher = KeyOrderedDispatcher(
+                handler, max_workers=max_workers, name=f"kafka-{group_id or 'tap'}"
+            )
+            dispatcher.start()
+            self._dispatchers.append(dispatcher)
+
+            async def deliver(record: Record) -> None:  # type: ignore[misc]
+                await dispatcher.submit(record)
+
+        async def pump() -> None:
+            async for msg in consumer:
+                record = Record(
+                    topic=msg.topic,
+                    key=msg.key,
+                    value=msg.value or b"",
+                    headers={
+                        k: v.decode("utf-8", errors="replace") for k, v in (msg.headers or [])
+                    },
+                    offset=msg.offset,
+                    timestamp=msg.timestamp / 1000.0,
+                )
+                try:
+                    await deliver(record)
+                except Exception:  # noqa: BLE001
+                    logger.exception("kafka delivery failed on %s", msg.topic)
+
+        task = asyncio.get_running_loop().create_task(pump(), name=f"kafka-pump-{topics}")
+        self._tasks.append(task)
+
+        async def stop_fn() -> None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            await consumer.stop()
+            if consumer in self._consumers:
+                self._consumers.remove(consumer)
+            if dispatcher is not None:
+                await dispatcher.stop()
+                if dispatcher in self._dispatchers:
+                    self._dispatchers.remove(dispatcher)
+
+        return _KafkaSubscription(stop_fn)
+
+    # --------------------------------------------------------------- tables
+    def table_reader(self, topic: str) -> TableReader:
+        return _KafkaTableReader(self, topic)
+
+    def table_writer(self, topic: str) -> TableWriter:
+        return _KafkaTableWriter(self, topic)
+
+
+class _KafkaTableReader(TableReader):
+    """Compacted-topic view: consume-all into a dict, catch-up + barrier."""
+
+    def __init__(self, mesh: KafkaMesh, topic: str):
+        self._mesh = mesh
+        self._topic = topic
+        self._view: dict[str, bytes] = {}
+        self._consumer = None
+        self._task: asyncio.Task[None] | None = None
+        self._caught_up = False
+        self._positions: dict[int, int] = {}
+        self._advanced = asyncio.Event()
+
+    async def start(self, *, timeout: float = 30.0) -> None:
+        aiokafka = _aiokafka()
+        self._consumer = aiokafka.AIOKafkaConsumer(
+            self._topic,
+            **self._mesh._common_kwargs(),
+            group_id=None,
+            auto_offset_reset="earliest",
+            enable_auto_commit=False,
+        )
+        await self._consumer.start()
+        # groupless consumers get their assignment lazily; wait for it so the
+        # catch-up gate sees real end offsets
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not self._consumer.assignment():
+            if asyncio.get_running_loop().time() > deadline:
+                raise TimeoutError(f"no partition assignment for {self._topic}")
+            await asyncio.sleep(0.05)
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+        # catch-up gate: consume to attach-time end offsets before serving
+        await self.barrier(timeout=max(deadline - asyncio.get_running_loop().time(), 1.0))
+        self._caught_up = True
+
+    async def _pump(self) -> None:
+        async for msg in self._consumer:
+            key = (msg.key or b"").decode("utf-8", errors="replace")
+            if key:
+                if msg.value:
+                    self._view[key] = msg.value
+                else:
+                    self._view.pop(key, None)
+            self._positions[msg.partition] = msg.offset + 1
+            self._advanced.set()
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+        if self._consumer:
+            await self._consumer.stop()
+
+    async def barrier(self, *, timeout: float = 30.0) -> None:
+        """Freshness barrier across ALL partitions: capture end offsets at
+        call time and wait until consumption reaches every one of them.
+
+        A sentinel write would only prove visibility for the sentinel's own
+        partition — Kafka gives no cross-partition ordering — so the gate is
+        offset-based instead."""
+        if self._consumer is None:
+            raise RuntimeError("table reader not started")
+        partitions = list(self._consumer.assignment())
+        if not partitions:
+            return
+        end_offsets = await self._consumer.end_offsets(partitions)
+
+        async def gate() -> None:
+            while any(
+                self._positions.get(tp.partition, 0) < off
+                for tp, off in end_offsets.items()
+                if off > 0
+            ):
+                self._advanced.clear()
+                await self._advanced.wait()
+
+        await asyncio.wait_for(gate(), timeout=timeout)
+
+    def get(self, key: str) -> bytes | None:
+        return self._view.get(key)
+
+    def items(self) -> dict[str, bytes]:
+        return {k: v for k, v in self._view.items() if not k.startswith("__barrier__")}
+
+    @property
+    def is_caught_up(self) -> bool:
+        return self._caught_up
+
+
+class _KafkaTableWriter(TableWriter):
+    def __init__(self, mesh: KafkaMesh, topic: str):
+        self._mesh = mesh
+        self._topic = topic
+
+    async def put(self, key: str, value: bytes) -> None:
+        await self._mesh.publish(self._topic, value, key=key.encode("utf-8"))
+
+    async def tombstone(self, key: str) -> None:
+        await self._mesh.publish(self._topic, b"", key=key.encode("utf-8"))

@@ -1,0 +1,210 @@
+"""In-memory mesh + key-ordered dispatcher semantics."""
+
+import asyncio
+
+import pytest
+
+from calfkit_tpu.mesh import InMemoryMesh, KeyOrderedDispatcher, Record
+
+
+def rec(topic="t", key=None, value=b"v", **kw):
+    return Record(topic=topic, key=key, value=value, **kw)
+
+
+class TestDispatcher:
+    async def test_per_key_serial_cross_key_parallel(self):
+        order: list[str] = []
+        gate = asyncio.Event()
+
+        async def handler(record: Record):
+            name = record.value.decode()
+            if name == "a1":
+                order.append("a1-start")
+                await gate.wait()
+                order.append("a1-end")
+            else:
+                order.append(name)
+                if name == "b1":
+                    gate.set()
+
+        d = KeyOrderedDispatcher(handler, max_workers=4)
+        d.start()
+        # a1, a2 share a key -> serial; b1 is free to run between them
+        await d.submit(rec(key=b"a", value=b"a1"))
+        await d.submit(rec(key=b"a", value=b"a2"))
+        await d.submit(rec(key=b"b", value=b"b1"))
+        await d.stop()
+        # b1 completed while a1 was parked (cross-key parallelism) …
+        assert order.index("b1") < order.index("a1-end")
+        # … and a2 strictly followed a1 (per-key serialization)
+        assert order.index("a1-end") < order.index("a2")
+
+    async def test_backpressure_bound_is_2n(self):
+        entered = 0
+        release = asyncio.Event()
+
+        async def handler(record: Record):
+            nonlocal entered
+            entered += 1
+            await release.wait()
+
+        d = KeyOrderedDispatcher(handler, max_workers=2)  # bound = 4
+        d.start()
+        for i in range(4):
+            await asyncio.wait_for(d.submit(rec(key=f"k{i}".encode())), timeout=1)
+        with pytest.raises(asyncio.TimeoutError):
+            await asyncio.wait_for(d.submit(rec(key=b"k9")), timeout=0.1)
+        release.set()
+        await d.stop()
+
+    async def test_handler_error_does_not_kill_lane(self):
+        seen: list[str] = []
+
+        async def handler(record: Record):
+            if record.value == b"boom":
+                raise RuntimeError("boom")
+            seen.append(record.value.decode())
+
+        d = KeyOrderedDispatcher(handler, max_workers=2)
+        d.start()
+        await d.submit(rec(key=b"k", value=b"boom"))
+        await d.submit(rec(key=b"k", value=b"after"))
+        await d.stop()
+        assert seen == ["after"]
+
+    async def test_drain_waits_for_inflight(self):
+        done: list[int] = []
+
+        async def handler(record: Record):
+            await asyncio.sleep(0.05)
+            done.append(1)
+
+        d = KeyOrderedDispatcher(handler, max_workers=2)
+        d.start()
+        for i in range(3):
+            await d.submit(rec(key=f"{i}".encode()))
+        await d.stop()
+        assert len(done) == 3
+
+
+class TestInMemoryMesh:
+    async def test_group_delivery_and_ordering(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        got: list[tuple[str, str]] = []
+
+        async def handler(record: Record):
+            got.append((record.key.decode(), record.value.decode()))
+
+        await mesh.subscribe(["t"], handler, group_id="g")
+        for key in ("a", "b"):
+            for i in range(5):
+                await mesh.publish("t", f"{key}{i}".encode(), key=key.encode())
+        await asyncio.sleep(0.1)
+        await mesh.stop()
+        assert len(got) == 10
+        for key in ("a", "b"):
+            vals = [v for k, v in got if k == key]
+            assert vals == [f"{key}{i}" for i in range(5)]  # per-key order holds
+
+    async def test_group_shares_work_across_members(self):
+        mesh = InMemoryMesh(partitions=8)
+        await mesh.start()
+        got1, got2 = [], []
+
+        async def h1(r):
+            got1.append(r.value)
+
+        async def h2(r):
+            got2.append(r.value)
+
+        await mesh.subscribe(["t"], h1, group_id="g")
+        await mesh.subscribe(["t"], h2, group_id="g")
+        for i in range(40):
+            await mesh.publish("t", str(i).encode(), key=f"key{i}".encode())
+        await asyncio.sleep(0.3)
+        await mesh.stop()
+        assert len(got1) + len(got2) == 40
+        assert got1 and got2  # both members actually worked
+
+    async def test_broadcast_tap_from_latest(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        await mesh.publish("t", b"before", key=b"k")
+        got = []
+
+        async def handler(r):
+            got.append(r.value)
+
+        await mesh.subscribe(["t"], handler, group_id=None, from_latest=True, ordered=False)
+        await asyncio.sleep(0.05)
+        await mesh.publish("t", b"after", key=b"k")
+        await asyncio.sleep(0.1)
+        await mesh.stop()
+        assert got == [b"after"]
+
+    async def test_two_groups_each_get_everything(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        a, b = [], []
+
+        async def ha(r):
+            a.append(r.value)
+
+        async def hb(r):
+            b.append(r.value)
+
+        await mesh.subscribe(["t"], ha, group_id="g1")
+        await mesh.subscribe(["t"], hb, group_id="g2")
+        for i in range(5):
+            await mesh.publish("t", str(i).encode(), key=b"k")
+        await asyncio.sleep(0.1)
+        await mesh.stop()
+        assert len(a) == 5 and len(b) == 5
+
+    async def test_oversized_message_rejected(self):
+        mesh = InMemoryMesh(max_message_bytes=100)
+        await mesh.start()
+        with pytest.raises(ValueError, match="exceeds"):
+            await mesh.publish("t", b"x" * 101)
+        await mesh.stop()
+
+    async def test_subscription_stop_rebalances(self):
+        mesh = InMemoryMesh(partitions=4)
+        await mesh.start()
+        got1, got2 = [], []
+
+        async def h1(r):
+            got1.append(r.value)
+
+        async def h2(r):
+            got2.append(r.value)
+
+        sub1 = await mesh.subscribe(["t"], h1, group_id="g")
+        await mesh.subscribe(["t"], h2, group_id="g")
+        await sub1.stop()
+        for i in range(8):
+            await mesh.publish("t", str(i).encode(), key=f"k{i}".encode())
+        await asyncio.sleep(0.3)
+        await mesh.stop()
+        assert not got1 and len(got2) == 8  # survivor owns all partitions
+
+
+class TestTables:
+    async def test_put_get_tombstone(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        writer = mesh.table_writer("tbl")
+        reader = mesh.table_reader("tbl")
+        await reader.start()
+        await writer.put("a", b"1")
+        await writer.put("b", b"2")
+        await writer.put("a", b"3")  # compaction: latest wins
+        await reader.barrier()
+        assert reader.get("a") == b"3"
+        assert reader.items() == {"a": b"3", "b": b"2"}
+        await writer.tombstone("a")
+        await reader.barrier()
+        assert reader.get("a") is None
+        assert reader.items() == {"b": b"2"}
+        await mesh.stop()
