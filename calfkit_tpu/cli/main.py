@@ -14,31 +14,23 @@ def main() -> None:
 
 
 def _register() -> None:
-    """Attach subcommand groups; each is optional while subsystems land."""
-    try:
-        from calfkit_tpu.cli.run import run_command
+    """Attach subcommand groups that have landed.
 
-        main.add_command(run_command)
-    except ImportError:
-        pass
-    try:
-        from calfkit_tpu.cli.dev import dev_group
+    Absence is checked via ``find_spec`` so a genuine import failure inside a
+    present module propagates instead of silently dropping the subcommand.
+    """
+    from importlib import import_module
+    from importlib.util import find_spec
 
-        main.add_command(dev_group)
-    except ImportError:
-        pass
-    try:
-        from calfkit_tpu.cli.chat import chat_command
-
-        main.add_command(chat_command)
-    except ImportError:
-        pass
-    try:
-        from calfkit_tpu.cli.topics import topics_group
-
-        main.add_command(topics_group)
-    except ImportError:
-        pass
+    for module_name, attr in (
+        ("calfkit_tpu.cli.run", "run_command"),
+        ("calfkit_tpu.cli.dev", "dev_group"),
+        ("calfkit_tpu.cli.chat", "chat_command"),
+        ("calfkit_tpu.cli.topics", "topics_group"),
+    ):
+        if find_spec(module_name) is None:
+            continue
+        main.add_command(getattr(import_module(module_name), attr))
 
 
 _register()

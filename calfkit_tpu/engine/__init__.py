@@ -1,0 +1,40 @@
+"""The agent-turn engine: model-client contract + turn runner.
+
+This is the owned equivalent of the load-bearing subset of the reference's
+vendored pydantic-ai (SURVEY.md §2.2 "Rebuild note"): a model-client ABC,
+function-signature → JSON-schema extraction, deterministic test models, and
+the one-model-turn runner with structured output and deferred tool calls.
+"""
+
+from calfkit_tpu.engine.model_client import (
+    ModelClient,
+    ModelRequestParameters,
+    ModelSettings,
+    ResponseDone,
+    StreamEvent,
+    TextDelta,
+)
+from calfkit_tpu.engine.schema import FunctionSchema, function_schema
+from calfkit_tpu.engine.turn import FINAL_RESULT_TOOL, TurnOutcome, run_turn
+from calfkit_tpu.engine.testing import (
+    EchoModelClient,
+    FunctionModelClient,
+    TestModelClient,
+)
+
+__all__ = [
+    "EchoModelClient",
+    "FINAL_RESULT_TOOL",
+    "FunctionModelClient",
+    "FunctionSchema",
+    "ModelClient",
+    "ModelRequestParameters",
+    "ModelSettings",
+    "ResponseDone",
+    "StreamEvent",
+    "TestModelClient",
+    "TextDelta",
+    "TurnOutcome",
+    "function_schema",
+    "run_turn",
+]

@@ -1,0 +1,94 @@
+"""The provider interface: what a model backend must implement.
+
+This is the seam the TPU backend plugs into (reference: the vendored `Model`
+ABC at calfkit/_vendor/pydantic_ai/models/__init__.py:621, ``request()``
+:648, ``request_stream()`` :671 — SURVEY.md §1 layer 4 calls it "the seam
+the TPU backend replaces").  Implementations in-tree:
+
+- :class:`calfkit_tpu.inference.JaxLocalModelClient` — the local TPU path;
+- :mod:`calfkit_tpu.engine.testing` — deterministic models for tests;
+- remote-API fallbacks can be added the same way.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Any, AsyncIterator, Union
+
+from pydantic import BaseModel, Field
+
+from calfkit_tpu.models.capability import ToolDef
+from calfkit_tpu.models.messages import ModelMessage, ModelResponse
+
+
+class ModelSettings(BaseModel):
+    """Per-request generation knobs (all optional; backends ignore unknowns)."""
+
+    max_tokens: int | None = None
+    temperature: float | None = None
+    top_p: float | None = None
+    top_k: int | None = None
+    stop_sequences: list[str] = Field(default_factory=list)
+    seed: int | None = None
+    extra: dict[str, Any] = Field(default_factory=dict)
+
+
+class ModelRequestParameters(BaseModel):
+    """What the agent loop hands the model besides messages."""
+
+    tool_defs: list[ToolDef] = Field(default_factory=list)
+    # structured output via an output tool (the model "calls" this tool with
+    # the final answer); None means plain-text output
+    output_tool: ToolDef | None = None
+    allow_text_output: bool = True
+
+    def all_tools(self) -> list[ToolDef]:
+        return self.tool_defs + ([self.output_tool] if self.output_tool else [])
+
+
+@dataclass(frozen=True)
+class TextDelta:
+    """Incremental generated text."""
+
+    text: str
+
+
+@dataclass(frozen=True)
+class ResponseDone:
+    """Terminal stream event carrying the complete response."""
+
+    response: ModelResponse
+
+
+StreamEvent = Union[TextDelta, ResponseDone]
+
+
+class ModelClient(abc.ABC):
+    """A model backend.  Implementations must be safe for concurrent
+    ``request`` calls (the worker batches them)."""
+
+    @property
+    @abc.abstractmethod
+    def model_name(self) -> str: ...
+
+    @abc.abstractmethod
+    async def request(
+        self,
+        messages: list[ModelMessage],
+        settings: ModelSettings | None = None,
+        params: ModelRequestParameters | None = None,
+    ) -> ModelResponse: ...
+
+    async def request_stream(
+        self,
+        messages: list[ModelMessage],
+        settings: ModelSettings | None = None,
+        params: ModelRequestParameters | None = None,
+    ) -> AsyncIterator[StreamEvent]:
+        """Streaming generation; the default adapter degrades to one shot."""
+        response = await self.request(messages, settings, params)
+        text = response.text()
+        if text:
+            yield TextDelta(text)
+        yield ResponseDone(response)

@@ -1,0 +1,169 @@
+"""The one-model-turn runner.
+
+Semantics carried from the reference's use of the vendored loop with
+``output_type=[final, DeferredToolRequests]`` (calfkit/nodes/agent.py:189,
+662-689): a turn is exactly ONE model request; any tool calls in the response
+are *deferred* — returned to the caller for dispatch over the mesh — never
+executed in-process.  Structured output rides an output tool
+(``final_result``); malformed structured output triggers bounded in-turn
+retries before surfacing a validation fault.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from pydantic import TypeAdapter, ValidationError
+
+from calfkit_tpu.engine.model_client import (
+    ModelClient,
+    ModelRequestParameters,
+    ModelSettings,
+)
+from calfkit_tpu.engine.schema import output_tool_def
+from calfkit_tpu.models.capability import ToolDef
+from calfkit_tpu.models.error_report import ErrorReport, FaultTypes
+from calfkit_tpu.models.messages import (
+    ModelMessage,
+    ModelRequest,
+    ModelResponse,
+    RetryPart,
+    ToolCallOutput,
+    Usage,
+)
+from calfkit_tpu.models.node_result import extract_lenient
+
+FINAL_RESULT_TOOL = "final_result"
+
+
+class TurnError(Exception):
+    def __init__(self, report: ErrorReport):
+        self.report = report
+        super().__init__(report.describe())
+
+
+@dataclass
+class TurnOutcome:
+    """What one model turn produced.
+
+    Exactly one of:
+    - ``tool_calls`` non-empty → the caller dispatches them over the mesh;
+    - otherwise ``output`` is the final (possibly structured) result.
+    ``new_messages`` are the wire-state messages to commit either way.
+    """
+
+    new_messages: list[ModelMessage]
+    response: ModelResponse
+    usage: Usage
+    tool_calls: list[ToolCallOutput] = field(default_factory=list)
+    output: Any = None
+
+    @property
+    def is_final(self) -> bool:
+        return not self.tool_calls
+
+
+async def run_turn(
+    model: ModelClient,
+    messages: list[ModelMessage],
+    *,
+    tool_defs: list[ToolDef] | None = None,
+    output_type: type = str,
+    settings: ModelSettings | None = None,
+    author: str | None = None,
+    max_output_retries: int = 2,
+) -> TurnOutcome:
+    """Run one model turn against ``messages`` (already including any staged
+    user prompt / tool returns as the final request)."""
+    structured = output_type is not str
+    params = ModelRequestParameters(
+        tool_defs=list(tool_defs or []),
+        output_tool=output_tool_def(output_type) if structured else None,
+        allow_text_output=not structured,
+    )
+    adapter: TypeAdapter[Any] | None = TypeAdapter(output_type) if structured else None
+
+    working = list(messages)
+    new_messages: list[ModelMessage] = []
+    usage = Usage()
+    last_error: Exception | None = None
+
+    for _attempt in range(max_output_retries + 1):
+        response = await model.request(working, settings, params)
+        if author and response.author is None:
+            response = response.model_copy(update={"author": author})
+        usage = usage + response.usage
+        new_messages.append(response)
+
+        calls = response.tool_calls()
+        final_calls = [c for c in calls if c.tool_name == FINAL_RESULT_TOOL]
+        dispatch_calls = [c for c in calls if c.tool_name != FINAL_RESULT_TOOL]
+
+        if dispatch_calls:
+            # tool calls defer to the mesh; a stray final_result alongside
+            # them is ignored this turn (the model will be re-asked)
+            return TurnOutcome(
+                new_messages=new_messages,
+                response=response,
+                usage=usage,
+                tool_calls=dispatch_calls,
+            )
+
+        retry: RetryPart | None = None
+        if structured:
+            assert adapter is not None
+            if final_calls:
+                call = final_calls[0]
+                try:
+                    output = adapter.validate_python(call.args_dict())
+                    return TurnOutcome(
+                        new_messages=new_messages,
+                        response=response,
+                        usage=usage,
+                        output=output,
+                    )
+                except (ValidationError, ValueError) as exc:
+                    last_error = exc
+                    retry = RetryPart(
+                        content=f"Invalid {FINAL_RESULT_TOOL} arguments: {exc}. "
+                        "Call it again with arguments matching the schema.",
+                        tool_call_id=call.tool_call_id,
+                        tool_name=FINAL_RESULT_TOOL,
+                    )
+            else:
+                text = response.text()
+                try:
+                    output = extract_lenient(text, adapter)
+                    return TurnOutcome(
+                        new_messages=new_messages,
+                        response=response,
+                        usage=usage,
+                        output=output,
+                    )
+                except (ValidationError, ValueError) as exc:
+                    last_error = exc
+                    retry = RetryPart(
+                        content="Your reply must be the final structured result: "
+                        f"call the {FINAL_RESULT_TOOL} tool with arguments matching "
+                        f"the schema (error: {exc})."
+                    )
+        else:
+            return TurnOutcome(
+                new_messages=new_messages,
+                response=response,
+                usage=usage,
+                output=response.text(),
+            )
+
+        retry_request = ModelRequest(parts=[retry])
+        new_messages.append(retry_request)
+        working = working + [response, retry_request]
+
+    raise TurnError(
+        ErrorReport.build_safe(
+            FaultTypes.VALIDATION_ERROR,
+            f"model failed to produce valid structured output after "
+            f"{max_output_retries + 1} attempts: {last_error}",
+        )
+    )

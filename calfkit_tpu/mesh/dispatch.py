@@ -141,7 +141,18 @@ class KeyOrderedDispatcher:
             try:
                 await self._handler(record)
             except asyncio.CancelledError:
-                raise
+                task = asyncio.current_task()
+                if task is not None and task.cancelling():
+                    raise  # stop() is cancelling this worker
+                # handler-originated cancellation (e.g. it cancelled a child
+                # and let the error escape): a fault, not a shutdown — the
+                # lane must survive or its queued records leak permits
+                logger.exception(
+                    "[%s] handler leaked CancelledError on %s (lane %d)",
+                    self._name,
+                    record.topic,
+                    lane,
+                )
             except BaseException:
                 # the handler owns its fault rail; anything escaping it is a
                 # floor-level bug — log loudly, never kill the lane

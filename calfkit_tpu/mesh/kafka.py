@@ -268,16 +268,24 @@ class _KafkaTableReader(TableReader):
             enable_auto_commit=False,
         )
         await self._consumer.start()
-        # groupless consumers get their assignment lazily; wait for it so the
-        # catch-up gate sees real end offsets
-        deadline = asyncio.get_running_loop().time() + timeout
-        while not self._consumer.assignment():
-            if asyncio.get_running_loop().time() > deadline:
-                raise TimeoutError(f"no partition assignment for {self._topic}")
-            await asyncio.sleep(0.05)
-        self._task = asyncio.get_running_loop().create_task(self._pump())
-        # catch-up gate: consume to attach-time end offsets before serving
-        await self.barrier(timeout=max(deadline - asyncio.get_running_loop().time(), 1.0))
+        try:
+            # groupless consumers get their assignment lazily; wait for it so
+            # the catch-up gate sees real end offsets
+            deadline = asyncio.get_running_loop().time() + timeout
+            while not self._consumer.assignment():
+                if asyncio.get_running_loop().time() > deadline:
+                    raise TimeoutError(f"no partition assignment for {self._topic}")
+                await asyncio.sleep(0.05)
+            self._task = asyncio.get_running_loop().create_task(self._pump())
+            # catch-up gate: consume to attach-time end offsets before serving
+            await self.barrier(
+                timeout=max(deadline - asyncio.get_running_loop().time(), 1.0)
+            )
+        except BaseException:
+            # failed start must not leak the consumer/pump (callers won't
+            # stop() a reader that never started)
+            await self.stop()
+            raise
         self._caught_up = True
 
     async def _pump(self) -> None:
@@ -315,13 +323,18 @@ class _KafkaTableReader(TableReader):
             return
         end_offsets = await self._consumer.end_offsets(partitions)
 
-        async def gate() -> None:
-            while any(
+        def behind() -> bool:
+            return any(
                 self._positions.get(tp.partition, 0) < off
                 for tp, off in end_offsets.items()
                 if off > 0
-            ):
+            )
+
+        async def gate() -> None:
+            while behind():
                 self._advanced.clear()
+                if not behind():  # re-check after clear: lost-wakeup guard
+                    return
                 await self._advanced.wait()
 
         await asyncio.wait_for(gate(), timeout=timeout)
@@ -330,7 +343,7 @@ class _KafkaTableReader(TableReader):
         return self._view.get(key)
 
     def items(self) -> dict[str, bytes]:
-        return {k: v for k, v in self._view.items() if not k.startswith("__barrier__")}
+        return dict(self._view)
 
     @property
     def is_caught_up(self) -> bool:

@@ -47,6 +47,8 @@ class _Topic:
         # compacted topics also maintain the folded view at publish time so
         # table reads are O(1) instead of re-folding the log
         self.table: dict[str, bytes] = {}
+        # set by the mesh: remaps persisted group cursors after a log trim
+        self.on_compact: Callable[["_Topic", int, list[Record], list[Record]], None] | None = None
         self._rr = itertools.count()
         self._offset = itertools.count()
 
@@ -72,16 +74,21 @@ class _Topic:
             else:
                 self.table[k] = value
             # bound log growth (heartbeats rewrite the same keys forever);
-            # only safe when no pump holds an index-based cursor on the log
+            # only safe when no pump holds an index-based cursor on the log —
+            # persisted group cursors are remapped via on_compact
             if self.consumer_count == 0 and len(self.partitions[p]) > self.COMPACT_THRESHOLD:
+                old = self.partitions[p]
                 latest: dict[bytes, Record] = {}
-                for r in self.partitions[p]:
+                for r in old:
                     if r.key is not None:
                         latest[r.key] = r
-                self.partitions[p] = sorted(
+                kept = sorted(
                     (r for r in latest.values() if len(r.value) > 0),
                     key=lambda r: r.offset,
                 )
+                self.partitions[p] = kept
+                if self.on_compact is not None:
+                    self.on_compact(self, p, old, kept)
         self.changed.set()
 
     def ends(self) -> list[int]:
@@ -175,6 +182,7 @@ class InMemoryMesh(MeshTransport):
             if not (create or (create is None and self._auto_create)):
                 raise KeyError(f"unknown topic {name!r} (auto-create disabled)")
             topic = _Topic(name, self._partitions, compacted)
+            topic.on_compact = self._remap_group_cursors
             self._topics[name] = topic
         elif compacted and not topic.compacted:
             # upgrade a topic auto-created by an early publish: backfill the
@@ -194,6 +202,21 @@ class InMemoryMesh(MeshTransport):
 
     def topic_names(self) -> list[str]:
         return sorted(self._topics)
+
+    def _remap_group_cursors(
+        self, topic: _Topic, p: int, old: list[Record], kept: list[Record]
+    ) -> None:
+        """After a log trim, persisted cursors of (possibly stopped) groups
+        index the OLD list; remap each to its position in the kept list so a
+        returning group member resumes without skipping records."""
+        for (topic_name, _gid), group in self._groups.items():
+            if topic_name != topic.name:
+                continue
+            c = group.cursors[p]
+            if c <= 0:
+                continue
+            boundary = old[c - 1].offset if c <= len(old) else old[-1].offset
+            group.cursors[p] = sum(1 for r in kept if r.offset <= boundary)
 
     # -------------------------------------------------------------- produce
     async def publish(

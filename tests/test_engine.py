@@ -1,0 +1,181 @@
+"""Agent-turn engine: schema extraction, turn runner, test models."""
+
+import pytest
+from pydantic import BaseModel
+
+from calfkit_tpu.engine import (
+    EchoModelClient,
+    FunctionModelClient,
+    ModelRequestParameters,
+    TestModelClient,
+    function_schema,
+    run_turn,
+)
+from calfkit_tpu.engine.schema import ToolSchemaError, output_tool_def
+from calfkit_tpu.engine.turn import FINAL_RESULT_TOOL, TurnError
+from calfkit_tpu.models.messages import (
+    ModelResponse,
+    TextOutput,
+    ToolCallOutput,
+    user_message,
+)
+
+
+class TestFunctionSchema:
+    def test_extraction_with_docstring(self):
+        def get_weather(city: str, units: str = "celsius") -> str:
+            """Get current weather for a city.
+
+            Args:
+                city: The city name to look up.
+                units: Temperature units.
+            """
+            return f"{city}:{units}"
+
+        fs = function_schema(get_weather)
+        assert fs.tool_def.name == "get_weather"
+        assert fs.tool_def.description == "Get current weather for a city."
+        props = fs.tool_def.parameters_schema["properties"]
+        assert props["city"]["description"] == "The city name to look up."
+        assert props["city"]["type"] == "string"
+        assert fs.tool_def.parameters_schema["required"] == ["city"]
+        assert not fs.takes_ctx
+
+    def test_ctx_param_excluded(self):
+        def tool(ctx, x: int) -> int:
+            return x
+
+        fs = function_schema(tool)
+        assert fs.takes_ctx
+        assert list(fs.tool_def.parameters_schema["properties"]) == ["x"]
+
+    async def test_call_validates_and_coerces(self):
+        def add(a: int, b: int = 1) -> int:
+            return a + b
+
+        fs = function_schema(add)
+        assert await fs.call({"a": "2", "b": 3}) == 5
+        assert await fs.call({"a": 1}) == 2
+        with pytest.raises(Exception):
+            await fs.call({"a": "not-an-int"})
+
+    async def test_async_fn_and_ctx_injection(self):
+        async def fetch(ctx, q: str) -> str:
+            return f"{ctx}:{q}"
+
+        fs = function_schema(fetch)
+        assert await fs.call({"q": "x"}, ctx="C") == "C:x"
+
+    def test_var_args_rejected(self):
+        def bad(*args): ...
+
+        with pytest.raises(ToolSchemaError):
+            function_schema(bad)
+
+
+class _Weather(BaseModel):
+    city: str
+    temp_c: float
+
+
+class TestRunTurn:
+    async def test_text_final(self):
+        outcome = await run_turn(EchoModelClient(), [user_message("hi")])
+        assert outcome.is_final and outcome.output == "echo: hi"
+        assert len(outcome.new_messages) == 1
+
+    async def test_tool_calls_deferred(self):
+        def model(messages, params):
+            return ModelResponse(parts=[
+                ToolCallOutput(tool_call_id="1", tool_name="get_weather",
+                               args={"city": "SF"})
+            ])
+
+        fs = function_schema(lambda city: city, name="get_weather")
+        outcome = await run_turn(
+            FunctionModelClient(model), [user_message("weather?")],
+            tool_defs=[fs.tool_def],
+        )
+        assert not outcome.is_final
+        assert outcome.tool_calls[0].tool_name == "get_weather"
+
+    async def test_structured_output_via_tool(self):
+        def model(messages, params):
+            assert params.output_tool is not None
+            return ModelResponse(parts=[
+                ToolCallOutput(tool_call_id="1", tool_name=FINAL_RESULT_TOOL,
+                               args={"city": "SF", "temp_c": 18.0})
+            ])
+
+        outcome = await run_turn(
+            FunctionModelClient(model), [user_message("weather?")],
+            output_type=_Weather,
+        )
+        assert outcome.is_final and outcome.output.city == "SF"
+
+    async def test_structured_output_retry_then_success(self):
+        calls = {"n": 0}
+
+        def model(messages, params):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return ModelResponse(parts=[
+                    ToolCallOutput(tool_call_id="1", tool_name=FINAL_RESULT_TOOL,
+                                   args={"city": "SF"})  # missing temp_c
+                ])
+            return ModelResponse(parts=[
+                ToolCallOutput(tool_call_id="2", tool_name=FINAL_RESULT_TOOL,
+                               args={"city": "SF", "temp_c": 1.0})
+            ])
+
+        outcome = await run_turn(
+            FunctionModelClient(model), [user_message("x")], output_type=_Weather,
+        )
+        assert outcome.output.temp_c == 1.0
+        assert calls["n"] == 2
+        # retry request committed to history between the two responses
+        assert outcome.new_messages[1].parts[0].kind == "retry"
+
+    async def test_structured_output_exhausted_retries(self):
+        def model(messages, params):
+            return ModelResponse(parts=[TextOutput(text="not json at all")])
+
+        with pytest.raises(TurnError) as exc_info:
+            await run_turn(
+                FunctionModelClient(model), [user_message("x")],
+                output_type=_Weather, max_output_retries=1,
+            )
+        assert "mesh.validation_error" in exc_info.value.report.error_type
+
+    async def test_author_stamped(self):
+        outcome = await run_turn(
+            EchoModelClient(), [user_message("hi")], author="weather_agent"
+        )
+        assert outcome.response.author == "weather_agent"
+
+
+class TestTestModel:
+    async def test_calls_all_tools_then_finalizes(self):
+        model = TestModelClient(custom_output_text="done")
+
+        def get_weather(city: str) -> str:
+            return city
+
+        fs = function_schema(get_weather)
+        params = ModelRequestParameters(tool_defs=[fs.tool_def])
+        first = await model.request([user_message("x")], None, params)
+        assert first.tool_calls()[0].tool_name == "get_weather"
+        assert first.tool_calls()[0].args_dict() == {"city": "a"}
+        history = [user_message("x"), first]
+        second = await model.request(history, None, params)
+        assert second.text() == "done"
+
+    async def test_structured_output_stub(self):
+        model = TestModelClient()
+        params = ModelRequestParameters(
+            output_tool=output_tool_def(_Weather), allow_text_output=False
+        )
+        resp = await model.request([user_message("x")], None, params)
+        call = resp.tool_calls()[0]
+        assert call.tool_name == FINAL_RESULT_TOOL
+        assert set(call.args_dict()) == {"city", "temp_c"}
