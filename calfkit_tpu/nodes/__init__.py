@@ -1,0 +1,49 @@
+"""Node kinds over the shared kernel (SURVEY.md §1 layers 2-3)."""
+
+from calfkit_tpu.nodes.base import BaseNodeDef, NodeRunContext, handler
+from calfkit_tpu.nodes.consumer import ConsumerContext, ConsumerNode, consumer
+from calfkit_tpu.nodes.fanout_store import (
+    FANOUT_STORE_KEY,
+    FanoutBatchStore,
+    KtablesFanoutBatchStore,
+)
+from calfkit_tpu.nodes.registry import RegistryMixin
+from calfkit_tpu.nodes.steps import (
+    DeniedCall,
+    HandedOff,
+    HopStepLedger,
+    InferenceFact,
+    Observed,
+    Said,
+)
+from calfkit_tpu.nodes.tool import (
+    ModelRetry,
+    ToolNodeDef,
+    Tools,
+    agent_tool,
+    eager_tools,
+)
+
+__all__ = [
+    "BaseNodeDef",
+    "ConsumerContext",
+    "ConsumerNode",
+    "DeniedCall",
+    "FANOUT_STORE_KEY",
+    "FanoutBatchStore",
+    "HandedOff",
+    "HopStepLedger",
+    "InferenceFact",
+    "KtablesFanoutBatchStore",
+    "ModelRetry",
+    "NodeRunContext",
+    "Observed",
+    "RegistryMixin",
+    "Said",
+    "ToolNodeDef",
+    "Tools",
+    "agent_tool",
+    "consumer",
+    "eager_tools",
+    "handler",
+]

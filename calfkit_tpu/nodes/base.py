@@ -1,0 +1,798 @@
+"""The node kernel: the staged per-delivery pipeline every node kind shares.
+
+Pipeline (reference: calfkit/nodes/base.py:244-2094, restructured, not
+ported):
+
+    stage 0   decode floor + classify (call / return / fault / reentry)
+    stage 1   aggregation — returns & faults resolve against the pending
+              call: on_callee_error seams, durable fan-out fold/close
+    stage 2   before_node seam chain
+    stage 3   routed body (chain-of-responsibility over @handler patterns)
+    stage 4   after_node seam chain
+    stage 5   publish chokepoint (Call push / ReturnCall unwind / TailCall
+              retarget) + fan-out OPEN
+    exit      step-ledger flush (once) + broadcast mirror
+
+Fault rail invariants preserved from the reference:
+
+- **No silent drops**: every failure lands a typed FaultMessage to the
+  caller, or a floor log when there is no caller; a reply-owing delivery
+  declined by every handler auto-faults (``mesh.declined``).
+- **Mint rule**: user code raises :class:`NodeFaultError` to emit a typed
+  fault; any other exception is harvested into a ``mesh.node_error`` report
+  after the ``on_node_error`` chain gets a recovery chance.
+- **Escalation ladder**: an oversized fault degrades full → no-tracebacks →
+  minimal+state-elided rather than dropping (base.py:838-905 analog).
+- **Single-writer**: every publish is keyed by ``partition_key(task_id)``.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, ClassVar, Sequence
+
+from pydantic import ValidationError
+
+from calfkit_tpu import protocol
+from calfkit_tpu.exceptions import NodeFaultError
+from calfkit_tpu.keying import partition_key
+from calfkit_tpu.mesh.transport import MeshTransport, Record
+from calfkit_tpu.models.actions import Call, Next, NodeResult, ReturnCall, TailCall
+from calfkit_tpu.models.error_report import ErrorReport, FaultTypes
+from calfkit_tpu.models.fanout import (
+    EnvelopeSnapshot,
+    FanoutOpen,
+    FanoutOutcome,
+    SlotRef,
+)
+from calfkit_tpu.models.marker import CallMarker, ToolCallMarker
+from calfkit_tpu.models.messages import RetryPart, ToolReturnPart
+from calfkit_tpu.models.payload import ContentPart, is_retry, render_parts_as_text
+from calfkit_tpu.models.reply import FaultMessage, ReturnMessage
+from calfkit_tpu.models.session_context import CallFrame, Envelope, new_id
+from calfkit_tpu.models.state import State
+from calfkit_tpu.nodes.fanout_store import (
+    FANOUT_STORE_KEY,
+    FanoutBatchStore,
+    classify_sibling,
+    record_outcome,
+)
+from calfkit_tpu.nodes.registry import RegistryMixin, handler  # noqa: F401 (re-export)
+from calfkit_tpu.nodes.seams import (
+    MintedFault,
+    run_chain,
+    run_chain_guarded,
+    validate_seam_arity,
+)
+from calfkit_tpu.nodes.steps import HopStepLedger, Observed
+
+logger = logging.getLogger(__name__)
+
+_REENTRY_KEY = "fanout_reentry"
+
+# aggregation outcomes
+_HANDLED = "handled"
+_RESUME = "resume"
+
+
+@dataclass
+class NodeRunContext:
+    """What the body and seams see for one delivery."""
+
+    node: "BaseNodeDef"
+    envelope: Envelope
+    route: str
+    delivery_kind: str
+    correlation_id: str | None
+    task_id: str
+    ledger: HopStepLedger
+    headers: dict[str, str] = field(default_factory=dict)
+    # the resolved callee outcome for return/fault resumptions
+    folded: FanoutOutcome | None = None
+    # the broadcast mirror fires at most once per hop
+    mirrored: bool = False
+    # captured at stage 0: the run's step-stream destination survives the
+    # frame unwind that a ReturnCall performs before flush time
+    root_topic: str | None = None
+
+    @property
+    def state(self) -> State:
+        return self.envelope.context.state
+
+    @property
+    def deps(self) -> dict[str, Any]:
+        return self.envelope.context.deps
+
+    @property
+    def frame(self) -> CallFrame | None:
+        return self.envelope.workflow.current()
+
+    @property
+    def payload(self) -> list[ContentPart]:
+        frame = self.frame
+        return frame.payload if frame else []
+
+    def resource(self, key: str) -> Any:
+        return self.node.resources.get(key)
+
+
+class BaseNodeDef(RegistryMixin):
+    kind: ClassVar[str] = "node"
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        before_node: Sequence[Any] = (),
+        after_node: Sequence[Any] = (),
+        on_node_error: Sequence[Any] = (),
+        on_callee_error: Sequence[Any] = (),
+    ):
+        protocol.require_topic_safe(name, what="node name")
+        self.name = name
+        self.instance_id = uuid.uuid4().hex[:12]
+        for seam in before_node:
+            validate_seam_arity(seam, 1, name="before_node")
+        for seam in after_node:
+            validate_seam_arity(seam, 2, name="after_node")
+        for seam in on_node_error:
+            validate_seam_arity(seam, 2, name="on_node_error")
+        for seam in on_callee_error:
+            validate_seam_arity(seam, 2, name="on_callee_error")
+        self.before_node = list(before_node)
+        self.after_node = list(after_node)
+        self.on_node_error = list(on_node_error)
+        self.on_callee_error = list(on_callee_error)
+        self.resources: dict[str, Any] = {}
+        self._transport: MeshTransport | None = None
+
+    # ------------------------------------------------------------ identity
+    @property
+    def node_id(self) -> str:
+        return f"{self.kind}.{self.name}"
+
+    @property
+    def emitter(self) -> str:
+        return protocol.emitter_header(self.kind, self.name)
+
+    def input_topics(self) -> list[str]:
+        raise NotImplementedError
+
+    def return_topic(self) -> str:
+        raise NotImplementedError
+
+    def publish_topic(self) -> str | None:
+        return None
+
+    def all_topics(self) -> list[str]:
+        topics = list(self.input_topics()) + [self.return_topic()]
+        pub = self.publish_topic()
+        if pub:
+            topics.append(pub)
+        return topics
+
+    # ------------------------------------------------------------- binding
+    def bind(self, transport: MeshTransport) -> None:
+        self._transport = transport
+
+    @property
+    def transport(self) -> MeshTransport:
+        if self._transport is None:
+            raise RuntimeError(f"node {self.node_id} is not bound to a transport")
+        return self._transport
+
+    @property
+    def fanout_store(self) -> FanoutBatchStore | None:
+        return self.resources.get(FANOUT_STORE_KEY)
+
+    # =====================================================================
+    # entrypoint
+    # =====================================================================
+    async def handler(self, record: Record) -> None:
+        """The transport-facing entrypoint (one delivery, one hop)."""
+        try:
+            await self._handle_delivery(record)
+        except Exception:  # noqa: BLE001 - absolute floor: never kill the lane
+            logger.exception(
+                "[%s] delivery pipeline escaped its fault rail on %s",
+                self.node_id,
+                record.topic,
+            )
+
+    async def _handle_delivery(self, record: Record) -> None:
+        headers = record.headers
+        if not protocol.is_envelope(headers):
+            return  # step/other wire kinds are not for the kernel
+        try:
+            envelope = Envelope.from_wire(record.value)
+        except (ValidationError, ValueError):
+            # decode floor: no frame to fault against — loud, then drop
+            logger.error(
+                "[%s] undecodable envelope on %s (%d bytes): dropped",
+                self.node_id,
+                record.topic,
+                len(record.value),
+            )
+            return
+
+        correlation_id = headers.get(protocol.HDR_CORRELATION)
+        task_id = headers.get(protocol.HDR_TASK) or new_id()  # ingress mint
+        kind = headers.get(protocol.HDR_KIND)
+        if kind not in protocol.MESSAGE_KINDS:
+            kind = "return" if envelope.reply is not None else "call"
+
+        frame = envelope.workflow.current()
+        route = frame.route if frame else headers.get(protocol.HDR_ROUTE, "run")
+        ctx = NodeRunContext(
+            node=self,
+            envelope=envelope,
+            route=route,
+            delivery_kind=kind,
+            correlation_id=correlation_id,
+            task_id=task_id,
+            ledger=HopStepLedger(self.emitter),
+            headers=dict(headers),
+            root_topic=envelope.workflow.root_callback_topic(),
+        )
+        log_id = (correlation_id or task_id)[:8]
+
+        try:
+            await self._execute(ctx)
+        except MintedFault as minted:
+            await self._publish_fault(ctx, minted.error.report)
+        except NodeFaultError as fault:
+            await self._publish_fault(ctx, fault.report)
+        except Exception as exc:  # noqa: BLE001 - the fault rail
+            report = ErrorReport.build_safe(
+                self._own_fault_type(),
+                exc=exc,
+                node=self.node_id,
+                route=ctx.route,
+            )
+            recovered = False
+            try:
+                recovery = await run_chain_guarded(self.on_node_error, ctx, report)
+            except MintedFault as minted:
+                await self._publish_fault(ctx, minted.error.report)
+                recovery, recovered = None, True
+            except Exception:  # noqa: BLE001 - seam crash joins the fault
+                logger.exception("[%s] on_node_error seam crashed", log_id)
+                recovery = None
+            if recovery is not None and not recovered:
+                try:
+                    await self._publish_action(ctx, recovery)
+                    recovered = True
+                except Exception:  # noqa: BLE001
+                    logger.exception("[%s] recovery action publish failed", log_id)
+            if not recovered:
+                # a failed recovery must not swallow the original fault
+                await self._publish_fault(ctx, report)
+        finally:
+            await self._flush_steps(ctx)
+
+    def _own_fault_type(self) -> str:
+        return FaultTypes.NODE_ERROR
+
+    # =====================================================================
+    # stages
+    # =====================================================================
+    async def _execute(self, ctx: NodeRunContext) -> None:
+        if ctx.delivery_kind in ("return", "fault"):
+            outcome = await self._aggregate(ctx)
+            if outcome != _RESUME:
+                return
+        await run_chain(self.before_node, ctx)
+        action = await self._dispatch_routed(ctx)
+        if isinstance(action, Observed):
+            ctx.ledger.absorb(action.facts)
+            action = action.action
+        transformed = await run_chain(self.after_node, ctx, action)
+        if transformed is not None:
+            action = transformed
+        await self._publish_action(ctx, action)
+
+    async def _dispatch_routed(self, ctx: NodeRunContext) -> NodeResult | Observed:
+        chain = self.handlers_for(ctx.route)
+        if not chain:
+            raise NodeFaultError(
+                ErrorReport.build_safe(
+                    FaultTypes.DECLINED,
+                    f"no handler for route {ctx.route!r} on {self.node_id}",
+                    node=self.node_id,
+                    route=ctx.route,
+                )
+            )
+        for body in chain:
+            result = body(ctx)
+            if hasattr(result, "__await__"):
+                result = await result
+            if not isinstance(result, Next):
+                return result
+        # every handler declined
+        return Next()
+
+    # ------------------------------------------------------------ aggregate
+    async def _aggregate(self, ctx: NodeRunContext) -> str:
+        envelope = ctx.envelope
+        reply = envelope.reply
+        envelope.reply = None
+        if reply is None:
+            logger.warning(
+                "[%s] %s delivery with empty reply slot: stray, dropped",
+                self.node_id,
+                ctx.delivery_kind,
+            )
+            return _HANDLED
+
+        # fan-out close reentry?
+        if (
+            isinstance(reply.marker, CallMarker)
+            and _REENTRY_KEY in reply.marker.data
+        ):
+            return await self._close_fanout_batch(
+                ctx, reply.marker.data[_REENTRY_KEY]
+            )
+
+        frame = envelope.workflow.current()
+        if frame is not None and frame.fanout_id:
+            return await self._fold_sibling_reply(ctx, frame.fanout_id, reply)
+
+        # single pending call: resolve (seams on faults), then resume body
+        outcome = await self._resolve_callee(
+            ctx, reply, slot_id=reply.frame_id or ""
+        )
+        if outcome.fault is not None:
+            # unrecovered callee fault escalates one hop up the stack
+            escalated = ErrorReport.build_safe(
+                FaultTypes.CALLEE_FAULT,
+                f"callee fault reached {self.node_id}",
+                node=self.node_id,
+                route=ctx.route,
+                cause=outcome.fault,
+                frame_id=frame.frame_id if frame else None,
+            )
+            await self._publish_fault(ctx, escalated)
+            return _HANDLED
+        self.materialize_outcome(ctx, outcome)
+        ctx.folded = outcome
+        return _RESUME
+
+    async def _resolve_callee(
+        self, ctx: NodeRunContext, reply: Any, *, slot_id: str
+    ) -> FanoutOutcome:
+        """Stage-1 resolution: returns pass through; faults get the
+        on_callee_error chain (parts = recovery, None = stays a fault)."""
+        if isinstance(reply, ReturnMessage):
+            outcome = FanoutOutcome(
+                slot_id=slot_id, parts=list(reply.parts), marker=reply.marker
+            )
+            self._note_fold(ctx, outcome)
+            return outcome
+        assert isinstance(reply, FaultMessage)
+        report = reply.report
+        recovery = await run_chain_guarded(self.on_callee_error, ctx, report)
+        if recovery is not None:
+            parts = (
+                recovery
+                if isinstance(recovery, list)
+                else [recovery]  # a single part is accepted
+            )
+            outcome = FanoutOutcome(
+                slot_id=slot_id, parts=parts, marker=reply.marker
+            )
+            self._note_fold(ctx, outcome)
+            return outcome
+        outcome = FanoutOutcome(slot_id=slot_id, fault=report, marker=reply.marker)
+        self._note_fold(ctx, outcome)
+        return outcome
+
+    def _note_fold(self, ctx: NodeRunContext, outcome: FanoutOutcome) -> None:
+        """Pair law: the result step for a marked call mints at the fold."""
+        marker = outcome.marker
+        if isinstance(marker, ToolCallMarker):
+            if outcome.fault is not None:
+                ctx.ledger.fold_failed(
+                    marker.tool_call_id, marker.tool_name, outcome.fault
+                )
+            else:
+                ctx.ledger.folded(
+                    marker.tool_call_id,
+                    marker.tool_name,
+                    render_parts_as_text(outcome.parts or []),
+                )
+
+    def materialize_outcome(self, ctx: NodeRunContext, outcome: FanoutOutcome) -> None:
+        """Default slot materialization: marked tool results land in
+        ``state.tool_results`` (retry-marked parts become RetryPart)."""
+        marker = outcome.marker
+        if not isinstance(marker, ToolCallMarker):
+            return
+        parts = outcome.parts or []
+        if any(is_retry(p) for p in parts):
+            ctx.state.tool_results[marker.tool_call_id] = RetryPart(
+                content=render_parts_as_text(parts),
+                tool_call_id=marker.tool_call_id,
+                tool_name=marker.tool_name,
+            )
+        else:
+            ctx.state.tool_results[marker.tool_call_id] = ToolReturnPart(
+                tool_call_id=marker.tool_call_id,
+                tool_name=marker.tool_name,
+                content=render_parts_as_text(parts),
+            )
+
+    # -------------------------------------------------------------- fan-out
+    def _require_store(self) -> FanoutBatchStore:
+        store = self.fanout_store
+        if store is None:
+            raise NodeFaultError(
+                ErrorReport.build_safe(
+                    FaultTypes.LIFECYCLE_ERROR,
+                    f"{self.node_id}: parallel calls need a fanout store "
+                    f"resource ({FANOUT_STORE_KEY!r})",
+                    node=self.node_id,
+                )
+            )
+        return store
+
+    async def _handle_fanout_open(self, ctx: NodeRunContext, calls: list[Call]) -> None:
+        """OPEN: snapshot + pre-minted slots + marked own frame + dispatch."""
+        store = self._require_store()
+        envelope = ctx.envelope
+        fanout_id = new_id()
+        slots = [
+            SlotRef(
+                slot_id=new_id(),
+                tag=call.tag,
+                tool_name=(
+                    call.marker.tool_name
+                    if isinstance(call.marker, ToolCallMarker)
+                    else None
+                ),
+            )
+            for call in calls
+        ]
+        envelope.workflow.mark_fanout(fanout_id)
+        snapshot = EnvelopeSnapshot(
+            context=envelope.context.model_copy(deep=True),
+            workflow=envelope.workflow.model_copy(deep=True),
+        )
+        await store.open(
+            fanout_id, FanoutOpen(fanout_id=fanout_id, slots=slots), snapshot
+        )
+        for call, slot in zip(calls, slots):
+            sibling = Envelope(
+                context=envelope.context.model_copy(deep=True),
+                workflow=envelope.workflow.model_copy(deep=True),
+            )
+            if call.isolate_state:
+                sibling.context.state = call.state_override or State()
+            await self._dispatch_call(ctx, sibling, call, frame_id=slot.slot_id)
+
+    async def _dispatch_call(
+        self,
+        ctx: NodeRunContext,
+        envelope: Envelope,
+        call: Call,
+        *,
+        frame_id: str | None = None,
+    ) -> None:
+        """The one push-frame/publish/note-dispatch sequence for outgoing
+        calls (single and fan-out siblings)."""
+        frame = CallFrame(
+            target_topic=call.target_topic,
+            callback_topic=self.return_topic(),
+            route=call.route,
+            payload=call.parts,
+            tag=call.tag,
+            marker=call.marker,
+            caller_kind=self.kind,
+            caller_name=self.name,
+        )
+        if frame_id is not None:
+            frame.frame_id = frame_id
+        envelope.workflow.invoke_frame(frame)
+        await self._publish_envelope(
+            ctx, call.target_topic, envelope, kind="call", route=call.route
+        )
+        if isinstance(call.marker, ToolCallMarker):
+            args: dict[str, Any] = {}
+            if call.parts:
+                data = getattr(call.parts[0], "data", None)
+                if isinstance(data, dict):
+                    args = data.get("args", data)
+                    if not isinstance(args, dict):
+                        args = {}
+            ctx.ledger.note_dispatch(
+                call.marker.tool_call_id, call.marker.tool_name, args
+            )
+
+    async def _fold_sibling_reply(
+        self, ctx: NodeRunContext, fanout_id: str, reply: Any
+    ) -> str:
+        store = self._require_store()
+        slot_id = reply.frame_id or ""
+        state = await store.load(fanout_id)
+        classification = classify_sibling(state, slot_id)
+        if classification != "expected":
+            logger.warning(
+                "[%s] sibling reply %s classified %s for batch %s: dropped",
+                self.node_id,
+                slot_id[:8],
+                classification,
+                fanout_id[:8],
+            )
+            return _HANDLED
+        assert state is not None
+        outcome = await self._resolve_callee(ctx, reply, slot_id=slot_id)
+        state = record_outcome(state, outcome)
+        if state.is_complete() and not state.closing:
+            state = state.model_copy(update={"closing": True})
+            await store.save(state)
+            await self._publish_reentry(ctx, fanout_id)
+        else:
+            await store.save(state)
+        return _HANDLED
+
+    async def _publish_reentry(self, ctx: NodeRunContext, fanout_id: str) -> None:
+        """Self-published close trigger, through the same key-ordered lane."""
+        envelope = Envelope(
+            reply=ReturnMessage(marker=CallMarker(data={_REENTRY_KEY: fanout_id}))
+        )
+        await self._publish_envelope(
+            ctx,
+            self.return_topic(),
+            envelope,
+            kind="return",
+            route="fanout.close",
+            mirror=False,  # internal control record: never on the events tap
+        )
+
+    async def _close_fanout_batch(self, ctx: NodeRunContext, fanout_id: str) -> str:
+        store = self._require_store()
+        state = await store.load(fanout_id)
+        if state is None:
+            logger.warning(
+                "[%s] duplicate close for batch %s: dropped",
+                self.node_id,
+                fanout_id[:8],
+            )
+            return _HANDLED
+        snapshot = await store.load_snapshot(fanout_id)
+        await store.close(fanout_id)  # tombstone-first, exactly-once close
+        if snapshot is None:
+            logger.error(
+                "[%s] batch %s registered without snapshot: write-order "
+                "invariant broken; run stranded",
+                self.node_id,
+                fanout_id[:8],
+            )
+            return _HANDLED
+        # restore the caller's continuation (incl. the step-stream root,
+        # which the reentry envelope's empty workflow couldn't provide)
+        ctx.envelope.context = snapshot.context
+        ctx.envelope.workflow = snapshot.workflow
+        ctx.envelope.workflow.mark_fanout(None)
+        ctx.root_topic = ctx.envelope.workflow.root_callback_topic()
+        ctx.route = (
+            ctx.envelope.workflow.current().route
+            if ctx.envelope.workflow.current()
+            else ctx.route
+        )
+
+        faults = [o for o in state.outcomes.values() if o.fault is not None]
+        if faults:
+            group = ErrorReport.build_safe(
+                FaultTypes.FANOUT_ABORTED,
+                f"{len(faults)} of {len(state.open.slots)} parallel calls "
+                f"faulted on {self.node_id}",
+                node=self.node_id,
+                route=ctx.route,
+                cause=faults[0].fault,
+                data={"faulted_slots": str(len(faults))},
+            )
+            await self._publish_fault(ctx, group)
+            return _HANDLED
+        for slot in state.open.slots:
+            outcome = state.outcomes[slot.slot_id]
+            self.materialize_outcome(ctx, outcome)
+        return _RESUME
+
+    # =====================================================================
+    # publish chokepoint
+    # =====================================================================
+    async def _publish_action(self, ctx: NodeRunContext, action: NodeResult) -> None:
+        envelope = ctx.envelope
+        if isinstance(action, list):
+            if not all(isinstance(c, Call) for c in action):
+                raise NodeFaultError(
+                    ErrorReport.build_safe(
+                        FaultTypes.NODE_ERROR,
+                        "a list action must contain only Calls",
+                        node=self.node_id,
+                    )
+                )
+            if not action:
+                action = None  # empty batch = no action; decline check below
+            elif len(action) == 1 and not action[0].isolate_state:
+                action = action[0]  # degenerate list: plain call
+            else:
+                await self._handle_fanout_open(ctx, action)
+                return
+
+        if isinstance(action, Call):
+            if action.isolate_state:
+                # isolated single call = degenerate durable batch (the
+                # caller's state must survive outside the wire)
+                await self._handle_fanout_open(ctx, [action])
+                return
+            envelope.reply = None
+            await self._dispatch_call(ctx, envelope, action)
+            return
+
+        if isinstance(action, TailCall):
+            frame = envelope.workflow.require_current()
+            frame.target_topic = action.target_topic
+            frame.route = action.route
+            if action.parts:
+                frame.payload = action.parts
+            envelope.reply = None
+            await self._publish_envelope(
+                ctx, action.target_topic, envelope, kind="call", route=action.route
+            )
+            return
+
+        if isinstance(action, ReturnCall):
+            frame = envelope.workflow.unwind_frame()
+            envelope.reply = ReturnMessage(
+                parts=action.parts,
+                frame_id=frame.frame_id,
+                tag=frame.tag,
+                marker=frame.marker,
+            )
+            await self._publish_envelope(
+                ctx, frame.callback_topic, envelope, kind="return", route=frame.route
+            )
+            return
+
+        # None / Next: a reply-owing delivery must not be silently dropped
+        if envelope.workflow.depth > 0:
+            raise NodeFaultError(
+                ErrorReport.build_safe(
+                    FaultTypes.DECLINED,
+                    f"{self.node_id} declined a reply-owing delivery "
+                    f"(route {ctx.route!r})",
+                    node=self.node_id,
+                    route=ctx.route,
+                )
+            )
+
+    # ---------------------------------------------------------------- fault
+    async def _publish_fault(self, ctx: NodeRunContext, report: ErrorReport) -> None:
+        envelope = ctx.envelope
+        if envelope.workflow.depth == 0:
+            # no caller: the fault rail's floor
+            logger.error(
+                "[%s] unroutable fault (no caller frame): %s",
+                self.node_id,
+                report.model_dump_json(),
+            )
+            return
+        frame = envelope.workflow.unwind_frame()
+        report = report.model_copy(
+            update={"frame_chain": ([frame.frame_id] + report.frame_chain)[:32]}
+        )
+        # the state-elision ladder: full -> no tracebacks -> minimal+elide
+        budget = self.transport.max_message_bytes
+        attempts = [
+            (report, False),
+            (report.without_tracebacks(), False),
+            (report.to_minimal(), True),
+        ]
+        for attempt, elide_state in attempts:
+            candidate = envelope
+            if elide_state:
+                candidate = envelope.model_copy(deep=True)
+                candidate.context.state = State()
+                candidate.state_elided = True
+            candidate.reply = FaultMessage(
+                report=attempt,
+                frame_id=frame.frame_id,
+                tag=frame.tag,
+                marker=frame.marker,
+            )
+            wire = candidate.to_wire()
+            if len(wire) <= budget:
+                try:
+                    await self._publish_envelope(
+                        ctx,
+                        frame.callback_topic,
+                        candidate,
+                        kind="fault",
+                        route=frame.route,
+                        error_type=attempt.error_type,
+                    )
+                    if elide_state or attempt is not report:
+                        logger.warning(
+                            "[%s] fault degraded to fit wire budget "
+                            "(state_elided=%s)",
+                            self.node_id,
+                            elide_state,
+                        )
+                    return
+                except Exception:  # noqa: BLE001 - try the next rung
+                    logger.exception(
+                        "[%s] fault publish attempt failed; degrading",
+                        self.node_id,
+                    )
+        logger.error(
+            "[%s] fault could not be published at any elision rung: %s",
+            self.node_id,
+            report.to_minimal().model_dump_json(),
+        )
+
+    # ------------------------------------------------------------ transport
+    async def _publish_envelope(
+        self,
+        ctx: NodeRunContext,
+        topic: str,
+        envelope: Envelope,
+        *,
+        kind: str,
+        route: str,
+        error_type: str | None = None,
+        mirror: bool = True,
+    ) -> None:
+        headers = {
+            protocol.HDR_EMITTER: self.emitter,
+            protocol.HDR_KIND: kind,
+            protocol.HDR_WIRE: "envelope",
+            protocol.HDR_ROUTE: route,
+            protocol.HDR_TASK: ctx.task_id,
+        }
+        if ctx.correlation_id:
+            headers[protocol.HDR_CORRELATION] = ctx.correlation_id
+        if error_type:
+            headers[protocol.HDR_ERROR_TYPE] = error_type
+        await self.transport.publish(
+            topic,
+            envelope.to_wire(),
+            key=partition_key(ctx.task_id),
+            headers=headers,
+        )
+        # broadcast mirror: the hop's outcome re-published for broker-level
+        # taps (reference: base.py:580-701,919) — best-effort, once per hop
+        mirror_topic = self.publish_topic() if mirror else None
+        if mirror_topic and mirror_topic != topic and not ctx.mirrored:
+            ctx.mirrored = True
+            try:
+                await self.transport.publish(
+                    mirror_topic,
+                    envelope.to_wire(),
+                    key=partition_key(ctx.task_id),
+                    headers=headers,
+                )
+            except Exception:  # noqa: BLE001
+                logger.warning(
+                    "[%s] broadcast mirror failed (run unaffected)",
+                    self.node_id,
+                    exc_info=True,
+                )
+
+    async def _flush_steps(self, ctx: NodeRunContext) -> None:
+        if not ctx.ledger.has_steps:
+            return
+        root = ctx.root_topic or ctx.envelope.workflow.root_callback_topic()
+        try:
+            await ctx.ledger.flush(
+                self.transport,
+                root,
+                correlation_id=ctx.correlation_id,
+                task_id=ctx.task_id,
+            )
+        except Exception:  # noqa: BLE001 - steps never fault the run
+            logger.warning(
+                "[%s] step flush failed (run unaffected)", self.node_id, exc_info=True
+            )

@@ -1,0 +1,107 @@
+"""Shared harness for kernel tests: deploy nodes on an InMemoryMesh the way
+the Worker will, plus a scripted caller that collects replies."""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+
+from calfkit_tpu import protocol
+from calfkit_tpu.keying import partition_key
+from calfkit_tpu.mesh import InMemoryMesh, Record
+from calfkit_tpu.models import (
+    CallFrame,
+    Envelope,
+    SessionContext,
+    State,
+    StepMessage,
+    WorkflowState,
+)
+from calfkit_tpu.models.payload import ContentPart
+from calfkit_tpu.models.session_context import new_id
+from calfkit_tpu.nodes import FANOUT_STORE_KEY, KtablesFanoutBatchStore
+from calfkit_tpu.nodes.base import BaseNodeDef
+
+INBOX = "test.caller.inbox"
+
+
+async def deploy(mesh: InMemoryMesh, *nodes: BaseNodeDef) -> None:
+    for node in nodes:
+        node.bind(mesh)
+        if FANOUT_STORE_KEY not in node.resources:
+            store = KtablesFanoutBatchStore(mesh, node.node_id)
+            await store.start()
+            node.resources[FANOUT_STORE_KEY] = store
+        topics = list(node.input_topics()) + [node.return_topic()]
+        await mesh.subscribe(topics, node.handler, group_id=node.name)
+
+
+@dataclass
+class Caller:
+    """Collects replies + steps landing on the test inbox."""
+
+    mesh: InMemoryMesh
+    replies: list[tuple[dict, Envelope]] = field(default_factory=list)
+    steps: list[StepMessage] = field(default_factory=list)
+
+    async def start(self) -> None:
+        await self.mesh.subscribe(
+            [INBOX], self._on_record, group_id=None, from_latest=False, ordered=False
+        )
+
+    async def _on_record(self, record: Record) -> None:
+        if record.headers.get(protocol.HDR_WIRE) == "step":
+            self.steps.append(StepMessage.from_wire(record.value))
+        else:
+            self.replies.append((dict(record.headers), Envelope.from_wire(record.value)))
+
+    async def call(
+        self,
+        target_topic: str,
+        parts: list[ContentPart],
+        *,
+        route: str = "run",
+        state: State | None = None,
+        task_id: str | None = None,
+        correlation_id: str | None = None,
+    ) -> str:
+        task = task_id or new_id()
+        env = Envelope(
+            context=SessionContext(state=state or State()),
+            workflow=WorkflowState(
+                frames=[
+                    CallFrame(
+                        target_topic=target_topic,
+                        callback_topic=INBOX,
+                        route=route,
+                        payload=parts,
+                        caller_kind="client",
+                        caller_name="test",
+                    )
+                ]
+            ),
+        )
+        await self.mesh.publish(
+            target_topic,
+            env.to_wire(),
+            key=partition_key(task),
+            headers={
+                protocol.HDR_KIND: "call",
+                protocol.HDR_WIRE: "envelope",
+                protocol.HDR_ROUTE: route,
+                protocol.HDR_TASK: task,
+                protocol.HDR_CORRELATION: correlation_id or task,
+                protocol.HDR_EMITTER: "client/test",
+            },
+        )
+        return task
+
+    async def wait_reply(self, n: int = 1, timeout: float = 5.0):
+        deadline = asyncio.get_event_loop().time() + timeout
+        while len(self.replies) < n:
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(
+                    f"expected {n} replies, got {len(self.replies)}"
+                )
+            await asyncio.sleep(0.01)
+        return self.replies[n - 1]

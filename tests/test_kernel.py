@@ -1,0 +1,558 @@
+"""Node kernel: delivery pipeline, fault rail, fan-out fold/close, steps."""
+
+import asyncio
+
+import pytest
+
+from calfkit_tpu import protocol
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models import (
+    Call,
+    DataPart,
+    ErrorReport,
+    FaultMessage,
+    FaultTypes,
+    Next,
+    ReturnCall,
+    ReturnMessage,
+    TextPart,
+    ToolCallStep,
+    ToolResultStep,
+)
+from calfkit_tpu.models.marker import ToolCallMarker
+from calfkit_tpu.models.payload import render_parts_as_text
+from calfkit_tpu.exceptions import NodeFaultError
+from calfkit_tpu.nodes import BaseNodeDef, ModelRetry, agent_tool, consumer, handler
+from calfkit_tpu.nodes.steps import Observed, Said
+
+from tests.kernel_harness import INBOX, Caller, deploy
+
+
+@pytest.fixture
+def mesh_and_caller():
+    async def make():
+        mesh = InMemoryMesh()
+        await mesh.start()
+        caller = Caller(mesh)
+        await caller.start()
+        return mesh, caller
+
+    return make
+
+
+# --------------------------------------------------------------------------- #
+# scripted node kinds for kernel-level tests
+# --------------------------------------------------------------------------- #
+
+
+class ScriptedNode(BaseNodeDef):
+    kind = "agent"
+
+    def __init__(self, name, script, **kw):
+        super().__init__(name, **kw)
+        self.script = script  # async fn(ctx) -> NodeResult
+
+    def input_topics(self):
+        return [protocol.agent_input_topic(self.name)]
+
+    def return_topic(self):
+        return protocol.agent_return_topic(self.name)
+
+    def publish_topic(self):
+        return protocol.agent_publish_topic(self.name)
+
+    @handler("run")
+    async def run(self, ctx):
+        return await self.script(ctx)
+
+
+class TestToolRoundTrip:
+    async def test_call_return(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        @agent_tool
+        def get_weather(city: str) -> dict:
+            """Weather lookup."""
+            return {"city": city, "temp_c": 18.0}
+
+        await deploy(mesh, get_weather)
+        await caller.call(
+            "tool.get_weather.input", [DataPart(data={"city": "SF"})]
+        )
+        headers, env = await caller.wait_reply()
+        assert headers[protocol.HDR_KIND] == "return"
+        assert isinstance(env.reply, ReturnMessage)
+        assert env.reply.parts[0].data == {"city": "SF", "temp_c": 18.0}
+        assert env.workflow.depth == 0  # frame unwound
+        await mesh.stop()
+
+    async def test_model_retry_becomes_retry_part(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        @agent_tool
+        def fussy(x: int) -> str:
+            raise ModelRetry("need a bigger x")
+
+        await deploy(mesh, fussy)
+        await caller.call("tool.fussy.input", [DataPart(data={"x": 1})])
+        _, env = await caller.wait_reply()
+        from calfkit_tpu.models import is_retry
+
+        assert is_retry(env.reply.parts[0])
+        assert "bigger x" in env.reply.parts[0].text
+        await mesh.stop()
+
+    async def test_bad_args_become_retry_not_fault(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        @agent_tool
+        def typed(x: int) -> int:
+            return x
+
+        await deploy(mesh, typed)
+        await caller.call("tool.typed.input", [DataPart(data={"x": "zzz"})])
+        _, env = await caller.wait_reply()
+        assert isinstance(env.reply, ReturnMessage)
+        from calfkit_tpu.models import is_retry
+
+        assert is_retry(env.reply.parts[0])
+        await mesh.stop()
+
+    async def test_tool_crash_faults_with_tag_echo(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        @agent_tool
+        def boom() -> str:
+            raise RuntimeError("kaboom")
+
+        await deploy(mesh, boom)
+        # tag on the frame must echo on the fault
+        from calfkit_tpu.models import CallFrame, Envelope, WorkflowState
+        from calfkit_tpu.keying import partition_key
+
+        env = Envelope(
+            workflow=WorkflowState(frames=[
+                CallFrame(target_topic="tool.boom.input", callback_topic=INBOX,
+                          tag="tc-9", marker=ToolCallMarker(tool_call_id="tc-9",
+                                                            tool_name="boom"))
+            ])
+        )
+        await mesh.publish("tool.boom.input", env.to_wire(), key=partition_key("t1"),
+                           headers={protocol.HDR_KIND: "call", protocol.HDR_TASK: "t1"})
+        headers, reply_env = await caller.wait_reply()
+        assert headers[protocol.HDR_KIND] == "fault"
+        assert headers[protocol.HDR_ERROR_TYPE] == FaultTypes.TOOL_ERROR
+        assert isinstance(reply_env.reply, FaultMessage)
+        assert reply_env.reply.tag == "tc-9"
+        assert reply_env.reply.marker.tool_call_id == "tc-9"
+        assert "kaboom" in reply_env.reply.report.message
+        await mesh.stop()
+
+    async def test_non_wire_safe_result_faults(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        @agent_tool
+        def leaky() -> object:
+            return object()
+
+        await deploy(mesh, leaky)
+        await caller.call("tool.leaky.input", [])
+        headers, env = await caller.wait_reply()
+        assert headers[protocol.HDR_KIND] == "fault"
+        assert "wire-safe" in env.reply.report.message
+        await mesh.stop()
+
+
+class TestFaultRail:
+    async def test_declined_reply_owing_autofaults(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        async def decline(ctx):
+            return Next()
+
+        node = ScriptedNode("decliner", decline)
+        await deploy(mesh, node)
+        await caller.call("agent.decliner.private.input", [TextPart(text="x")])
+        headers, env = await caller.wait_reply()
+        assert env.reply.report.error_type == FaultTypes.DECLINED
+        await mesh.stop()
+
+    async def test_minted_fault_propagates_type(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        async def mint(ctx):
+            raise NodeFaultError(ErrorReport.build_safe(
+                FaultTypes.CAPABILITY_UNAVAILABLE, "no such tool"))
+
+        await deploy(mesh, ScriptedNode("minter", mint))
+        await caller.call("agent.minter.private.input", [])
+        headers, env = await caller.wait_reply()
+        assert env.reply.report.error_type == FaultTypes.CAPABILITY_UNAVAILABLE
+        await mesh.stop()
+
+    async def test_on_node_error_recovers(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        async def broken(ctx):
+            raise ValueError("internal")
+
+        async def recover(ctx, report):
+            return ReturnCall(parts=[TextPart(text=f"recovered:{report.error_type}")])
+
+        node = ScriptedNode("healer", broken, on_node_error=[recover])
+        await deploy(mesh, node)
+        await caller.call("agent.healer.private.input", [])
+        headers, env = await caller.wait_reply()
+        assert headers[protocol.HDR_KIND] == "return"
+        assert "recovered:mesh.node_error" in env.reply.parts[0].text
+        await mesh.stop()
+
+    async def test_callee_fault_escalates_through_caller(self, mesh_and_caller):
+        """A calls B; B crashes; A has no recovery -> caller sees CALLEE_FAULT
+        wrapping B's NODE_ERROR (the escalation ladder)."""
+        mesh, caller = await mesh_and_caller()
+
+        async def call_b(ctx):
+            if ctx.delivery_kind == "call":
+                return Call(target_topic="agent.b.private.input", route="run")
+            pytest.fail("A should have escalated before re-entering body")
+
+        async def crash(ctx):
+            raise RuntimeError("B died")
+
+        await deploy(mesh, ScriptedNode("a", call_b), ScriptedNode("b", crash))
+        await caller.call("agent.a.private.input", [])
+        headers, env = await caller.wait_reply()
+        assert headers[protocol.HDR_KIND] == "fault"
+        report = env.reply.report
+        assert report.error_type == FaultTypes.CALLEE_FAULT
+        assert report.causes and report.causes[0].error_type == FaultTypes.NODE_ERROR
+        assert "B died" in report.root_cause().message
+        await mesh.stop()
+
+    async def test_on_callee_error_recovery_resumes_body(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+        seen = {}
+
+        async def call_then_return(ctx):
+            if ctx.delivery_kind == "call":
+                return Call(
+                    target_topic="agent.b2.private.input",
+                    route="run",
+                    marker=ToolCallMarker(tool_call_id="t1", tool_name="b2"),
+                )
+            seen["resumed"] = True
+            seen["tool_results"] = dict(ctx.state.tool_results)
+            return ReturnCall(parts=[TextPart(text="done")])
+
+        async def crash(ctx):
+            raise RuntimeError("B died")
+
+        async def substitute(ctx, report):
+            return [TextPart(text="fallback-value")]
+
+        await deploy(
+            mesh,
+            ScriptedNode("a2", call_then_return, on_callee_error=[substitute]),
+            ScriptedNode("b2", crash),
+        )
+        await caller.call("agent.a2.private.input", [])
+        headers, env = await caller.wait_reply()
+        assert headers[protocol.HDR_KIND] == "return"
+        assert env.reply.parts[0].text == "done"
+        assert seen["resumed"]
+        assert seen["tool_results"]["t1"].content == "fallback-value"
+        await mesh.stop()
+
+    async def test_oversized_fault_elides_state(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+        mesh._max_bytes = 6000  # tiny wire budget
+
+        async def crash(ctx):
+            raise RuntimeError("x" * 20000)  # giant message → giant traceback
+
+        await deploy(mesh, ScriptedNode("big", crash))
+        from calfkit_tpu.models import State, ModelRequest, UserPart
+
+        fat_state = State(message_history=[
+            ModelRequest(parts=[UserPart(content="y" * 3000)])])
+        await caller.call("agent.big.private.input", [], state=fat_state)
+        headers, env = await caller.wait_reply()
+        assert headers[protocol.HDR_KIND] == "fault"
+        assert env.state_elided
+        assert env.context.state.message_history == []
+        assert env.reply.report.error_type == FaultTypes.NODE_ERROR
+        await mesh.stop()
+
+
+class TestFanout:
+    async def test_open_fold_close_resumes_with_all_slots(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+        resumed = {}
+
+        async def fan(ctx):
+            if ctx.delivery_kind == "call":
+                return [
+                    Call(target_topic="tool.double.input", route="run",
+                         parts=[DataPart(data={"x": i})],
+                         tag=f"tc-{i}",
+                         marker=ToolCallMarker(tool_call_id=f"tc-{i}",
+                                               tool_name="double"))
+                    for i in range(3)
+                ]
+            resumed["tool_results"] = {
+                k: v.content for k, v in ctx.state.tool_results.items()
+            }
+            return ReturnCall(parts=[TextPart(text="all-done")])
+
+        @agent_tool
+        def double(x: int) -> int:
+            return x * 2
+
+        await deploy(mesh, ScriptedNode("fan", fan), double)
+        await caller.call("agent.fan.private.input", [])
+        headers, env = await caller.wait_reply(timeout=10)
+        assert env.reply.parts[0].text == "all-done"
+        assert resumed["tool_results"] == {"tc-0": "0", "tc-1": "2", "tc-2": "4"}
+        await mesh.stop()
+
+    async def test_fanout_with_fault_aborts_batch(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        async def fan(ctx):
+            if ctx.delivery_kind == "call":
+                return [
+                    Call(target_topic="tool.ok.input", route="run",
+                         parts=[DataPart(data={})],
+                         marker=ToolCallMarker(tool_call_id="t-ok", tool_name="ok")),
+                    Call(target_topic="tool.bad.input", route="run",
+                         parts=[DataPart(data={})],
+                         marker=ToolCallMarker(tool_call_id="t-bad", tool_name="bad")),
+                ]
+            pytest.fail("must abort, not resume")
+
+        @agent_tool
+        def ok() -> str:
+            return "fine"
+
+        @agent_tool
+        def bad() -> str:
+            raise RuntimeError("sibling died")
+
+        await deploy(mesh, ScriptedNode("fan2", fan), ok, bad)
+        await caller.call("agent.fan2.private.input", [])
+        headers, env = await caller.wait_reply(timeout=10)
+        assert headers[protocol.HDR_KIND] == "fault"
+        assert env.reply.report.error_type == FaultTypes.FANOUT_ABORTED
+        assert "sibling died" in env.reply.report.root_cause().message
+        await mesh.stop()
+
+    async def test_duplicate_sibling_reply_is_idempotent(self, mesh_and_caller):
+        """Replay a sibling reply record: the fold must classify duplicate and
+        the batch must still close exactly once."""
+        mesh, caller = await mesh_and_caller()
+        resumes = []
+
+        async def fan(ctx):
+            if ctx.delivery_kind == "call":
+                return [
+                    Call(target_topic="tool.once.input", route="run",
+                         parts=[DataPart(data={})],
+                         marker=ToolCallMarker(tool_call_id="t1", tool_name="once")),
+                    Call(target_topic="tool.twice.input", route="run",
+                         parts=[DataPart(data={})],
+                         marker=ToolCallMarker(tool_call_id="t2", tool_name="twice")),
+                ]
+            resumes.append(1)
+            return ReturnCall(parts=[TextPart(text="closed")])
+
+        @agent_tool
+        def once() -> str:
+            return "a"
+
+        @agent_tool
+        def twice() -> str:
+            return "b"
+
+        node = ScriptedNode("fan3", fan)
+        await deploy(mesh, node, once, twice)
+        await caller.call("agent.fan3.private.input", [])
+        await caller.wait_reply(timeout=10)
+        # replay every record that landed on fan3's return topic
+        topic = mesh._topic("agent.fan3.private.return")
+        records = [r for p in topic.partitions for r in p]
+        for r in records:
+            await mesh.publish(r.topic, r.value, key=r.key, headers=r.headers)
+        await asyncio.sleep(0.3)
+        assert len(resumes) == 1  # no double close, no double resume
+        assert len(caller.replies) == 1
+        await mesh.stop()
+
+
+class TestStepsAndMirror:
+    async def test_steps_flush_to_root_callback(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        async def speak(ctx):
+            return Observed(
+                action=ReturnCall(parts=[TextPart(text="hi")]),
+                facts=[Said(text="hi", author="speaker")],
+            )
+
+        await deploy(mesh, ScriptedNode("speaker", speak))
+        await caller.call("agent.speaker.private.input", [])
+        await caller.wait_reply()
+        await asyncio.sleep(0.1)
+        assert caller.steps, "no StepMessage reached the root callback"
+        steps = caller.steps[0].steps
+        assert steps[0].kind == "agent_message" and steps[0].text == "hi"
+        await mesh.stop()
+
+    async def test_tool_call_step_pair_minted(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        async def one_call(ctx):
+            if ctx.delivery_kind == "call":
+                return Call(target_topic="tool.t.input", route="run",
+                            parts=[DataPart(data={"tool_name": "t", "args": {}})],
+                            marker=ToolCallMarker(tool_call_id="tc", tool_name="t"))
+            return ReturnCall(parts=[TextPart(text="fin")])
+
+        @agent_tool(name="t")
+        def t() -> str:
+            return "res"
+
+        await deploy(mesh, ScriptedNode("pairs", one_call), t)
+        await caller.call("agent.pairs.private.input", [])
+        await caller.wait_reply()
+        await asyncio.sleep(0.2)
+        kinds = [s.kind for m in caller.steps for s in m.steps]
+        assert "tool_call" in kinds and "tool_result" in kinds
+        await mesh.stop()
+
+    async def test_broadcast_mirror(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        async def simple(ctx):
+            return ReturnCall(parts=[TextPart(text="ok")])
+
+        node = ScriptedNode("mirrored", simple)
+        await deploy(mesh, node)
+        mirrored = []
+
+        async def tap(record):
+            mirrored.append(record)
+
+        await mesh.subscribe(["agent.mirrored.events"], tap, group_id=None,
+                             from_latest=False, ordered=False)
+        await caller.call("agent.mirrored.private.input", [])
+        await caller.wait_reply()
+        await asyncio.sleep(0.1)
+        assert mirrored, "hop outcome not mirrored to publish topic"
+        await mesh.stop()
+
+
+class TestConsumer:
+    async def test_observes_without_replying(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+        seen = []
+
+        @consumer(topics=["agent.obs.events"])
+        async def observer(cctx):
+            seen.append((cctx.topic, cctx.envelope is not None))
+
+        async def simple(ctx):
+            return ReturnCall(parts=[TextPart(text="ok")])
+
+        await deploy(mesh, ScriptedNode("obs", simple), observer)
+        await caller.call("agent.obs.private.input", [])
+        await caller.wait_reply()
+        await asyncio.sleep(0.1)
+        assert seen and seen[0][0] == "agent.obs.events" and seen[0][1]
+        assert len(caller.replies) == 1  # consumer added no traffic to caller
+        await mesh.stop()
+
+    async def test_consumer_error_floor(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        @consumer(topics=["agent.obs2.events"], name="obs2c")
+        async def observer(cctx):
+            raise RuntimeError("observer bug")
+
+        async def simple(ctx):
+            return ReturnCall(parts=[TextPart(text="ok")])
+
+        await deploy(mesh, ScriptedNode("obs2", simple), observer)
+        await caller.call("agent.obs2.private.input", [])
+        _, env = await caller.wait_reply()
+        assert env.reply.parts[0].text == "ok"  # run unaffected
+        await mesh.stop()
+
+
+class TestReviewRegressions:
+    """Regressions for reproduced review findings."""
+
+    async def test_empty_list_action_declines_not_strands(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        async def empty(ctx):
+            return []  # zero tool calls: must not open an uncloseable batch
+
+        from tests.kernel_harness import deploy as _deploy
+        await _deploy(mesh, ScriptedNode("empty", empty))
+        await caller.call("agent.empty.private.input", [TextPart(text="x")])
+        headers, env = await caller.wait_reply()
+        assert env.reply.report.error_type == FaultTypes.DECLINED
+        await mesh.stop()
+
+    async def test_failed_recovery_publishes_original_fault(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        async def broken(ctx):
+            raise ValueError("original failure")
+
+        async def bad_recovery(ctx, report):
+            return [object()]  # not Calls: recovery publish raises
+
+        node = ScriptedNode("badheal", broken, on_node_error=[bad_recovery])
+        from tests.kernel_harness import deploy as _deploy
+        await _deploy(mesh, node)
+        await caller.call("agent.badheal.private.input", [])
+        headers, env = await caller.wait_reply()
+        assert headers[protocol.HDR_KIND] == "fault"
+        assert "original failure" in env.reply.report.message
+        await mesh.stop()
+
+    async def test_close_hop_steps_reach_root(self, mesh_and_caller):
+        mesh, caller = await mesh_and_caller()
+
+        async def fan(ctx):
+            if ctx.delivery_kind == "call":
+                return [
+                    Call(target_topic="tool.s1.input", route="run",
+                         parts=[DataPart(data={})],
+                         marker=ToolCallMarker(tool_call_id="a", tool_name="s1")),
+                    Call(target_topic="tool.s2.input", route="run",
+                         parts=[DataPart(data={})],
+                         marker=ToolCallMarker(tool_call_id="b", tool_name="s2")),
+                ]
+            return Observed(action=ReturnCall(parts=[TextPart(text="done")]),
+                            facts=[Said(text="closing words")])
+
+        @agent_tool(name="s1")
+        def s1() -> str:
+            return "1"
+
+        @agent_tool(name="s2")
+        def s2() -> str:
+            return "2"
+
+        from tests.kernel_harness import deploy as _deploy
+        await _deploy(mesh, ScriptedNode("fanstep", fan), s1, s2)
+        await caller.call("agent.fanstep.private.input", [])
+        await caller.wait_reply(timeout=10)
+        await asyncio.sleep(0.2)
+        texts = [s.text for m in caller.steps for s in m.steps
+                 if s.kind == "agent_message"]
+        assert "closing words" in texts  # close-hop facts must stream
+        await mesh.stop()
