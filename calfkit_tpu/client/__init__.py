@@ -1,0 +1,7 @@
+"""The caller surface (SURVEY.md §1 layer 8)."""
+
+from calfkit_tpu.client.caller import AgentGateway, Client
+from calfkit_tpu.client.events import EventStream
+from calfkit_tpu.client.hub import Hub, InvocationHandle
+
+__all__ = ["AgentGateway", "Client", "EventStream", "Hub", "InvocationHandle"]

@@ -1,0 +1,250 @@
+"""The Client: the caller surface onto the mesh.
+
+Reference: calfkit/client/caller.py:46-437 + gateway.py.  Semantics kept:
+
+- ``Client.connect(...)`` is **lazy sync** — no I/O until first use;
+- the inbox subscriber is consuming before the first call publishes;
+- three verbs per agent: ``send`` (fire token), ``start`` (handle),
+  ``execute`` (await result);
+- handles register before publish (race-free);
+- ``client.events()`` is the bounded drop-oldest firehose.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import uuid
+from typing import Any, Generic, TypeVar
+
+from calfkit_tpu import protocol
+from calfkit_tpu.exceptions import ClientClosedError
+from calfkit_tpu.keying import partition_key
+from calfkit_tpu.mesh.transport import MeshTransport, Subscription
+from calfkit_tpu.models.messages import ModelMessage
+from calfkit_tpu.models.node_result import InvocationResult
+from calfkit_tpu.models.payload import ContentPart, TextPart
+from calfkit_tpu.models.session_context import (
+    CallFrame,
+    Envelope,
+    SessionContext,
+    WorkflowState,
+    new_id,
+)
+from calfkit_tpu.models.state import State
+from calfkit_tpu.client.events import EventStream
+from calfkit_tpu.client.hub import Hub, InvocationHandle
+
+OutputT = TypeVar("OutputT")
+
+DEFAULT_TIMEOUT = 60.0
+
+
+class Client:
+    def __init__(
+        self,
+        mesh: MeshTransport,
+        *,
+        client_id: str | None = None,
+        default_timeout: float = DEFAULT_TIMEOUT,
+    ):
+        self.mesh = mesh
+        self.client_id = client_id or uuid.uuid4().hex[:12]
+        self.inbox_topic = protocol.client_inbox_topic(self.client_id)
+        self.default_timeout = default_timeout
+        self._hub = Hub()
+        self._subscription: Subscription | None = None
+        self._started = False
+        self._closed = False
+        self._start_lock: asyncio.Lock | None = None
+        self._mesh_view: Any = None
+
+    # ------------------------------------------------------------- connect
+    @classmethod
+    def connect(
+        cls,
+        mesh: MeshTransport,
+        *,
+        client_id: str | None = None,
+        default_timeout: float = DEFAULT_TIMEOUT,
+    ) -> "Client":
+        """Lazy constructor: performs no I/O (reference: caller.py:102)."""
+        return cls(mesh, client_id=client_id, default_timeout=default_timeout)
+
+    async def _ensure_started(self) -> None:
+        if self._closed:
+            raise ClientClosedError("client is closed")
+        if self._started:
+            return
+        if self._start_lock is None:
+            self._start_lock = asyncio.Lock()
+        async with self._start_lock:
+            if self._started:
+                return
+            await self.mesh.start()
+            await self.mesh.ensure_topics([self.inbox_topic])
+            # inbox must be consuming BEFORE any call publishes
+            self._subscription = await self.mesh.subscribe(
+                [self.inbox_topic],
+                self._hub.on_record,
+                group_id=None,
+                from_latest=False,
+                ordered=False,
+            )
+            self._started = True
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._subscription is not None:
+            with contextlib.suppress(Exception):
+                await self._subscription.stop()
+            self._subscription = None
+
+    async def __aenter__(self) -> "Client":
+        await self._ensure_started()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.close()
+
+    # ------------------------------------------------------------- agents
+    def agent(
+        self, name: str, *, output_type: type[OutputT] = str
+    ) -> "AgentGateway[OutputT]":
+        return AgentGateway(self, name, output_type)
+
+    # ------------------------------------------------------------ firehose
+    def events(self, *, buffer: int = 1024) -> EventStream:
+        """Every step event this client observes, across all runs.
+
+        ``stream.close()`` detaches the tap from the hub."""
+        stream = EventStream(buffer=buffer, on_close=self._hub.remove_tap)
+        self._hub.add_tap(stream)
+        return stream
+
+    # ------------------------------------------------------------ internal
+    async def _publish_call(
+        self,
+        target_topic: str,
+        parts: list[ContentPart],
+        *,
+        route: str,
+        correlation_id: str,
+        task_id: str,
+        state: State,
+        deps: dict[str, Any],
+    ) -> None:
+        envelope = Envelope(
+            context=SessionContext(state=state, deps=deps),
+            workflow=WorkflowState(
+                frames=[
+                    CallFrame(
+                        target_topic=target_topic,
+                        callback_topic=self.inbox_topic,
+                        route=route,
+                        payload=parts,
+                        caller_kind="client",
+                        caller_name=self.client_id,
+                    )
+                ]
+            ),
+        )
+        await self.mesh.publish(
+            target_topic,
+            envelope.to_wire(),
+            key=partition_key(task_id),
+            headers={
+                protocol.HDR_EMITTER: protocol.emitter_header("client", self.client_id),
+                protocol.HDR_KIND: "call",
+                protocol.HDR_WIRE: "envelope",
+                protocol.HDR_ROUTE: route,
+                protocol.HDR_TASK: task_id,
+                protocol.HDR_CORRELATION: correlation_id,
+            },
+        )
+
+
+class AgentGateway(Generic[OutputT]):
+    """Typed per-agent verbs (reference: client/gateway.py:32-120)."""
+
+    def __init__(self, client: Client, name: str, output_type: type[OutputT]):
+        self._client = client
+        self.name = name
+        self.output_type = output_type
+        self.input_topic = protocol.agent_input_topic(name)
+
+    def _build_state(
+        self, message_history: list[ModelMessage] | None
+    ) -> State:
+        return State(message_history=list(message_history or []))
+
+    @staticmethod
+    def _as_parts(prompt: str | list[ContentPart]) -> list[ContentPart]:
+        if isinstance(prompt, str):
+            return [TextPart(text=prompt)]
+        return list(prompt)
+
+    async def start(
+        self,
+        prompt: str | list[ContentPart],
+        *,
+        message_history: list[ModelMessage] | None = None,
+        deps: dict[str, Any] | None = None,
+        route: str = "run",
+        timeout: float | None = None,
+    ) -> InvocationHandle[OutputT]:
+        """Begin a run; returns a handle (reference: gateway.py:70)."""
+        client = self._client
+        await client._ensure_started()
+        correlation_id = new_id()
+        task_id = new_id()
+        # register BEFORE publish: the reply cannot beat the handle
+        channel = client._hub.track(correlation_id, task_id)
+        handle: InvocationHandle[OutputT] = InvocationHandle(
+            channel,
+            self.output_type,
+            default_timeout=timeout if timeout is not None else client.default_timeout,
+        )
+        await client._publish_call(
+            self.input_topic,
+            self._as_parts(prompt),
+            route=route,
+            correlation_id=correlation_id,
+            task_id=task_id,
+            state=self._build_state(message_history),
+            deps=deps or {},
+        )
+        return handle
+
+    async def send(
+        self,
+        prompt: str | list[ContentPart],
+        *,
+        message_history: list[ModelMessage] | None = None,
+        deps: dict[str, Any] | None = None,
+        route: str = "run",
+    ) -> str:
+        """Fire-and-forget; returns the correlation id (reference:
+        gateway.py 'send' — the fire token)."""
+        handle = await self.start(
+            prompt, message_history=message_history, deps=deps, route=route
+        )
+        return handle.correlation_id
+
+    async def execute(
+        self,
+        prompt: str | list[ContentPart],
+        *,
+        message_history: list[ModelMessage] | None = None,
+        deps: dict[str, Any] | None = None,
+        route: str = "run",
+        timeout: float | None = None,
+    ) -> InvocationResult[OutputT]:
+        handle = await self.start(
+            prompt,
+            message_history=message_history,
+            deps=deps,
+            route=route,
+            timeout=timeout,
+        )
+        return await handle.result()

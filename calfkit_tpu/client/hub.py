@@ -1,0 +1,244 @@
+"""The client hub: one groupless inbox subscriber demuxing replies and steps
+by correlation id into weakly-held run channels.
+
+Reference: calfkit/client/hub.py:89-426.  Invariants preserved:
+
+- a handle is registered BEFORE the call publishes (race-free: the reply
+  cannot beat the registration);
+- channels are weakly held — an abandoned handle stops consuming memory;
+- cancel-safe: ``result()``/``stream()`` can be cancelled without corrupting
+  the channel; a late reply to a dead handle goes to the firehose only.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import weakref
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Generic, TypeVar
+
+from calfkit_tpu import protocol
+from calfkit_tpu.exceptions import ClientTimeoutError, NodeFaultError
+from calfkit_tpu.mesh.transport import Record
+from calfkit_tpu.models.error_report import ErrorReport, FaultTypes
+from calfkit_tpu.models.node_result import InvocationResult
+from calfkit_tpu.models.reply import FaultMessage, ReturnMessage
+from calfkit_tpu.models.session_context import Envelope
+from calfkit_tpu.models.step import StepEvent, StepMessage
+
+logger = logging.getLogger(__name__)
+
+OutputT = TypeVar("OutputT")
+
+
+@dataclass
+class RunCompleted:
+    envelope: Envelope
+    headers: dict[str, str]
+
+
+@dataclass
+class RunFailed:
+    report: ErrorReport
+    envelope: Envelope | None = None
+
+
+Terminal = RunCompleted | RunFailed
+
+
+@dataclass
+class _RunChannel:
+    correlation_id: str
+    task_id: str
+    steps: asyncio.Queue[StepEvent] = field(
+        default_factory=lambda: asyncio.Queue(maxsize=1024)
+    )
+    terminal: asyncio.Future[Terminal] = field(
+        default_factory=lambda: asyncio.get_running_loop().create_future()
+    )
+
+    def push_step(self, event: StepEvent) -> None:
+        try:
+            self.steps.put_nowait(event)
+        except asyncio.QueueFull:
+            # drop-oldest: the terminal result matters more than telemetry
+            with contextlib.suppress(asyncio.QueueEmpty, asyncio.QueueFull):
+                self.steps.get_nowait()
+                self.steps.put_nowait(event)
+
+    def complete(self, terminal: Terminal) -> None:
+        if not self.terminal.done():
+            self.terminal.set_result(terminal)
+
+
+class InvocationHandle(Generic[OutputT]):
+    """The caller's grip on one in-flight run."""
+
+    def __init__(
+        self,
+        channel: _RunChannel,
+        output_type: type[OutputT],
+        *,
+        default_timeout: float | None = None,
+    ):
+        self._channel = channel
+        self._output_type = output_type
+        self._default_timeout = default_timeout
+
+    @property
+    def correlation_id(self) -> str:
+        return self._channel.correlation_id
+
+    @property
+    def task_id(self) -> str:
+        return self._channel.task_id
+
+    async def result(self, timeout: float | None = None) -> InvocationResult[OutputT]:
+        """Await the terminal reply; faults raise :class:`NodeFaultError`."""
+        timeout = timeout if timeout is not None else self._default_timeout
+        try:
+            terminal = await asyncio.wait_for(
+                asyncio.shield(self._channel.terminal), timeout
+            )
+        except asyncio.TimeoutError:
+            raise ClientTimeoutError(
+                f"run {self.correlation_id[:8]} produced no terminal reply "
+                f"within {timeout}s"
+            ) from None
+        if isinstance(terminal, RunFailed):
+            raise NodeFaultError(terminal.report)
+        return InvocationResult.from_envelope(
+            terminal.envelope,
+            self._output_type,
+            correlation_id=self.correlation_id,
+            task_id=self.task_id,
+        )
+
+    async def stream(
+        self, timeout: float | None = None
+    ) -> AsyncIterator[StepEvent | InvocationResult[OutputT]]:
+        """Yield step events live, ending with the typed result."""
+        timeout = timeout if timeout is not None else self._default_timeout
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout if timeout is not None else None
+        while True:
+            remaining: float | None = None
+            if deadline is not None:
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    raise ClientTimeoutError(
+                        f"run {self.correlation_id[:8]} stream timed out"
+                    )
+            step_task = asyncio.ensure_future(self._channel.steps.get())
+            try:
+                done, _ = await asyncio.wait(
+                    [step_task, self._channel.terminal],
+                    timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+            except asyncio.CancelledError:
+                step_task.cancel()
+                raise
+            if not done:
+                step_task.cancel()
+                raise ClientTimeoutError(
+                    f"run {self.correlation_id[:8]} stream timed out"
+                )
+            if step_task in done:
+                yield step_task.result()
+                continue
+            step_task.cancel()
+            # drain any steps that raced the terminal
+            while not self._channel.steps.empty():
+                yield self._channel.steps.get_nowait()
+            terminal = self._channel.terminal.result()
+            if isinstance(terminal, RunFailed):
+                raise NodeFaultError(terminal.report)
+            yield InvocationResult.from_envelope(
+                terminal.envelope,
+                self._output_type,
+                correlation_id=self.correlation_id,
+                task_id=self.task_id,
+            )
+            return
+
+
+class Hub:
+    """Demuxes the client inbox into run channels + the firehose tee."""
+
+    def __init__(self) -> None:
+        self._channels: weakref.WeakValueDictionary[str, _RunChannel] = (
+            weakref.WeakValueDictionary()
+        )
+        self._firehose_taps: list[Any] = []  # EventStream instances
+
+    def track(self, correlation_id: str, task_id: str) -> _RunChannel:
+        channel = _RunChannel(correlation_id=correlation_id, task_id=task_id)
+        self._channels[correlation_id] = channel
+        return channel
+
+    def add_tap(self, tap: Any) -> None:
+        self._firehose_taps.append(tap)
+
+    def remove_tap(self, tap: Any) -> None:
+        if tap in self._firehose_taps:
+            self._firehose_taps.remove(tap)
+
+    # ----------------------------------------------------------- dispatch
+    async def on_record(self, record: Record) -> None:
+        headers = record.headers
+        correlation_id = headers.get(protocol.HDR_CORRELATION)
+        if headers.get(protocol.HDR_WIRE) == "step":
+            self._on_step(record, correlation_id)
+            return
+        self._on_reply(record, correlation_id, headers)
+
+    def _on_step(self, record: Record, correlation_id: str | None) -> None:
+        try:
+            message = StepMessage.from_wire(record.value)
+        except ValueError:
+            logger.debug("undecodable step message dropped")
+            return
+        for step in message.steps:
+            event = StepEvent(
+                correlation_id=correlation_id or "",
+                task_id=record.headers.get(protocol.HDR_TASK),
+                node=message.emitter or None,
+                step=step,
+            )
+            channel = self._channels.get(correlation_id or "")
+            if channel is not None:
+                channel.push_step(event)
+            for tap in self._firehose_taps:
+                tap.push(event)
+
+    def _on_reply(
+        self, record: Record, correlation_id: str | None, headers: dict[str, str]
+    ) -> None:
+        try:
+            envelope = Envelope.from_wire(record.value)
+        except ValueError:
+            logger.warning("undecodable reply on client inbox dropped")
+            return
+        channel = self._channels.get(correlation_id or "")
+        if channel is None:
+            logger.debug(
+                "reply for unknown/abandoned run %s", (correlation_id or "?")[:8]
+            )
+            return
+        reply = envelope.reply
+        if isinstance(reply, ReturnMessage):
+            channel.complete(RunCompleted(envelope=envelope, headers=headers))
+        elif isinstance(reply, FaultMessage):
+            channel.complete(RunFailed(report=reply.report, envelope=envelope))
+        else:
+            channel.complete(
+                RunFailed(
+                    report=ErrorReport.build_safe(
+                        FaultTypes.DESERIALIZATION_ERROR,
+                        "terminal record carried no reply",
+                    )
+                )
+            )

@@ -1,5 +1,7 @@
 """Node kinds over the shared kernel (SURVEY.md §1 layers 2-3)."""
 
+from calfkit_tpu.nodes.agent import Agent, BaseAgentNodeDef, StatelessAgent
+
 from calfkit_tpu.nodes.base import BaseNodeDef, NodeRunContext, handler
 from calfkit_tpu.nodes.consumer import ConsumerContext, ConsumerNode, consumer
 from calfkit_tpu.nodes.fanout_store import (
@@ -25,6 +27,8 @@ from calfkit_tpu.nodes.tool import (
 )
 
 __all__ = [
+    "Agent",
+    "BaseAgentNodeDef",
     "BaseNodeDef",
     "ConsumerContext",
     "ConsumerNode",
@@ -40,6 +44,7 @@ __all__ = [
     "Observed",
     "RegistryMixin",
     "Said",
+    "StatelessAgent",
     "ToolNodeDef",
     "Tools",
     "agent_tool",

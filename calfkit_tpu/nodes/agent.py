@@ -1,0 +1,356 @@
+"""The Agent node: one model turn per delivery, tools dispatched as mesh
+calls.
+
+Reference: calfkit/nodes/agent.py:80-1031.  The hot loop (SURVEY.md §3.3):
+
+    delivery(call)   → stage user prompt → model turn
+    model turn       → tool calls?  dispatch as Call/fan-out (tag =
+                       tool_call_id, marker-stamped) and suspend
+                     → final?      ReturnCall with text/structured parts
+    delivery(return) → materialized tool_results → next model turn
+
+State discipline: the staged request (user prompt or tool-returns) is
+committed to ``message_history`` only after a successful model turn, so a
+redelivered hop cannot double-commit; in-flight ``tool_calls`` /
+``tool_results`` live in :class:`State` and ride the wire.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Sequence
+
+from pydantic_core import to_jsonable_python
+
+from calfkit_tpu import protocol
+from calfkit_tpu.engine.model_client import ModelClient, ModelSettings
+from calfkit_tpu.engine.turn import FINAL_RESULT_TOOL, TurnOutcome, run_turn
+from calfkit_tpu.exceptions import NodeFaultError
+from calfkit_tpu.models.actions import Call, NodeResult, ReturnCall
+from calfkit_tpu.models.agents import AgentCard
+from calfkit_tpu.models.capability import CapabilityRecord
+from calfkit_tpu.models.error_report import ErrorReport, FaultTypes
+from calfkit_tpu.models.marker import ToolCallMarker
+from calfkit_tpu.models.messages import (
+    ModelRequest,
+    RetryPart,
+    ToolReturnPart,
+    UserPart,
+)
+from calfkit_tpu.models.payload import DataPart, TextPart, render_parts_as_text
+from calfkit_tpu.models.tool_dispatch import ToolBinding, ToolCallRef
+from calfkit_tpu.nodes.base import BaseNodeDef, NodeRunContext, handler
+from calfkit_tpu.nodes.steps import DeniedCall, Fact, InferenceFact, Observed, Said
+from calfkit_tpu.nodes.tool import ToolNodeDef, eager_tools
+
+Instructions = str | Callable[[NodeRunContext], str]
+ToolsSpec = Any  # ToolNodeDef list | ToolBinding list | selector with .resolve()
+
+CAPABILITY_VIEW_KEY = "capability_view"
+
+
+class BaseAgentNodeDef(BaseNodeDef):
+    kind = "agent"
+
+    def __init__(
+        self,
+        name: str,
+        *,
+        model: ModelClient,
+        instructions: Instructions | None = None,
+        tools: ToolsSpec = (),
+        output_type: type = str,
+        description: str = "",
+        model_settings: ModelSettings | None = None,
+        max_output_retries: int = 2,
+        **seams: Any,
+    ):
+        super().__init__(name, **seams)
+        self.model = model
+        self.instructions = instructions
+        self.tools = tools
+        self.output_type = output_type
+        self.description = description
+        self.model_settings = model_settings
+        self.max_output_retries = max_output_retries
+
+    # ------------------------------------------------------------- topics
+    def input_topics(self) -> list[str]:
+        return [protocol.agent_input_topic(self.name)]
+
+    def return_topic(self) -> str:
+        return protocol.agent_return_topic(self.name)
+
+    def publish_topic(self) -> str | None:
+        return protocol.agent_publish_topic(self.name)
+
+    # -------------------------------------------------------- control plane
+    def agent_card(self) -> AgentCard:
+        return AgentCard(
+            name=self.name,
+            description=self.description,
+            structured_output=self.output_type is not str,
+        )
+
+    # ------------------------------------------------------ tool resolution
+    def _resolve_tools(self, ctx: NodeRunContext) -> list[ToolBinding]:
+        """Per-turn resolution (reference: agent.py:621 — selectors resolve
+        against the live capability view each turn)."""
+        spec = self.tools
+        if not spec:
+            return []
+        if isinstance(spec, (list, tuple)):
+            bindings: list[ToolBinding] = []
+            node_defs = [t for t in spec if isinstance(t, ToolNodeDef)]
+            bindings.extend(eager_tools(*node_defs))
+            bindings.extend(t for t in spec if isinstance(t, ToolBinding))
+            return bindings
+        if hasattr(spec, "resolve"):
+            records = self._capability_records(ctx)
+            return spec.resolve(records)
+        raise NodeFaultError(
+            ErrorReport.build_safe(
+                FaultTypes.LIFECYCLE_ERROR,
+                f"unsupported tools spec {type(spec).__name__}",
+                node=self.node_id,
+            )
+        )
+
+    def _capability_records(self, ctx: NodeRunContext) -> list[CapabilityRecord]:
+        view = ctx.resource(CAPABILITY_VIEW_KEY)
+        if view is None:
+            raise NodeFaultError(
+                ErrorReport.build_safe(
+                    FaultTypes.CAPABILITY_UNAVAILABLE,
+                    f"{self.node_id} uses a discovery selector but no "
+                    "capability view is attached (control plane not running?)",
+                    node=self.node_id,
+                )
+            )
+        return view.records()
+
+    # ---------------------------------------------------------------- body
+    _MAX_REJECTED_LOOPS = 3
+
+    @handler("run")
+    async def run(self, ctx: NodeRunContext) -> NodeResult | Observed:
+        for _ in range(self._MAX_REJECTED_LOOPS):
+            try:
+                return await self._run_one_turn(ctx)
+            except _AllCallsRejected:
+                # tool_results already hold retry parts; loop = next model
+                # turn within this same hop
+                ctx.delivery_kind = "return"
+                continue
+        raise NodeFaultError(
+            ErrorReport.build_safe(
+                FaultTypes.VALIDATION_ERROR,
+                f"{self.node_id}: model repeated invalid tool calls "
+                f"{self._MAX_REJECTED_LOOPS} times",
+                node=self.node_id,
+            )
+        )
+
+    async def _run_one_turn(self, ctx: NodeRunContext) -> NodeResult | Observed:
+        state = ctx.state
+        facts: list[Fact] = []
+
+        # ---- build the staged request for this hop
+        if ctx.delivery_kind == "call":
+            if state.uncommitted_message is not None:
+                # a client-staged prompt (or a redelivered hop) already rides
+                # in the state; reuse it instead of double-staging
+                staged = state.uncommitted_message
+            else:
+                parts = ctx.payload
+                content = render_parts_as_text(parts) if parts else ""
+                staged = ModelRequest(parts=[UserPart(content=content)])
+                state.uncommitted_message = staged
+            state.clear_inflight()
+        else:
+            staged = self._tool_results_request(ctx)
+
+        # ---- resolve tools & instructions
+        bindings = self._resolve_tools(ctx)
+        self._guard_reserved_names(bindings)
+        instructions = self._render_instructions(ctx)
+        request = staged.model_copy(update={"instructions": instructions})
+        messages = list(state.message_history) + [request]
+
+        # ---- ONE model turn
+        started = time.perf_counter()
+        outcome: TurnOutcome = await run_turn(
+            self.model,
+            messages,
+            tool_defs=[b.tool for b in bindings],
+            output_type=self.output_type,
+            settings=self.model_settings,
+            author=self.name,
+            max_output_retries=self.max_output_retries,
+        )
+        elapsed_ms = (time.perf_counter() - started) * 1000.0
+        facts.append(
+            InferenceFact(
+                model_name=self.model.model_name,
+                decode_ms=elapsed_ms,
+                prompt_tokens=outcome.usage.input_tokens,
+                generated_tokens=outcome.usage.output_tokens,
+            )
+        )
+
+        # ---- commit the hop's messages (staged request + model output)
+        state.message_history.append(staged)
+        state.message_history.extend(outcome.new_messages)
+        state.uncommitted_message = None
+        state.clear_inflight()
+
+        text = outcome.response.text()
+        if text:
+            facts.append(Said(text=text, author=self.name))
+
+        # ---- dispatch or finalize
+        if outcome.tool_calls:
+            action = self._dispatch_tool_calls(ctx, bindings, outcome, facts)
+            return Observed(action=action, facts=facts)
+        return Observed(action=self._final_action(outcome), facts=facts)
+
+    # ------------------------------------------------------------- helpers
+    def _tool_results_request(self, ctx: NodeRunContext) -> ModelRequest:
+        """The re-entry request: every in-flight call's materialized result,
+        in dispatch order (reference: agent.py:662 DeferredToolResults)."""
+        state = ctx.state
+        parts: list[Any] = []
+        for call_id in state.tool_calls:
+            result = state.tool_results.get(call_id)
+            if result is None:
+                call = state.tool_calls[call_id]
+                result = RetryPart(
+                    content="No result was produced for this tool call.",
+                    tool_call_id=call_id,
+                    tool_name=call.tool_name,
+                )
+            parts.append(result)
+        if not parts:
+            raise NodeFaultError(
+                ErrorReport.build_safe(
+                    FaultTypes.STRAY_REPLY,
+                    f"{self.node_id} re-entered with no in-flight tool calls",
+                    node=self.node_id,
+                    route=ctx.route,
+                )
+            )
+        return ModelRequest(parts=parts)
+
+    def _render_instructions(self, ctx: NodeRunContext) -> str | None:
+        base = self.instructions
+        rendered = base(ctx) if callable(base) else base
+        temp = ctx.state.temp_instructions
+        if temp:
+            rendered = f"{rendered}\n\n{temp}" if rendered else temp
+        return rendered
+
+    def _guard_reserved_names(self, bindings: list[ToolBinding]) -> None:
+        if self.output_type is not str:
+            for binding in bindings:
+                if binding.tool.name == FINAL_RESULT_TOOL:
+                    raise NodeFaultError(
+                        ErrorReport.build_safe(
+                            FaultTypes.LIFECYCLE_ERROR,
+                            f"tool name {FINAL_RESULT_TOOL!r} is reserved for "
+                            "structured output",
+                            node=self.node_id,
+                        )
+                    )
+
+    def _dispatch_tool_calls(
+        self,
+        ctx: NodeRunContext,
+        bindings: list[ToolBinding],
+        outcome: TurnOutcome,
+        facts: list[Fact],
+    ) -> NodeResult:
+        """Validate each model call and build the Call batch; invalid calls
+        become immediate retry results instead of dispatches (reference:
+        agent.py:733-932)."""
+        state = ctx.state
+        by_name = {b.tool.name: b for b in bindings}
+        calls: list[Call] = []
+        for tool_call in outcome.tool_calls:
+            state.tool_calls[tool_call.tool_call_id] = tool_call
+            binding = by_name.get(tool_call.tool_name)
+            if binding is None:
+                state.tool_results[tool_call.tool_call_id] = RetryPart(
+                    content=f"Unknown tool {tool_call.tool_name!r}. Available: "
+                    f"{sorted(by_name)}",
+                    tool_call_id=tool_call.tool_call_id,
+                    tool_name=tool_call.tool_name,
+                )
+                facts.append(
+                    DeniedCall(
+                        tool_call_id=tool_call.tool_call_id,
+                        tool_name=tool_call.tool_name,
+                        reason="unknown tool",
+                    )
+                )
+                continue
+            try:
+                args = tool_call.args_dict()
+            except ValueError as exc:
+                state.tool_results[tool_call.tool_call_id] = RetryPart(
+                    content=f"Malformed arguments for {tool_call.tool_name}: {exc}",
+                    tool_call_id=tool_call.tool_call_id,
+                    tool_name=tool_call.tool_name,
+                )
+                facts.append(
+                    DeniedCall(
+                        tool_call_id=tool_call.tool_call_id,
+                        tool_name=tool_call.tool_name,
+                        reason=f"malformed arguments: {exc}",
+                    )
+                )
+                continue
+            ref = ToolCallRef(
+                tool_call_id=tool_call.tool_call_id,
+                tool_name=tool_call.tool_name,
+                args=args,
+            )
+            calls.append(
+                Call(
+                    target_topic=binding.dispatch_topic,
+                    route="run",
+                    parts=[DataPart(data=ref.model_dump())],
+                    tag=tool_call.tool_call_id,
+                    marker=ToolCallMarker(
+                        tool_call_id=tool_call.tool_call_id,
+                        tool_name=tool_call.tool_name,
+                    ),
+                )
+            )
+        if not calls:
+            # every call was rejected pre-dispatch: absorb this pass's facts
+            # (DeniedCall pairs, inference metrics) so they aren't lost, then
+            # loop into another model turn on this same hop (bounded)
+            ctx.ledger.absorb(facts)
+            facts.clear()
+            raise _AllCallsRejected()
+        return calls if len(calls) > 1 else calls[0]
+
+    def _final_action(self, outcome: TurnOutcome) -> ReturnCall:
+        output = outcome.output
+        if self.output_type is str:
+            return ReturnCall(parts=[TextPart(text=output or "")])
+        return ReturnCall(parts=[DataPart(data=to_jsonable_python(output))])
+
+
+class _AllCallsRejected(Exception):
+    """Internal: every model tool call was denied pre-dispatch; the base
+    run() loop catches this and runs another turn on the same hop."""
+
+
+class Agent(BaseAgentNodeDef):
+    """The durable-conversation agent (per-run state rides the wire)."""
+
+
+class StatelessAgent(Agent):
+    """Alias reserved for the future durable-thread-memory split
+    (reference: agent.py:1023-1031 naming)."""

@@ -652,6 +652,11 @@ class BaseNodeDef(RegistryMixin):
                 tag=frame.tag,
                 marker=frame.marker,
             )
+            # steps flush BEFORE the terminal reply: both land on the same
+            # topic+key, so per-key ordering guarantees stream consumers see
+            # every step before the result on any broker (reference order:
+            # base.py:1982 flush precedes the action publish)
+            await self._flush_steps(ctx)
             await self._publish_envelope(
                 ctx, frame.callback_topic, envelope, kind="return", route=frame.route
             )
@@ -684,6 +689,7 @@ class BaseNodeDef(RegistryMixin):
         report = report.model_copy(
             update={"frame_chain": ([frame.frame_id] + report.frame_chain)[:32]}
         )
+        await self._flush_steps(ctx)  # steps precede the fault (same key)
         # the state-elision ladder: full -> no tracebacks -> minimal+elide
         budget = self.transport.max_message_bytes
         attempts = [

@@ -1,0 +1,6 @@
+"""The serving host (SURVEY.md §1 layer 7)."""
+
+from calfkit_tpu.worker.lifecycle import LifecycleHookMixin
+from calfkit_tpu.worker.worker import Worker
+
+__all__ = ["LifecycleHookMixin", "Worker"]

@@ -1,0 +1,168 @@
+"""The Worker: hosts nodes on a shared mesh transport.
+
+Reference: calfkit/worker/worker.py:40-746.  Responsibilities:
+
+- register each node's key-ordered subscriber (input + return topics, one
+  consumer group per node name → horizontal scaling via group membership);
+- provision topics at boot;
+- provide per-node durable fan-out stores;
+- run the lifecycle brackets (see :mod:`calfkit_tpu.worker.lifecycle`) with
+  rollback on failed boot;
+- wire the control plane (adverts + heartbeats + views) when available;
+- three run surfaces: ``run()`` (blocking), ``start()/stop()``,
+  ``async with``.
+
+Workers are single-use objects (a stopped worker is not restartable),
+matching the reference's stance (worker.py:628).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import signal
+from typing import Any, Sequence
+
+from calfkit_tpu.exceptions import LifecycleConfigError
+from calfkit_tpu.mesh.transport import MeshTransport, Subscription
+from calfkit_tpu.nodes.base import BaseNodeDef
+from calfkit_tpu.nodes.fanout_store import FANOUT_STORE_KEY, KtablesFanoutBatchStore
+from calfkit_tpu.worker.lifecycle import LifecycleHookMixin
+
+logger = logging.getLogger(__name__)
+
+
+class Worker(LifecycleHookMixin):
+    def __init__(
+        self,
+        nodes: Sequence[BaseNodeDef],
+        *,
+        mesh: MeshTransport,
+        group_id: str | None = None,
+        max_workers: int = 8,
+        owns_transport: bool = False,
+        control_plane: Any = None,
+    ):
+        super().__init__()
+        if not nodes:
+            raise LifecycleConfigError("Worker requires at least one node")
+        names = [n.name for n in nodes]
+        if len(set(names)) != len(names):
+            raise LifecycleConfigError(f"duplicate node names: {names}")
+        self.nodes = list(nodes)
+        self.mesh = mesh
+        self.group_id = group_id
+        self.max_workers = max_workers
+        self.owns_transport = owns_transport
+        self.control_plane = control_plane
+        self.resources: dict[str, Any] = {}
+        self._subscriptions: list[Subscription] = []
+        self._stores: list[KtablesFanoutBatchStore] = []
+        self._state = "new"  # new -> serving -> stopped
+        self._advertiser: Any = None
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        if self._state != "new":
+            raise LifecycleConfigError(
+                f"workers are single-use; this one is {self._state!r}"
+            )
+        try:
+            await self._boot()
+        except BaseException:
+            logger.exception("worker boot failed; rolling back")
+            await self._teardown(rollback=True)
+            raise
+        self._state = "serving"
+
+    async def _boot(self) -> None:
+        await self._run_hooks(self._on_startup, phase="on_startup")
+        await self._enter_resources(self.resources)
+        await self.mesh.start()
+
+        # provision every topic the nodes touch
+        topics: list[str] = []
+        for node in self.nodes:
+            topics.extend(node.all_topics())
+        await self.mesh.ensure_topics(sorted(set(topics)))
+
+        for node in self.nodes:
+            node.bind(self.mesh)
+            node.resources.setdefault("worker", self)
+            for key, value in self.resources.items():
+                node.resources.setdefault(key, value)
+            if FANOUT_STORE_KEY not in node.resources:
+                store = KtablesFanoutBatchStore(self.mesh, node.node_id)
+                await store.start()
+                self._stores.append(store)
+                node.resources[FANOUT_STORE_KEY] = store
+            subscribe_topics = list(node.input_topics()) + [node.return_topic()]
+            subscription = await self.mesh.subscribe(
+                subscribe_topics,
+                node.handler,
+                group_id=self.group_id or node.name,
+                max_workers=self.max_workers,
+            )
+            self._subscriptions.append(subscription)
+
+        # control plane: adverts + heartbeats + views (present from layer 7 on)
+        if self.control_plane is not None:
+            self._advertiser = await self.control_plane.attach(self)
+
+        await self._run_hooks(self._after_startup, phase="after_startup")
+
+    async def stop(self) -> None:
+        if self._state == "stopped":
+            return
+        self._state = "stopped"
+        await self._teardown(rollback=False)
+
+    async def _teardown(self, *, rollback: bool) -> None:
+        with contextlib.suppress(Exception):
+            await self._run_hooks(self._on_shutdown, phase="on_shutdown")
+        if self._advertiser is not None:
+            with contextlib.suppress(Exception):
+                await self._advertiser.stop()  # tombstones before drain
+            self._advertiser = None
+        for subscription in self._subscriptions:
+            with contextlib.suppress(Exception):
+                await subscription.stop()
+        self._subscriptions = []
+        for store in self._stores:
+            with contextlib.suppress(Exception):
+                await store.stop()
+        self._stores = []
+        with contextlib.suppress(Exception):
+            await self._run_hooks(self._after_shutdown, phase="after_shutdown")
+        await self._exit_resources()
+        if self.owns_transport:
+            with contextlib.suppress(Exception):
+                await self.mesh.stop()
+        if rollback:
+            self._state = "stopped"
+
+    # --------------------------------------------------------- run surfaces
+    async def __aenter__(self) -> "Worker":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        await self.stop()
+
+    async def serve_forever(self) -> None:
+        """Start and serve until cancelled (SIGINT/SIGTERM aware)."""
+        await self.start()
+        stop_event = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            with contextlib.suppress(NotImplementedError, RuntimeError):
+                loop.add_signal_handler(sig, stop_event.set)
+        try:
+            await stop_event.wait()
+        finally:
+            await self.stop()
+
+    def run(self) -> None:
+        """Blocking entrypoint: boot, serve until SIGINT/SIGTERM, drain."""
+        asyncio.run(self.serve_forever())

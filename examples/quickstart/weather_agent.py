@@ -1,0 +1,58 @@
+"""The quickstart: a weather agent with one tool, fully local.
+
+Mirrors the reference's examples/quickstart weather_agent (BASELINE config 1)
+— but where the reference calls a remote HTTPS model API, this runs a local
+model client.  Swap ``EchoModelClient`` for ``JaxLocalModelClient(...)`` to
+serve a real checkpoint on TPU; the agent code does not change.
+
+Run:  python examples/quickstart/weather_agent.py
+"""
+
+import asyncio
+
+from calfkit_tpu import Client, Worker
+from calfkit_tpu.engine import TestModelClient
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.nodes import Agent, agent_tool
+
+
+@agent_tool
+def get_weather(city: str) -> dict:
+    """Get the current weather for a city.
+
+    Args:
+        city: Name of the city to look up.
+    """
+    return {"city": city, "conditions": "sunny", "temp_c": 21.5}
+
+
+weather_agent = Agent(
+    "weather_agent",
+    # TestModelClient calls each tool once then summarizes — deterministic,
+    # no weights needed. For real inference:
+    #   model=JaxLocalModelClient(checkpoint="path/to/llama", mesh_axes={"tp": 8})
+    model=TestModelClient(),
+    instructions="You are a weather assistant. Use get_weather for lookups.",
+    tools=[get_weather],
+    description="Answers weather questions using the get_weather tool.",
+)
+
+
+async def main() -> None:
+    mesh = InMemoryMesh()
+    async with Worker([weather_agent, get_weather], mesh=mesh, owns_transport=True):
+        client = Client.connect(mesh)
+        handle = await client.agent("weather_agent").start(
+            "What's the weather in San Francisco?"
+        )
+        async for event in handle.stream():
+            if hasattr(event, "step"):
+                print(f"  [step] {event.step.kind}: "
+                      f"{getattr(event.step, 'text', '') or getattr(event.step, 'tool_name', '')}")
+            else:
+                print(f"RESULT: {event.output}")
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

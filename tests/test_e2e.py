@@ -1,0 +1,255 @@
+"""End-to-end slice: Client + Worker + Agent + ToolNode on the in-memory mesh
+(BASELINE config 1 analog) plus fault/timeout surfaces at the client."""
+
+import asyncio
+
+import pytest
+from pydantic import BaseModel
+
+from calfkit_tpu.client import Client
+from calfkit_tpu.engine import EchoModelClient, FunctionModelClient, TestModelClient
+from calfkit_tpu.exceptions import ClientTimeoutError, NodeFaultError
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models import FaultTypes, ModelResponse, TextOutput, ToolCallOutput
+from calfkit_tpu.models.node_result import InvocationResult
+from calfkit_tpu.nodes import Agent, agent_tool
+from calfkit_tpu.worker import Worker
+
+
+@agent_tool
+def get_weather(city: str) -> dict:
+    """Get current weather.
+
+    Args:
+        city: City name.
+    """
+    return {"city": city, "conditions": "sunny", "temp_c": 21.5}
+
+
+class TestQuickstart:
+    async def test_single_tool_single_turn(self):
+        mesh = InMemoryMesh()
+        agent = Agent(
+            "weather",
+            model=TestModelClient(custom_output_text="It is sunny in SF, 21.5C"),
+            instructions="Weather assistant.",
+            tools=[get_weather],
+        )
+        async with Worker([agent, get_weather], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("weather").execute(
+                "Weather in SF?", timeout=10
+            )
+            assert result.output == "It is sunny in SF, 21.5C"
+            # conversation state came back: user msg, tool call, tool return,
+            # final answer
+            roles = [m.role for m in result.state.message_history]
+            assert roles == ["request", "response", "request", "response"]
+            await client.close()
+
+    async def test_streaming_steps_then_result(self):
+        mesh = InMemoryMesh()
+        agent = Agent(
+            "streamer",
+            model=TestModelClient(custom_output_text="done"),
+            tools=[get_weather],
+        )
+        async with Worker([agent, get_weather], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            handle = await client.agent("streamer").start("go", timeout=10)
+            events = [e async for e in handle.stream()]
+            kinds = [e.step.kind for e in events if hasattr(e, "step")]
+            assert "tool_call" in kinds and "tool_result" in kinds
+            final = events[-1]
+            assert isinstance(final, InvocationResult) and final.output == "done"
+            await client.close()
+
+    async def test_structured_output(self):
+        class Weather(BaseModel):
+            city: str
+            temp_c: float
+
+        def scripted(messages, params):
+            assert params.output_tool is not None
+            return ModelResponse(parts=[
+                ToolCallOutput(tool_call_id="1", tool_name="final_result",
+                               args={"city": "SF", "temp_c": 18.5})
+            ])
+
+        mesh = InMemoryMesh()
+        agent = Agent(
+            "typed", model=FunctionModelClient(scripted), output_type=Weather
+        )
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("typed", output_type=Weather).execute(
+                "weather?", timeout=10
+            )
+            assert result.output.city == "SF" and result.output.temp_c == 18.5
+            await client.close()
+
+    async def test_multi_turn_with_history(self):
+        mesh = InMemoryMesh()
+        agent = Agent("chat", model=EchoModelClient())
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            gateway = client.agent("chat")
+            r1 = await gateway.execute("first", timeout=10)
+            assert r1.output == "echo: first"
+            r2 = await gateway.execute(
+                "second", message_history=r1.state.message_history, timeout=10
+            )
+            assert r2.output == "echo: second"
+            assert len(r2.state.message_history) == 4  # both turns retained
+            await client.close()
+
+    async def test_parallel_tool_calls_fanout(self):
+        @agent_tool
+        def city_temp(city: str) -> float:
+            """Temperature lookup.
+
+            Args:
+                city: City name.
+            """
+            return {"sf": 18.0, "nyc": 25.0}.get(city.lower(), 20.0)
+
+        turn = {"n": 0}
+
+        def scripted(messages, params):
+            turn["n"] += 1
+            if turn["n"] == 1:
+                return ModelResponse(parts=[
+                    ToolCallOutput(tool_call_id="a", tool_name="city_temp",
+                                   args={"city": "SF"}),
+                    ToolCallOutput(tool_call_id="b", tool_name="city_temp",
+                                   args={"city": "NYC"}),
+                ])
+            return ModelResponse(parts=[TextOutput(text="SF 18, NYC 25")])
+
+        mesh = InMemoryMesh()
+        agent = Agent(
+            "multi", model=FunctionModelClient(scripted), tools=[city_temp]
+        )
+        async with Worker([agent, city_temp], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("multi").execute("temps?", timeout=10)
+            assert result.output == "SF 18, NYC 25"
+            await client.close()
+
+
+class TestClientSurfaces:
+    async def test_fault_raises_typed_error(self):
+        @agent_tool
+        def bomb() -> str:
+            raise RuntimeError("tool exploded")
+
+        def scripted(messages, params):
+            return ModelResponse(parts=[
+                ToolCallOutput(tool_call_id="x", tool_name="bomb", args={})
+            ])
+
+        mesh = InMemoryMesh()
+        agent = Agent("bomber", model=FunctionModelClient(scripted), tools=[bomb])
+        async with Worker([agent, bomb], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            with pytest.raises(NodeFaultError) as exc_info:
+                await client.agent("bomber").execute("go", timeout=10)
+            report = exc_info.value.report
+            assert report.error_type == FaultTypes.CALLEE_FAULT
+            assert "tool exploded" in report.root_cause().message
+            await client.close()
+
+    async def test_timeout(self):
+        mesh = InMemoryMesh()
+        await mesh.start()  # no worker: nobody will reply
+        client = Client.connect(mesh)
+        with pytest.raises(ClientTimeoutError):
+            await client.agent("nobody").execute("hello", timeout=0.3)
+        await client.close()
+        await mesh.stop()
+
+    async def test_send_fire_and_forget(self):
+        mesh = InMemoryMesh()
+        agent = Agent("fire", model=EchoModelClient())
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            cid = await client.agent("fire").send("hello")
+            assert isinstance(cid, str) and len(cid) == 32
+            await asyncio.sleep(0.2)  # run completes without a listener
+            await client.close()
+
+    async def test_firehose_sees_all_runs(self):
+        mesh = InMemoryMesh()
+        agent = Agent(
+            "noisy", model=TestModelClient(custom_output_text="ok"),
+            tools=[get_weather],
+        )
+        async with Worker([agent, get_weather], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            stream = client.events()
+            await client.agent("noisy").execute("a", timeout=10)
+            await client.agent("noisy").execute("b", timeout=10)
+            await asyncio.sleep(0.2)
+            stream.close()
+            events = [e async for e in stream]  # close() ends iteration
+            cids = {e.correlation_id for e in events}
+            assert len(cids) == 2  # events from both runs hit the firehose
+            await client.close()
+
+
+class TestWorkerLifecycle:
+    async def test_single_use(self):
+        mesh = InMemoryMesh()
+        worker = Worker([Agent("once", model=EchoModelClient())], mesh=mesh)
+        await worker.start()
+        await worker.stop()
+        from calfkit_tpu.exceptions import LifecycleConfigError
+
+        with pytest.raises(LifecycleConfigError):
+            await worker.start()
+        await mesh.stop()
+
+    async def test_resource_brackets_and_rollback(self):
+        mesh = InMemoryMesh()
+        log = []
+        worker = Worker([Agent("r", model=EchoModelClient())], mesh=mesh)
+
+        @worker.resource
+        async def db():
+            log.append("db-up")
+            yield {"conn": 1}
+            log.append("db-down")
+
+        @worker.on_startup
+        def hello():
+            log.append("startup")
+
+        @worker.after_shutdown
+        def bye():
+            log.append("after-shutdown")
+
+        await worker.start()
+        assert worker.resources["db"] == {"conn": 1}
+        await worker.stop()
+        assert log == ["startup", "db-up", "after-shutdown", "db-down"]
+        await mesh.stop()
+
+    async def test_boot_failure_rolls_back(self):
+        mesh = InMemoryMesh()
+        log = []
+        worker = Worker([Agent("rb", model=EchoModelClient())], mesh=mesh)
+
+        @worker.resource
+        async def res():
+            log.append("up")
+            yield
+            log.append("down")
+
+        @worker.after_startup
+        def explode():
+            raise RuntimeError("boot failed")
+
+        with pytest.raises(RuntimeError):
+            await worker.start()
+        assert log == ["up", "down"]  # resource torn down by rollback
+        await mesh.stop()
