@@ -1,0 +1,126 @@
+"""Headline benchmark: agent-serving decode throughput on the local chip(s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": N}
+
+Baseline: 2000 decode tok/s/chip (BASELINE.md north star, stated for
+Llama-3-8B TP=8 on v5e-8).  This round measures the TinyLlama-1.1B
+architecture (BASELINE configs 2/3: the provider-swap model) under
+continuous batching on however many chips are visible; the metric name
+carries the exact config so rounds stay comparable.
+
+Uses the persistent XLA compilation cache — the first run on a machine pays
+compiles, later runs start hot.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+
+def _bench_config():
+    import jax
+
+    n_dev = len(jax.devices())
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # offline smoke mode: tiny model, tiny workload
+        return dict(
+            preset="debug", bs=8, max_seq=256, prefill_chunk=32,
+            steps=8, requests=8, new_tokens=32, prompt_len=16,
+        )
+    return dict(
+        preset="tinyllama-1.1b", bs=64, max_seq=1024, prefill_chunk=128,
+        steps=32, requests=64, new_tokens=128, prompt_len=64,
+    )
+
+
+async def run() -> dict:
+    import jax
+
+    from calfkit_tpu.inference.config import RuntimeConfig, preset
+    from calfkit_tpu.inference.engine import InferenceEngine
+
+    cfg = _bench_config()
+    n_dev = len(jax.devices())
+    model = preset(cfg["preset"], max_seq_len=cfg["max_seq"])
+    runtime = RuntimeConfig(
+        max_batch_size=cfg["bs"],
+        max_seq_len=cfg["max_seq"],
+        prefill_chunk=cfg["prefill_chunk"],
+        decode_steps_per_dispatch=cfg["steps"],
+        tp=1,
+        dp=1,
+    )
+    engine = InferenceEngine(model, runtime)
+    await engine.start()
+
+    # warm every specialization the measured run will touch
+    warm = [
+        t
+        async for t in engine.generate(
+            list(range(5, 5 + cfg["prompt_len"])),
+            max_new_tokens=cfg["new_tokens"],
+        )
+    ]
+    assert warm, "warmup produced no tokens"
+
+    stats = engine.stats
+    stats.decode_tokens = 0
+    stats.decode_time_s = 0.0
+    stats.decode_dispatches = 0
+    stats.occupancy_sum = 0.0
+
+    async def one(i: int) -> int:
+        n = 0
+        async for _ in engine.generate(
+            [3 + (i % 41), *range(7, 6 + cfg["prompt_len"])],
+            max_new_tokens=cfg["new_tokens"],
+        ):
+            n += 1
+        return n
+
+    started = time.perf_counter()
+    counts = await asyncio.gather(*[one(i) for i in range(cfg["requests"])])
+    wall = time.perf_counter() - started
+    await engine.stop()
+
+    total = sum(counts)
+    wall_tps = total / wall / n_dev
+    decode_tps = stats.tokens_per_second / n_dev
+    return {
+        "metric": (
+            f"decode_tok_s_per_chip[{model.name} bs={cfg['bs']} "
+            f"continuous-batching wall]"
+        ),
+        "value": round(wall_tps, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(wall_tps / 2000.0, 3),
+        "detail": {
+            "decode_only_tok_s_per_chip": round(decode_tps, 1),
+            "mean_batch_occupancy": round(stats.mean_occupancy, 3),
+            "requests": cfg["requests"],
+            "new_tokens_per_request": cfg["new_tokens"],
+            "devices": n_dev,
+            "platform": jax.devices()[0].platform,
+        },
+    }
+
+
+def main() -> None:
+    # honor an explicit JAX_PLATFORMS=cpu even where a sitecustomize pins a
+    # TPU plugin platform (this image's axon site does)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    result = asyncio.run(run())
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

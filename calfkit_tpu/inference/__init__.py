@@ -1,0 +1,25 @@
+"""The local TPU inference backend — the seam the reference filled with
+remote HTTPS APIs (SURVEY.md §1 layer 4, §2.3).
+
+Compute path: JAX/XLA with GSPMD tensor-parallel sharding over a device mesh;
+Pallas paged-attention kernels for decode; a continuous-batching engine that
+the Worker drives from Kafka-partition consumption.
+
+Import is lazy at the package boundary: nothing here pulls in jax until an
+inference class is actually constructed.
+"""
+
+from typing import Any
+
+from calfkit_tpu.inference.config import ModelConfig, PRESETS, RuntimeConfig
+
+__all__ = ["JaxLocalModelClient", "ModelConfig", "PRESETS", "RuntimeConfig"]
+
+
+def __getattr__(name: str) -> Any:
+    # lazy: importing calfkit_tpu.inference must not pull in jax
+    if name == "JaxLocalModelClient":
+        from calfkit_tpu.inference.client import JaxLocalModelClient
+
+        return JaxLocalModelClient
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,301 @@
+"""JaxLocalModelClient — the ModelClient that replaces remote HTTPS APIs.
+
+This is the seam swap (reference: SURVEY.md §3.3 "THE SEAM THE TPU BACKEND
+REPLACES"): `Agent(model=JaxLocalModelClient(...))` and every model turn runs
+on the local device mesh through the continuous-batching engine.
+
+Message rendering uses the HF chat template when a checkpoint tokenizer is
+available, else a deterministic plain template.  Tool calling rides a JSON
+grammar: the model is instructed to emit ``{"tool_name": ..., "args": ...}``
+objects; responses are scanned for them (configurable via
+``tool_call_parser``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Any, AsyncIterator, Callable
+
+from calfkit_tpu.engine.model_client import (
+    ModelClient,
+    ModelRequestParameters,
+    ModelSettings,
+    ResponseDone,
+    StreamEvent,
+    TextDelta,
+)
+from calfkit_tpu.exceptions import InferenceError
+from calfkit_tpu.models.capability import ToolDef
+from calfkit_tpu.models.messages import (
+    ModelMessage,
+    ModelRequest,
+    ModelResponse,
+    RetryPart,
+    SystemPart,
+    TextOutput,
+    ToolCallOutput,
+    ToolReturnPart,
+    Usage,
+    UserPart,
+)
+from calfkit_tpu.models.payload import render_parts_as_text
+
+ToolCallParser = Callable[[str], tuple[str, list[ToolCallOutput]]]
+
+def default_tool_call_parser(text: str) -> tuple[str, list[ToolCallOutput]]:
+    """Extract ``{"tool_name": ..., "args": {...}}`` objects (arbitrarily
+    nested args) from the text; returns (remaining_text, calls)."""
+    decoder = json.JSONDecoder()
+    calls: list[ToolCallOutput] = []
+    kept: list[str] = []
+    i = 0
+    while i < len(text):
+        start = text.find("{", i)
+        if start == -1:
+            kept.append(text[i:])
+            break
+        obj = None
+        try:
+            obj, consumed = decoder.raw_decode(text, start)
+        except ValueError:
+            pass
+        if isinstance(obj, dict) and isinstance(obj.get("tool_name"), str):
+            args = obj.get("args", {})
+            calls.append(
+                ToolCallOutput(
+                    tool_call_id=f"local_{int(time.time()*1000)}_{len(calls)}",
+                    tool_name=obj["tool_name"],
+                    args=args if isinstance(args, dict) else {},
+                )
+            )
+            kept.append(text[i:start])
+            i = consumed
+        else:
+            kept.append(text[i : start + 1])
+            i = start + 1
+    return "".join(kept).strip(), calls
+
+
+def render_messages(
+    messages: list[ModelMessage],
+    params: ModelRequestParameters,
+) -> str:
+    """Deterministic chat rendering (the fallback template)."""
+    lines: list[str] = []
+    system: list[str] = []
+    for message in messages:
+        if isinstance(message, ModelRequest):
+            if message.instructions:
+                system.append(message.instructions)
+            for part in message.parts:
+                if isinstance(part, SystemPart):
+                    system.append(part.content)
+                elif isinstance(part, UserPart):
+                    content = (
+                        part.content
+                        if isinstance(part.content, str)
+                        else render_parts_as_text(part.content)
+                    )
+                    author = f" ({part.author})" if part.author else ""
+                    lines.append(f"<|user|>{author}\n{content}")
+                elif isinstance(part, ToolReturnPart):
+                    lines.append(
+                        f"<|tool_result|> {part.tool_name}: "
+                        f"{json.dumps(part.content, default=str)}"
+                    )
+                elif isinstance(part, RetryPart):
+                    lines.append(f"<|user|>\n[retry] {part.content}")
+        else:  # ModelResponse
+            text = message.text()
+            calls = message.tool_calls()
+            body = text
+            for call in calls:
+                args = call.args if isinstance(call.args, str) else json.dumps(call.args)
+                body += f'\n{{"tool_name": "{call.tool_name}", "args": {args}}}'
+            lines.append(f"<|assistant|>\n{body.strip()}")
+
+    tools = params.all_tools()
+    if tools:
+        tool_block = "\n".join(
+            f"- {t.name}: {t.description}\n  parameters: "
+            f"{json.dumps(t.parameters_schema)}"
+            for t in tools
+        )
+        system.append(
+            "You can call tools by replying with a JSON object "
+            '{"tool_name": "<name>", "args": {...}} on its own line.\n'
+            f"Available tools:\n{tool_block}"
+        )
+    header = f"<|system|>\n{chr(10).join(system)}\n" if system else ""
+    return header + "\n".join(lines) + "\n<|assistant|>\n"
+
+
+class JaxLocalModelClient(ModelClient):
+    """Local inference over a JAX device mesh.
+
+    Construction is cheap; device work (param init / checkpoint load,
+    engine start) happens on first request or explicit :meth:`start`.
+    """
+
+    def __init__(
+        self,
+        *,
+        checkpoint: str | None = None,
+        config: Any = None,  # ModelConfig | preset name | None (from ckpt)
+        runtime: Any = None,  # RuntimeConfig
+        tokenizer: Any = None,
+        sampling: Any = None,
+        engine: Any = None,  # pre-built InferenceEngine (tests)
+        tool_call_parser: ToolCallParser = default_tool_call_parser,
+        max_new_tokens: int = 512,
+        seed: int = 0,
+    ):
+        self._checkpoint = checkpoint
+        self._config_spec = config
+        self._runtime = runtime
+        self._tokenizer = tokenizer
+        self._sampling = sampling
+        self._engine = engine
+        self._parser = tool_call_parser
+        self._max_new_tokens = max_new_tokens
+        self._seed = seed
+        self._start_lock: asyncio.Lock | None = None
+
+    @property
+    def model_name(self) -> str:
+        if self._engine is not None:
+            return self._engine.config.name
+        if isinstance(self._config_spec, str):
+            return self._config_spec
+        if self._config_spec is not None:
+            return self._config_spec.name
+        return self._checkpoint or "jax-local"
+
+    # ------------------------------------------------------------- startup
+    async def start(self) -> None:
+        if self._engine is not None and getattr(self._engine, "_running", False):
+            return
+        if self._start_lock is None:
+            self._start_lock = asyncio.Lock()
+        async with self._start_lock:
+            if self._engine is not None and getattr(self._engine, "_running", False):
+                return
+            if self._engine is None:
+                self._engine = await asyncio.to_thread(self._build_engine)
+            await self._engine.start()
+            if self._tokenizer is None:
+                self._tokenizer = self._default_tokenizer()
+
+    def _build_engine(self) -> Any:
+        from calfkit_tpu.inference.config import ModelConfig, RuntimeConfig, preset
+        from calfkit_tpu.inference.engine import InferenceEngine
+        from calfkit_tpu.inference.sharding import make_mesh, param_shardings
+
+        runtime = self._runtime or RuntimeConfig()
+        params = None
+        if self._checkpoint is not None:
+            from calfkit_tpu.inference.loader import config_from_hf, load_params
+            from calfkit_tpu.inference.tokenizer import HFTokenizer
+
+            config = config_from_hf(self._checkpoint)
+            mesh = make_mesh(tp=runtime.tp, dp=runtime.dp)
+            params = load_params(
+                self._checkpoint, config, param_shardings(config, mesh)
+            )
+            if self._tokenizer is None:
+                self._tokenizer = HFTokenizer(self._checkpoint)
+            return InferenceEngine(
+                config, runtime, params=params, mesh=mesh,
+                sampling=self._sampling, seed=self._seed,
+            )
+        if isinstance(self._config_spec, str):
+            config = preset(self._config_spec)
+        elif self._config_spec is not None:
+            config = self._config_spec
+        else:
+            raise InferenceError(
+                "JaxLocalModelClient needs a checkpoint path or a config"
+            )
+        return InferenceEngine(
+            config, runtime, sampling=self._sampling, seed=self._seed
+        )
+
+    def _default_tokenizer(self) -> Any:
+        from calfkit_tpu.inference.tokenizer import ByteTokenizer
+
+        return ByteTokenizer()
+
+    async def stop(self) -> None:
+        if self._engine is not None:
+            await self._engine.stop()
+
+    # ------------------------------------------------------------- request
+    async def request(
+        self,
+        messages: list[ModelMessage],
+        settings: ModelSettings | None = None,
+        params: ModelRequestParameters | None = None,
+    ) -> ModelResponse:
+        chunks: list[str] = []
+        usage = Usage()
+        async for event in self.request_stream(messages, settings, params):
+            if isinstance(event, ResponseDone):
+                return event.response
+            chunks.append(event.text)
+        raise InferenceError("stream ended without a terminal response")
+
+    async def request_stream(
+        self,
+        messages: list[ModelMessage],
+        settings: ModelSettings | None = None,
+        params: ModelRequestParameters | None = None,
+    ) -> AsyncIterator[StreamEvent]:
+        await self.start()
+        params = params or ModelRequestParameters()
+        settings = settings or ModelSettings()
+        tokenizer = self._tokenizer
+        prompt_text = render_messages(messages, params)
+        prompt = [tokenizer.bos_id, *tokenizer.encode(prompt_text)]
+        max_new = settings.max_tokens or self._max_new_tokens
+
+        started = time.perf_counter()
+        generated: list[int] = []
+        emitted = 0
+        _EMIT_EVERY = 4  # re-decode cadence: bounds detokenize cost
+        async for token in self._engine.generate(
+            prompt,
+            max_new_tokens=max_new,
+            stop_tokens=frozenset({tokenizer.eos_id}),
+        ):
+            generated.append(token)
+            if len(generated) % _EMIT_EVERY:
+                continue
+            # emit only the prefix that can't change: a trailing replacement
+            # char may be a multi-byte sequence still completing
+            text = tokenizer.decode(generated).rstrip("�")
+            if len(text) > emitted:
+                yield TextDelta(text[emitted:])
+                emitted = len(text)
+        elapsed = time.perf_counter() - started
+
+        full_text = tokenizer.decode(generated)
+        if len(full_text) > emitted:
+            yield TextDelta(full_text[emitted:])  # flush the tail
+        remaining, calls = (
+            self._parser(full_text) if params.tool_defs or params.output_tool
+            else (full_text, [])
+        )
+        parts: list[Any] = []
+        if remaining:
+            parts.append(TextOutput(text=remaining))
+        parts.extend(calls)
+        response = ModelResponse(
+            parts=parts,
+            usage=Usage(
+                input_tokens=len(prompt), output_tokens=len(generated)
+            ),
+            model_name=self.model_name,
+        )
+        yield ResponseDone(response)

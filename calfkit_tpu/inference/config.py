@@ -1,0 +1,120 @@
+"""Model/runtime configuration for the local inference backend.
+
+Pure dataclasses — importable without jax (the Worker/CLI read these before
+any device work happens).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """A Llama-family decoder architecture description."""
+
+    name: str = "debug"
+    vocab_size: int = 32000
+    d_model: int = 2048
+    n_layers: int = 22
+    n_heads: int = 32
+    n_kv_heads: int = 4
+    d_ff: int = 5632
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 2048
+    dtype: str = "bfloat16"
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def param_count(self) -> int:
+        """Approximate parameter count (for memory planning)."""
+        embed = self.vocab_size * self.d_model * (1 if self.tie_embeddings else 2)
+        per_layer = (
+            # attention: q, k, v, o
+            self.d_model * self.n_heads * self.head_dim
+            + 2 * self.d_model * self.n_kv_heads * self.head_dim
+            + self.n_heads * self.head_dim * self.d_model
+            # mlp: gate, up, down
+            + 3 * self.d_model * self.d_ff
+            # norms
+            + 2 * self.d_model
+        )
+        return embed + self.n_layers * per_layer + self.d_model
+
+
+@dataclass(frozen=True)
+class RuntimeConfig:
+    """Serving-engine knobs (reference analog: the model config block the
+    TPU build adds to the provider, SURVEY.md §5 config notes)."""
+
+    max_batch_size: int = 32
+    max_seq_len: int = 2048
+    page_size: int = 64  # tokens per KV page (pallas paged-attention block)
+    max_pages_per_seq: int = 0  # 0 → derived from max_seq_len
+    tp: int = 1  # tensor-parallel degree (mesh 'tp' axis size)
+    dp: int = 1  # data/batch-parallel replicas of the serving engine
+    decode_steps_per_dispatch: int = 8  # tokens generated per scheduler tick
+    prefill_chunk: int = 512  # prompts pad/bucket to multiples of this
+    attention_impl: str = "auto"  # "auto" | "xla" | "pallas"
+    # decode attention window buckets (each is one jit specialization);
+    # sparse buckets = few compiles, dense = tighter HBM reads
+    window_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
+    compilation_cache_dir: str | None = "~/.cache/calfkit_tpu_xla"
+
+    def pages_per_seq(self) -> int:
+        if self.max_pages_per_seq:
+            return self.max_pages_per_seq
+        return -(-self.max_seq_len // self.page_size)
+
+
+# --------------------------------------------------------------------------- #
+# presets
+# --------------------------------------------------------------------------- #
+
+PRESETS: dict[str, ModelConfig] = {
+    # tiny config for unit tests / CI — compiles in seconds on CPU
+    "debug": ModelConfig(
+        name="debug",
+        vocab_size=512,
+        d_model=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        d_ff=128,
+        max_seq_len=256,
+    ),
+    # BASELINE config 2: TinyLlama-1.1B (HF: TinyLlama/TinyLlama-1.1B-Chat)
+    "tinyllama-1.1b": ModelConfig(
+        name="tinyllama-1.1b",
+        vocab_size=32000,
+        d_model=2048,
+        n_layers=22,
+        n_heads=32,
+        n_kv_heads=4,
+        d_ff=5632,
+        rope_theta=10000.0,
+        max_seq_len=2048,
+    ),
+    # BASELINE config 5 / north star: Llama-3-8B (HF: meta-llama/Meta-Llama-3-8B)
+    "llama-3-8b": ModelConfig(
+        name="llama-3-8b",
+        vocab_size=128256,
+        d_model=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        d_ff=14336,
+        rope_theta=500000.0,
+        max_seq_len=8192,
+    ),
+}
+
+
+def preset(name: str, **overrides: object) -> ModelConfig:
+    cfg = PRESETS[name]
+    return replace(cfg, **overrides) if overrides else cfg

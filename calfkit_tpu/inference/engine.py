@@ -1,0 +1,446 @@
+"""The continuous-batching inference engine.
+
+This is the boundary object between the two communication tiers (SURVEY.md
+§2.4): Kafka partitions feed requests in; token streams come out.  Design:
+
+- a fixed pool of ``max_batch_size`` slots backed by ONE device-resident KV
+  cache [L, B, S, K, hd]; admission = prefill into a free slot's rows;
+- decode runs for ALL active slots together: one jitted dispatch generates
+  ``decode_steps_per_dispatch`` tokens per slot via ``lax.scan`` (host syncs
+  once per dispatch, not per token);
+- prefill is per-request, bucketed to ``prefill_chunk`` multiples so each
+  bucket compiles once; a prefill never blocks the decode cadence for more
+  than one tick (new work is admitted between decode dispatches —
+  continuous batching, not static batching);
+- caches are donated through jit, so memory stays at one cache copy;
+- everything device-side is static-shape; per-request stop conditions (eos,
+  max_new_tokens) are applied host-side on the freshly synced token block.
+
+The engine is model-agnostic over :mod:`calfkit_tpu.inference.model`'s
+functional forward and owns the jit specializations.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, AsyncIterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from calfkit_tpu.exceptions import InferenceError
+from calfkit_tpu.inference import model as M
+from calfkit_tpu.inference.config import ModelConfig, RuntimeConfig
+from calfkit_tpu.inference.sampler import SamplingParams, sample
+from calfkit_tpu.inference.sharding import (
+    cache_sharding,
+    make_mesh,
+    param_shardings,
+    place_params,
+)
+
+logger = logging.getLogger(__name__)
+
+_DONE = object()
+
+
+@dataclass
+class GenRequest:
+    prompt: list[int]
+    max_new_tokens: int
+    stop_tokens: frozenset[int]
+    out: asyncio.Queue = field(default_factory=asyncio.Queue)
+    slot: int = -1
+    generated: int = 0
+    prefill_ms: float = 0.0
+    started_at: float = field(default_factory=time.perf_counter)
+
+
+@dataclass
+class EngineStats:
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
+    decode_dispatches: int = 0
+    decode_time_s: float = 0.0
+    occupancy_sum: float = 0.0
+
+    @property
+    def tokens_per_second(self) -> float:
+        return self.decode_tokens / self.decode_time_s if self.decode_time_s else 0.0
+
+    @property
+    def mean_occupancy(self) -> float:
+        if not self.decode_dispatches:
+            return 0.0
+        return self.occupancy_sum / self.decode_dispatches
+
+
+class InferenceEngine:
+    def __init__(
+        self,
+        config: ModelConfig,
+        runtime: RuntimeConfig | None = None,
+        *,
+        params: Any = None,
+        mesh: Any = None,
+        sampling: SamplingParams | None = None,
+        seed: int = 0,
+    ):
+        self.config = config
+        self.runtime = runtime or RuntimeConfig()
+        self.sampling = sampling or SamplingParams()
+        rt = self.runtime
+        if rt.compilation_cache_dir:
+            # persistent XLA cache: window/prefill specializations compile
+            # once per machine, not once per process
+            import os
+
+            try:
+                jax.config.update(
+                    "jax_compilation_cache_dir",
+                    os.path.expanduser(rt.compilation_cache_dir),
+                )
+                jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+            except Exception:  # noqa: BLE001 - cache is best-effort
+                logger.debug("persistent compilation cache unavailable")
+
+        self.mesh = mesh if mesh is not None else make_mesh(tp=rt.tp, dp=rt.dp)
+        shardings = param_shardings(config, self.mesh)
+        if params is None:
+            logger.info(
+                "initializing random %s params (%.2fB)", config.name,
+                config.param_count / 1e9,
+            )
+            params = M.init_params(config, jax.random.key(seed))
+        self.params = place_params(params, shardings)
+
+        B, S = rt.max_batch_size, rt.max_seq_len
+        cache_sh = cache_sharding(config, self.mesh, B)
+        self._k = jax.device_put(
+            jnp.zeros(
+                (config.n_layers, B, config.n_kv_heads, S, config.head_dim),
+                jnp.dtype(config.dtype),
+            ),
+            cache_sh,
+        )
+        self._v = jax.device_put(jnp.zeros_like(self._k), cache_sh)
+        self._last = jnp.zeros((B,), jnp.int32)
+        self._lens = jnp.zeros((B,), jnp.int32)
+        self._host_lens = np.zeros((B,), np.int64)  # host mirror for windows
+        self._key = jax.random.key(seed + 1)
+
+        self._free: list[int] = list(range(B))
+        self._active: dict[int, GenRequest] = {}
+        self._queue: asyncio.Queue[GenRequest] = asyncio.Queue()
+        self._wake = asyncio.Event()
+        self._task: asyncio.Task[None] | None = None
+        self._running = False
+        self.stats = EngineStats()
+
+        self._decode_jits: dict[int, Any] = {}
+        self._prefill_jits: dict[tuple[int, int], Any] = {}
+
+    # ------------------------------------------------------------ jit build
+    def _window_bucket(self, needed: int) -> int:
+        """Smallest configured window ≥ needed (cap max_seq): the decode
+        attention scan only reads this prefix of the cache, and each bucket
+        is one compile."""
+        cap = self.runtime.max_seq_len
+        for w in self.runtime.window_buckets:
+            if needed <= w <= cap:
+                return w
+        return cap
+
+    def _decode_jit(self, window: int) -> Any:
+        fn = self._decode_jits.get(window)
+        if fn is not None:
+            return fn
+        cfg = self.config
+        sampling = self.sampling
+        steps = self.runtime.decode_steps_per_dispatch
+
+        def decode(params, k, v, last, lens, active, key):
+            # ring-buffer decode: the main cache is READ-ONLY during the
+            # scan; fresh K/V goes to a dense ring, consolidated once below.
+            # The attention window is sliced ONCE per dispatch (a loop
+            # constant), so per-step reads cover only live prefixes.
+            B = last.shape[0]
+            kw = k[:, :, :, :window]
+            vw = v[:, :, :, :window]
+            ring = (
+                jnp.zeros(
+                    (cfg.n_layers, steps, B, cfg.n_kv_heads, cfg.head_dim),
+                    k.dtype,
+                ),
+                jnp.zeros(
+                    (cfg.n_layers, steps, B, cfg.n_kv_heads, cfg.head_dim),
+                    v.dtype,
+                ),
+            )
+
+            def step(carry, t):
+                ring, last, key = carry
+                key, sub = jax.random.split(key)
+                logits, ring = M.decode_step_ring(
+                    params, cfg, last[:, None], (kw, vw), ring, t, lens,
+                )
+                nxt = sample(logits[:, -1], sub, sampling)
+                nxt = jnp.where(active, nxt, last)
+                return (ring, nxt, key), nxt
+
+            (ring, last, key), toks = lax.scan(
+                step, (ring, last, key), jnp.arange(steps)
+            )
+            k, v = M.consolidate_ring((k, v), ring, lens)
+            new_lens = jnp.where(active, lens + steps, lens)
+            return k, v, last, new_lens, key, toks  # toks [steps, B]
+
+        fn = jax.jit(decode, donate_argnums=(1, 2))
+        self._decode_jits[window] = fn
+        return fn
+
+    def _prefill_jit(self, bucket: int, rows: int) -> Any:
+        """Batched prefill: R admissions run as one [R, bucket] forward on a
+        scratch cache, then scatter into the slot rows — one dispatch per
+        admission WAVE, not per request."""
+        fn = self._prefill_jits.get((bucket, rows))
+        if fn is not None:
+            return fn
+        cfg = self.config
+        sampling = self.sampling
+
+        def prefill(params, k, v, tokens, slots, true_lens, key):
+            # tokens: [R, bucket]; slots/true_lens: [R]
+            R, P = tokens.shape
+            scratch = (
+                jnp.zeros((cfg.n_layers, R, cfg.n_kv_heads, P, cfg.head_dim), k.dtype),
+                jnp.zeros((cfg.n_layers, R, cfg.n_kv_heads, P, cfg.head_dim), v.dtype),
+            )
+            pos = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (R, P))
+            logits, (sk, sv) = M.forward(
+                params, cfg, tokens, pos, scratch, jnp.full((R,), P, jnp.int32)
+            )
+            for r in range(R):  # R is small & static: unrolled row scatter
+                k = lax.dynamic_update_slice_in_dim(
+                    k, lax.dynamic_slice_in_dim(sk, r, 1, axis=1)[:, :, :, :P],
+                    slots[r], axis=1,
+                )
+                v = lax.dynamic_update_slice_in_dim(
+                    v, lax.dynamic_slice_in_dim(sv, r, 1, axis=1)[:, :, :, :P],
+                    slots[r], axis=1,
+                )
+            idx = jnp.clip(true_lens - 1, 0, P - 1)
+            last_logits = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1
+            )[:, 0]
+            firsts = sample(last_logits, key, sampling)
+            return k, v, firsts
+
+        fn = jax.jit(prefill, donate_argnums=(1, 2))
+        self._prefill_jits[(bucket, rows)] = fn
+        return fn
+
+    # ------------------------------------------------------------ lifecycle
+    async def start(self) -> None:
+        if self._running:
+            return
+        self._running = True
+        self._loop = asyncio.get_running_loop()
+        self._task = self._loop.create_task(self._serve(), name="inference-engine")
+
+    async def stop(self) -> None:
+        self._running = False
+        self._wake.set()
+        if self._task is not None:
+            try:
+                await asyncio.wait_for(self._task, timeout=30)
+            except asyncio.TimeoutError:
+                self._task.cancel()
+            self._task = None
+        self._finish_all()
+
+    def _finish_all(self) -> None:
+        """Terminate every waiter: active slots AND still-queued requests
+        (a queued request left without _DONE hangs its generate() forever)."""
+        for request in list(self._active.values()):
+            request.out.put_nowait(_DONE)
+        self._active.clear()
+        while not self._queue.empty():
+            self._queue.get_nowait().out.put_nowait(_DONE)
+
+    # -------------------------------------------------------------- submit
+    async def generate(
+        self,
+        prompt: list[int],
+        *,
+        max_new_tokens: int = 256,
+        stop_tokens: frozenset[int] = frozenset(),
+    ) -> AsyncIterator[int]:
+        """Submit a prompt; yields generated token ids as they decode."""
+        if not self._running:
+            raise InferenceError("engine not started")
+        if len(prompt) >= self.runtime.max_seq_len:
+            raise InferenceError(
+                f"prompt of {len(prompt)} tokens exceeds max_seq_len "
+                f"{self.runtime.max_seq_len}"
+            )
+        request = GenRequest(
+            prompt=list(prompt),
+            max_new_tokens=max_new_tokens,
+            stop_tokens=stop_tokens,
+        )
+        await self._queue.put(request)
+        self._wake.set()
+        while True:
+            item = await request.out.get()
+            if item is _DONE:
+                return
+            yield item
+
+    # ------------------------------------------------------------ scheduler
+    async def _serve(self) -> None:
+        try:
+            while self._running:
+                admitted = await self._admit()
+                if not self._active:
+                    if not admitted:
+                        self._wake.clear()
+                        if self._queue.empty():
+                            await self._wake.wait()
+                    continue
+                await asyncio.to_thread(self._decode_tick)
+        except Exception:  # noqa: BLE001
+            logger.exception("inference engine scheduler crashed")
+            self._running = False
+            self._finish_all()
+
+    async def _admit(self) -> bool:
+        admitted = False
+        while self._free and not self._queue.empty():
+            # one admission WAVE: same-bucket requests prefill together
+            rt = self.runtime
+
+            def bucket_of(req: GenRequest) -> int:
+                return min(
+                    -(-len(req.prompt) // rt.prefill_chunk) * rt.prefill_chunk,
+                    rt.max_seq_len,
+                )
+
+            wave: list[GenRequest] = [self._queue.get_nowait()]
+            wave_bucket = bucket_of(wave[0])
+            while (
+                len(wave) < len(self._free)
+                and len(wave) < 8
+                and not self._queue.empty()
+                and bucket_of(self._queue._queue[0]) == wave_bucket  # peek
+            ):
+                wave.append(self._queue.get_nowait())
+            for request in wave:
+                request.slot = self._free.pop()
+            await asyncio.to_thread(self._prefill_wave, wave, wave_bucket)
+            for request in wave:
+                # a request can retire DURING its own prefill (first token
+                # was a stop, or max_new_tokens == 1): _emit already freed
+                # its slot and set slot = -1 — don't resurrect it
+                if request.slot != -1:
+                    self._active[request.slot] = request
+            admitted = True
+        return admitted
+
+    # ------------------------------------------------------- device work
+    def _prefill_wave(self, wave: list[GenRequest], bucket: int) -> None:
+        R = len(wave)
+        tokens = np.zeros((R, bucket), np.int32)
+        true_lens = np.zeros((R,), np.int32)
+        slots = np.zeros((R,), np.int32)
+        for r, request in enumerate(wave):
+            tokens[r, : len(request.prompt)] = request.prompt
+            true_lens[r] = len(request.prompt)
+            slots[r] = request.slot
+        started = time.perf_counter()
+        self._key, sub = jax.random.split(self._key)
+        fn = self._prefill_jit(bucket, R)
+        self._k, self._v, firsts = fn(
+            self.params,
+            self._k,
+            self._v,
+            jnp.asarray(tokens),
+            jnp.asarray(slots),
+            jnp.asarray(true_lens),
+            sub,
+        )
+        firsts = np.asarray(firsts)
+        elapsed_ms = (time.perf_counter() - started) * 1000.0
+        for r, request in enumerate(wave):
+            request.prefill_ms = elapsed_ms
+            self.stats.prefill_tokens += int(true_lens[r])
+            # the prompt occupies [0, true_len); decode inserts from true_len
+            self._lens = self._lens.at[request.slot].set(int(true_lens[r]))
+            self._last = self._last.at[request.slot].set(int(firsts[r]))
+            self._host_lens[request.slot] = int(true_lens[r])
+            self._emit(request, int(firsts[r]))
+
+    def _decode_tick(self) -> None:
+        active_mask = np.zeros((self.runtime.max_batch_size,), bool)
+        needed = 1
+        for slot in self._active:
+            active_mask[slot] = True
+            needed = max(needed, self._host_lens[slot])
+        # the ring covers in-dispatch growth; the window only needs to cover
+        # what's already in the main cache
+        window = self._window_bucket(needed)
+        started = time.perf_counter()
+        self._k, self._v, self._last, self._lens, self._key, toks = (
+            self._decode_jit(window)(
+                self.params,
+                self._k,
+                self._v,
+                self._last,
+                self._lens,
+                jnp.asarray(active_mask),
+                self._key,
+            )
+        )
+        for slot in self._active:
+            self._host_lens[slot] += self.runtime.decode_steps_per_dispatch
+        block = np.asarray(toks)  # [steps, B] — THE host sync per dispatch
+        elapsed = time.perf_counter() - started
+        n_active = len(self._active)
+        self.stats.decode_dispatches += 1
+        self.stats.decode_time_s += elapsed
+        self.stats.occupancy_sum += n_active / self.runtime.max_batch_size
+        for slot, request in list(self._active.items()):
+            for step_tokens in block:
+                self._emit(request, int(step_tokens[slot]))
+                if request.slot == -1:
+                    break
+
+    def _emit(self, request: GenRequest, token: int) -> None:
+        """Record one generated token; retire the request on stop.
+
+        Runs on the to_thread worker: queue puts are marshalled back to the
+        event loop (asyncio.Queue is not thread-safe).
+        """
+        if request.slot == -1:
+            return
+        request.generated += 1
+        hit_stop = token in request.stop_tokens
+        if not hit_stop:
+            self._loop.call_soon_threadsafe(request.out.put_nowait, token)
+            self.stats.decode_tokens += 1
+        exhausted = (
+            request.generated >= request.max_new_tokens
+            or len(request.prompt) + request.generated
+            >= self.runtime.max_seq_len - 1
+        )
+        if hit_stop or exhausted:
+            self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)
+            self._active.pop(request.slot, None)
+            self._free.append(request.slot)
+            request.slot = -1

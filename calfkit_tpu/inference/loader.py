@@ -1,0 +1,159 @@
+"""HF checkpoint → sharded JAX params.
+
+Loads a *local* Llama-family HF directory (config.json + safetensors) into
+the stacked-layer pytree of :mod:`calfkit_tpu.inference.model`, placing each
+tensor straight onto its NamedSharding so no host copy of the full model
+lingers (model-side "checkpointing is loading", SURVEY.md §5).
+
+Weight name mapping (HF → ours):
+    model.embed_tokens.weight                     → embed [V, D]
+    model.layers.{i}.self_attn.{q,k,v}_proj.weight→ wq/wk/wv (transposed,
+                                                    reshaped to [D, N, hd])
+    model.layers.{i}.self_attn.o_proj.weight      → wo [H, hd, D]
+    model.layers.{i}.mlp.{gate,up,down}_proj.weight → w_gate/w_up/w_down
+    model.layers.{i}.{input,post_attention}_layernorm.weight → norms
+    model.norm.weight                              → final_norm
+    lm_head.weight                                 → lm_head [D, V]
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from calfkit_tpu.inference.config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+
+def config_from_hf(path: str | Path) -> ModelConfig:
+    raw = json.loads((Path(path) / "config.json").read_text())
+    return ModelConfig(
+        name=raw.get("_name_or_path", str(path)),
+        vocab_size=raw["vocab_size"],
+        d_model=raw["hidden_size"],
+        n_layers=raw["num_hidden_layers"],
+        n_heads=raw["num_attention_heads"],
+        n_kv_heads=raw.get("num_key_value_heads", raw["num_attention_heads"]),
+        d_ff=raw["intermediate_size"],
+        rope_theta=raw.get("rope_theta", 10000.0),
+        norm_eps=raw.get("rms_norm_eps", 1e-5),
+        max_seq_len=raw.get("max_position_embeddings", 2048),
+        tie_embeddings=raw.get("tie_word_embeddings", False),
+    )
+
+
+def _open_safetensors(path: Path) -> dict[str, Any]:
+    """name -> lazy tensor getter across all shards."""
+    from safetensors import safe_open  # ships with transformers
+
+    index_file = path / "model.safetensors.index.json"
+    files: dict[str, Path] = {}
+    if index_file.exists():
+        index = json.loads(index_file.read_text())
+        for name, shard in index["weight_map"].items():
+            files[name] = path / shard
+    else:
+        single = path / "model.safetensors"
+        if not single.exists():
+            raise FileNotFoundError(f"no safetensors found under {path}")
+        with safe_open(str(single), framework="np") as f:
+            for name in f.keys():
+                files[name] = single
+    return files
+
+
+def load_params(
+    path: str | Path,
+    config: ModelConfig,
+    shardings: dict[str, Any],
+) -> dict[str, Any]:
+    """Load + transpose + stack + shard-place the checkpoint."""
+    import jax
+    from safetensors import safe_open
+
+    path = Path(path)
+    files = _open_safetensors(path)
+    handles: dict[Path, Any] = {}
+
+    def get(name: str) -> np.ndarray:
+        f = files[name]
+        if f not in handles:
+            handles[f] = safe_open(str(f), framework="np").__enter__()
+        return handles[f].get_tensor(name)
+
+    D, H, K, hd = config.d_model, config.n_heads, config.n_kv_heads, config.head_dim
+    L = config.n_layers
+
+    def put(arr: np.ndarray, sharding: Any) -> Any:
+        return jax.device_put(arr.astype(np.dtype(config.dtype)), sharding)
+
+    def stack(fmt: str, transform: Any) -> np.ndarray:
+        return np.stack([transform(get(fmt.format(i))) for i in range(L)])
+
+    ls = shardings["layers"]
+    params: dict[str, Any] = {
+        "embed": put(get("model.embed_tokens.weight"), shardings["embed"]),
+        "layers": {
+            # HF projections are [out, in]; ours are [in, heads, hd]
+            "wq": put(
+                stack(
+                    "model.layers.{}.self_attn.q_proj.weight",
+                    lambda w: w.T.reshape(D, H, hd),
+                ),
+                ls["wq"],
+            ),
+            "wk": put(
+                stack(
+                    "model.layers.{}.self_attn.k_proj.weight",
+                    lambda w: w.T.reshape(D, K, hd),
+                ),
+                ls["wk"],
+            ),
+            "wv": put(
+                stack(
+                    "model.layers.{}.self_attn.v_proj.weight",
+                    lambda w: w.T.reshape(D, K, hd),
+                ),
+                ls["wv"],
+            ),
+            "wo": put(
+                stack(
+                    "model.layers.{}.self_attn.o_proj.weight",
+                    lambda w: w.T.reshape(H, hd, D),
+                ),
+                ls["wo"],
+            ),
+            "w_gate": put(
+                stack("model.layers.{}.mlp.gate_proj.weight", lambda w: w.T),
+                ls["w_gate"],
+            ),
+            "w_up": put(
+                stack("model.layers.{}.mlp.up_proj.weight", lambda w: w.T),
+                ls["w_up"],
+            ),
+            "w_down": put(
+                stack("model.layers.{}.mlp.down_proj.weight", lambda w: w.T),
+                ls["w_down"],
+            ),
+            "attn_norm": put(
+                stack("model.layers.{}.input_layernorm.weight", lambda w: w),
+                ls["attn_norm"],
+            ),
+            "mlp_norm": put(
+                stack(
+                    "model.layers.{}.post_attention_layernorm.weight", lambda w: w
+                ),
+                ls["mlp_norm"],
+            ),
+        },
+        "final_norm": put(get("model.norm.weight"), shardings["final_norm"]),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = put(get("lm_head.weight").T, shardings["lm_head"])
+    logger.info("loaded %s from %s", config.name, path)
+    return params

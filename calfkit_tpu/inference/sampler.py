@@ -1,0 +1,45 @@
+"""Token sampling: greedy / temperature / top-k / top-p, jit-compatible.
+
+All branching on sampling *mode* happens in Python at trace time (the engine
+jits one specialization per settings bundle); everything under jit is static
+shape, data-parallel over the batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0  # 0 → greedy
+    top_k: int = 0  # 0 → off
+    top_p: float = 1.0  # 1 → off
+
+
+def sample(
+    logits: jax.Array,  # [B, V] (last-token logits)
+    key: jax.Array,
+    params: SamplingParams,
+) -> jax.Array:
+    """→ [B] int32 next tokens."""
+    if params.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits.astype(jnp.float32) / params.temperature
+    if params.top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -params.top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if params.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(probs, axis=-1)
+        # smallest set of tokens whose mass ≥ top_p: keep while cum-prev < p
+        keep_sorted = (cumulative - probs) < params.top_p
+        threshold = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

@@ -1,0 +1,120 @@
+"""GSPMD sharding layout for the inference backend.
+
+The scaling-model recipe: pick a mesh, annotate param/cache shardings, let
+XLA insert the collectives (all-reduce on attention/MLP outputs, all-gather
+on logits), profile, iterate.  Axes:
+
+- ``tp`` — tensor parallelism *inside* one model replica: attention heads,
+  MLP hidden, and vocab are split over ``tp``; XLA emits psum/all-gathers
+  that ride ICI.
+- ``dp`` — independent serving replicas: the batch dimension of the KV cache
+  and token buffers is split over ``dp``.
+
+Weights that don't divide evenly by the axis (e.g. 4 KV heads on tp=8) fall
+back to replication for that tensor — GSPMD remains correct either way, this
+just keeps layouts predictable.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from calfkit_tpu.inference.config import ModelConfig
+
+Params = dict[str, Any]
+
+
+def make_mesh(
+    tp: int = 1, dp: int = 1, *, devices: list[jax.Device] | None = None
+) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    need = tp * dp
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh needs {need} devices (tp={tp} × dp={dp}), have {len(devices)}"
+        )
+    grid = np.array(devices[:need]).reshape(dp, tp)
+    return Mesh(grid, ("dp", "tp"))
+
+
+def _spec(mesh: Mesh, dims: list[tuple[int, str | None]]) -> P:
+    """Build a PartitionSpec, dropping axis names whose size doesn't divide
+    the dim (replicate instead)."""
+    parts: list[str | None] = []
+    for size, axis in dims:
+        if axis is None or size % mesh.shape[axis] != 0:
+            parts.append(None)
+        else:
+            parts.append(axis)
+    return P(*parts)
+
+
+def param_shardings(config: ModelConfig, mesh: Mesh) -> Params:
+    """NamedSharding pytree matching :func:`model.init_params` structure."""
+    D, H, K, hd, F, V = (
+        config.d_model,
+        config.n_heads,
+        config.n_kv_heads,
+        config.head_dim,
+        config.d_ff,
+        config.vocab_size,
+    )
+
+    def ns(dims: list[tuple[int, str | None]]) -> NamedSharding:
+        return NamedSharding(mesh, _spec(mesh, dims))
+
+    L = (config.n_layers, None)
+    shardings: Params = {
+        "embed": ns([(V, "tp"), (D, None)]),
+        "layers": {
+            "wq": ns([L, (D, None), (H, "tp"), (hd, None)]),
+            "wk": ns([L, (D, None), (K, "tp"), (hd, None)]),
+            "wv": ns([L, (D, None), (K, "tp"), (hd, None)]),
+            "wo": ns([L, (H, "tp"), (hd, None), (D, None)]),
+            "w_gate": ns([L, (D, None), (F, "tp")]),
+            "w_up": ns([L, (D, None), (F, "tp")]),
+            "w_down": ns([L, (F, "tp"), (D, None)]),
+            "attn_norm": ns([L, (D, None)]),
+            "mlp_norm": ns([L, (D, None)]),
+        },
+        "final_norm": ns([(D, None)]),
+    }
+    if not config.tie_embeddings:
+        shardings["lm_head"] = ns([(D, None), (V, "tp")])
+    return shardings
+
+
+def cache_sharding(config: ModelConfig, mesh: Mesh, batch: int) -> NamedSharding:
+    """KV cache [L, B, K, S, hd]: batch over dp, kv heads over tp."""
+    return NamedSharding(
+        mesh,
+        _spec(
+            mesh,
+            [
+                (config.n_layers, None),
+                (batch, "dp"),
+                (config.n_kv_heads, "tp"),
+                (1, None),
+                (config.head_dim, None),
+            ],
+        ),
+    )
+
+
+def batch_sharding(mesh: Mesh, batch: int) -> NamedSharding:
+    return NamedSharding(mesh, _spec(mesh, [(batch, "dp")]))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def place_params(params: Params, shardings: Params) -> Params:
+    """Device-put the param pytree onto its sharding layout."""
+    return jax.tree.map(
+        lambda arr, sh: jax.device_put(arr, sh), params, shardings
+    )

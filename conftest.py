@@ -11,9 +11,19 @@ import sys
 
 sys.path.insert(0, os.path.dirname(__file__))
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# this image's sitecustomize registers an 'axon' TPU plugin and pins
+# jax.config.jax_platforms — env vars alone don't win; override the config
+# directly (safe: runs before any backend initializes)
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass
