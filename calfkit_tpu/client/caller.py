@@ -113,6 +113,18 @@ class Client:
     ) -> "AgentGateway[OutputT]":
         return AgentGateway(self, name, output_type)
 
+    # ---------------------------------------------------------------- mesh
+    @property
+    def mesh_directory(self) -> Any:
+        """The read-only directory of live agents/capabilities
+        (``client.mesh`` in the reference; named ``mesh_directory`` here
+        because ``.mesh`` is the transport)."""
+        if self._mesh_view is None:
+            from calfkit_tpu.client.mesh import Mesh
+
+            self._mesh_view = Mesh(self)
+        return self._mesh_view
+
     # ------------------------------------------------------------ firehose
     def events(self, *, buffer: int = 1024) -> EventStream:
         """Every step event this client observes, across all runs.

@@ -1,0 +1,14 @@
+"""Control plane: discovery + liveness over compacted mesh tables
+(SURVEY.md §1 layer 5)."""
+
+from calfkit_tpu.controlplane.config import ControlPlaneConfig
+from calfkit_tpu.controlplane.publisher import ControlPlanePublisher
+from calfkit_tpu.controlplane.view import ControlPlaneView
+from calfkit_tpu.controlplane.plane import ControlPlane
+
+__all__ = [
+    "ControlPlane",
+    "ControlPlaneConfig",
+    "ControlPlanePublisher",
+    "ControlPlaneView",
+]

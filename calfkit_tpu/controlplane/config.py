@@ -1,0 +1,17 @@
+"""Control-plane tuning (reference: calfkit/controlplane/config.py)."""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, Field
+
+
+class ControlPlaneConfig(BaseModel):
+    enabled: bool = True
+    heartbeat_interval: float = Field(default=5.0, gt=0)
+    # a node is live while now - heartbeat_at < stale_multiplier × interval
+    stale_multiplier: float = Field(default=3.0, ge=1.0)
+    catchup_timeout: float = Field(default=30.0, gt=0)
+
+    @property
+    def stale_after(self) -> float:
+        return self.heartbeat_interval * self.stale_multiplier

@@ -1,0 +1,125 @@
+"""ControlPlane: the worker-side wiring of publisher + views.
+
+``Worker`` calls :meth:`attach` at boot (reference: the auto-registration in
+calfkit/worker/worker.py:197-330): every hosted node's adverts start
+heartbeating, and capability/agents views are attached to node resources so
+selectors (`Tools(discover=True)`, `Messaging`, `Handoff`) resolve live.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from calfkit_tpu import protocol
+from calfkit_tpu.controlplane.config import ControlPlaneConfig
+from calfkit_tpu.controlplane.publisher import Advert, ControlPlanePublisher
+from calfkit_tpu.controlplane.view import ControlPlaneView
+from calfkit_tpu.models.agents import AgentCard
+from calfkit_tpu.models.capability import CapabilityRecord
+
+logger = logging.getLogger(__name__)
+
+CAPABILITY_VIEW_KEY = "capability_view"
+AGENTS_VIEW_KEY = "agents_view"
+
+
+class _Attached:
+    def __init__(
+        self,
+        publisher: ControlPlanePublisher,
+        views: list[ControlPlaneView[Any]],
+    ):
+        self._publisher = publisher
+        self._views = views
+
+    async def stop(self) -> None:
+        await self._publisher.stop()  # tombstones first
+        for view in self._views:
+            try:
+                await view.stop()
+            except Exception:  # noqa: BLE001
+                logger.debug("view stop failed", exc_info=True)
+
+
+class ControlPlane:
+    def __init__(self, config: ControlPlaneConfig | None = None):
+        self.config = config or ControlPlaneConfig()
+
+    def adverts_for(self, node: Any) -> list[Advert]:
+        adverts: list[Advert] = []
+        if hasattr(node, "agent_card"):
+            card: AgentCard = node.agent_card()
+            adverts.append(
+                Advert(
+                    topic=protocol.AGENTS_TOPIC,
+                    node_name=card.name,
+                    node_kind=node.kind,
+                    instance_id=node.instance_id,
+                    payload=card.model_dump(),
+                )
+            )
+        if hasattr(node, "capability_record"):
+            record: CapabilityRecord = node.capability_record()
+            adverts.append(
+                Advert(
+                    topic=protocol.CAPABILITIES_TOPIC,
+                    node_name=record.node_id,
+                    node_kind=node.kind,
+                    instance_id=node.instance_id,
+                    payload=record.model_dump(),
+                )
+            )
+        return adverts
+
+    async def attach(self, worker: Any) -> _Attached:
+        transport = worker.mesh
+        config = self.config
+
+        capability_view: ControlPlaneView[CapabilityRecord] = ControlPlaneView(
+            transport,
+            protocol.CAPABILITIES_TOPIC,
+            CapabilityRecord,
+            stale_after=config.stale_after,
+            catchup_timeout=config.catchup_timeout,
+        )
+        agents_view: ControlPlaneView[AgentCard] = ControlPlaneView(
+            transport,
+            protocol.AGENTS_TOPIC,
+            AgentCard,
+            stale_after=config.stale_after,
+            catchup_timeout=config.catchup_timeout,
+        )
+        await transport.ensure_topics(
+            [protocol.AGENTS_TOPIC, protocol.CAPABILITIES_TOPIC], compacted=True
+        )
+        # views catch up BEFORE serving: a turn must not resolve against a
+        # half-read directory.  Anything started before a failure is stopped
+        # again — a failed attach must not orphan readers.
+        started: list[ControlPlaneView[Any]] = []
+        try:
+            for view in (capability_view, agents_view):
+                await view.start()
+                started.append(view)
+
+            adverts: list[Advert] = []
+            for node in worker.nodes:
+                adverts.extend(self.adverts_for(node))
+                node.resources.setdefault(CAPABILITY_VIEW_KEY, capability_view)
+                node.resources.setdefault(AGENTS_VIEW_KEY, agents_view)
+            worker.resources.setdefault(CAPABILITY_VIEW_KEY, capability_view)
+            worker.resources.setdefault(AGENTS_VIEW_KEY, agents_view)
+
+            publisher = ControlPlanePublisher(transport, adverts, config)
+            await publisher.start()  # fail-loud first adverts
+        except BaseException:
+            for view in started:
+                try:
+                    await view.stop()
+                except Exception:  # noqa: BLE001
+                    logger.debug("view rollback stop failed", exc_info=True)
+            raise
+        logger.info(
+            "control plane attached: %d adverts, views live", len(adverts)
+        )
+        return _Attached(publisher, [capability_view, agents_view])

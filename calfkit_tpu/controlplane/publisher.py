@@ -1,0 +1,110 @@
+"""The worker-owned heartbeat publisher.
+
+Contract (reference: calfkit/controlplane/publisher.py:42-127):
+
+- the FIRST publish of every advert is fail-loud: a worker that cannot
+  announce itself must not report a healthy boot;
+- subsequent ticks are resilient: a transient publish failure logs WARNING
+  and the loop continues;
+- shutdown cancels the tick task BEFORE writing tombstones, so a tick can't
+  resurrect a record mid-withdrawal.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any
+
+from calfkit_tpu.mesh.transport import MeshTransport
+from calfkit_tpu.models.records import ControlPlaneRecord, ControlPlaneStamp
+from calfkit_tpu.controlplane.config import ControlPlaneConfig
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class Advert:
+    topic: str
+    node_name: str
+    node_kind: str
+    instance_id: str
+    payload: dict[str, Any]  # AgentCard / CapabilityRecord dump
+
+    @property
+    def key(self) -> str:
+        return f"{self.node_name}@{self.instance_id}"
+
+
+class ControlPlanePublisher:
+    def __init__(
+        self,
+        transport: MeshTransport,
+        adverts: list[Advert],
+        config: ControlPlaneConfig | None = None,
+    ):
+        self._transport = transport
+        self._adverts = adverts
+        self._config = config or ControlPlaneConfig()
+        self._writers = {
+            topic: transport.table_writer(topic)
+            for topic in {a.topic for a in adverts}
+        }
+        self._task: asyncio.Task[None] | None = None
+        self._started_at = time.time()
+
+    def _record(self, advert: Advert) -> ControlPlaneRecord:
+        return ControlPlaneRecord(
+            stamp=ControlPlaneStamp(
+                node_name=advert.node_name,
+                node_kind=advert.node_kind,
+                instance_id=advert.instance_id,
+                started_at=self._started_at,
+                heartbeat_at=time.time(),
+            ),
+            record=advert.payload,
+        )
+
+    async def start(self) -> None:
+        topics = sorted(self._writers)
+        await self._transport.ensure_topics(topics, compacted=True)
+        # first adverts: fail-loud
+        for advert in self._adverts:
+            await self._writers[advert.topic].put(
+                advert.key, self._record(advert).to_wire()
+            )
+        self._task = asyncio.get_running_loop().create_task(
+            self._beat(), name="control-plane-heartbeat"
+        )
+
+    async def _beat(self) -> None:
+        while True:
+            await asyncio.sleep(self._config.heartbeat_interval)
+            for advert in self._adverts:
+                try:
+                    await self._writers[advert.topic].put(
+                        advert.key, self._record(advert).to_wire()
+                    )
+                except Exception:  # noqa: BLE001 - per-tick resilience
+                    logger.warning(
+                        "heartbeat publish failed for %s (retrying next tick)",
+                        advert.key,
+                        exc_info=True,
+                    )
+
+    async def stop(self) -> None:
+        # cancel BEFORE tombstoning: no tick may resurrect a record
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+        for advert in self._adverts:
+            try:
+                await self._writers[advert.topic].tombstone(advert.key)
+            except Exception:  # noqa: BLE001
+                logger.warning("tombstone failed for %s", advert.key, exc_info=True)

@@ -26,7 +26,7 @@ from calfkit_tpu import protocol
 from calfkit_tpu.engine.model_client import ModelClient, ModelSettings
 from calfkit_tpu.engine.turn import FINAL_RESULT_TOOL, TurnOutcome, run_turn
 from calfkit_tpu.exceptions import NodeFaultError
-from calfkit_tpu.models.actions import Call, NodeResult, ReturnCall
+from calfkit_tpu.models.actions import Call, NodeResult, ReturnCall, TailCall
 from calfkit_tpu.models.agents import AgentCard
 from calfkit_tpu.models.capability import CapabilityRecord
 from calfkit_tpu.models.error_report import ErrorReport, FaultTypes
@@ -37,16 +37,61 @@ from calfkit_tpu.models.messages import (
     ToolReturnPart,
     UserPart,
 )
-from calfkit_tpu.models.payload import DataPart, TextPart, render_parts_as_text
+from calfkit_tpu.models.payload import (
+    DataPart,
+    TextPart,
+    render_parts_as_text,
+    retry_text_part,
+)
 from calfkit_tpu.models.tool_dispatch import ToolBinding, ToolCallRef
 from calfkit_tpu.nodes.base import BaseNodeDef, NodeRunContext, handler
-from calfkit_tpu.nodes.steps import DeniedCall, Fact, InferenceFact, Observed, Said
+from calfkit_tpu.nodes.projection import project
+from calfkit_tpu.nodes.steps import (
+    DeniedCall,
+    Fact,
+    HandedOff,
+    InferenceFact,
+    Observed,
+    Said,
+)
 from calfkit_tpu.nodes.tool import ToolNodeDef, eager_tools
+from calfkit_tpu.peers.handoff import HANDOFF_TOOL, arbitrate_handoff
+from calfkit_tpu.peers.messaging import MESSAGE_AGENT_TOOL
 
 Instructions = str | Callable[[NodeRunContext], str]
 ToolsSpec = Any  # ToolNodeDef list | ToolBinding list | selector with .resolve()
 
 CAPABILITY_VIEW_KEY = "capability_view"
+AGENTS_VIEW_KEY = "agents_view"
+
+
+def render_fault_for_model(report: ErrorReport) -> Any:
+    """A callee fault rendered as a model-visible retry part (the
+    ``surface_to_model`` prebuilt, reference: nodes/_tool_error.py:116)."""
+    return retry_text_part(
+        f"The tool call failed: {report.describe()}. "
+        "You may retry, use another tool, or answer without it."
+    )
+
+
+def surface_to_model(ctx: NodeRunContext, report: ErrorReport) -> list[Any]:
+    return [render_fault_for_model(report)]
+
+
+def _adapt_on_tool_error(fn: Callable[..., Any]) -> Callable[..., Any]:
+    """Adapt ``on_tool_error(tool_call_marker, ctx, report)`` onto the
+    kernel's 2-arg ``on_callee_error`` seam."""
+
+    async def seam(ctx: NodeRunContext, report: ErrorReport) -> Any:
+        marker = ctx.folding_marker
+        if not isinstance(marker, ToolCallMarker):
+            return None  # not a tool-call reply: fall through the chain
+        result = fn(marker, ctx, report)
+        if hasattr(result, "__await__"):
+            result = await result
+        return result
+
+    return seam
 
 
 class BaseAgentNodeDef(BaseNodeDef):
@@ -59,20 +104,36 @@ class BaseAgentNodeDef(BaseNodeDef):
         model: ModelClient,
         instructions: Instructions | None = None,
         tools: ToolsSpec = (),
+        peers: Sequence[Any] = (),  # Messaging / Handoff selectors
         output_type: type = str,
         description: str = "",
         model_settings: ModelSettings | None = None,
         max_output_retries: int = 2,
+        on_tool_error: Callable[..., Any] | None = None,
         **seams: Any,
     ):
         super().__init__(name, **seams)
         self.model = model
         self.instructions = instructions
         self.tools = tools
+        self.peers = list(peers)
+        kinds = [getattr(p, "kind", "?") for p in self.peers]
+        if len(kinds) != len(set(kinds)):
+            from calfkit_tpu.exceptions import LifecycleConfigError
+
+            raise LifecycleConfigError(
+                f"agent {name!r}: one peer selector per kind (got {kinds}); "
+                "list multiple names inside one selector instead"
+            )
         self.output_type = output_type
         self.description = description
         self.model_settings = model_settings
         self.max_output_retries = max_output_retries
+        if on_tool_error is not None:
+            # sugar: (tool_call_marker, ctx, report) -> parts | None, adapted
+            # onto the kernel's on_callee_error seam (reference:
+            # nodes/_tool_error.py:42-150)
+            self.on_callee_error.append(_adapt_on_tool_error(on_tool_error))
 
     # ------------------------------------------------------------- topics
     def input_topics(self) -> list[str]:
@@ -156,11 +217,16 @@ class BaseAgentNodeDef(BaseNodeDef):
         facts: list[Fact] = []
 
         # ---- build the staged request for this hop
+        staged: ModelRequest | None
         if ctx.delivery_kind == "call":
             if state.uncommitted_message is not None:
                 # a client-staged prompt (or a redelivered hop) already rides
                 # in the state; reuse it instead of double-staging
                 staged = state.uncommitted_message
+            elif not ctx.payload and state.message_history:
+                # a handoff continuation: the history is the conversation;
+                # nothing new to stage
+                staged = None
             else:
                 parts = ctx.payload
                 content = render_parts_as_text(parts) if parts else ""
@@ -170,19 +236,31 @@ class BaseAgentNodeDef(BaseNodeDef):
         else:
             staged = self._tool_results_request(ctx)
 
-        # ---- resolve tools & instructions
+        # ---- resolve tools, peers & instructions
         bindings = self._resolve_tools(ctx)
         self._guard_reserved_names(bindings)
+        peer_defs, peer_targets = self._resolve_peers(ctx)
         instructions = self._render_instructions(ctx)
-        request = staged.model_copy(update={"instructions": instructions})
-        messages = list(state.message_history) + [request]
+        # history is POV-projected: foreign turns render as attributed text
+        history = project(list(state.message_history), self.name)
+        if staged is not None:
+            request = staged.model_copy(update={"instructions": instructions})
+            messages = history + [request]
+        elif history and instructions:
+            messages = history[:-1] + [
+                history[-1].model_copy(update={"instructions": instructions})
+                if isinstance(history[-1], ModelRequest)
+                else history[-1]
+            ]
+        else:
+            messages = history
 
         # ---- ONE model turn
         started = time.perf_counter()
         outcome: TurnOutcome = await run_turn(
             self.model,
             messages,
-            tool_defs=[b.tool for b in bindings],
+            tool_defs=[b.tool for b in bindings] + peer_defs,
             output_type=self.output_type,
             settings=self.model_settings,
             author=self.name,
@@ -199,7 +277,8 @@ class BaseAgentNodeDef(BaseNodeDef):
         )
 
         # ---- commit the hop's messages (staged request + model output)
-        state.message_history.append(staged)
+        if staged is not None:
+            state.message_history.append(staged)
         state.message_history.extend(outcome.new_messages)
         state.uncommitted_message = None
         state.clear_inflight()
@@ -208,9 +287,18 @@ class BaseAgentNodeDef(BaseNodeDef):
         if text:
             facts.append(Said(text=text, author=self.name))
 
+        # ---- handoff arbitration (whole-response: first valid wins)
+        if any(c.tool_name == HANDOFF_TOOL for c in outcome.tool_calls):
+            action = self._arbitrate_handoff(ctx, outcome, peer_targets, facts)
+            if action is not None:
+                return Observed(action=action, facts=facts)
+            # no valid handoff: rejections already materialized as retries
+
         # ---- dispatch or finalize
         if outcome.tool_calls:
-            action = self._dispatch_tool_calls(ctx, bindings, outcome, facts)
+            action = self._dispatch_tool_calls(
+                ctx, bindings, outcome, facts, peer_targets
+            )
             return Observed(action=action, facts=facts)
         return Observed(action=self._final_action(outcome), facts=facts)
 
@@ -250,17 +338,124 @@ class BaseAgentNodeDef(BaseNodeDef):
         return rendered
 
     def _guard_reserved_names(self, bindings: list[ToolBinding]) -> None:
+        reserved = {MESSAGE_AGENT_TOOL, HANDOFF_TOOL}
         if self.output_type is not str:
-            for binding in bindings:
-                if binding.tool.name == FINAL_RESULT_TOOL:
-                    raise NodeFaultError(
-                        ErrorReport.build_safe(
-                            FaultTypes.LIFECYCLE_ERROR,
-                            f"tool name {FINAL_RESULT_TOOL!r} is reserved for "
-                            "structured output",
-                            node=self.node_id,
-                        )
+            reserved.add(FINAL_RESULT_TOOL)
+        for binding in bindings:
+            if binding.tool.name in reserved:
+                raise NodeFaultError(
+                    ErrorReport.build_safe(
+                        FaultTypes.LIFECYCLE_ERROR,
+                        f"tool name {binding.tool.name!r} is reserved (peer "
+                        "capabilities / structured output)",
+                        node=self.node_id,
                     )
+                )
+
+    def _resolve_peers(
+        self, ctx: NodeRunContext
+    ) -> tuple[list[Any], dict[str, set[str]]]:
+        """Per-turn peer resolution → (tool defs, kind -> allowed names)."""
+        if not self.peers:
+            return [], {}
+        cards = self._agent_cards(ctx)
+        defs: list[Any] = []
+        targets: dict[str, set[str]] = {}
+        for peer in self.peers:
+            allowed = {c.name for c in peer.allowed(cards, self.name)}
+            if not allowed:
+                continue  # no live targets: don't lure the model into a
+                # tool that can only be rejected
+            defs.append(peer.tool_def(cards, self.name))
+            targets.setdefault(peer.kind, set()).update(allowed)
+        return defs, targets
+
+    def _agent_cards(self, ctx: NodeRunContext) -> list[AgentCard]:
+        view = ctx.resource(AGENTS_VIEW_KEY)
+        if view is not None:
+            return view.records()
+        # no control plane: curated peer names resolve blindly by topic
+        # derivation; discover-mode peers need the live view
+        if any(getattr(p, "discover", False) for p in self.peers):
+            raise NodeFaultError(
+                ErrorReport.build_safe(
+                    FaultTypes.CAPABILITY_UNAVAILABLE,
+                    f"{self.node_id} uses discover-mode peers but no agents "
+                    "view is attached (control plane not running?)",
+                    node=self.node_id,
+                )
+            )
+        names = {n for p in self.peers for n in getattr(p, "names", [])}
+        return [AgentCard(name=n) for n in sorted(names)]
+
+    def _arbitrate_handoff(
+        self,
+        ctx: NodeRunContext,
+        outcome: TurnOutcome,
+        peer_targets: dict[str, set[str]],
+        facts: list[Fact],
+    ) -> NodeResult | None:
+        state = ctx.state
+        decision = arbitrate_handoff(
+            outcome.tool_calls, peer_targets.get("handoff", set())
+        )
+        for call in outcome.tool_calls:
+            state.tool_calls[call.tool_call_id] = call
+        closing: list[Any] = []
+        for call_id, stub in decision.stubbed.items():
+            call = state.tool_calls[call_id]
+            closing.append(
+                ToolReturnPart(
+                    tool_call_id=call_id, tool_name=call.tool_name, content=stub
+                )
+            )
+            facts.append(
+                DeniedCall(
+                    tool_call_id=call_id,
+                    tool_name=call.tool_name,
+                    reason="superseded by handoff",
+                )
+            )
+        for call_id, reason in decision.rejected.items():
+            if decision.winner is not None:
+                # a later handoff won: close the rejected call in-history so
+                # no tool call is left unanswered after the TailCall (real
+                # model APIs reject dangling tool_use)
+                closing.append(
+                    ToolReturnPart(
+                        tool_call_id=call_id,
+                        tool_name=HANDOFF_TOOL,
+                        content=reason,
+                    )
+                )
+            else:
+                state.tool_results[call_id] = RetryPart(
+                    content=reason,
+                    tool_call_id=call_id,
+                    tool_name=HANDOFF_TOOL,
+                )
+            facts.append(
+                DeniedCall(
+                    tool_call_id=call_id,
+                    tool_name=HANDOFF_TOOL,
+                    reason="invalid handoff target",
+                )
+            )
+        if decision.winner is None:
+            return None  # fall through: rejections loop another model turn
+        closing.append(
+            ToolReturnPart(
+                tool_call_id=decision.winner.tool_call_id,
+                tool_name=HANDOFF_TOOL,
+                content=f"Handing off to {decision.target}.",
+            )
+        )
+        state.message_history.append(ModelRequest(parts=closing))
+        state.clear_inflight()
+        facts.append(HandedOff(to_agent=decision.target, from_agent=self.name))
+        return TailCall(
+            target_topic=protocol.agent_input_topic(decision.target), route="run"
+        )
 
     def _dispatch_tool_calls(
         self,
@@ -268,15 +463,26 @@ class BaseAgentNodeDef(BaseNodeDef):
         bindings: list[ToolBinding],
         outcome: TurnOutcome,
         facts: list[Fact],
+        peer_targets: dict[str, set[str]] | None = None,
     ) -> NodeResult:
         """Validate each model call and build the Call batch; invalid calls
         become immediate retry results instead of dispatches (reference:
         agent.py:733-932)."""
         state = ctx.state
+        peer_targets = peer_targets or {}
         by_name = {b.tool.name: b for b in bindings}
         calls: list[Call] = []
         for tool_call in outcome.tool_calls:
+            if tool_call.tool_call_id in state.tool_results:
+                continue  # already closed (e.g. rejected handoff)
             state.tool_calls[tool_call.tool_call_id] = tool_call
+            if tool_call.tool_name == MESSAGE_AGENT_TOOL:
+                peer_call = self._message_agent_call(
+                    ctx, tool_call, peer_targets.get("messaging", set()), facts
+                )
+                if peer_call is not None:
+                    calls.append(peer_call)
+                continue
             binding = by_name.get(tool_call.tool_name)
             if binding is None:
                 state.tool_results[tool_call.tool_call_id] = RetryPart(
@@ -334,6 +540,52 @@ class BaseAgentNodeDef(BaseNodeDef):
             facts.clear()
             raise _AllCallsRejected()
         return calls if len(calls) > 1 else calls[0]
+
+    def _message_agent_call(
+        self,
+        ctx: NodeRunContext,
+        tool_call: Any,
+        allowed: set[str],
+        facts: list[Fact],
+    ) -> Call | None:
+        """Build the isolated-state Call for a model ``message_agent`` call
+        (reference: agent.py:540 — isolate_state + degenerate durable
+        batch); invalid targets become retries."""
+        state = ctx.state
+        try:
+            args = tool_call.args_dict()
+        except ValueError as exc:
+            args = None
+            reason = f"malformed arguments: {exc}"
+        if args is not None:
+            target = args.get("agent_name")
+            message = args.get("message", "")
+            if isinstance(target, str) and target in allowed:
+                return Call(
+                    target_topic=protocol.agent_input_topic(target),
+                    route="run",
+                    parts=[TextPart(text=str(message))],
+                    tag=tool_call.tool_call_id,
+                    marker=ToolCallMarker(
+                        tool_call_id=tool_call.tool_call_id,
+                        tool_name=MESSAGE_AGENT_TOOL,
+                    ),
+                    isolate_state=True,
+                )
+            reason = f"{target!r} is not an available agent"
+        state.tool_results[tool_call.tool_call_id] = RetryPart(
+            content=f"message_agent failed: {reason}",
+            tool_call_id=tool_call.tool_call_id,
+            tool_name=MESSAGE_AGENT_TOOL,
+        )
+        facts.append(
+            DeniedCall(
+                tool_call_id=tool_call.tool_call_id,
+                tool_name=MESSAGE_AGENT_TOOL,
+                reason=reason,
+            )
+        )
+        return None
 
     def _final_action(self, outcome: TurnOutcome) -> ReturnCall:
         output = outcome.output

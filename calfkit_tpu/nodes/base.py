@@ -91,6 +91,9 @@ class NodeRunContext:
     headers: dict[str, str] = field(default_factory=dict)
     # the resolved callee outcome for return/fault resumptions
     folded: FanoutOutcome | None = None
+    # the marker of the reply currently being resolved (set during stage-1
+    # aggregation so on_callee_error sugar can see which call faulted)
+    folding_marker: Any = None
     # the broadcast mirror fires at most once per hop
     mirrored: bool = False
     # captured at stage 0: the run's step-stream destination survives the
@@ -364,6 +367,7 @@ class BaseNodeDef(RegistryMixin):
     ) -> FanoutOutcome:
         """Stage-1 resolution: returns pass through; faults get the
         on_callee_error chain (parts = recovery, None = stays a fault)."""
+        ctx.folding_marker = getattr(reply, "marker", None)
         if isinstance(reply, ReturnMessage):
             outcome = FanoutOutcome(
                 slot_id=slot_id, parts=list(reply.parts), marker=reply.marker
@@ -636,8 +640,10 @@ class BaseNodeDef(RegistryMixin):
             frame = envelope.workflow.require_current()
             frame.target_topic = action.target_topic
             frame.route = action.route
-            if action.parts:
-                frame.payload = action.parts
+            # the retargeted frame carries ONLY what the TailCall specifies:
+            # keeping the old payload would re-stage the original prompt at
+            # the handoff target (duplicate user turns per hop)
+            frame.payload = action.parts
             envelope.reply = None
             await self._publish_envelope(
                 ctx, action.target_topic, envelope, kind="call", route=action.route

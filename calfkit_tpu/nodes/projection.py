@@ -1,0 +1,61 @@
+"""POV projection of multi-agent history.
+
+Each agent sees its OWN turns natively; other agents' turns appear as
+attributed user-visible text, and foreign tool calls/returns are stripped
+(a model must never see tool-call ids it didn't mint).  Reference:
+calfkit/nodes/_projection.py:88-139.
+"""
+
+from __future__ import annotations
+
+from calfkit_tpu.models.messages import (
+    ModelMessage,
+    ModelRequest,
+    ModelResponse,
+    RetryPart,
+    SystemPart,
+    ToolReturnPart,
+    UserPart,
+)
+
+
+def project(history: list[ModelMessage], self_name: str) -> list[ModelMessage]:
+    """Re-render ``history`` from ``self_name``'s point of view."""
+    projected: list[ModelMessage] = []
+    own_call_ids: set[str] = set()
+    for message in history:
+        if isinstance(message, ModelResponse):
+            author = message.author
+            if author is None or author == self_name:
+                own_call_ids |= {c.tool_call_id for c in message.tool_calls()}
+                projected.append(message)
+                continue
+            text = message.text()
+            if text:
+                projected.append(
+                    ModelRequest(
+                        parts=[UserPart(content=f"[{author}] {text}", author=author)]
+                    )
+                )
+            # foreign tool calls are stripped entirely
+            continue
+        # ModelRequest: keep own-tool returns/retries, user and system parts
+        kept = []
+        for part in message.parts:
+            if isinstance(part, (ToolReturnPart, RetryPart)):
+                if part.tool_call_id and part.tool_call_id not in own_call_ids:
+                    continue
+            kept.append(part)
+        if kept or message.instructions:
+            projected.append(
+                ModelRequest(parts=kept, instructions=message.instructions)
+            )
+    return projected
+
+
+def structured_output_preamble(schema_name: str) -> str:
+    """Reference: _projection.py:116."""
+    return (
+        f"When you have the final answer, return it as a {schema_name} "
+        "structured result rather than prose."
+    )

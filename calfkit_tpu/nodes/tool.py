@@ -133,10 +133,9 @@ class Tools:
     def __init__(
         self, *names: str, discover: bool = False, exclude: Sequence[str] = ()
     ):
-        if names and discover:
-            raise ValueError("Tools takes either names or discover=True, not both")
-        if not names and not discover:
-            raise ValueError("Tools requires tool names, or discover=True")
+        from calfkit_tpu.utils_names import validate_curated_or_discover
+
+        validate_curated_or_discover("Tools", names, discover)
         self.names = list(names)
         self.discover = discover
         self.exclude = set(exclude)

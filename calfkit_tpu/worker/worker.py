@@ -55,6 +55,23 @@ class Worker(LifecycleHookMixin):
         self.group_id = group_id
         self.max_workers = max_workers
         self.owns_transport = owns_transport
+        # control plane default ON: pass False (or a disabled config) to opt
+        # out; a ControlPlaneConfig customizes; a ControlPlane is used as-is
+        from calfkit_tpu.controlplane import ControlPlane, ControlPlaneConfig
+
+        if control_plane is None or control_plane is True:
+            control_plane = ControlPlane()
+        elif control_plane is False:
+            control_plane = None
+        elif isinstance(control_plane, ControlPlaneConfig):
+            control_plane = (
+                ControlPlane(control_plane) if control_plane.enabled else None
+            )
+        elif not hasattr(control_plane, "attach"):
+            raise LifecycleConfigError(
+                f"control_plane must be a ControlPlane, ControlPlaneConfig, "
+                f"True/False or None, got {type(control_plane).__name__}"
+            )
         self.control_plane = control_plane
         self.resources: dict[str, Any] = {}
         self._subscriptions: list[Subscription] = []
@@ -97,6 +114,13 @@ class Worker(LifecycleHookMixin):
                 await store.start()
                 self._stores.append(store)
                 node.resources[FANOUT_STORE_KEY] = store
+
+        # control plane attaches BEFORE subscriptions: a delivery consumed
+        # in the boot window must already find its views
+        if self.control_plane is not None:
+            self._advertiser = await self.control_plane.attach(self)
+
+        for node in self.nodes:
             subscribe_topics = list(node.input_topics()) + [node.return_topic()]
             subscription = await self.mesh.subscribe(
                 subscribe_topics,
@@ -105,10 +129,6 @@ class Worker(LifecycleHookMixin):
                 max_workers=self.max_workers,
             )
             self._subscriptions.append(subscription)
-
-        # control plane: adverts + heartbeats + views (present from layer 7 on)
-        if self.control_plane is not None:
-            self._advertiser = await self.control_plane.attach(self)
 
         await self._run_hooks(self._after_startup, phase="after_startup")
 

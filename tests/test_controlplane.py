@@ -1,0 +1,355 @@
+"""Control plane: adverts, heartbeats, staleness, views, client.mesh,
+discovery selectors, peers (messaging + handoff)."""
+
+import asyncio
+import time
+
+import pytest
+
+from calfkit_tpu import protocol
+from calfkit_tpu.client import Client
+from calfkit_tpu.controlplane import ControlPlaneConfig
+from calfkit_tpu.engine import EchoModelClient, FunctionModelClient, TestModelClient
+from calfkit_tpu.exceptions import MeshUnavailableError, NodeFaultError
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models import (
+    FaultTypes,
+    ModelResponse,
+    TextOutput,
+    ToolCallOutput,
+)
+from calfkit_tpu.nodes import Agent, Tools, agent_tool
+from calfkit_tpu.peers import Handoff, Messaging
+from calfkit_tpu.worker import Worker
+
+
+@agent_tool
+def lookup(q: str) -> str:
+    """Lookup a fact.
+
+    Args:
+        q: Query.
+    """
+    return f"fact({q})"
+
+
+class TestDiscovery:
+    async def test_adverts_views_and_mesh_directory(self):
+        mesh = InMemoryMesh()
+        agent = Agent("finder", model=TestModelClient(custom_output_text="ok"),
+                      tools=Tools(discover=True), description="Finds things.")
+        async with Worker([agent, lookup], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            cards = await client.mesh_directory.get_agents()
+            assert [c.name for c in cards] == ["finder"]
+            assert cards[0].description == "Finds things."
+            caps = await client.mesh_directory.get_capabilities()
+            assert caps and caps[0].tools[0].name == "lookup"
+            # discovery selector resolves the live tool and the run works
+            result = await client.agent("finder").execute("find it", timeout=10)
+            assert result.output == "ok"
+            await client.mesh_directory.close()
+            await client.close()
+
+    async def test_tombstones_on_worker_stop(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        agent = Agent("fleeting", model=EchoModelClient())
+        worker = Worker([agent], mesh=mesh)
+        await worker.start()
+        client = Client.connect(mesh)
+        assert [c.name for c in await client.mesh_directory.get_agents()] == ["fleeting"]
+        await worker.stop()
+        await asyncio.sleep(0.05)
+        assert await client.mesh_directory.get_agents() == []  # tombstoned
+        await client.mesh_directory.close()
+        await client.close()
+        await mesh.stop()
+
+    async def test_stale_heartbeats_filtered(self):
+        from calfkit_tpu.controlplane.view import ControlPlaneView
+        from calfkit_tpu.models.agents import AgentCard
+        from calfkit_tpu.models.records import ControlPlaneRecord, ControlPlaneStamp
+
+        mesh = InMemoryMesh()
+        await mesh.start()
+        writer = mesh.table_writer(protocol.AGENTS_TOPIC)
+        stale = ControlPlaneRecord(
+            stamp=ControlPlaneStamp(
+                node_name="ghost", node_kind="agent", instance_id="i1",
+                heartbeat_at=time.time() - 120,
+            ),
+            record=AgentCard(name="ghost").model_dump(),
+        )
+        live = ControlPlaneRecord(
+            stamp=ControlPlaneStamp(
+                node_name="alive", node_kind="agent", instance_id="i2",
+            ),
+            record=AgentCard(name="alive").model_dump(),
+        )
+        await writer.put("ghost@i1", stale.to_wire())
+        await writer.put("alive@i2", live.to_wire())
+        view = ControlPlaneView(mesh, protocol.AGENTS_TOPIC, AgentCard,
+                               stale_after=15.0)
+        await view.start()
+        assert [c.name for c in view.records()] == ["alive"]
+        await view.stop()
+        await mesh.stop()
+
+    async def test_discover_without_control_plane_faults(self):
+        mesh = InMemoryMesh()
+        agent = Agent("blind", model=TestModelClient(),
+                      tools=Tools(discover=True))
+        async with Worker([agent], mesh=mesh, owns_transport=True,
+                          control_plane=False):
+            client = Client.connect(mesh)
+            with pytest.raises(NodeFaultError) as exc_info:
+                await client.agent("blind").execute("x", timeout=10)
+            assert exc_info.value.report.error_type == FaultTypes.CAPABILITY_UNAVAILABLE
+            await client.close()
+
+    async def test_mesh_unavailable_reason(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        client = Client.connect(mesh)
+        # no worker ever ran: views catch up on empty topics fine -> empty
+        assert await client.mesh_directory.get_agents() == []
+        await client.mesh_directory.close()
+        await client.close()
+        await mesh.stop()
+
+
+class TestPeersMessaging:
+    async def test_message_agent_roundtrip_isolated_state(self):
+        turn = {"n": 0}
+
+        def asker_model(messages, params):
+            turn["n"] += 1
+            if turn["n"] == 1:
+                return ModelResponse(parts=[ToolCallOutput(
+                    tool_call_id="m1", tool_name="message_agent",
+                    args={"agent_name": "expert", "message": "What is X?"},
+                )])
+            return ModelResponse(parts=[TextOutput(text="expert says: done")])
+
+        expert_seen = {}
+
+        def expert_model(messages, params):
+            expert_seen["history_len"] = len(messages)
+            return ModelResponse(parts=[TextOutput(text="X is 42")])
+
+        mesh = InMemoryMesh()
+        asker = Agent("asker", model=FunctionModelClient(asker_model),
+                      peers=[Messaging("expert")])
+        expert = Agent("expert", model=FunctionModelClient(expert_model),
+                       description="Knows X.")
+        async with Worker([asker, expert], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("asker").execute("ask the expert", timeout=15)
+            assert result.output == "expert says: done"
+            # isolation: the expert saw ONLY the message, not asker's history
+            assert expert_seen["history_len"] == 1
+            # the reply was materialized into asker's history as a tool return
+            history = result.state.message_history
+            returns = [p for m in history if m.role == "request"
+                       for p in m.parts if p.kind == "tool_return"]
+            assert any("X is 42" in str(r.content) for r in returns)
+            await client.close()
+
+    async def test_message_unknown_agent_retries(self):
+        turn = {"n": 0}
+
+        def model(messages, params):
+            turn["n"] += 1
+            if turn["n"] == 1:
+                return ModelResponse(parts=[ToolCallOutput(
+                    tool_call_id="m1", tool_name="message_agent",
+                    args={"agent_name": "nobody", "message": "hi"},
+                )])
+            # sees the retry and gives up gracefully
+            return ModelResponse(parts=[TextOutput(text="could not reach")])
+
+        mesh = InMemoryMesh()
+        agent = Agent("lonely", model=FunctionModelClient(model),
+                      peers=[Messaging("friend")])  # friend not deployed
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("lonely").execute("try", timeout=15)
+            assert result.output == "could not reach"
+            assert turn["n"] == 2
+            await client.close()
+
+
+class TestPeersHandoff:
+    async def test_handoff_tailcall_reaches_caller(self):
+        def fronter_model(messages, params):
+            return ModelResponse(parts=[ToolCallOutput(
+                tool_call_id="h1", tool_name="handoff_to_agent",
+                args={"agent_name": "specialist"},
+            )])
+
+        def specialist_model(messages, params):
+            return ModelResponse(parts=[TextOutput(text="specialist answer")])
+
+        mesh = InMemoryMesh()
+        fronter = Agent("fronter", model=FunctionModelClient(fronter_model),
+                        peers=[Handoff("specialist")])
+        specialist = Agent("specialist", model=FunctionModelClient(specialist_model))
+        async with Worker([fronter, specialist], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            handle = await client.agent("fronter").start("help me", timeout=15)
+            events = [e async for e in handle.stream()]
+            final = events[-1]
+            assert final.output == "specialist answer"
+            kinds = [e.step.kind for e in events if hasattr(e, "step")]
+            assert "handoff" in kinds
+            await client.close()
+
+    async def test_invalid_handoff_target_retries(self):
+        turn = {"n": 0}
+
+        def model(messages, params):
+            turn["n"] += 1
+            if turn["n"] == 1:
+                return ModelResponse(parts=[ToolCallOutput(
+                    tool_call_id="h1", tool_name="handoff_to_agent",
+                    args={"agent_name": "ghost"},
+                )])
+            return ModelResponse(parts=[TextOutput(text="staying here")])
+
+        mesh = InMemoryMesh()
+        agent = Agent("careful", model=FunctionModelClient(model),
+                      peers=[Handoff("real_target")])
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("careful").execute("go", timeout=15)
+            assert result.output == "staying here"
+            await client.close()
+
+
+class TestProjection:
+    def test_pov_projection(self):
+        from calfkit_tpu.models.messages import (
+            ModelRequest,
+            ToolReturnPart,
+            UserPart,
+        )
+        from calfkit_tpu.nodes.projection import project
+
+        history = [
+            ModelRequest(parts=[UserPart(content="hi")]),
+            ModelResponse(parts=[
+                TextOutput(text="let me check"),
+                ToolCallOutput(tool_call_id="t1", tool_name="lookup", args={}),
+            ], author="me"),
+            ModelRequest(parts=[ToolReturnPart(tool_call_id="t1",
+                                               tool_name="lookup", content="x")]),
+            ModelResponse(parts=[TextOutput(text="other agent speaking")],
+                          author="other"),
+        ]
+        mine = project(history, "me")
+        # own turns native (tool call + return preserved)
+        assert mine[1].tool_calls()[0].tool_call_id == "t1"
+        assert mine[2].parts[0].kind == "tool_return"
+        # foreign turn rendered as attributed user text
+        assert mine[3].role == "request"
+        assert "[other]" in mine[3].parts[0].content
+
+        theirs = project(history, "other")
+        # my tool call/return stripped from their view; my text attributed
+        flat = [p.kind for m in theirs if m.role == "request" for p in m.parts]
+        assert "tool_return" not in flat
+        assert any("[me]" in str(getattr(p, "content", ""))
+                   for m in theirs if m.role == "request" for p in m.parts)
+
+
+class TestOnToolError:
+    async def test_on_tool_error_substitutes(self):
+        @agent_tool
+        def fragile() -> str:
+            raise RuntimeError("backend down")
+
+        turn = {"n": 0}
+
+        def model(messages, params):
+            turn["n"] += 1
+            if turn["n"] == 1:
+                return ModelResponse(parts=[ToolCallOutput(
+                    tool_call_id="f1", tool_name="fragile", args={})])
+            return ModelResponse(parts=[TextOutput(text="handled gracefully")])
+
+        def on_tool_error(marker, ctx, report):
+            assert marker.tool_name == "fragile"
+            from calfkit_tpu.models import TextPart
+            return [TextPart(text=f"(fallback for {marker.tool_name})")]
+
+        mesh = InMemoryMesh()
+        agent = Agent("resilient", model=FunctionModelClient(model),
+                      tools=[fragile], on_tool_error=on_tool_error)
+        async with Worker([agent, fragile], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("resilient").execute("go", timeout=15)
+            assert result.output == "handled gracefully"
+            await client.close()
+
+
+class TestHandoffRegressions:
+    async def test_handoff_does_not_duplicate_prompt(self):
+        """The TailCall clears the frame payload: the target must see the
+        user prompt exactly once (via shared history), not re-staged."""
+        seen = {}
+
+        def fronter_model(messages, params):
+            return ModelResponse(parts=[ToolCallOutput(
+                tool_call_id="h1", tool_name="handoff_to_agent",
+                args={"agent_name": "target"})])
+
+        def target_model(messages, params):
+            texts = [
+                p.content for m in messages if m.role == "request"
+                for p in m.parts if p.kind == "user"
+                and isinstance(p.content, str)
+            ]
+            seen["user_texts"] = texts
+            return ModelResponse(parts=[TextOutput(text="done")])
+
+        mesh = InMemoryMesh()
+        fronter = Agent("fronter2", model=FunctionModelClient(fronter_model),
+                        peers=[Handoff("target")])
+        target = Agent("target", model=FunctionModelClient(target_model))
+        async with Worker([fronter, target], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("fronter2").execute("the prompt", timeout=15)
+            assert result.output == "done"
+            assert seen["user_texts"].count("the prompt") == 1
+            await client.close()
+
+    async def test_losing_handoff_calls_are_closed_in_history(self):
+        """Rejected handoff + later winner: every tool call in the committed
+        history must have a matching closure (no dangling tool_use)."""
+        def model(messages, params):
+            return ModelResponse(parts=[
+                ToolCallOutput(tool_call_id="bad", tool_name="handoff_to_agent",
+                               args={"agent_name": "ghost"}),
+                ToolCallOutput(tool_call_id="good", tool_name="handoff_to_agent",
+                               args={"agent_name": "sink"}),
+            ])
+
+        def sink_model(messages, params):
+            # every tool call id in history must be answered
+            call_ids = {c.tool_call_id for m in messages if m.role == "response"
+                        for c in m.tool_calls()}
+            answered = {p.tool_call_id for m in messages if m.role == "request"
+                        for p in m.parts if p.kind in ("tool_return", "retry")}
+            assert call_ids <= answered, f"dangling: {call_ids - answered}"
+            return ModelResponse(parts=[TextOutput(text="clean")])
+
+        mesh = InMemoryMesh()
+        a = Agent("chooser", model=FunctionModelClient(model),
+                  peers=[Handoff("sink")])
+        sink = Agent("sink", model=FunctionModelClient(sink_model))
+        async with Worker([a, sink], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("chooser").execute("pick", timeout=15)
+            assert result.output == "clean"
+            await client.close()
