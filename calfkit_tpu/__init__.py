@@ -29,8 +29,10 @@ _LAZY: dict[str, str] = {
     "consumer": "calfkit_tpu.nodes",
     "ConsumerNode": "calfkit_tpu.nodes",
     "Tools": "calfkit_tpu.nodes",
-    "Toolboxes": "calfkit_tpu.nodes",
-    "MCPToolboxNode": "calfkit_tpu.nodes",
+    "Toolbox": "calfkit_tpu.mcp",
+    "Toolboxes": "calfkit_tpu.mcp",
+    "MCPToolboxNode": "calfkit_tpu.mcp",
+    "MCPServerSpec": "calfkit_tpu.mcp",
     "Messaging": "calfkit_tpu.peers",
     "Handoff": "calfkit_tpu.peers",
     "NodeFaultError": "calfkit_tpu.exceptions",

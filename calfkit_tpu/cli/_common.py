@@ -1,0 +1,68 @@
+"""Shared CLI helpers: node loading, mesh resolution."""
+
+from __future__ import annotations
+
+import importlib
+import importlib.util
+import os
+import sys
+from pathlib import Path
+from typing import Any
+
+import click
+
+MESH_URL_ENV = "CALFKIT_MESH_URL"
+
+
+def load_object(spec: str) -> Any:
+    """Load ``module:attr`` or ``path/to/file.py:attr``."""
+    if ":" not in spec:
+        raise click.ClickException(
+            f"node spec {spec!r} must be 'module:attr' or 'file.py:attr'"
+        )
+    module_part, attr = spec.rsplit(":", 1)
+    if module_part.endswith(".py") or "/" in module_part:
+        path = Path(module_part).resolve()
+        if not path.exists():
+            raise click.ClickException(f"no such file: {path}")
+        sys.path.insert(0, str(path.parent))
+        spec_obj = importlib.util.spec_from_file_location(path.stem, path)
+        module = importlib.util.module_from_spec(spec_obj)
+        sys.modules[path.stem] = module
+        spec_obj.loader.exec_module(module)
+    else:
+        module = importlib.import_module(module_part)
+    try:
+        return getattr(module, attr)
+    except AttributeError as exc:
+        raise click.ClickException(
+            f"{module_part!r} has no attribute {attr!r}"
+        ) from exc
+
+
+def load_nodes(specs: tuple[str, ...]) -> list[Any]:
+    nodes: list[Any] = []
+    for spec in specs:
+        obj = load_object(spec)
+        nodes.extend(obj if isinstance(obj, (list, tuple)) else [obj])
+    return nodes
+
+
+def resolve_mesh(url: str | None) -> Any:
+    """Build a transport from a mesh url.
+
+    - ``memory://`` (or unset) → in-process InMemoryMesh (single-process dev)
+    - ``kafka://host:port[,host:port...]`` → KafkaMesh (needs aiokafka)
+    """
+    url = url or os.environ.get(MESH_URL_ENV) or "memory://"
+    if url.startswith("memory://"):
+        from calfkit_tpu.mesh import InMemoryMesh
+
+        return InMemoryMesh()
+    if url.startswith("kafka://"):
+        from calfkit_tpu.mesh.kafka import KafkaMesh
+
+        return KafkaMesh(url.removeprefix("kafka://"))
+    raise click.ClickException(
+        f"unsupported mesh url {url!r} (use memory:// or kafka://host:port)"
+    )

@@ -1,0 +1,57 @@
+"""``ck dev`` — the zero-setup dev loop (reference: cli/dev.py:41-51).
+
+The reference spawns a bundled single-binary broker; this build's dev mesh is
+the in-process :class:`InMemoryMesh`, so ``ck dev run`` hosts the nodes AND
+the chat REPL in one process — no broker, no setup.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import click
+
+
+@click.group("dev", help="single-process dev mesh: serve + chat, no broker")
+def dev_group() -> None:
+    pass
+
+
+@dev_group.command("run")
+@click.argument("specs", nargs=-1, required=True)
+@click.option("--agent", "agent_name", default=None)
+def dev_run(specs: tuple[str, ...], agent_name: str | None) -> None:
+    """Serve nodes on an in-memory mesh and chat with them."""
+    from calfkit_tpu.cli._common import load_nodes
+    from calfkit_tpu.cli.chat import repl
+    from calfkit_tpu.client import Client
+    from calfkit_tpu.mesh import InMemoryMesh
+    from calfkit_tpu.worker import Worker
+
+    nodes = load_nodes(specs)
+
+    async def main() -> None:
+        mesh = InMemoryMesh()
+        async with Worker(nodes, mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            name = agent_name
+            if name is None:
+                agents = [n.name for n in nodes if n.kind == "agent"]
+                if not agents:
+                    raise click.ClickException("no agent nodes among the specs")
+                name = agents[0]
+            click.echo(f"dev mesh up: {[n.name for n in nodes]}; chatting with {name!r}")
+            await repl(client, name)
+            await client.close()
+
+    asyncio.run(main())
+
+
+@dev_group.command("status")
+def dev_status() -> None:
+    """Explain the dev-mesh model."""
+    click.echo(
+        "The dev mesh is in-process (memory://): `ck dev run file.py:agent` "
+        "serves and chats in one process.\nFor a multi-process mesh, point "
+        "CALFKIT_MESH_URL at a Kafka-compatible broker (kafka://host:port)."
+    )

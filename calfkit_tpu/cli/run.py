@@ -1,0 +1,27 @@
+"""``ck run module:attr ...`` — serve nodes (reference: cli/run.py:37)."""
+
+from __future__ import annotations
+
+import click
+
+from calfkit_tpu.cli._common import load_nodes, resolve_mesh
+
+
+@click.command("run")
+@click.argument("specs", nargs=-1, required=True)
+@click.option("--mesh", "mesh_url", default=None, help="memory:// or kafka://host:port")
+@click.option("--max-workers", default=8, show_default=True)
+@click.option("--group-id", default=None, help="override per-node consumer groups")
+def run_command(specs: tuple[str, ...], mesh_url: str | None, max_workers: int,
+                group_id: str | None) -> None:
+    """Serve the given nodes until interrupted."""
+    from calfkit_tpu.worker import Worker
+
+    nodes = load_nodes(specs)
+    mesh = resolve_mesh(mesh_url)
+    click.echo(f"serving {len(nodes)} node(s): {[n.name for n in nodes]}")
+    worker = Worker(
+        nodes, mesh=mesh, owns_transport=True, max_workers=max_workers,
+        group_id=group_id,
+    )
+    worker.run()

@@ -1,0 +1,48 @@
+"""``ck topics`` — provisioning (reference: cli/topics.py)."""
+
+from __future__ import annotations
+
+import asyncio
+
+import click
+
+from calfkit_tpu.cli._common import load_nodes, resolve_mesh
+
+
+@click.group("topics", help="topic provisioning")
+def topics_group() -> None:
+    pass
+
+
+@topics_group.command("provision")
+@click.argument("specs", nargs=-1, required=True)
+@click.option("--mesh", "mesh_url", default=None)
+@click.option("--dry-run", is_flag=True, help="print the topic plan only")
+def provision_command(specs: tuple[str, ...], mesh_url: str | None,
+                      dry_run: bool) -> None:
+    """Create every topic the given nodes need."""
+    from calfkit_tpu.provisioning import (
+        framework_topics_for_nodes,
+        provision,
+        topics_for_nodes,
+    )
+
+    nodes = load_nodes(specs)
+    if dry_run:
+        for topic in topics_for_nodes(nodes):
+            click.echo(f"  {topic}")
+        for topic in framework_topics_for_nodes(nodes):
+            click.echo(f"  {topic} (compacted)")
+        return
+
+    async def main() -> None:
+        mesh = resolve_mesh(mesh_url)
+        await mesh.start()
+        result = await provision(mesh, nodes)
+        click.echo(
+            f"provisioned {len(result['plain'])} topics "
+            f"+ {len(result['compacted'])} compacted"
+        )
+        await mesh.stop()
+
+    asyncio.run(main())

@@ -57,6 +57,7 @@ class ControlPlane:
                     node_kind=node.kind,
                     instance_id=node.instance_id,
                     payload=card.model_dump(),
+                    payload_fn=lambda n=node: n.agent_card().model_dump(),
                 )
             )
         if hasattr(node, "capability_record"):
@@ -68,6 +69,7 @@ class ControlPlane:
                     node_kind=node.kind,
                     instance_id=node.instance_id,
                     payload=record.model_dump(),
+                    payload_fn=lambda n=node: n.capability_record().model_dump(),
                 )
             )
         return adverts

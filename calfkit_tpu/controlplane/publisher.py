@@ -32,10 +32,23 @@ class Advert:
     node_kind: str
     instance_id: str
     payload: dict[str, Any]  # AgentCard / CapabilityRecord dump
+    # re-derives the payload per heartbeat tick so runtime changes (e.g. an
+    # MCP toolbox re-listing after tools/list_changed) reach the directory
+    payload_fn: Any = None  # Callable[[], dict] | None
 
     @property
     def key(self) -> str:
         return f"{self.node_name}@{self.instance_id}"
+
+    def current_payload(self) -> dict[str, Any]:
+        if self.payload_fn is not None:
+            try:
+                return self.payload_fn()
+            except Exception:  # noqa: BLE001 - fall back to the boot snapshot
+                logger.warning(
+                    "advert payload refresh failed for %s", self.key, exc_info=True
+                )
+        return self.payload
 
 
 class ControlPlanePublisher:
@@ -64,7 +77,7 @@ class ControlPlanePublisher:
                 started_at=self._started_at,
                 heartbeat_at=time.time(),
             ),
-            record=advert.payload,
+            record=advert.current_payload(),
         )
 
     async def start(self) -> None:

@@ -1,0 +1,11 @@
+"""MCP toolbox support: host an MCP server connection as a mesh node.
+
+The MCP python SDK is not a dependency — :mod:`calfkit_tpu.mcp.transport`
+implements the minimal JSON-RPC client (stdio + streamable HTTP) the toolbox
+needs: initialize, tools/list, tools/call, and list_changed notifications.
+"""
+
+from calfkit_tpu.mcp.toolbox import MCPToolboxNode, Toolbox, Toolboxes
+from calfkit_tpu.mcp.transport import MCPServerSpec, MCPSession
+
+__all__ = ["MCPServerSpec", "MCPSession", "MCPToolboxNode", "Toolbox", "Toolboxes"]

@@ -1,0 +1,15 @@
+"""Topic provisioning (SURVEY.md §1 layer 9)."""
+
+from calfkit_tpu.provisioning.provisioner import (
+    ProvisioningConfig,
+    framework_topics_for_nodes,
+    provision,
+    topics_for_nodes,
+)
+
+__all__ = [
+    "ProvisioningConfig",
+    "framework_topics_for_nodes",
+    "provision",
+    "topics_for_nodes",
+]

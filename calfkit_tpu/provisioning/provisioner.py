@@ -1,0 +1,70 @@
+"""Topic-set derivation + idempotent creation.
+
+Reference: calfkit/provisioning/provisioner.py:28-73 (``topics_for_nodes`` /
+``framework_topics_for_nodes``) and the created/existing/unauthorized
+classification at :13-18.  The transport's ``ensure_topics`` performs the
+actual creation; this module owns which topics exist and why.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Iterable
+
+from pydantic import BaseModel
+
+from calfkit_tpu import protocol
+from calfkit_tpu.exceptions import ProvisioningError
+from calfkit_tpu.mesh.transport import MeshTransport
+from calfkit_tpu.nodes.base import BaseNodeDef
+
+logger = logging.getLogger(__name__)
+
+
+class ProvisioningConfig(BaseModel):
+    enabled: bool = True
+    include_framework: bool = True
+
+
+def topics_for_nodes(nodes: Iterable[BaseNodeDef]) -> list[str]:
+    """Every topic the nodes themselves consume or publish."""
+    topics: set[str] = set()
+    for node in nodes:
+        topics.update(node.all_topics())
+    return sorted(topics)
+
+
+def framework_topics_for_nodes(nodes: Iterable[BaseNodeDef]) -> list[str]:
+    """Framework-owned topics backing the nodes: control plane + durable
+    fan-out tables (compacted)."""
+    topics: set[str] = {protocol.AGENTS_TOPIC, protocol.CAPABILITIES_TOPIC}
+    for node in nodes:
+        topics.add(protocol.fanout_state_topic(node.node_id))
+        topics.add(protocol.fanout_basestate_topic(node.node_id))
+    return sorted(topics)
+
+
+async def provision(
+    transport: MeshTransport,
+    nodes: Iterable[BaseNodeDef],
+    config: ProvisioningConfig | None = None,
+) -> dict[str, list[str]]:
+    """Create all topics for ``nodes``; returns {"plain": [...], "compacted":
+    [...]} of what was ensured.  Raises ProvisioningError on failure."""
+    config = config or ProvisioningConfig()
+    if not config.enabled:
+        return {"plain": [], "compacted": []}
+    nodes = list(nodes)
+    plain = topics_for_nodes(nodes)
+    compacted = framework_topics_for_nodes(nodes) if config.include_framework else []
+    try:
+        await transport.ensure_topics(plain)
+        if compacted:
+            await transport.ensure_topics(compacted, compacted=True)
+    except Exception as exc:  # noqa: BLE001
+        raise ProvisioningError(f"topic provisioning failed: {exc}") from exc
+    logger.info(
+        "provisioned %d topics (%d compacted)", len(plain) + len(compacted),
+        len(compacted),
+    )
+    return {"plain": plain, "compacted": compacted}

@@ -115,6 +115,13 @@ class Worker(LifecycleHookMixin):
                 self._stores.append(store)
                 node.resources[FANOUT_STORE_KEY] = store
 
+        # session-backed nodes (MCP toolboxes) connect before adverts so
+        # their capability records list real tools; independent handshakes
+        # run in parallel
+        sessions = [n for n in self.nodes if hasattr(n, "start_session")]
+        if sessions:
+            await asyncio.gather(*(n.start_session() for n in sessions))
+
         # control plane attaches BEFORE subscriptions: a delivery consumed
         # in the boot window must already find its views
         if self.control_plane is not None:
@@ -153,6 +160,10 @@ class Worker(LifecycleHookMixin):
             with contextlib.suppress(Exception):
                 await store.stop()
         self._stores = []
+        for node in self.nodes:
+            if hasattr(node, "stop_session"):
+                with contextlib.suppress(Exception):
+                    await node.stop_session()
         with contextlib.suppress(Exception):
             await self._run_hooks(self._after_shutdown, phase="after_shutdown")
         await self._exit_resources()

@@ -1,0 +1,71 @@
+"""A tiny in-repo stdio MCP server for round-trip tests (the analog of the
+reference's tests/integration/_mcp_roundtrip_server.py): newline-delimited
+JSON-RPC with two tools."""
+
+import json
+import sys
+
+TOOLS = [
+    {
+        "name": "add",
+        "description": "Add two integers.",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"a": {"type": "integer"}, "b": {"type": "integer"}},
+            "required": ["a", "b"],
+        },
+    },
+    {
+        "name": "shout",
+        "description": "Uppercase a string.",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"text": {"type": "string"}},
+            "required": ["text"],
+        },
+    },
+]
+
+
+def reply(rpc_id, result):
+    sys.stdout.write(json.dumps({"jsonrpc": "2.0", "id": rpc_id, "result": result}) + "\n")
+    sys.stdout.flush()
+
+
+def main() -> None:
+    for line in sys.stdin:
+        try:
+            message = json.loads(line)
+        except ValueError:
+            continue
+        method = message.get("method")
+        rpc_id = message.get("id")
+        if method == "initialize":
+            reply(rpc_id, {
+                "protocolVersion": message["params"]["protocolVersion"],
+                "capabilities": {"tools": {"listChanged": True}},
+                "serverInfo": {"name": "test-mcp", "version": "0"},
+            })
+        elif method == "tools/list":
+            reply(rpc_id, {"tools": TOOLS})
+        elif method == "tools/call":
+            name = message["params"]["name"]
+            args = message["params"].get("arguments", {})
+            if name == "add":
+                text = str(args["a"] + args["b"])
+            elif name == "shout":
+                text = str(args["text"]).upper()
+            else:
+                sys.stdout.write(json.dumps({
+                    "jsonrpc": "2.0", "id": rpc_id,
+                    "error": {"code": -32601, "message": f"no tool {name}"},
+                }) + "\n")
+                sys.stdout.flush()
+                continue
+            reply(rpc_id, {"content": [{"type": "text", "text": text}]})
+        elif rpc_id is not None:
+            reply(rpc_id, {})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""MCP toolbox: stdio round trip through a real subprocess server, selector
+trust boundary, agent integration."""
+
+import sys
+from pathlib import Path
+
+import pytest
+
+from calfkit_tpu.client import Client
+from calfkit_tpu.engine import FunctionModelClient
+from calfkit_tpu.mcp import MCPServerSpec, MCPSession, MCPToolboxNode, Toolbox
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models import ModelResponse, TextOutput, ToolCallOutput
+from calfkit_tpu.nodes import Agent
+from calfkit_tpu.worker import Worker
+
+SERVER = [sys.executable, str(Path(__file__).parent / "_mcp_server.py")]
+
+
+class TestMCPSession:
+    async def test_initialize_list_call(self):
+        session = MCPSession(MCPServerSpec(name="t", command=SERVER))
+        await session.start()
+        tools = await session.list_tools()
+        assert {t["name"] for t in tools} == {"add", "shout"}
+        assert await session.call_tool("add", {"a": 2, "b": 3}) == "5"
+        assert await session.call_tool("shout", {"text": "hi"}) == "HI"
+        with pytest.raises(Exception):
+            await session.call_tool("missing", {})
+        await session.stop()
+
+    def test_spec_xor(self):
+        with pytest.raises(ValueError):
+            MCPServerSpec(name="bad")
+        with pytest.raises(ValueError):
+            MCPServerSpec(name="bad", command=["x"], url="http://y")
+
+
+class TestToolboxNode:
+    async def test_agent_uses_mcp_tool_through_mesh(self):
+        toolbox = MCPToolboxNode(MCPServerSpec(name="calc", command=SERVER))
+        turn = {"n": 0}
+
+        def model(messages, params):
+            turn["n"] += 1
+            if turn["n"] == 1:
+                # the namespaced tool name came from the capability view
+                names = [t.name for t in params.tool_defs]
+                assert "toolbox.calc__add" in names
+                return ModelResponse(parts=[ToolCallOutput(
+                    tool_call_id="c1", tool_name="toolbox.calc__add",
+                    args={"a": 20, "b": 22})])
+            # the tool result is in the request
+            return ModelResponse(parts=[TextOutput(text="the answer is 42")])
+
+        agent = Agent(
+            "mathy", model=FunctionModelClient(model), tools=Toolbox("calc")
+        )
+        mesh = InMemoryMesh()
+        async with Worker([agent, toolbox], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("mathy").execute("what is 20+22?", timeout=15)
+            assert result.output == "the answer is 42"
+            await client.close()
+
+    async def test_include_trust_boundary(self):
+        toolbox = MCPToolboxNode(MCPServerSpec(name="locked", command=SERVER))
+        mesh = InMemoryMesh()
+        async with Worker([toolbox], mesh=mesh, owns_transport=True) as worker:
+            records = [toolbox.capability_record()]
+            allowed = Toolbox("locked", include=["shout"]).resolve(records)
+            assert [b.tool.name for b in allowed] == ["toolbox.locked__shout"]
+            everything = Toolbox("locked").resolve(records)
+            assert len(everything) == 2
