@@ -59,10 +59,14 @@ def resolve_mesh(url: str | None) -> Any:
         from calfkit_tpu.mesh import InMemoryMesh
 
         return InMemoryMesh()
+    if url.startswith("tcp://"):
+        from calfkit_tpu.mesh.tcp import TcpMesh
+
+        return TcpMesh(url.removeprefix("tcp://"))
     if url.startswith("kafka://"):
         from calfkit_tpu.mesh.kafka import KafkaMesh
 
         return KafkaMesh(url.removeprefix("kafka://"))
     raise click.ClickException(
-        f"unsupported mesh url {url!r} (use memory:// or kafka://host:port)"
+        f"unsupported mesh url {url!r} (use memory://, tcp://host:port or kafka://host:port)"
     )

@@ -47,11 +47,36 @@ def dev_run(specs: tuple[str, ...], agent_name: str | None) -> None:
     asyncio.run(main())
 
 
+@dev_group.command("mesh")
+@click.option("--port", default=19092, show_default=True)
+def dev_mesh(port: int) -> None:
+    """Run the native multi-process dev broker (meshd).
+
+    Then serve/chat from other terminals with --mesh tcp://127.0.0.1:PORT.
+    """
+    from calfkit_tpu.mesh.tcp import spawn_meshd
+
+    try:
+        proc = spawn_meshd(port)
+    except (FileNotFoundError, RuntimeError, TimeoutError) as exc:
+        raise click.ClickException(str(exc)) from exc
+    click.echo(
+        f"meshd up on tcp://127.0.0.1:{port} — export "
+        f"CALFKIT_MESH_URL=tcp://127.0.0.1:{port} (ctrl-c to stop)"
+    )
+    try:
+        proc.wait()
+    except KeyboardInterrupt:
+        proc.terminate()
+        click.echo("meshd stopped")
+
+
 @dev_group.command("status")
 def dev_status() -> None:
     """Explain the dev-mesh model."""
     click.echo(
-        "The dev mesh is in-process (memory://): `ck dev run file.py:agent` "
-        "serves and chats in one process.\nFor a multi-process mesh, point "
-        "CALFKIT_MESH_URL at a Kafka-compatible broker (kafka://host:port)."
+        "Single-process: `ck dev run file.py:agent` (memory:// — serve + chat "
+        "in one process, zero setup).\nMulti-process: `ck dev mesh` runs the "
+        "native meshd broker; point --mesh/CALFKIT_MESH_URL at "
+        "tcp://127.0.0.1:19092.\nProduction: kafka://host:port."
     )

@@ -26,7 +26,13 @@ from typing import Awaitable, Callable
 from calfkit_tpu.exceptions import MeshUnavailableError
 from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
 from calfkit_tpu.mesh.tables import TableReader, TableWriter
-from calfkit_tpu.mesh.transport import MeshTransport, Record, RecordHandler, Subscription
+from calfkit_tpu.mesh.transport import (
+    CallbackSubscription,
+    MeshTransport,
+    Record,
+    RecordHandler,
+    Subscription,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -42,14 +48,6 @@ def _aiokafka():
             "use InMemoryMesh for local development",
             reason="missing-dependency",
         ) from exc
-
-
-class _KafkaSubscription(Subscription):
-    def __init__(self, stop_fn: Callable[[], Awaitable[None]]):
-        self._stop_fn = stop_fn
-
-    async def stop(self) -> None:
-        await self._stop_fn()
 
 
 class KafkaMesh(MeshTransport):
@@ -235,7 +233,7 @@ class KafkaMesh(MeshTransport):
                 if dispatcher in self._dispatchers:
                     self._dispatchers.remove(dispatcher)
 
-        return _KafkaSubscription(stop_fn)
+        return CallbackSubscription(stop_fn)
 
     # --------------------------------------------------------------- tables
     def table_reader(self, topic: str) -> TableReader:

@@ -27,7 +27,13 @@ from typing import Awaitable, Callable
 
 from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
 from calfkit_tpu.mesh.tables import TableReader, TableWriter
-from calfkit_tpu.mesh.transport import MeshTransport, Record, RecordHandler, Subscription
+from calfkit_tpu.mesh.transport import (
+    CallbackSubscription,
+    MeshTransport,
+    Record,
+    RecordHandler,
+    Subscription,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -119,14 +125,6 @@ class _Group:
 class _GroupMember:
     def __init__(self) -> None:
         self.assigned: list[int] = []
-
-
-class _MemorySubscription(Subscription):
-    def __init__(self, stop_fn: Callable[[], Awaitable[None]]):
-        self._stop_fn = stop_fn
-
-    async def stop(self) -> None:
-        await self._stop_fn()
 
 
 class InMemoryMesh(MeshTransport):
@@ -315,7 +313,7 @@ class InMemoryMesh(MeshTransport):
                 if dispatcher in self._dispatchers:
                     self._dispatchers.remove(dispatcher)
 
-        return _MemorySubscription(stop_fn)
+        return CallbackSubscription(stop_fn)
 
     async def _pump_broadcast(
         self,

@@ -2,9 +2,13 @@
 
 Semantics contract (what nodes/clients may rely on, independent of backend):
 
-- **At-least-once** delivery to each consumer group; per-key ordering within
-  a topic (keys map to partitions; one partition is consumed serially per
-  group).
+- **At-least-once under redelivery, at-most-once under crash**: commits are
+  ACK-first (cadence independent of handler completion), so records
+  abandoned in flight by a crashed consumer are not redelivered — the
+  reference's documented stance (_faststream_ext/_subscriber.py:214-221).
+  Durable state (fan-out batches) makes workflows survive crashes anyway.
+- Per-key ordering within a topic (keys map to partitions; one partition is
+  consumed serially per group).
 - ``group_id=None`` subscriptions are *broadcast taps from latest*: every
   such subscriber sees every record published after it attached (the client
   inbox / firehose pattern).
@@ -45,6 +49,20 @@ class Subscription(abc.ABC):
 
     @abc.abstractmethod
     async def stop(self) -> None: ...
+
+
+class CallbackSubscription(Subscription):
+    """The standard stop_fn-wrapping subscription every transport uses."""
+
+    def __init__(self, stop_fn: Callable[[], Awaitable[None]]):
+        self._stop_fn = stop_fn
+        self._stopped = False
+
+    async def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        await self._stop_fn()
 
 
 class MeshTransport(abc.ABC):

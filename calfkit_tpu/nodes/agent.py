@@ -135,6 +135,15 @@ class BaseAgentNodeDef(BaseNodeDef):
             # nodes/_tool_error.py:42-150)
             self.on_callee_error.append(_adapt_on_tool_error(on_tool_error))
 
+    # --------------------------------------------------------- decorators
+    def instructions_fn(self, fn: Callable[[NodeRunContext], str]) -> Callable:
+        """Decorator: dynamic instructions rendered per turn.
+
+        ``@weather_agent.instructions_fn`` (reference: the instructions
+        decorator on the agent, SURVEY.md capability checklist)."""
+        self.instructions = fn
+        return fn
+
     # ------------------------------------------------------------- topics
     def input_topics(self) -> list[str]:
         return [protocol.agent_input_topic(self.name)]

@@ -1,0 +1,58 @@
+"""Multi-agent example: a coordinator that messages a researcher and can
+hand the conversation to a writer — discovery, messaging, and handoff in one
+mesh.
+
+Run:  python -m calfkit_tpu.cli.main dev run \\
+          examples/multi_agent/research_team.py:TEAM --agent coordinator
+"""
+
+from calfkit_tpu import Agent
+from calfkit_tpu.engine import TestModelClient
+from calfkit_tpu.nodes import Tools, agent_tool
+from calfkit_tpu.peers import Handoff, Messaging
+
+
+@agent_tool
+def search_notes(query: str) -> list[str]:
+    """Search the shared notebook.
+
+    Args:
+        query: What to look for.
+    """
+    return [f"note: {query} was discussed on Tuesday", f"note: {query} pending"]
+
+
+researcher = Agent(
+    "researcher",
+    model=TestModelClient(custom_output_text="Research summary: all clear."),
+    instructions="Dig into questions using the notebook.",
+    tools=Tools(discover=True),
+    description="Researches questions against the shared notebook.",
+)
+
+writer = Agent(
+    "writer",
+    model=TestModelClient(custom_output_text="Here is the polished write-up."),
+    instructions="Write the final answer beautifully.",
+    description="Writes polished final answers.",
+)
+
+coordinator = Agent(
+    "coordinator",
+    model=TestModelClient(custom_output_text="Delegating complete."),
+    instructions="Coordinate: ask the researcher, then hand off to the writer.",
+    peers=[Messaging("researcher"), Handoff("writer")],
+    description="Routes work between the researcher and the writer.",
+)
+
+
+@coordinator.instructions_fn
+def _dynamic(ctx) -> str:
+    return (
+        "Coordinate the team. The current task id is "
+        f"{ctx.task_id[:8]}. Ask the researcher for facts; hand off to the "
+        "writer for the final answer."
+    )
+
+
+TEAM = [coordinator, researcher, writer, search_notes]

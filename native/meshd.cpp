@@ -1,0 +1,347 @@
+// meshd — the single-binary dev-mesh broker.
+//
+// The reference ships a bundled single-binary Kafka-compatible broker for its
+// zero-setup dev mesh (Tansu, spawned by `ck dev`; reference
+// cli/_dev_broker.py).  This is our native equivalent: a small TCP broker
+// implementing the MeshTransport semantics the framework needs —
+// partitioned topics, consumer groups with exclusive partition assignment
+// (per-key ordering across processes), broadcast taps, and per-partition
+// end offsets for client-side table barriers (every publish is acked before
+// the response line returns).
+//
+// Protocol: newline-delimited text, one request -> one response.
+//   ENSURE t1,t2            -> OK
+//   PUB topic key* value* hdrs*        (* = base64, '-' for empty)
+//                           -> OK <offset>
+//   SUB topic group|- latest|earliest  -> OK <subid>
+//   POLL subid max timeout_ms -> N <k> then k x: REC part off key* value* hdrs*
+//   ENDS topic              -> OK n0,n1,...   (per-partition sizes)
+//   PING                    -> PONG
+// Subscription cleanup is disconnect-driven: closing the TCP connection
+// removes the member and rebalances its partitions.
+//
+// Dev-grade by design: one thread per connection, one global mutex, no
+// persistence.  Build: make -C native   (produces native/bin/meshd)
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr int kPartitions = 16;
+
+// ---------------------------------------------------------------- base64
+const char kB64[] =
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789+/";
+
+std::string b64encode(const std::string& in) {
+  std::string out;
+  int val = 0, valb = -6;
+  for (unsigned char c : in) {
+    val = (val << 8) + c;
+    valb += 8;
+    while (valb >= 0) {
+      out.push_back(kB64[(val >> valb) & 0x3F]);
+      valb -= 6;
+    }
+  }
+  if (valb > -6) out.push_back(kB64[((val << 8) >> (valb + 8)) & 0x3F]);
+  while (out.size() % 4) out.push_back('=');
+  return out;
+}
+
+std::string b64decode(const std::string& in) {
+  static int table[256];
+  static bool init = false;
+  if (!init) {
+    std::fill(table, table + 256, -1);
+    for (int i = 0; i < 64; i++) table[(unsigned char)kB64[i]] = i;
+    init = true;
+  }
+  std::string out;
+  int val = 0, valb = -8;
+  for (unsigned char c : in) {
+    if (table[c] == -1) break;  // '=' padding or garbage ends the payload
+    val = (val << 6) + table[c];
+    valb += 6;
+    if (valb >= 0) {
+      out.push_back(char((val >> valb) & 0xFF));
+      valb -= 8;
+    }
+  }
+  return out;
+}
+
+// ----------------------------------------------------------------- crc32
+uint32_t crc32(const std::string& data) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (unsigned char ch : data) c = table[(c ^ ch) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ----------------------------------------------------------------- state
+struct Record {
+  std::string key, value, headers;  // raw bytes (headers = JSON text)
+  int64_t offset;
+};
+
+struct Topic {
+  std::vector<std::vector<Record>> parts{kPartitions};
+  int64_t next_offset = 0;
+  int64_t rr = 0;  // round-robin for keyless records
+};
+
+struct Sub {
+  std::string topic, group;  // group empty = broadcast tap
+  std::vector<int64_t> cursors;  // per-partition (taps own these; groups
+                                 // use the shared group cursors)
+  bool alive = true;
+};
+
+struct GroupState {
+  std::vector<int64_t> cursors;
+  std::vector<int64_t> members;  // subids, assignment = index round-robin
+  GroupState() : cursors(kPartitions, 0) {}
+};
+
+std::mutex g_mu;
+std::condition_variable g_cv;
+std::map<std::string, Topic> g_topics;
+std::map<int64_t, Sub> g_subs;
+std::map<std::pair<std::string, std::string>, GroupState> g_groups;
+int64_t g_next_sub = 1;
+
+Topic& topic_of(const std::string& name) { return g_topics[name]; }
+
+std::vector<int> assigned_partitions(const Sub& sub, int64_t subid) {
+  if (sub.group.empty()) {
+    std::vector<int> all(kPartitions);
+    for (int i = 0; i < kPartitions; i++) all[i] = i;
+    return all;
+  }
+  auto& gs = g_groups[{sub.topic, sub.group}];
+  auto it = std::find(gs.members.begin(), gs.members.end(), subid);
+  if (it == gs.members.end()) return {};
+  int idx = int(it - gs.members.begin());
+  int n = int(gs.members.size());
+  std::vector<int> mine;
+  for (int p = idx; p < kPartitions; p += n) mine.push_back(p);
+  return mine;
+}
+
+// ------------------------------------------------------------- line io
+bool read_line(int fd, std::string& buf, std::string& line) {
+  for (;;) {
+    auto nl = buf.find('\n');
+    if (nl != std::string::npos) {
+      line = buf.substr(0, nl);
+      buf.erase(0, nl + 1);
+      return true;
+    }
+    char chunk[65536];
+    ssize_t n = read(fd, chunk, sizeof(chunk));
+    if (n <= 0) return false;
+    buf.append(chunk, size_t(n));
+  }
+}
+
+void write_all(int fd, const std::string& s) {
+  size_t off = 0;
+  while (off < s.size()) {
+    ssize_t n = write(fd, s.data() + off, s.size() - off);
+    if (n <= 0) return;
+    off += size_t(n);
+  }
+}
+
+std::string field(const std::string& s) { return s == "-" ? "" : b64decode(s); }
+std::string unfield(const std::string& s) {
+  return s.empty() ? "-" : b64encode(s);
+}
+
+// ------------------------------------------------------------- handlers
+void serve(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  std::string buf, line;
+  std::vector<int64_t> my_subs;
+  while (read_line(fd, buf, line)) {
+    std::istringstream in(line);
+    std::string op;
+    in >> op;
+    if (op == "PING") {
+      write_all(fd, "PONG\n");
+    } else if (op == "ENSURE") {
+      std::string csv;
+      in >> csv;
+      std::lock_guard<std::mutex> lk(g_mu);
+      std::stringstream ss(csv);
+      std::string t;
+      while (std::getline(ss, t, ',')) {
+        if (!t.empty()) topic_of(t);
+      }
+      write_all(fd, "OK\n");
+    } else if (op == "PUB") {
+      std::string t, k, v, h;
+      in >> t >> k >> v >> h;
+      int64_t offset;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        Topic& topic = topic_of(t);
+        Record rec{field(k), field(v), field(h), topic.next_offset++};
+        int part = rec.key.empty() ? int(topic.rr++ % kPartitions)
+                                   : int(crc32(rec.key) % kPartitions);
+        offset = rec.offset;
+        topic.parts[size_t(part)].push_back(std::move(rec));
+      }
+      g_cv.notify_all();
+      write_all(fd, "OK " + std::to_string(offset) + "\n");
+    } else if (op == "SUB") {
+      std::string t, g, mode;
+      in >> t >> g >> mode;
+      if (g == "-") g = "";
+      int64_t id;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        Topic& topic = topic_of(t);
+        id = g_next_sub++;
+        Sub sub;
+        sub.topic = t;
+        sub.group = g;
+        sub.cursors.assign(kPartitions, 0);
+        if (mode == "latest") {
+          for (int p = 0; p < kPartitions; p++)
+            sub.cursors[size_t(p)] = int64_t(topic.parts[size_t(p)].size());
+        }
+        if (!g.empty()) {
+          auto& gs = g_groups[{t, g}];
+          if (gs.members.empty() && mode == "latest") {
+            for (int p = 0; p < kPartitions; p++)
+              gs.cursors[size_t(p)] = int64_t(topic.parts[size_t(p)].size());
+          }
+          gs.members.push_back(id);
+        }
+        g_subs[id] = std::move(sub);
+      }
+      my_subs.push_back(id);
+      write_all(fd, "OK " + std::to_string(id) + "\n");
+    } else if (op == "POLL") {
+      int64_t id, maxn, timeout_ms;
+      in >> id >> maxn >> timeout_ms;
+      std::vector<std::string> lines;
+      {
+        std::unique_lock<std::mutex> lk(g_mu);
+        auto deadline = std::chrono::steady_clock::now() +
+                        std::chrono::milliseconds(timeout_ms);
+        for (;;) {
+          auto it = g_subs.find(id);
+          if (it == g_subs.end()) break;
+          Sub& sub = it->second;
+          Topic& topic = topic_of(sub.topic);
+          bool group_mode = !sub.group.empty();
+          auto* cursors = group_mode
+                              ? &g_groups[{sub.topic, sub.group}].cursors
+                              : &sub.cursors;
+          for (int p : assigned_partitions(sub, id)) {
+            auto& part = topic.parts[size_t(p)];
+            while ((*cursors)[size_t(p)] < int64_t(part.size()) &&
+                   int64_t(lines.size()) < maxn) {
+              const Record& r = part[size_t((*cursors)[size_t(p)])];
+              (*cursors)[size_t(p)]++;  // ack-first commit
+              lines.push_back("REC " + std::to_string(p) + " " +
+                              std::to_string(r.offset) + " " + unfield(r.key) +
+                              " " + unfield(r.value) + " " +
+                              unfield(r.headers) + "\n");
+            }
+            if (int64_t(lines.size()) >= maxn) break;
+          }
+          if (!lines.empty() || timeout_ms == 0) break;
+          if (g_cv.wait_until(lk, deadline) == std::cv_status::timeout) break;
+        }
+      }
+      std::string out = "N " + std::to_string(lines.size()) + "\n";
+      for (auto& l : lines) out += l;
+      write_all(fd, out);
+    } else if (op == "ENDS") {
+      std::string t;
+      in >> t;
+      std::string csv;
+      {
+        std::lock_guard<std::mutex> lk(g_mu);
+        Topic& topic = topic_of(t);
+        for (int p = 0; p < kPartitions; p++) {
+          if (p) csv += ",";
+          csv += std::to_string(topic.parts[size_t(p)].size());
+        }
+      }
+      write_all(fd, "OK " + csv + "\n");
+    } else {
+      write_all(fd, "ERR unknown op\n");
+    }
+  }
+  // connection closed: drop this connection's subscriptions (rebalance)
+  std::lock_guard<std::mutex> lk(g_mu);
+  for (int64_t id : my_subs) {
+    auto it = g_subs.find(id);
+    if (it == g_subs.end()) continue;
+    if (!it->second.group.empty()) {
+      auto& gs = g_groups[{it->second.topic, it->second.group}];
+      gs.members.erase(std::remove(gs.members.begin(), gs.members.end(), id),
+                       gs.members.end());
+    }
+    g_subs.erase(it);
+  }
+  g_cv.notify_all();
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 19092;
+  signal(SIGPIPE, SIG_IGN);
+  int server = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(server, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(uint16_t(port));
+  if (bind(server, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(server, 64);
+  fprintf(stderr, "meshd listening on 127.0.0.1:%d\n", port);
+  for (;;) {
+    int fd = accept(server, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(serve, fd).detach();
+  }
+}
