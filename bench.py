@@ -16,6 +16,7 @@ compiles, later runs start hot.
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import json
 import os
 import sys
@@ -87,6 +88,10 @@ async def run() -> dict:
     started = time.perf_counter()
     counts = await asyncio.gather(*[one(i) for i in range(cfg["requests"])])
     wall = time.perf_counter() - started
+
+    # ---- TTFT phase: p50 mesh-msg -> first streamed token through the FULL
+    # agent path (client -> mesh -> agent -> engine -> token step -> client)
+    ttft_p50_ms = await _ttft_phase(engine)
     await engine.stop()
 
     total = sum(counts)
@@ -103,12 +108,53 @@ async def run() -> dict:
         "detail": {
             "decode_only_tok_s_per_chip": round(decode_tps, 1),
             "mean_batch_occupancy": round(stats.mean_occupancy, 3),
+            "p50_mesh_to_first_token_ms": ttft_p50_ms,
             "requests": cfg["requests"],
             "new_tokens_per_request": cfg["new_tokens"],
             "devices": n_dev,
             "platform": jax.devices()[0].platform,
         },
     }
+
+
+async def _ttft_phase(engine) -> float | None:
+    """Median client-publish -> first-token latency over the live mesh."""
+    try:
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.inference.client import JaxLocalModelClient
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        model = JaxLocalModelClient(engine=engine, max_new_tokens=8)
+        await model.start()
+        mesh = InMemoryMesh()
+        agent = Agent("bench_agent", model=model, stream_tokens=True)
+        samples: list[float] = []
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            for i in range(10):
+                t0 = time.perf_counter()
+                handle = await client.agent("bench_agent").start(
+                    f"ping {i}", timeout=120
+                )
+                async for event in handle.stream():
+                    if getattr(getattr(event, "step", None), "kind", "") == "token":
+                        samples.append((time.perf_counter() - t0) * 1000.0)
+                        break
+                else:
+                    continue
+                # drain the rest of the run
+                with contextlib.suppress(Exception):
+                    await handle.result(timeout=120)
+            await client.close()
+        samples.sort()
+        return round(samples[len(samples) // 2], 1) if samples else None
+    except Exception:  # noqa: BLE001 - TTFT is auxiliary detail
+        import traceback
+
+        traceback.print_exc()
+        return None
 
 
 def main() -> None:

@@ -175,12 +175,19 @@ class JaxLocalModelClient(ModelClient):
 
     # ------------------------------------------------------------- startup
     async def start(self) -> None:
-        if self._engine is not None and getattr(self._engine, "_running", False):
+        def ready() -> bool:
+            return (
+                self._engine is not None
+                and getattr(self._engine, "_running", False)
+                and self._tokenizer is not None
+            )
+
+        if ready():
             return
         if self._start_lock is None:
             self._start_lock = asyncio.Lock()
         async with self._start_lock:
-            if self._engine is not None and getattr(self._engine, "_running", False):
+            if ready():
                 return
             if self._engine is None:
                 self._engine = await asyncio.to_thread(self._build_engine)

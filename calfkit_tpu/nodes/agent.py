@@ -110,6 +110,7 @@ class BaseAgentNodeDef(BaseNodeDef):
         model_settings: ModelSettings | None = None,
         max_output_retries: int = 2,
         on_tool_error: Callable[..., Any] | None = None,
+        stream_tokens: bool = False,
         **seams: Any,
     ):
         super().__init__(name, **seams)
@@ -129,6 +130,7 @@ class BaseAgentNodeDef(BaseNodeDef):
         self.description = description
         self.model_settings = model_settings
         self.max_output_retries = max_output_retries
+        self.stream_tokens = stream_tokens
         if on_tool_error is not None:
             # sugar: (tool_call_marker, ctx, report) -> parts | None, adapted
             # onto the kernel's on_callee_error seam (reference:
@@ -264,10 +266,14 @@ class BaseAgentNodeDef(BaseNodeDef):
         else:
             messages = history
 
-        # ---- ONE model turn
+        # ---- ONE model turn (optionally with live token streaming to the
+        # run's step stream — BASELINE config 3's downstream-topic tokens)
+        model: ModelClient = self.model
+        if self.stream_tokens and ctx.root_topic:
+            model = _TokenTap(self.model, self, ctx)
         started = time.perf_counter()
         outcome: TurnOutcome = await run_turn(
-            self.model,
+            model,
             messages,
             tool_defs=[b.tool for b in bindings] + peer_defs,
             output_type=self.output_type,
@@ -606,6 +612,72 @@ class BaseAgentNodeDef(BaseNodeDef):
 class _AllCallsRejected(Exception):
     """Internal: every model tool call was denied pre-dispatch; the base
     run() loop catches this and runs another turn on the same hop."""
+
+
+class _TokenTap(ModelClient):
+    """Wraps the agent's model so each request streams internally and
+    publishes TokenStep batches to the run's root callback topic WHILE the
+    turn generates (the per-hop ledger still carries the terminal steps).
+
+    The FIRST delta of each attempt flushes immediately (true TTFT on the
+    wire); later deltas batch up to ``_FLUSH_CHARS``.  When the turn runner
+    retries (invalid structured output), a retry-boundary token separates
+    the attempts so stream consumers don't see two concatenated answers.
+    """
+
+    _FLUSH_CHARS = 24
+    RETRY_BOUNDARY = "\n[retrying]\n"
+
+    def __init__(self, inner: ModelClient, node: "BaseAgentNodeDef", ctx: Any):
+        self._inner = inner
+        self._node = node
+        self._ctx = ctx
+        self._attempts = 0
+
+    @property
+    def model_name(self) -> str:
+        return self._inner.model_name
+
+    async def _flush(self, buffer: list[str]) -> None:
+        if not buffer:
+            return
+        text = "".join(buffer)
+        buffer.clear()
+        from calfkit_tpu.models.step import StepMessage, TokenStep
+        from calfkit_tpu.nodes.steps import publish_step_message
+
+        try:
+            await publish_step_message(
+                self._node.transport,
+                self._ctx.root_topic,
+                StepMessage(
+                    steps=[TokenStep(text=text, author=self._node.name)],
+                    emitter=self._node.emitter,
+                ),
+                correlation_id=self._ctx.correlation_id,
+                task_id=self._ctx.task_id,
+            )
+        except Exception:  # noqa: BLE001 - token telemetry never faults a run
+            pass
+
+    async def request(self, messages, settings=None, params=None):
+        from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
+
+        self._attempts += 1
+        buffer: list[str] = []
+        if self._attempts > 1:
+            await self._flush([self.RETRY_BOUNDARY])
+        first = True
+        async for event in self._inner.request_stream(messages, settings, params):
+            if isinstance(event, TextDelta):
+                buffer.append(event.text)
+                if first or sum(len(b) for b in buffer) >= self._FLUSH_CHARS:
+                    first = False
+                    await self._flush(buffer)
+            elif isinstance(event, ResponseDone):
+                await self._flush(buffer)
+                return event.response
+        raise RuntimeError("model stream ended without a terminal response")
 
 
 class Agent(BaseAgentNodeDef):

@@ -195,14 +195,34 @@ class HopStepLedger:
         message = self.drain()
         if message is None or root_topic is None:
             return
-        headers = {protocol.HDR_WIRE: "step", protocol.HDR_EMITTER: self._emitter}
-        if correlation_id:
-            headers[protocol.HDR_CORRELATION] = correlation_id
-        if task_id:
-            headers[protocol.HDR_TASK] = task_id
-        await transport.publish(
+        await publish_step_message(
+            transport,
             root_topic,
-            message.to_wire(),
-            key=partition_key(task_id) if task_id else None,
-            headers=headers,
+            message,
+            correlation_id=correlation_id,
+            task_id=task_id,
         )
+
+
+async def publish_step_message(
+    transport: Any,
+    root_topic: str,
+    message: StepMessage,
+    *,
+    correlation_id: str | None,
+    task_id: str | None,
+) -> None:
+    """The ONE way a wire StepMessage reaches the step stream — used by the
+    hop ledger's flush and by live token streaming, so headers/keying can
+    never diverge."""
+    headers = {protocol.HDR_WIRE: "step", protocol.HDR_EMITTER: message.emitter}
+    if correlation_id:
+        headers[protocol.HDR_CORRELATION] = correlation_id
+    if task_id:
+        headers[protocol.HDR_TASK] = task_id
+    await transport.publish(
+        root_topic,
+        message.to_wire(),
+        key=partition_key(task_id) if task_id else None,
+        headers=headers,
+    )

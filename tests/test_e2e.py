@@ -253,3 +253,83 @@ class TestWorkerLifecycle:
             await worker.start()
         assert log == ["up", "down"]  # resource torn down by rollback
         await mesh.stop()
+
+
+class _PacedModel:
+    """Streams chunks with real delays — deterministic liveness probe."""
+
+    model_name = "paced"
+
+    async def request(self, messages, settings=None, params=None):
+        from calfkit_tpu.engine.model_client import ResponseDone
+
+        async for event in self.request_stream(messages, settings, params):
+            if isinstance(event, ResponseDone):
+                return event.response
+
+    async def request_stream(self, messages, settings=None, params=None):
+        from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
+
+        text = ""
+        for i in range(5):
+            await asyncio.sleep(0.08)
+            chunk = f"chunk-{i} of the answer... "
+            text += chunk
+            yield TextDelta(chunk)
+        yield ResponseDone(ModelResponse(parts=[TextOutput(text=text)]))
+
+
+class TestTokenStreaming:
+    async def test_tokens_arrive_live_before_the_result(self):
+        """stream_tokens=True: TokenSteps must reach the client WHILE the
+        model generates — wall-clock ahead of the terminal result
+        (BASELINE config 3)."""
+        import time as _time
+
+        mesh = InMemoryMesh()
+        agent = Agent("paced", model=_PacedModel(), stream_tokens=True)
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            t0 = _time.perf_counter()
+            handle = await client.agent("paced").start("go", timeout=30)
+            arrivals, final_ms = [], None
+            async for event in handle.stream():
+                if hasattr(event, "step") and event.step.kind == "token":
+                    arrivals.append((_time.perf_counter() - t0) * 1000)
+                elif isinstance(event, InvocationResult):
+                    final_ms = (_time.perf_counter() - t0) * 1000
+            assert len(arrivals) >= 2
+            # the first token record landed ~4 chunks before the result
+            assert final_ms - arrivals[0] >= 150
+            await client.close()
+
+    async def test_local_jax_model_streams_token_records(self):
+        """The real local-inference path publishes token records before the
+        terminal steps (cadence is content-dependent)."""
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from calfkit_tpu.inference import JaxLocalModelClient
+        from calfkit_tpu.inference.config import RuntimeConfig, preset
+
+        model = JaxLocalModelClient(
+            config=preset("debug"),
+            runtime=RuntimeConfig(max_batch_size=2, max_seq_len=256,
+                                  prefill_chunk=32, decode_steps_per_dispatch=4),
+            max_new_tokens=48,
+        )
+        mesh = InMemoryMesh()
+        agent = Agent("streamer_local", model=model, stream_tokens=True)
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            handle = await client.agent("streamer_local").start(
+                "tell me things", timeout=120
+            )
+            kinds = []
+            async for event in handle.stream():
+                if hasattr(event, "step"):
+                    kinds.append(event.step.kind)
+            assert "token" in kinds
+            # token records precede the hop's terminal steps
+            assert kinds.index("token") < kinds.index("agent_message")
+            await client.close()
+        await model.stop()
