@@ -353,3 +353,64 @@ class TestHandoffRegressions:
             result = await client.agent("chooser").execute("pick", timeout=15)
             assert result.output == "clean"
             await client.close()
+
+
+class TestConcurrentMultiAgent:
+    async def test_three_agents_parallel_tool_calls(self):
+        """BASELINE config 4: 3 agent nodes on shared topics, concurrent
+        runs, parallel tool calls per run (reference analog:
+        tests/test_concurrent_tool_calls.py)."""
+        executed = []
+
+        @agent_tool
+        def probe(tag: str) -> str:
+            """Probe.
+
+            Args:
+                tag: Marker.
+            """
+            executed.append(tag)
+            return f"probe:{tag}"
+
+        def make_model(name):
+            # stateless per run: branch on the conversation, not a shared
+            # counter (model calls interleave across concurrent runs)
+            def model(messages, params):
+                import uuid
+
+                last = messages[-1]
+                has_returns = last.role == "request" and any(
+                    p.kind == "tool_return" for p in last.parts
+                )
+                if has_returns:
+                    return ModelResponse(parts=[TextOutput(text=f"{name} done")])
+                run_id = uuid.uuid4().hex[:6]
+                return ModelResponse(parts=[
+                    ToolCallOutput(tool_call_id=f"{name}-{run_id}-a",
+                                   tool_name="probe", args={"tag": f"{name}-a"}),
+                    ToolCallOutput(tool_call_id=f"{name}-{run_id}-b",
+                                   tool_name="probe", args={"tag": f"{name}-b"}),
+                ])
+
+            return FunctionModelClient(model)
+
+        mesh = InMemoryMesh()
+        agents = [
+            Agent(f"conc{i}", model=make_model(f"conc{i}"), tools=[probe])
+            for i in range(3)
+        ]
+        async with Worker([*agents, probe], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            results = await asyncio.gather(*[
+                client.agent(f"conc{i % 3}").execute(f"run {i}", timeout=30)
+                for i in range(9)
+            ])
+            assert [r.output for r in results] == [
+                f"conc{i % 3} done" for i in range(9)
+            ]
+            # EVERY run dispatched its 2-call parallel fan-out
+            assert len(executed) == 18
+            for r in results:
+                roles = [m.role for m in r.state.message_history]
+                assert roles == ["request", "response", "request", "response"]
+            await client.close()
