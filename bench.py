@@ -60,15 +60,19 @@ async def run() -> dict:
     engine = InferenceEngine(model, runtime)
     await engine.start()
 
-    # warm every specialization the measured run will touch
-    warm = [
-        t
-        async for t in engine.generate(
-            list(range(5, 5 + cfg["prompt_len"])),
+    # warm every specialization the measured run will touch: all power-of-two
+    # prefill-wave sizes plus the decode window, concurrently
+    async def _warm(i: int) -> int:
+        n = 0
+        async for _ in engine.generate(
+            [5 + i, *range(6, 5 + cfg["prompt_len"])],
             max_new_tokens=cfg["new_tokens"],
-        )
-    ]
-    assert warm, "warmup produced no tokens"
+        ):
+            n += 1
+        return n
+
+    warm = await asyncio.gather(*[_warm(i) for i in range(min(8, cfg["bs"]))])
+    assert all(warm), "warmup produced no tokens"
 
     stats = engine.stats
     stats.decode_tokens = 0

@@ -341,6 +341,14 @@ class InferenceEngine:
                 and bucket_of(self._queue._queue[0]) == wave_bucket  # peek
             ):
                 wave.append(self._queue.get_nowait())
+            # wave sizes are power-of-two so each prefill bucket compiles at
+            # most 4 jit variants (R in 1,2,4,8) instead of 8
+            keep = 1
+            while keep * 2 <= len(wave):
+                keep *= 2
+            for request in wave[keep:]:
+                self._queue.put_nowait(request)  # next wave takes them
+            wave = wave[:keep]
             for request in wave:
                 request.slot = self._free.pop()
             await asyncio.to_thread(self._prefill_wave, wave, wave_bucket)
