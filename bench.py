@@ -37,6 +37,7 @@ def _bench_config():
     return dict(
         preset="tinyllama-1.1b", bs=64, max_seq=1024, prefill_chunk=128,
         steps=32, requests=64, new_tokens=128, prompt_len=64,
+        quantization="int8",  # weight-only: halves the decode HBM stream
     )
 
 
@@ -56,23 +57,28 @@ async def run() -> dict:
         decode_steps_per_dispatch=cfg["steps"],
         tp=1,
         dp=1,
+        quantization=cfg.get("quantization"),
     )
     engine = InferenceEngine(model, runtime)
     await engine.start()
 
-    # warm every specialization the measured run will touch: all power-of-two
-    # prefill-wave sizes plus the decode window, concurrently
+    # warm every specialization the measured run will touch: each power-of-
+    # two prefill-wave size (deterministic sequential batches) + the decode
+    # window
     async def _warm(i: int) -> int:
         n = 0
         async for _ in engine.generate(
-            [5 + i, *range(6, 5 + cfg["prompt_len"])],
+            [5 + (i % 40), *range(6, 5 + cfg["prompt_len"])],
             max_new_tokens=cfg["new_tokens"],
         ):
             n += 1
         return n
 
-    warm = await asyncio.gather(*[_warm(i) for i in range(min(8, cfg["bs"]))])
-    assert all(warm), "warmup produced no tokens"
+    for size in (1, 2, 4, 8):
+        if size > cfg["bs"]:
+            break
+        warm = await asyncio.gather(*[_warm(i) for i in range(size)])
+        assert all(warm), "warmup produced no tokens"
 
     stats = engine.stats
     stats.decode_tokens = 0
@@ -103,7 +109,8 @@ async def run() -> dict:
     decode_tps = stats.tokens_per_second / n_dev
     return {
         "metric": (
-            f"decode_tok_s_per_chip[{model.name} bs={cfg['bs']} "
+            f"decode_tok_s_per_chip[{model.name} bs={cfg['bs']}"
+            f"{' ' + cfg['quantization'] if cfg.get('quantization') else ''} "
             f"continuous-batching wall]"
         ),
         "value": round(wall_tps, 1),

@@ -208,8 +208,16 @@ class JaxLocalModelClient(ModelClient):
 
             config = config_from_hf(self._checkpoint)
             mesh = make_mesh(tp=runtime.tp, dp=runtime.dp)
+            shardings = param_shardings(config, mesh)
+            if runtime.quantization == "int8":
+                from calfkit_tpu.inference.quant import quantize_shardings
+
+                shardings = quantize_shardings(shardings)
             params = load_params(
-                self._checkpoint, config, param_shardings(config, mesh)
+                self._checkpoint,
+                config,
+                shardings,
+                quantize=runtime.quantization,
             )
             if self._tokenizer is None:
                 self._tokenizer = HFTokenizer(self._checkpoint)

@@ -65,6 +65,9 @@ class RuntimeConfig:
     # sparse buckets = few compiles, dense = tighter HBM reads
     window_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
     compilation_cache_dir: str | None = "~/.cache/calfkit_tpu_xla"
+    # "int8" = weight-only quantization: halves decode HBM traffic and fits
+    # Llama-3-8B on one 16 GB chip; None = native dtype
+    quantization: str | None = None
 
     def pages_per_seq(self) -> int:
         if self.max_pages_per_seq:

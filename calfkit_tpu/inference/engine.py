@@ -118,6 +118,20 @@ class InferenceEngine:
                 config.param_count / 1e9,
             )
             params = M.init_params(config, jax.random.key(seed))
+        if rt.quantization == "int8":
+            from calfkit_tpu.inference.quant import (
+                is_quantized,
+                quantize_params,
+                quantize_shardings,
+            )
+
+            if not is_quantized(params.get("layers", {}).get("wq")):
+                # consume: free each full-precision tensor as it quantizes
+                # (peak ~1x model size — the 8B random-init path needs this)
+                params = quantize_params(params, consume=True)
+            shardings = quantize_shardings(shardings)
+        elif rt.quantization is not None:
+            raise ValueError(f"unsupported quantization {rt.quantization!r}")
         self.params = place_params(params, shardings)
 
         B, S = rt.max_batch_size, rt.max_seq_len
@@ -137,6 +151,7 @@ class InferenceEngine:
 
         self._free: list[int] = list(range(B))
         self._active: dict[int, GenRequest] = {}
+        self._carry: list[GenRequest] = []  # wave-trimmed, ahead of the queue
         self._queue: asyncio.Queue[GenRequest] = asyncio.Queue()
         self._wake = asyncio.Event()
         self._task: asyncio.Task[None] | None = None
@@ -271,6 +286,9 @@ class InferenceEngine:
         for request in list(self._active.values()):
             request.out.put_nowait(_DONE)
         self._active.clear()
+        for request in self._carry:
+            request.out.put_nowait(_DONE)
+        self._carry.clear()
         while not self._queue.empty():
             self._queue.get_nowait().out.put_nowait(_DONE)
 
@@ -320,9 +338,23 @@ class InferenceEngine:
             self._running = False
             self._finish_all()
 
+    def _next_pending(self) -> GenRequest | None:
+        if self._carry:
+            return self._carry.pop(0)
+        if not self._queue.empty():
+            return self._queue.get_nowait()
+        return None
+
+    def _peek_pending(self) -> GenRequest | None:
+        if self._carry:
+            return self._carry[0]
+        if not self._queue.empty():
+            return self._queue._queue[0]  # peek
+        return None
+
     async def _admit(self) -> bool:
         admitted = False
-        while self._free and not self._queue.empty():
+        while self._free and (self._carry or not self._queue.empty()):
             # one admission WAVE: same-bucket requests prefill together
             rt = self.runtime
 
@@ -332,22 +364,22 @@ class InferenceEngine:
                     rt.max_seq_len,
                 )
 
-            wave: list[GenRequest] = [self._queue.get_nowait()]
+            wave: list[GenRequest] = [self._next_pending()]
             wave_bucket = bucket_of(wave[0])
             while (
                 len(wave) < len(self._free)
                 and len(wave) < 8
-                and not self._queue.empty()
-                and bucket_of(self._queue._queue[0]) == wave_bucket  # peek
+                and (peeked := self._peek_pending()) is not None
+                and bucket_of(peeked) == wave_bucket
             ):
-                wave.append(self._queue.get_nowait())
+                wave.append(self._next_pending())
             # wave sizes are power-of-two so each prefill bucket compiles at
-            # most 4 jit variants (R in 1,2,4,8) instead of 8
+            # most 4 jit variants (R in 1,2,4,8) instead of 8; trimmed
+            # requests go to the FRONT carry list, preserving arrival order
             keep = 1
             while keep * 2 <= len(wave):
                 keep *= 2
-            for request in wave[keep:]:
-                self._queue.put_nowait(request)  # next wave takes them
+            self._carry = wave[keep:] + self._carry
             wave = wave[:keep]
             for request in wave:
                 request.slot = self._free.pop()

@@ -71,10 +71,21 @@ def load_params(
     path: str | Path,
     config: ModelConfig,
     shardings: dict[str, Any],
+    *,
+    quantize: str | None = None,
 ) -> dict[str, Any]:
-    """Load + transpose + stack + shard-place the checkpoint."""
+    """Load + transpose + stack + shard-place the checkpoint.
+
+    ``quantize="int8"`` quantizes each matmul weight ON HOST before the
+    device_put, so device memory never holds a full-precision copy — the
+    path that fits Llama-3-8B on one 16 GB chip.  Pass shardings already
+    expanded by :func:`calfkit_tpu.inference.quant.quantize_shardings`.
+    """
     import jax
     from safetensors import safe_open
+
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unsupported quantization {quantize!r}")
 
     path = Path(path)
     files = _open_safetensors(path)
@@ -88,8 +99,25 @@ def load_params(
 
     D, H, K, hd = config.d_model, config.n_heads, config.n_kv_heads, config.head_dim
     L = config.n_layers
+    _quant_axes: dict[str, tuple[int, ...]] = {}
+    if quantize == "int8":
+        from calfkit_tpu.inference.quant import (
+            LAYER_REDUCTION_AXES,
+            LM_HEAD_REDUCTION_AXES,
+        )
 
-    def put(arr: np.ndarray, sharding: Any) -> Any:
+        _quant_axes = {**LAYER_REDUCTION_AXES, "lm_head": LM_HEAD_REDUCTION_AXES}
+
+    def put(arr: np.ndarray, sharding: Any, name: str = "") -> Any:
+        axes = _quant_axes.get(name)
+        if axes is not None:
+            from calfkit_tpu.inference.quant import quantize_array_host
+
+            q = quantize_array_host(arr, axes)
+            return {
+                "q8": jax.device_put(q["q8"], sharding["q8"]),
+                "scale": jax.device_put(q["scale"], sharding["scale"]),
+            }
         return jax.device_put(arr.astype(np.dtype(config.dtype)), sharding)
 
     def stack(fmt: str, transform: Any) -> np.ndarray:
@@ -106,6 +134,7 @@ def load_params(
                     lambda w: w.T.reshape(D, H, hd),
                 ),
                 ls["wq"],
+                "wq",
             ),
             "wk": put(
                 stack(
@@ -113,6 +142,7 @@ def load_params(
                     lambda w: w.T.reshape(D, K, hd),
                 ),
                 ls["wk"],
+                "wk",
             ),
             "wv": put(
                 stack(
@@ -120,6 +150,7 @@ def load_params(
                     lambda w: w.T.reshape(D, K, hd),
                 ),
                 ls["wv"],
+                "wv",
             ),
             "wo": put(
                 stack(
@@ -127,18 +158,22 @@ def load_params(
                     lambda w: w.T.reshape(H, hd, D),
                 ),
                 ls["wo"],
+                "wo",
             ),
             "w_gate": put(
                 stack("model.layers.{}.mlp.gate_proj.weight", lambda w: w.T),
                 ls["w_gate"],
+                "w_gate",
             ),
             "w_up": put(
                 stack("model.layers.{}.mlp.up_proj.weight", lambda w: w.T),
                 ls["w_up"],
+                "w_up",
             ),
             "w_down": put(
                 stack("model.layers.{}.mlp.down_proj.weight", lambda w: w.T),
                 ls["w_down"],
+                "w_down",
             ),
             "attn_norm": put(
                 stack("model.layers.{}.input_layernorm.weight", lambda w: w),
@@ -154,6 +189,8 @@ def load_params(
         "final_norm": put(get("model.norm.weight"), shardings["final_norm"]),
     }
     if not config.tie_embeddings:
-        params["lm_head"] = put(get("lm_head.weight").T, shardings["lm_head"])
+        params["lm_head"] = put(
+            get("lm_head.weight").T, shardings["lm_head"], "lm_head"
+        )
     logger.info("loaded %s from %s", config.name, path)
     return params

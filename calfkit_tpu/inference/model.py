@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from calfkit_tpu.inference.config import ModelConfig
+from calfkit_tpu.inference.quant import dequant as _w
 
 Params = dict[str, Any]
 
@@ -204,9 +205,9 @@ def forward(
         The caller owns how pages are read/written (scan carry vs static).
         """
         h = rms_norm(x, lp["attn_norm"], eps)
-        q = jnp.einsum("bsd,dnh->bsnh", h, lp["wq"])
-        k = jnp.einsum("bsd,dkh->bskh", h, lp["wk"])
-        v = jnp.einsum("bsd,dkh->bskh", h, lp["wv"])
+        q = jnp.einsum("bsd,dnh->bsnh", h, _w(lp["wq"]))
+        k = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wk"]))
+        v = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wv"]))
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         k_page = _insert_chunk(k_page, k, insert_at)
@@ -214,11 +215,11 @@ def forward(
         attn = attention_xla(
             q, k_page[:, :, :W], v_page[:, :, :W], positions, seq_lens
         )
-        x = x + jnp.einsum("bsnh,nhd->bsd", attn, lp["wo"])
+        x = x + jnp.einsum("bsnh,nhd->bsd", attn, _w(lp["wo"]))
         h = rms_norm(x, lp["mlp_norm"], eps)
-        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
-        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
-        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+        gate = jnp.einsum("bsd,df->bsf", h, _w(lp["w_gate"]))
+        up = jnp.einsum("bsd,df->bsf", h, _w(lp["w_up"]))
+        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, _w(lp["w_down"]))
         return x, k_page, v_page
 
     if unroll:
@@ -246,7 +247,7 @@ def forward(
     if head is None:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, head)
+        logits = jnp.einsum("bsd,dv->bsv", x, _w(head))
     return logits, (new_k, new_v)
 
 
@@ -286,9 +287,9 @@ def decode_step_ring(
         x, ring_k, ring_v, i = carry
         lp, k_page, v_page = inputs
         h = rms_norm(x, lp["attn_norm"], eps)
-        q = jnp.einsum("bsd,dnh->bsnh", h, lp["wq"])
-        k = jnp.einsum("bsd,dkh->bskh", h, lp["wk"])
-        v = jnp.einsum("bsd,dkh->bskh", h, lp["wv"])
+        q = jnp.einsum("bsd,dnh->bsnh", h, _w(lp["wq"]))
+        k = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wk"]))
+        v = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wv"]))
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         # dense ring write at (layer i, slot t) — no scatter anywhere
@@ -305,11 +306,11 @@ def decode_step_ring(
             base_lens,
             t,
         )
-        x = x + jnp.einsum("bsnh,nhd->bsd", attn, lp["wo"])
+        x = x + jnp.einsum("bsnh,nhd->bsd", attn, _w(lp["wo"]))
         h = rms_norm(x, lp["mlp_norm"], eps)
-        gate = jnp.einsum("bsd,df->bsf", h, lp["w_gate"])
-        up = jnp.einsum("bsd,df->bsf", h, lp["w_up"])
-        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, lp["w_down"])
+        gate = jnp.einsum("bsd,df->bsf", h, _w(lp["w_gate"]))
+        up = jnp.einsum("bsd,df->bsf", h, _w(lp["w_up"]))
+        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, _w(lp["w_down"]))
         return (x, ring_k, ring_v, i + 1), None
 
     (x, ring_k, ring_v, _), _ = lax.scan(
@@ -322,7 +323,7 @@ def decode_step_ring(
     if head is None:
         logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
     else:
-        logits = jnp.einsum("bsd,dv->bsv", x, head)
+        logits = jnp.einsum("bsd,dv->bsv", x, _w(head))
     return logits, (ring_k, ring_v)
 
 

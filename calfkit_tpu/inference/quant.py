@@ -1,0 +1,135 @@
+"""Weight-only int8 quantization.
+
+Decode is HBM-bound: weights are read once per generated token, so storing
+matmul weights as int8 (+ bf16 per-output-channel scales) halves the
+dominant traffic and lets Llama-3-8B fit a single 16 GB v5e chip.  XLA fuses
+the dequant (convert+multiply) into the matmul's operand load — no
+materialized bf16 copy.
+
+Representation: a quantized tensor is the pytree leaf-pair
+``{"q8": int8[...], "scale": f32 broadcastable}``; :func:`dequant` is the
+single read-side seam (identity for plain arrays), applied at every weight
+use in :mod:`calfkit_tpu.inference.model`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+Params = dict[str, Any]
+
+# which layer weights quantize, and their INPUT (reduction/contraction)
+# axes — scales are per-output-channel (max over these axes)
+LAYER_REDUCTION_AXES: dict[str, tuple[int, ...]] = {
+    "wq": (1,),  # [L, D, H, hd] — reduce D
+    "wk": (1,),
+    "wv": (1,),
+    "wo": (1, 2),  # [L, H, hd, D] — reduce (H, hd)
+    "w_gate": (1,),  # [L, D, F]
+    "w_up": (1,),
+    "w_down": (1,),  # [L, F, D]
+}
+LM_HEAD_REDUCTION_AXES: tuple[int, ...] = (0,)  # [D, V] — reduce D
+
+
+def quantize_tensor(w: jax.Array, reduction_axes: tuple[int, ...]) -> dict[str, jax.Array]:
+    """int8 symmetric quantization with per-output-channel scales.
+
+    ``reduction_axes`` are the matmul's contraction dims; every other dim
+    keeps its own scale (rank preserved — the scale broadcasts and reuses
+    the full tensor's sharding spec).
+    """
+    w32 = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w32), axis=reduction_axes, keepdims=True)
+    scale = jnp.maximum(absmax / 127.0, 1e-8)
+    q8 = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
+    return {"q8": q8, "scale": scale.astype(jnp.float32)}
+
+
+def is_quantized(leaf: Any) -> bool:
+    return isinstance(leaf, dict) and "q8" in leaf and "scale" in leaf
+
+
+def dequant(leaf: Any, dtype: Any = jnp.bfloat16) -> jax.Array:
+    """The read-side seam: plain arrays pass through.  The multiply runs in
+    f32 (the scale's storage precision) and casts once — XLA fuses the
+    convert+multiply into the consuming matmul's operand load."""
+    if is_quantized(leaf):
+        return (leaf["q8"].astype(jnp.float32) * leaf["scale"]).astype(dtype)
+    return leaf
+
+
+def quantize_params(params: Params, *, consume: bool = False) -> Params:
+    """Quantize the large matmul weights; norms and embeddings stay bf16.
+
+    ``consume=True`` pops tensors out of the input tree as they quantize so
+    each full-precision original frees before the next allocates — peak
+    memory stays ~1x model size instead of 1.5x (this is what lets an 8B
+    random-init quantize on a 16 GB chip).
+
+    The embedding table stays unquantized: it is a gather at the bottom and
+    (when untied) the lm_head handles the top; quantizing gathers gives no
+    bandwidth win proportional to its complexity.
+    """
+    layers = params["layers"]
+    out: Params = {"embed": params["embed"], "final_norm": params["final_norm"]}
+    qlayers: Params = {}
+    for name in list(layers):
+        w = layers.pop(name) if consume else layers[name]
+        if name in LAYER_REDUCTION_AXES:
+            qlayers[name] = quantize_tensor(w, LAYER_REDUCTION_AXES[name])
+        else:
+            qlayers[name] = w  # norms
+        del w
+    out["layers"] = qlayers
+    if "lm_head" in params:
+        head = params.pop("lm_head") if consume else params["lm_head"]
+        out["lm_head"] = quantize_tensor(head, LM_HEAD_REDUCTION_AXES)
+    return out
+
+
+def quantize_array_host(w: Any, reduction_axes: tuple[int, ...]) -> dict[str, Any]:
+    """Numpy-side quantization for the checkpoint loader: only the int8
+    tensor + small scale ever reach the device, so a 16 GB chip loads an 8B
+    model without a transient bf16 copy."""
+    import numpy as np
+
+    w32 = np.asarray(w, dtype=np.float32)
+    absmax = np.max(np.abs(w32), axis=reduction_axes, keepdims=True)
+    scale = np.maximum(absmax / 127.0, 1e-8).astype(np.float32)
+    q8 = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
+    return {"q8": q8, "scale": scale}
+
+
+def quantize_shardings(shardings: Params) -> Params:
+    """Mirror a sharding pytree onto the quantized structure: q8 keeps the
+    tensor's spec; the scale clears the spec at reduction axes (those dims
+    are singletons after keepdims and can't stay sharded — scales are tiny,
+    replicating them is free)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def expand(ns: Any, reduction_axes: tuple[int, ...]) -> Any:
+        spec = list(ns.spec) + [None] * 8  # pad: P() may be shorter than rank
+        for axis in reduction_axes:
+            spec[axis] = None
+        scale_ns = NamedSharding(ns.mesh, P(*spec[: len(ns.spec)]))
+        return {"q8": ns, "scale": scale_ns}
+
+    out: Params = {
+        "embed": shardings["embed"],
+        "final_norm": shardings["final_norm"],
+    }
+    layers = shardings["layers"]
+    qlayers: Params = {}
+    for name, ns in layers.items():
+        if name in LAYER_REDUCTION_AXES:
+            qlayers[name] = expand(ns, LAYER_REDUCTION_AXES[name])
+        else:
+            qlayers[name] = ns
+    out["layers"] = qlayers
+    if "lm_head" in shardings:
+        out["lm_head"] = expand(shardings["lm_head"], LM_HEAD_REDUCTION_AXES)
+    return out
