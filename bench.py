@@ -68,7 +68,7 @@ async def run() -> dict:
     async def _warm(i: int) -> int:
         n = 0
         async for _ in engine.generate(
-            [5 + (i % 40), *range(6, 5 + cfg["prompt_len"])],
+            [5 + i, *range(6, 5 + cfg["prompt_len"])],
             max_new_tokens=cfg["new_tokens"],
         ):
             n += 1

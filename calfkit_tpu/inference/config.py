@@ -60,7 +60,7 @@ class RuntimeConfig:
     dp: int = 1  # data/batch-parallel replicas of the serving engine
     decode_steps_per_dispatch: int = 8  # tokens generated per scheduler tick
     prefill_chunk: int = 512  # prompts pad/bucket to multiples of this
-    attention_impl: str = "auto"  # "auto" | "xla" | "pallas"
+    attention_impl: str = "auto"  # auto | xla | pallas | pallas_interpret
     # decode attention window buckets (each is one jit specialization);
     # sparse buckets = few compiles, dense = tighter HBM reads
     window_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)

@@ -132,6 +132,11 @@ class InferenceEngine:
             shardings = quantize_shardings(shardings)
         elif rt.quantization is not None:
             raise ValueError(f"unsupported quantization {rt.quantization!r}")
+        if rt.attention_impl not in ("auto", "xla", "pallas", "pallas_interpret"):
+            raise ValueError(
+                f"unsupported attention_impl {rt.attention_impl!r} "
+                "(auto | xla | pallas | pallas_interpret)"
+            )
         self.params = place_params(params, shardings)
 
         B, S = rt.max_batch_size, rt.max_seq_len
@@ -179,6 +184,11 @@ class InferenceEngine:
         cfg = self.config
         sampling = self.sampling
         steps = self.runtime.decode_steps_per_dispatch
+        # "auto" stays on the XLA path until the Pallas kernel is profiled on
+        # hardware; "pallas"/"pallas_interpret" opt in explicitly
+        attn_impl = self.runtime.attention_impl
+        if attn_impl == "auto":
+            attn_impl = "xla"
 
         def decode(params, k, v, last, lens, active, key):
             # ring-buffer decode: the main cache is READ-ONLY during the
@@ -204,6 +214,7 @@ class InferenceEngine:
                 key, sub = jax.random.split(key)
                 logits, ring = M.decode_step_ring(
                     params, cfg, last[:, None], (kw, vw), ring, t, lens,
+                    attn_impl=attn_impl,
                 )
                 nxt = sample(logits[:, -1], sub, sampling)
                 nxt = jnp.where(active, nxt, last)

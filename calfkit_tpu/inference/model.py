@@ -260,6 +260,7 @@ def decode_step_ring(
     t: jax.Array,  # scalar: this dispatch's step index (ring write slot)
     base_lens: jax.Array,  # [B] kv length at dispatch start (main cache)
     attn_window: int | None = None,
+    attn_impl: str = "xla",  # static: "xla" | "pallas" | "pallas_interpret"
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """One decode step in the ring-buffer scheme.
 
@@ -297,7 +298,7 @@ def decode_step_ring(
         ring_k = lax.dynamic_update_slice(ring_k, slab, (i, t, 0, 0, 0))
         slab = v[:, 0].astype(ring_v.dtype)[None, None]
         ring_v = lax.dynamic_update_slice(ring_v, slab, (i, t, 0, 0, 0))
-        attn = _merged_decode_attention(
+        attn_args = (
             q,
             k_page[:, :, :W],
             v_page[:, :, :W],
@@ -306,6 +307,16 @@ def decode_step_ring(
             base_lens,
             t,
         )
+        if attn_impl.startswith("pallas"):
+            from calfkit_tpu.inference.pallas_attention import (
+                merged_decode_attention_pallas,
+            )
+
+            attn = merged_decode_attention_pallas(
+                *attn_args, interpret=attn_impl == "pallas_interpret"
+            )
+        else:
+            attn = _merged_decode_attention(*attn_args)
         x = x + jnp.einsum("bsnh,nhd->bsd", attn, _w(lp["wo"]))
         h = rms_norm(x, lp["mlp_norm"], eps)
         gate = jnp.einsum("bsd,df->bsf", h, _w(lp["w_gate"]))
@@ -356,21 +367,42 @@ def _merged_decode_attention(
     z1 = jnp.sum(p1.astype(jnp.float32), axis=-1, keepdims=True)
     o1 = _einsum_f32("bkgs,bksh->bkgh", p1, v_cache)
 
-    # source 2: the ring (tiny: T ≤ steps-per-dispatch)
+    o2, m2, z2 = ring_attention_source(qg, ring_k, ring_v, t)
+    out = logsumexp_merge((o1, m1, z1), (o2, m2, z2))
+    return out.reshape(B, 1, H, hd).astype(q.dtype)
+
+
+def ring_attention_source(
+    qg: jax.Array,  # [B, K, G, hd]
+    ring_k: jax.Array,  # [T, B, K, hd]
+    ring_v: jax.Array,
+    t: jax.Array,  # ring slots 0..t valid
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The fresh-token attention source (tiny: T ≤ steps-per-dispatch) →
+    (o unnormalized, m, z) — shared by the XLA and Pallas merged paths."""
+    T = ring_k.shape[0]
+    scale = 1.0 / math.sqrt(qg.shape[-1])
     s2 = _einsum_f32("bkgh,tbkh->bkgt", qg, ring_k) * scale  # [B,K,G,T]
-    valid2 = (jnp.arange(T) <= t).reshape(1, 1, 1, T)  # ring slots j ≤ t
+    valid2 = (jnp.arange(T) <= t).reshape(1, 1, 1, T)
     s2 = jnp.where(valid2, s2, -1e30)
     m2 = jnp.max(s2, axis=-1, keepdims=True)
     p2 = jnp.exp(s2 - m2).astype(ring_k.dtype)
     z2 = jnp.sum(p2.astype(jnp.float32), axis=-1, keepdims=True)
     o2 = _einsum_f32("bkgt,tbkh->bkgh", p2, ring_v)
+    return o2, m2, z2
 
+
+def logsumexp_merge(
+    a: tuple[jax.Array, jax.Array, jax.Array],
+    b: tuple[jax.Array, jax.Array, jax.Array],
+) -> jax.Array:
+    """Combine two (o unnormalized, m, z) attention sources."""
+    o1, m1, z1 = a
+    o2, m2, z2 = b
     m = jnp.maximum(m1, m2)
     w1 = jnp.exp(m1 - m)
     w2 = jnp.exp(m2 - m)
-    denom = z1 * w1 + z2 * w2
-    out = (o1 * w1 + o2 * w2) / denom
-    return out.reshape(B, 1, H, hd).astype(q.dtype)
+    return (o1 * w1 + o2 * w2) / (z1 * w1 + z2 * w2)
 
 
 def consolidate_ring(

@@ -1,0 +1,124 @@
+"""Pallas TPU kernel for decode-time GQA attention over the main KV cache.
+
+Why a kernel: the XLA einsum path maps GQA decode badly — per (batch, kv
+head) the score matmul is [G=8, hd=64] × [hd, W], a sliver of the 128×128
+MXU, and measured effective bandwidth over the cache was ~110 GB/s.  The
+kernel streams each (b, k) cache slice through VMEM once and fuses mask +
+softmax-statistics + weighted sum, so HBM traffic is exactly one read of
+K/V.
+
+The kernel returns *unnormalized* output plus the softmax statistics
+``(m, z)`` so the caller can fold in the fresh-token ring (tiny, handled in
+plain XLA) with the same logsumexp merge used by the XLA path — the kernel
+never needs to know about the ring.
+
+Grid: one program per (batch row, kv head).  The whole [W, hd] slice sits in
+VMEM (W=4096, hd=64, bf16 → 512 KB per operand; VMEM is ~16 MB), so no
+inner blocking is needed at current window sizes.
+
+Validated in interpret mode on CPU (tests); opt-in on hardware via
+``RuntimeConfig(attention_impl="pallas")`` until profiled on a real chip.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _decode_attn_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, z_ref):
+    """One (batch, kv-head) program: masked scores + softmax stats + PV."""
+    q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
+    k = k_ref[0, 0].astype(jnp.float32)  # [W, hd]
+    v = v_ref[0, 0].astype(jnp.float32)  # [W, hd]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [G, W]
+    valid = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1) < lens_ref[0]
+    scores = jnp.where(valid, scores, -1e30)
+
+    m = jnp.max(scores, axis=-1, keepdims=True)  # [G, 1]
+    m = jnp.maximum(m, -1e29)  # fresh rows stay finite
+    p = jnp.exp(scores - m)
+    z = jnp.sum(p, axis=-1, keepdims=True)  # [G, 1]
+    o = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [G, hd] — unnormalized
+
+    o_ref[0, 0] = o
+    m_ref[0, 0] = m[:, 0]
+    z_ref[0, 0] = z[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def decode_attention_pallas(
+    q: jax.Array,  # [B, K, G, hd]
+    k_cache: jax.Array,  # [B, K, W, hd]
+    v_cache: jax.Array,  # [B, K, W, hd]
+    base_lens: jax.Array,  # [B] valid kv per row
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """→ (o [B,K,G,hd] f32 unnormalized, m [B,K,G] f32, z [B,K,G] f32)."""
+    from jax.experimental import pallas as pl
+
+    B, K, G, hd = q.shape
+    W = k_cache.shape[2]
+
+    grid = (B, K)
+    out_shapes = (
+        jax.ShapeDtypeStruct((B, K, G, hd), jnp.float32),
+        jax.ShapeDtypeStruct((B, K, G), jnp.float32),
+        jax.ShapeDtypeStruct((B, K, G), jnp.float32),
+    )
+    return pl.pallas_call(
+        _decode_attn_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, k: (b,)),  # lens: this row's scalar
+            pl.BlockSpec((1, 1, G, hd), lambda b, k: (b, k, 0, 0)),
+            pl.BlockSpec((1, 1, W, hd), lambda b, k: (b, k, 0, 0)),
+            pl.BlockSpec((1, 1, W, hd), lambda b, k: (b, k, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, G, hd), lambda b, k: (b, k, 0, 0)),
+            pl.BlockSpec((1, 1, G), lambda b, k: (b, k, 0)),
+            pl.BlockSpec((1, 1, G), lambda b, k: (b, k, 0)),
+        ),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(base_lens, q, k_cache, v_cache)
+
+
+def merged_decode_attention_pallas(
+    q: jax.Array,  # [B, 1, H, hd]
+    k_cache: jax.Array,  # [B, K, W, hd]
+    v_cache: jax.Array,
+    ring_k: jax.Array,  # [T, B, K, hd]
+    ring_v: jax.Array,
+    base_lens: jax.Array,  # [B]
+    t: jax.Array,  # current ring step
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in for :func:`model._merged_decode_attention` with the main-cache
+    source computed by the Pallas kernel and the (tiny) ring folded in via
+    the same logsumexp merge in plain XLA."""
+    from calfkit_tpu.inference.model import logsumexp_merge, ring_attention_source
+
+    B, _, H, hd = q.shape
+    K = k_cache.shape[1]
+    G = H // K
+    qg = q.reshape(B, K, G, hd)
+
+    o1, m1, z1 = decode_attention_pallas(
+        qg, k_cache, v_cache, base_lens, interpret=interpret
+    )
+    o2, m2, z2 = ring_attention_source(qg, ring_k, ring_v, t)
+    out = logsumexp_merge((o1, m1[..., None], z1[..., None]), (o2, m2, z2))
+    return out.reshape(B, 1, H, hd).astype(q.dtype)

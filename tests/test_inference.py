@@ -319,3 +319,58 @@ class TestQuantization:
         again = [t async for t in engine.generate([1, 5, 9], max_new_tokens=10)]
         assert again == out  # deterministic under quantization too
         await engine.stop()
+
+
+class TestPallasAttention:
+    def test_interpret_matches_xla_merged(self, params):
+        """The Pallas kernel (interpret mode) must match the XLA merged
+        attention bit-for-tolerance on ragged lens + ring contents."""
+        from calfkit_tpu.inference.model import _merged_decode_attention
+        from calfkit_tpu.inference.pallas_attention import (
+            merged_decode_attention_pallas,
+        )
+
+        B, K, G, hd, W, T = 3, CFG.n_kv_heads, CFG.n_heads // CFG.n_kv_heads, \
+            CFG.head_dim, 32, 4
+        ks = jax.random.split(jax.random.key(11), 5)
+        q = jax.random.normal(ks[0], (B, 1, CFG.n_heads, hd), jnp.float32)
+        kc = jax.random.normal(ks[1], (B, K, W, hd), jnp.float32)
+        vc = jax.random.normal(ks[2], (B, K, W, hd), jnp.float32)
+        rk = jax.random.normal(ks[3], (T, B, K, hd), jnp.float32)
+        rv = jax.random.normal(ks[4], (T, B, K, hd), jnp.float32)
+        lens = jnp.array([0, 7, 31])  # incl. a fresh row (len 0)
+        for t in (0, 2, 3):
+            ref = _merged_decode_attention(q, kc, vc, rk, rv, lens, jnp.int32(t))
+            out = merged_decode_attention_pallas(
+                q, kc, vc, rk, rv, lens, jnp.int32(t), interpret=True
+            )
+            np.testing.assert_allclose(
+                np.asarray(ref, np.float32), np.asarray(out, np.float32),
+                atol=2e-3, rtol=2e-3,
+            )
+
+    async def test_engine_runs_pallas_interpret(self):
+        engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=2, max_seq_len=128, prefill_chunk=16,
+                          decode_steps_per_dispatch=4,
+                          attention_impl="pallas_interpret"),
+        )
+        await engine.start()
+        out = [t async for t in engine.generate([1, 5, 9], max_new_tokens=8)]
+        assert len(out) == 8
+        await engine.stop()
+
+        xla_engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=2, max_seq_len=128, prefill_chunk=16,
+                          decode_steps_per_dispatch=4),
+        )
+        await xla_engine.start()
+        ref = [t async for t in xla_engine.generate([1, 5, 9], max_new_tokens=8)]
+        await xla_engine.stop()
+        # NOTE: holds for these fixed seeds/prompts; on random-init weights
+        # greedy argmax can amplify benign accumulation-order differences,
+        # so don't extend this to arbitrary prompts (the numerical bound is
+        # the allclose test above)
+        assert out == ref  # same greedy tokens through either kernel
