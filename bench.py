@@ -79,6 +79,10 @@ async def run() -> dict:
             break
         warm = await asyncio.gather(*[_warm(i) for i in range(size)])
         assert all(warm), "warmup produced no tokens"
+    # oversubscribe once: waiting admissions trigger the SHORT decode
+    # dispatch variant, compiling it outside the measured region
+    warm = await asyncio.gather(*[_warm(i) for i in range(cfg["bs"] + 2)])
+    assert all(warm), "oversubscribed warmup produced no tokens"
 
     stats = engine.stats
     stats.decode_tokens = 0

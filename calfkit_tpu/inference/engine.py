@@ -177,13 +177,13 @@ class InferenceEngine:
                 return w
         return cap
 
-    def _decode_jit(self, window: int) -> Any:
-        fn = self._decode_jits.get(window)
+    def _decode_jit(self, window: int, steps: int | None = None) -> Any:
+        steps = steps or self.runtime.decode_steps_per_dispatch
+        fn = self._decode_jits.get((window, steps))
         if fn is not None:
             return fn
         cfg = self.config
         sampling = self.sampling
-        steps = self.runtime.decode_steps_per_dispatch
         # "auto" stays on the XLA path until the Pallas kernel is profiled on
         # hardware; "pallas"/"pallas_interpret" opt in explicitly
         attn_impl = self.runtime.attention_impl
@@ -228,8 +228,14 @@ class InferenceEngine:
             return k, v, last, new_lens, key, toks  # toks [steps, B]
 
         fn = jax.jit(decode, donate_argnums=(1, 2))
-        self._decode_jits[window] = fn
+        self._decode_jits[(window, steps)] = fn
         return fn
+
+    def _short_steps(self) -> int:
+        """Dispatch length while admissions are waiting: a new request's
+        time-to-prefill is bounded by one SHORT dispatch instead of a full
+        one (TTFT lever; throughput ticks resume once the queue drains)."""
+        return max(4, self.runtime.decode_steps_per_dispatch // 4)
 
     def _prefill_jit(self, bucket: int, rows: int) -> Any:
         """Batched prefill: R admissions run as one [R, bucket] forward on a
@@ -446,9 +452,15 @@ class InferenceEngine:
         # the ring covers in-dispatch growth; the window only needs to cover
         # what's already in the main cache
         window = self._window_bucket(needed)
+        # admissions waiting? shorten the dispatch so their prefill (and
+        # freed slots) aren't gated behind a full tick
+        pending = bool(self._carry) or not self._queue.empty()
+        steps = self._short_steps() if pending else (
+            self.runtime.decode_steps_per_dispatch
+        )
         started = time.perf_counter()
         self._k, self._v, self._last, self._lens, self._key, toks = (
-            self._decode_jit(window)(
+            self._decode_jit(window, steps)(
                 self.params,
                 self._k,
                 self._v,
@@ -459,7 +471,7 @@ class InferenceEngine:
             )
         )
         for slot in self._active:
-            self._host_lens[slot] += self.runtime.decode_steps_per_dispatch
+            self._host_lens[slot] += steps
         block = np.asarray(toks)  # [steps, B] — THE host sync per dispatch
         elapsed = time.perf_counter() - started
         n_active = len(self._active)
