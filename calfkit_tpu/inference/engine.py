@@ -163,7 +163,7 @@ class InferenceEngine:
         self._running = False
         self.stats = EngineStats()
 
-        self._decode_jits: dict[int, Any] = {}
+        self._decode_jits: dict[tuple[int, int], Any] = {}  # (window, steps)
         self._prefill_jits: dict[tuple[int, int], Any] = {}
 
     # ------------------------------------------------------------ jit build
@@ -232,10 +232,24 @@ class InferenceEngine:
         return fn
 
     def _short_steps(self) -> int:
-        """Dispatch length while admissions are waiting: a new request's
-        time-to-prefill is bounded by one SHORT dispatch instead of a full
-        one (TTFT lever; throughput ticks resume once the queue drains)."""
-        return max(4, self.runtime.decode_steps_per_dispatch // 4)
+        """Dispatch length while a waiting admission could actually unblock:
+        a new request's time-to-prefill is bounded by one SHORT dispatch
+        instead of a full one (TTFT lever; never longer than a full tick)."""
+        steps = self.runtime.decode_steps_per_dispatch
+        return min(steps, max(4, steps // 4))
+
+    def _retirement_near(self, horizon: int) -> bool:
+        """Will any active request hit a stop bound within ``horizon`` steps?
+        (Shortening ticks while nothing can retire just multiplies dispatch
+        overhead — slots only free on retirement.)"""
+        for request in self._active.values():
+            remaining = request.max_new_tokens - request.generated
+            seq_room = self.runtime.max_seq_len - 1 - (
+                len(request.prompt) + request.generated
+            )
+            if min(remaining, seq_room) <= horizon:
+                return True
+        return False
 
     def _prefill_jit(self, bucket: int, rows: int) -> Any:
         """Batched prefill: R admissions run as one [R, bucket] forward on a
@@ -452,11 +466,16 @@ class InferenceEngine:
         # the ring covers in-dispatch growth; the window only needs to cover
         # what's already in the main cache
         window = self._window_bucket(needed)
-        # admissions waiting? shorten the dispatch so their prefill (and
-        # freed slots) aren't gated behind a full tick
+        # admissions waiting AND a retirement in reach? shorten the dispatch
+        # so the freed slot (and the waiter's prefill) isn't gated behind a
+        # full tick; under saturation with no retirement near, full ticks
+        # keep dispatch overhead amortized
+        full = self.runtime.decode_steps_per_dispatch
         pending = bool(self._carry) or not self._queue.empty()
-        steps = self._short_steps() if pending else (
-            self.runtime.decode_steps_per_dispatch
+        steps = (
+            self._short_steps()
+            if pending and self._retirement_near(full)
+            else full
         )
         started = time.perf_counter()
         self._k, self._v, self._last, self._lens, self._key, toks = (
