@@ -275,27 +275,83 @@ class JaxLocalModelClient(ModelClient):
         prompt = [tokenizer.bos_id, *tokenizer.encode(prompt_text)]
         max_new = settings.max_tokens or self._max_new_tokens
 
+        # per-request sampling: each provided knob overrides that knob of
+        # the engine default (top_p alone must NOT force greedy by zeroing
+        # temperature); the engine batches mixed settings row-wise
+        sampling = None
+        if (
+            settings.temperature is not None
+            or settings.top_p is not None
+            or settings.top_k is not None
+        ):
+            from calfkit_tpu.inference.sampler import SamplingParams
+
+            base = self._engine.sampling
+            temperature = (
+                settings.temperature
+                if settings.temperature is not None
+                else base.temperature
+            )
+            if temperature <= 0.0 and settings.temperature is None and (
+                settings.top_p is not None or settings.top_k is not None
+            ):
+                # filtering was requested but the default is greedy: sample
+                # at T=1 so top_p/top_k actually apply
+                temperature = 1.0
+            sampling = SamplingParams(
+                temperature=temperature,
+                top_k=settings.top_k if settings.top_k is not None else base.top_k,
+                top_p=settings.top_p if settings.top_p is not None else base.top_p,
+            )
+        stops = [s for s in settings.stop_sequences if s]
+        # stop sequences cut host-side on decoded text; hold back enough of
+        # the tail that a sequence spanning an emission boundary is never
+        # already streamed when it completes
+        holdback = max((len(s) for s in stops), default=1) - 1
+
+        def first_stop(text: str) -> int:
+            hits = [i for s in stops if (i := text.find(s)) != -1]
+            return min(hits) if hits else -1
+
         started = time.perf_counter()
         generated: list[int] = []
         emitted = 0
+        stopped_at = -1
         _EMIT_EVERY = 4  # re-decode cadence: bounds detokenize cost
-        async for token in self._engine.generate(
+        token_stream = self._engine.generate(
             prompt,
             max_new_tokens=max_new,
             stop_tokens=frozenset({tokenizer.eos_id}),
-        ):
-            generated.append(token)
-            if len(generated) % _EMIT_EVERY:
-                continue
-            # emit only the prefix that can't change: a trailing replacement
-            # char may be a multi-byte sequence still completing
-            text = tokenizer.decode(generated).rstrip("�")
-            if len(text) > emitted:
-                yield TextDelta(text[emitted:])
-                emitted = len(text)
+            sampling=sampling,
+            seed=settings.seed,
+        )
+        try:
+            async for token in token_stream:
+                generated.append(token)
+                if len(generated) % _EMIT_EVERY:
+                    continue
+                # emit only the prefix that can't change: a trailing
+                # replacement char may be a multi-byte sequence completing
+                text = tokenizer.decode(generated).rstrip("�")
+                if stops:
+                    stopped_at = first_stop(text)
+                    if stopped_at != -1:
+                        break
+                    text = text[: len(text) - holdback] if holdback else text
+                if len(text) > emitted:
+                    yield TextDelta(text[emitted:])
+                    emitted = len(text)
+        finally:
+            # a break above abandons the stream; close NOW (not at GC) so
+            # the engine reclaims the slot at its next tick
+            await token_stream.aclose()
         elapsed = time.perf_counter() - started
 
         full_text = tokenizer.decode(generated)
+        if stops and stopped_at == -1:
+            stopped_at = first_stop(full_text)
+        if stopped_at != -1:
+            full_text = full_text[:stopped_at]
         if len(full_text) > emitted:
             yield TextDelta(full_text[emitted:])  # flush the tail
         remaining, calls = (

@@ -25,8 +25,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Any, AsyncIterator
 
 import jax
@@ -37,7 +37,7 @@ from jax import lax
 from calfkit_tpu.exceptions import InferenceError
 from calfkit_tpu.inference import model as M
 from calfkit_tpu.inference.config import ModelConfig, RuntimeConfig
-from calfkit_tpu.inference.sampler import SamplingParams, sample
+from calfkit_tpu.inference.sampler import SamplingParams, sample_slots
 from calfkit_tpu.inference.sharding import (
     cache_sharding,
     make_mesh,
@@ -55,10 +55,13 @@ class GenRequest:
     prompt: list[int]
     max_new_tokens: int
     stop_tokens: frozenset[int]
+    sampling: SamplingParams | None = None  # None → engine default
+    seed: int | None = None  # None → engine-derived per-admission stream
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
     slot: int = -1
     generated: int = 0
     prefill_ms: float = 0.0
+    cancelled: bool = False
     started_at: float = field(default_factory=time.perf_counter)
 
 
@@ -152,12 +155,18 @@ class InferenceEngine:
         self._last = jnp.zeros((B,), jnp.int32)
         self._lens = jnp.zeros((B,), jnp.int32)
         self._host_lens = np.zeros((B,), np.int64)  # host mirror for windows
-        self._key = jax.random.key(seed + 1)
+        # per-slot sampling state: one decode dispatch serves mixed settings
+        # (row-wise knobs are data, not jit specializations)
+        self._slot_keys = jax.random.split(jax.random.key(seed + 2), B)
+        self._temp = jnp.zeros((B,), jnp.float32)
+        self._top_k = jnp.zeros((B,), jnp.int32)
+        self._top_p = jnp.ones((B,), jnp.float32)
+        self._admissions = 0  # per-request default seed stream
 
         self._free: list[int] = list(range(B))
         self._active: dict[int, GenRequest] = {}
         self._carry: list[GenRequest] = []  # wave-trimmed, ahead of the queue
-        self._queue: asyncio.Queue[GenRequest] = asyncio.Queue()
+        self._pending: deque[GenRequest] = deque()
         self._wake = asyncio.Event()
         self._task: asyncio.Task[None] | None = None
         self._running = False
@@ -177,20 +186,21 @@ class InferenceEngine:
                 return w
         return cap
 
-    def _decode_jit(self, window: int, steps: int | None = None) -> Any:
+    def _decode_jit(
+        self, window: int, steps: int | None = None, sampled: bool = False
+    ) -> Any:
         steps = steps or self.runtime.decode_steps_per_dispatch
-        fn = self._decode_jits.get((window, steps))
+        fn = self._decode_jits.get((window, steps, sampled))
         if fn is not None:
             return fn
         cfg = self.config
-        sampling = self.sampling
         # "auto" stays on the XLA path until the Pallas kernel is profiled on
         # hardware; "pallas"/"pallas_interpret" opt in explicitly
         attn_impl = self.runtime.attention_impl
         if attn_impl == "auto":
             attn_impl = "xla"
 
-        def decode(params, k, v, last, lens, active, key):
+        def decode(params, k, v, last, lens, active, slot_keys, temp, top_k, top_p):
             # ring-buffer decode: the main cache is READ-ONLY during the
             # scan; fresh K/V goes to a dense ring, consolidated once below.
             # The attention window is sliced ONCE per dispatch (a loop
@@ -210,25 +220,31 @@ class InferenceEngine:
             )
 
             def step(carry, t):
-                ring, last, key = carry
-                key, sub = jax.random.split(key)
+                ring, last = carry
                 logits, ring = M.decode_step_ring(
                     params, cfg, last[:, None], (kw, vw), ring, t, lens,
                     attn_impl=attn_impl,
                 )
-                nxt = sample(logits[:, -1], sub, sampling)
+                if sampled:
+                    # per-(request, position) streams: deterministic for a
+                    # given seed regardless of batch composition / slot reuse
+                    # (+1: position ``lens`` itself was the prefill's draw)
+                    subs = jax.vmap(jax.random.fold_in)(slot_keys, lens + t + 1)
+                    nxt = sample_slots(logits[:, -1], subs, temp, top_k, top_p)
+                else:
+                    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
                 nxt = jnp.where(active, nxt, last)
-                return (ring, nxt, key), nxt
+                return (ring, nxt), nxt
 
-            (ring, last, key), toks = lax.scan(
-                step, (ring, last, key), jnp.arange(steps)
+            (ring, last), toks = lax.scan(
+                step, (ring, last), jnp.arange(steps)
             )
             k, v = M.consolidate_ring((k, v), ring, lens)
             new_lens = jnp.where(active, lens + steps, lens)
-            return k, v, last, new_lens, key, toks  # toks [steps, B]
+            return k, v, last, new_lens, toks  # toks [steps, B]
 
         fn = jax.jit(decode, donate_argnums=(1, 2))
-        self._decode_jits[(window, steps)] = fn
+        self._decode_jits[(window, steps, sampled)] = fn
         return fn
 
     def _short_steps(self) -> int:
@@ -251,17 +267,21 @@ class InferenceEngine:
                 return True
         return False
 
-    def _prefill_jit(self, bucket: int, rows: int) -> Any:
+    def _prefill_jit(self, bucket: int, rows: int, sampled: bool = False) -> Any:
         """Batched prefill: R admissions run as one [R, bucket] forward on a
         scratch cache, then scatter into the slot rows — one dispatch per
-        admission WAVE, not per request."""
-        fn = self._prefill_jits.get((bucket, rows))
+        admission WAVE, not per request.  The wave's per-slot sampling state
+        (keys/temp/top_k/top_p) is scattered in the same dispatch."""
+        fn = self._prefill_jits.get((bucket, rows, sampled))
         if fn is not None:
             return fn
         cfg = self.config
-        sampling = self.sampling
 
-        def prefill(params, k, v, tokens, slots, true_lens, key):
+        def prefill(
+            params, k, v, tokens, slots, true_lens,
+            slot_keys, temp, top_k, top_p,  # [B] engine state
+            seeds, w_temp, w_top_k, w_top_p,  # [R] wave values
+        ):
             # tokens: [R, bucket]; slots/true_lens: [R]
             R, P = tokens.shape
             scratch = (
@@ -281,15 +301,24 @@ class InferenceEngine:
                     v, lax.dynamic_slice_in_dim(sv, r, 1, axis=1)[:, :, :, :P],
                     slots[r], axis=1,
                 )
+            wave_keys = jax.vmap(jax.random.key)(seeds)
+            slot_keys = slot_keys.at[slots].set(wave_keys)
+            temp = temp.at[slots].set(w_temp)
+            top_k = top_k.at[slots].set(w_top_k)
+            top_p = top_p.at[slots].set(w_top_p)
             idx = jnp.clip(true_lens - 1, 0, P - 1)
             last_logits = jnp.take_along_axis(
                 logits, idx[:, None, None], axis=1
             )[:, 0]
-            firsts = sample(last_logits, key, sampling)
-            return k, v, firsts
+            if sampled:
+                subs = jax.vmap(jax.random.fold_in)(wave_keys, true_lens)
+                firsts = sample_slots(last_logits, subs, w_temp, w_top_k, w_top_p)
+            else:
+                firsts = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+            return k, v, slot_keys, temp, top_k, top_p, firsts
 
         fn = jax.jit(prefill, donate_argnums=(1, 2))
-        self._prefill_jits[(bucket, rows)] = fn
+        self._prefill_jits[(bucket, rows, sampled)] = fn
         return fn
 
     # ------------------------------------------------------------ lifecycle
@@ -320,8 +349,8 @@ class InferenceEngine:
         for request in self._carry:
             request.out.put_nowait(_DONE)
         self._carry.clear()
-        while not self._queue.empty():
-            self._queue.get_nowait().out.put_nowait(_DONE)
+        while self._pending:
+            self._pending.popleft().out.put_nowait(_DONE)
 
     # -------------------------------------------------------------- submit
     async def generate(
@@ -330,8 +359,16 @@ class InferenceEngine:
         *,
         max_new_tokens: int = 256,
         stop_tokens: frozenset[int] = frozenset(),
+        sampling: SamplingParams | None = None,
+        seed: int | None = None,
     ) -> AsyncIterator[int]:
-        """Submit a prompt; yields generated token ids as they decode."""
+        """Submit a prompt; yields generated token ids as they decode.
+
+        ``sampling``/``seed`` override the engine defaults for this request
+        only — requests with different settings share decode dispatches
+        (row-wise sampling state).  Abandoning the iterator cancels the
+        request: its slot is reclaimed at the next scheduler tick.
+        """
         if not self._running:
             raise InferenceError("engine not started")
         if len(prompt) >= self.runtime.max_seq_len:
@@ -343,24 +380,34 @@ class InferenceEngine:
             prompt=list(prompt),
             max_new_tokens=max_new_tokens,
             stop_tokens=stop_tokens,
+            sampling=sampling,
+            seed=seed,
         )
-        await self._queue.put(request)
+        self._pending.append(request)
         self._wake.set()
-        while True:
-            item = await request.out.get()
-            if item is _DONE:
-                return
-            yield item
+        done = False
+        try:
+            while True:
+                item = await request.out.get()
+                if item is _DONE:
+                    done = True
+                    return
+                yield item
+        finally:
+            if not done:
+                request.cancelled = True
+                self._wake.set()
 
     # ------------------------------------------------------------ scheduler
     async def _serve(self) -> None:
         try:
             while self._running:
+                self._reap_cancelled()
                 admitted = await self._admit()
                 if not self._active:
                     if not admitted:
                         self._wake.clear()
-                        if self._queue.empty():
+                        if not self._pending and not self._carry:
                             await self._wake.wait()
                     continue
                 await asyncio.to_thread(self._decode_tick)
@@ -369,23 +416,58 @@ class InferenceEngine:
             self._running = False
             self._finish_all()
 
+    def _reap_cancelled(self) -> None:
+        """Drain cancelled requests: active slots AND still-queued entries.
+
+        Runs on the event loop between device dispatches (the decode thread
+        also mutates ``_active``, so cancellation itself only sets a flag).
+        Queued entries must be drained here too — leaving them in place
+        would keep ``_pending`` non-empty and turn the idle wait in
+        ``_serve`` into a busy spin with no suspension point.
+        """
+        for slot, request in list(self._active.items()):
+            if request.cancelled:
+                self._active.pop(slot, None)
+                self._free.append(slot)
+                request.slot = -1
+                request.out.put_nowait(_DONE)
+        if any(r.cancelled for r in self._carry):
+            kept = []
+            for request in self._carry:
+                if request.cancelled:
+                    request.out.put_nowait(_DONE)
+                else:
+                    kept.append(request)
+            self._carry = kept
+        if any(r.cancelled for r in self._pending):
+            kept_q: deque[GenRequest] = deque()
+            for request in self._pending:
+                if request.cancelled:
+                    request.out.put_nowait(_DONE)
+                else:
+                    kept_q.append(request)
+            self._pending = kept_q
+
     def _next_pending(self) -> GenRequest | None:
-        if self._carry:
-            return self._carry.pop(0)
-        if not self._queue.empty():
-            return self._queue.get_nowait()
+        while self._carry or self._pending:
+            request = (
+                self._carry.pop(0) if self._carry else self._pending.popleft()
+            )
+            if request.cancelled:
+                request.out.put_nowait(_DONE)
+                continue
+            return request
         return None
 
     def _peek_pending(self) -> GenRequest | None:
-        if self._carry:
-            return self._carry[0]
-        if not self._queue.empty():
-            return self._queue._queue[0]  # peek
+        for request in (*self._carry, *self._pending):
+            if not request.cancelled:
+                return request
         return None
 
     async def _admit(self) -> bool:
         admitted = False
-        while self._free and (self._carry or not self._queue.empty()):
+        while self._free and self._peek_pending() is not None:
             # one admission WAVE: same-bucket requests prefill together
             rt = self.runtime
 
@@ -425,26 +507,52 @@ class InferenceEngine:
         return admitted
 
     # ------------------------------------------------------- device work
+    def _effective_sampling(self, request: GenRequest) -> SamplingParams:
+        return request.sampling if request.sampling is not None else self.sampling
+
     def _prefill_wave(self, wave: list[GenRequest], bucket: int) -> None:
         R = len(wave)
         tokens = np.zeros((R, bucket), np.int32)
         true_lens = np.zeros((R,), np.int32)
         slots = np.zeros((R,), np.int32)
+        seeds = np.zeros((R,), np.uint32)
+        w_temp = np.zeros((R,), np.float32)
+        w_top_k = np.zeros((R,), np.int32)
+        w_top_p = np.ones((R,), np.float32)
+        sampled = False
         for r, request in enumerate(wave):
             tokens[r, : len(request.prompt)] = request.prompt
             true_lens[r] = len(request.prompt)
             slots[r] = request.slot
+            self._admissions += 1
+            seeds[r] = (
+                request.seed if request.seed is not None else self._admissions
+            ) & 0xFFFFFFFF
+            params = self._effective_sampling(request)
+            w_temp[r] = params.temperature
+            w_top_k[r] = params.top_k
+            w_top_p[r] = params.top_p
+            sampled |= not params.is_greedy
         started = time.perf_counter()
-        self._key, sub = jax.random.split(self._key)
-        fn = self._prefill_jit(bucket, R)
-        self._k, self._v, firsts = fn(
+        fn = self._prefill_jit(bucket, R, sampled)
+        (
+            self._k, self._v, self._slot_keys, self._temp, self._top_k,
+            self._top_p, firsts,
+        ) = fn(
             self.params,
             self._k,
             self._v,
             jnp.asarray(tokens),
             jnp.asarray(slots),
             jnp.asarray(true_lens),
-            sub,
+            self._slot_keys,
+            self._temp,
+            self._top_k,
+            self._top_p,
+            jnp.asarray(seeds),
+            jnp.asarray(w_temp),
+            jnp.asarray(w_top_k),
+            jnp.asarray(w_top_p),
         )
         firsts = np.asarray(firsts)
         elapsed_ms = (time.perf_counter() - started) * 1000.0
@@ -471,22 +579,31 @@ class InferenceEngine:
         # full tick; under saturation with no retirement near, full ticks
         # keep dispatch overhead amortized
         full = self.runtime.decode_steps_per_dispatch
-        pending = bool(self._carry) or not self._queue.empty()
+        # length check only: this runs on the decode thread, and iterating
+        # the deque (as _peek_pending does) races event-loop appends
+        pending = bool(self._carry) or bool(self._pending)
         steps = (
             self._short_steps()
             if pending and self._retirement_near(full)
             else full
         )
+        sampled = any(
+            not self._effective_sampling(r).is_greedy
+            for r in self._active.values()
+        )
         started = time.perf_counter()
-        self._k, self._v, self._last, self._lens, self._key, toks = (
-            self._decode_jit(window, steps)(
+        self._k, self._v, self._last, self._lens, toks = (
+            self._decode_jit(window, steps, sampled)(
                 self.params,
                 self._k,
                 self._v,
                 self._last,
                 self._lens,
                 jnp.asarray(active_mask),
-                self._key,
+                self._slot_keys,
+                self._temp,
+                self._top_k,
+                self._top_p,
             )
         )
         for slot in self._active:
@@ -522,7 +639,10 @@ class InferenceEngine:
             >= self.runtime.max_seq_len - 1
         )
         if hit_stop or exhausted:
-            self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)
+            # bookkeeping BEFORE the _DONE signal: once the consumer observes
+            # completion, the slot is already reclaimed (no window where a
+            # finished request still occupies _active)
             self._active.pop(request.slot, None)
             self._free.append(request.slot)
             request.slot = -1
+            self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)

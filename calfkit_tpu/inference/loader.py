@@ -97,6 +97,21 @@ def load_params(
             handles[f] = safe_open(str(f), framework="np").__enter__()
         return handles[f].get_tensor(name)
 
+    try:
+        return _build_params(config, shardings, get, quantize)
+    finally:
+        for handle in handles.values():
+            handle.__exit__(None, None, None)
+
+
+def _build_params(
+    config: ModelConfig,
+    shardings: dict[str, Any],
+    get: Any,
+    quantize: str | None,
+) -> dict[str, Any]:
+    import jax
+
     D, H, K, hd = config.d_model, config.n_heads, config.n_kv_heads, config.head_dim
     L = config.n_layers
     _quant_axes: dict[str, tuple[int, ...]] = {}
@@ -192,5 +207,5 @@ def load_params(
         params["lm_head"] = put(
             get("lm_head.weight").T, shardings["lm_head"], "lm_head"
         )
-    logger.info("loaded %s from %s", config.name, path)
+    logger.info("loaded %s params", config.name)
     return params

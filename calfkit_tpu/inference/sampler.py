@@ -1,8 +1,13 @@
 """Token sampling: greedy / temperature / top-k / top-p, jit-compatible.
 
-All branching on sampling *mode* happens in Python at trace time (the engine
-jits one specialization per settings bundle); everything under jit is static
-shape, data-parallel over the batch.
+Two entry points:
+
+- :func:`sample` — one static ``SamplingParams`` bundle for the whole batch
+  (trace-time branching; the cheap path for uniform workloads);
+- :func:`sample_slots` — **per-row** temperature/top_k/top_p/key tensors, so
+  one continuous-batching decode dispatch serves requests with different
+  settings without fragmenting the batch into per-settings jit variants.
+  Everything is static-shape; row-wise knobs are data.
 """
 
 from __future__ import annotations
@@ -18,6 +23,10 @@ class SamplingParams:
     temperature: float = 0.0  # 0 → greedy
     top_k: int = 0  # 0 → off
     top_p: float = 1.0  # 1 → off
+
+    @property
+    def is_greedy(self) -> bool:
+        return self.temperature <= 0.0
 
 
 def sample(
@@ -43,3 +52,39 @@ def sample(
         )
         logits = jnp.where(logits < threshold, -jnp.inf, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def sample_slots(
+    logits: jax.Array,  # [B, V] (last-token logits)
+    keys: jax.Array,  # [B] stacked typed PRNG keys (one stream per slot)
+    temperature: jax.Array,  # [B] f32; <= 0 → greedy for that row
+    top_k: jax.Array,  # [B] i32; 0 → off
+    top_p: jax.Array,  # [B] f32; >= 1 → off
+) -> jax.Array:
+    """Per-row sampling → [B] int32 next tokens.
+
+    One descending sort serves both top-k (rank cutoff) and top-p (nucleus
+    mass cutoff); rows with filtering off use rank < V / mass < 1 which keep
+    everything.  Greedy rows bypass the categorical draw via a final where.
+    """
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    safe_temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits.astype(jnp.float32) / safe_temp
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    ranks = jnp.arange(V, dtype=jnp.int32)[None, :]
+    k_eff = jnp.where(top_k > 0, top_k, V)[:, None]
+    keep = ranks < k_eff
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cumulative = jnp.cumsum(probs, axis=-1)
+    keep &= (cumulative - probs) < jnp.minimum(top_p, 1.0)[:, None]
+    keep |= ranks == 0  # never filter out every token
+    threshold = jnp.min(
+        jnp.where(keep, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    filtered = jnp.where(scaled < threshold, -jnp.inf, scaled)
+    drawn = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row, axis=-1)
+    )(keys, filtered).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, drawn, greedy)

@@ -148,12 +148,15 @@ class KafkaMesh(MeshTransport):
     async def publish(
         self,
         topic: str,
-        value: bytes,
+        value: bytes | None,
         *,
         key: bytes | None = None,
         headers: dict[str, str] | None = None,
     ) -> None:
-        if len(value) > self._max_bytes:
+        # value=None is a real null-value record — REQUIRED for tombstones:
+        # Kafka log compaction only purges null values, an empty byte value
+        # would be retained (and replayed to every table reader) forever
+        if value is not None and len(value) > self._max_bytes:
             raise ValueError(
                 f"message of {len(value)} bytes exceeds max_message_bytes={self._max_bytes}"
             )
@@ -357,4 +360,4 @@ class _KafkaTableWriter(TableWriter):
         await self._mesh.publish(self._topic, value, key=key.encode("utf-8"))
 
     async def tombstone(self, key: str) -> None:
-        await self._mesh.publish(self._topic, b"", key=key.encode("utf-8"))
+        await self._mesh.publish(self._topic, None, key=key.encode("utf-8"))

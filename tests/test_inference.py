@@ -374,3 +374,163 @@ class TestPallasAttention:
         # so don't extend this to arbitrary prompts (the numerical bound is
         # the allclose test above)
         assert out == ref  # same greedy tokens through either kernel
+
+
+class TestPerRequestSampling:
+    """Round-2: ModelSettings knobs ride per-slot device tensors, so one
+    decode dispatch serves mixed greedy/sampled requests (ADVICE r1 medium)."""
+
+    def _engine(self, max_batch_size=4):
+        return InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=max_batch_size, max_seq_len=128,
+                          prefill_chunk=16, decode_steps_per_dispatch=4),
+        )
+
+    async def test_seeded_sampling_reproducible(self):
+        engine = self._engine()
+        await engine.start()
+        params = SamplingParams(temperature=1.2, top_k=50)
+        prompt = [1, 5, 9, 13]
+        out1 = [t async for t in engine.generate(
+            prompt, max_new_tokens=12, sampling=params, seed=7)]
+        out2 = [t async for t in engine.generate(
+            prompt, max_new_tokens=12, sampling=params, seed=7)]
+        assert out1 == out2  # same seed -> same stream, slot-independent
+        assert len(out1) == 12
+        await engine.stop()
+
+    async def test_mixed_batch_greedy_rows_unaffected(self):
+        engine = self._engine()
+        await engine.start()
+        prompt = [2, 4, 6]
+        baseline = [t async for t in engine.generate(prompt, max_new_tokens=8)]
+
+        async def sampled(i):
+            return [t async for t in engine.generate(
+                [3 + i, 7, 11], max_new_tokens=8,
+                sampling=SamplingParams(temperature=1.5, top_p=0.9), seed=i)]
+
+        async def greedy():
+            return [t async for t in engine.generate(prompt, max_new_tokens=8)]
+
+        results = await asyncio.gather(greedy(), sampled(1), sampled(2))
+        assert results[0] == baseline  # sampled neighbors don't perturb greedy
+        await engine.stop()
+
+    async def test_abandoned_iterator_frees_slot(self):
+        engine = self._engine(max_batch_size=2)
+        await engine.start()
+        agen = engine.generate([1, 2, 3], max_new_tokens=64)
+        got = 0
+        async for _ in agen:
+            got += 1
+            if got >= 2:
+                break  # abandon mid-stream
+        await agen.aclose()
+        # engine must reclaim the slot and keep serving at full capacity
+        outs = await asyncio.gather(*[
+            _collect(engine.generate([5 + i, 6], max_new_tokens=6))
+            for i in range(4)
+        ])
+        assert all(len(o) == 6 for o in outs)
+        assert not engine._active
+        assert sorted(engine._free) == [0, 1]
+        await engine.stop()
+
+
+async def _collect(agen):
+    return [t async for t in agen]
+
+
+class TestModelSettingsThreading:
+    """JaxLocalModelClient honors per-request ModelSettings (ADVICE r1)."""
+
+    def _client(self):
+        from calfkit_tpu.inference.client import JaxLocalModelClient
+
+        return JaxLocalModelClient(
+            config=preset("debug"),
+            runtime=RuntimeConfig(max_batch_size=2, max_seq_len=256,
+                                  prefill_chunk=32,
+                                  decode_steps_per_dispatch=4),
+            max_new_tokens=24,
+        )
+
+    async def test_temperature_seed_reproducible(self):
+        from calfkit_tpu.engine.model_client import ModelSettings
+        from calfkit_tpu.models.messages import user_message
+
+        client = self._client()
+        settings = ModelSettings(temperature=0.9, top_k=40, seed=11)
+        r1 = await client.request([user_message("hello")], settings)
+        r2 = await client.request([user_message("hello")], settings)
+        assert r1.text() == r2.text()
+        await client.stop()
+
+    async def test_stop_sequences_terminate(self):
+        from calfkit_tpu.engine.model_client import ModelSettings
+        from calfkit_tpu.models.messages import user_message
+
+        client = self._client()
+        free = await client.request([user_message("hi")])
+        full = free.text()
+        assert full  # byte tokenizer on random weights always emits text
+        stop = full[1:3]  # a sequence the greedy model WILL produce
+        r = await client.request(
+            [user_message("hi")], ModelSettings(stop_sequences=[stop])
+        )
+        assert stop not in r.text()
+        assert len(r.text()) < len(full)
+        # the engine reclaims the cancelled slot at its next tick
+        for _ in range(100):
+            if not client._engine._active:
+                break
+            await asyncio.sleep(0.05)
+        assert not client._engine._active
+        await client.stop()
+
+    async def test_max_tokens_respected(self):
+        from calfkit_tpu.engine.model_client import ModelSettings
+        from calfkit_tpu.models.messages import user_message
+
+        client = self._client()
+        r = await client.request(
+            [user_message("hi")], ModelSettings(max_tokens=5)
+        )
+        assert r.usage.output_tokens <= 5
+        await client.stop()
+
+
+class TestQueuedCancellation:
+    async def test_cancel_while_queued_drains_and_engine_stays_live(self):
+        """A request cancelled BEFORE admission must be drained; the idle
+        engine must keep awaiting (review r2: a skipped-but-present pending
+        entry turned the serve loop into a busy spin)."""
+        engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=1, max_seq_len=128, prefill_chunk=16,
+                          decode_steps_per_dispatch=4),
+        )
+        await engine.start()
+
+        async def long_req():
+            return [t async for t in engine.generate([1, 2], max_new_tokens=24)]
+
+        first = asyncio.create_task(long_req())
+        await asyncio.sleep(0.3)  # first request admitted (slot occupied)
+        queued = engine.generate([3, 4], max_new_tokens=24)
+        starter = asyncio.create_task(anext(queued))
+        await asyncio.sleep(0.1)  # body started: request enqueued, blocked
+        starter.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await starter
+        await queued.aclose()
+        assert (await first)  # original request completes
+        # engine idles without spinning and still serves new work
+        out = await asyncio.wait_for(
+            _collect(engine.generate([5, 6], max_new_tokens=6)), timeout=30
+        )
+        assert len(out) == 6
+        assert not engine._pending and not engine._active
+        await engine.stop()
