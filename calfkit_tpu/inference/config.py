@@ -54,8 +54,15 @@ class RuntimeConfig:
 
     max_batch_size: int = 32
     max_seq_len: int = 2048
+    # "dense" = [L, B, K, max_seq, hd] per-slot rows (fastest when B×S fits
+    # HBM); "paged" = block-table pool, memory ∝ requested footprints — the
+    # layout that fits 128 concurrent 8B streams on one 16 GB chip
+    kv_layout: str = "dense"
     page_size: int = 64  # tokens per KV page (pallas paged-attention block)
     max_pages_per_seq: int = 0  # 0 → derived from max_seq_len
+    # total pages in the paged pool (incl. the reserved trash page);
+    # 0 → max_batch_size × pages_per_seq + 1 (no oversubscription)
+    num_kv_pages: int = 0
     tp: int = 1  # tensor-parallel degree (mesh 'tp' axis size)
     dp: int = 1  # data/batch-parallel replicas of the serving engine
     decode_steps_per_dispatch: int = 8  # tokens generated per scheduler tick
@@ -73,6 +80,12 @@ class RuntimeConfig:
         if self.max_pages_per_seq:
             return self.max_pages_per_seq
         return -(-self.max_seq_len // self.page_size)
+
+    def pool_pages(self) -> int:
+        """Total pages in the paged pool (page 0 is the trash page)."""
+        if self.num_kv_pages:
+            return self.num_kv_pages
+        return self.max_batch_size * self.pages_per_seq() + 1
 
 
 # --------------------------------------------------------------------------- #

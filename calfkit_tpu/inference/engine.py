@@ -58,6 +58,7 @@ class GenRequest:
     sampling: SamplingParams | None = None  # None → engine default
     seed: int | None = None  # None → engine-derived per-admission stream
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
+    pages: list[int] = field(default_factory=list)  # paged-KV reservation
     slot: int = -1
     generated: int = 0
     prefill_ms: float = 0.0
@@ -143,15 +144,49 @@ class InferenceEngine:
         self.params = place_params(params, shardings)
 
         B, S = rt.max_batch_size, rt.max_seq_len
-        cache_sh = cache_sharding(config, self.mesh, B)
-        self._k = jax.device_put(
-            jnp.zeros(
-                (config.n_layers, B, config.n_kv_heads, S, config.head_dim),
-                jnp.dtype(config.dtype),
-            ),
-            cache_sh,
-        )
-        self._v = jax.device_put(jnp.zeros_like(self._k), cache_sh)
+        if rt.kv_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"unsupported kv_layout {rt.kv_layout!r} (dense | paged)"
+            )
+        self._paged = rt.kv_layout == "paged"
+        if self._paged:
+            from calfkit_tpu.inference.paged import PageAllocator
+            from calfkit_tpu.inference.sharding import pool_sharding
+
+            if rt.prefill_chunk % rt.page_size:
+                raise ValueError(
+                    "page_size must divide prefill_chunk "
+                    f"({rt.page_size} vs {rt.prefill_chunk})"
+                )
+            if rt.max_seq_len % rt.page_size:
+                # a prefill bucket capped at max_seq_len must still be a
+                # whole number of pages (page-granular scatter)
+                raise ValueError(
+                    "page_size must divide max_seq_len "
+                    f"({rt.page_size} vs {rt.max_seq_len})"
+                )
+            n_pages = rt.pool_pages()
+            pool_sh = pool_sharding(config, self.mesh)
+            pool_k, pool_v = M.make_page_pool(config, n_pages, rt.page_size)
+            self._k = jax.device_put(pool_k, pool_sh)
+            self._v = jax.device_put(pool_v, pool_sh)
+            self._tables = jnp.zeros((B, rt.pages_per_seq()), jnp.int32)
+            self._page_alloc = PageAllocator(n_pages)
+            logger.info(
+                "paged KV pool: %d pages x %d tokens (%.2f GB)",
+                n_pages, rt.page_size,
+                2 * self._k.size * self._k.dtype.itemsize / 1e9,
+            )
+        else:
+            cache_sh = cache_sharding(config, self.mesh, B)
+            self._k = jax.device_put(
+                jnp.zeros(
+                    (config.n_layers, B, config.n_kv_heads, S, config.head_dim),
+                    jnp.dtype(config.dtype),
+                ),
+                cache_sh,
+            )
+            self._v = jax.device_put(jnp.zeros_like(self._k), cache_sh)
         self._last = jnp.zeros((B,), jnp.int32)
         self._lens = jnp.zeros((B,), jnp.int32)
         self._host_lens = np.zeros((B,), np.int64)  # host mirror for windows
@@ -189,6 +224,8 @@ class InferenceEngine:
     def _decode_jit(
         self, window: int, steps: int | None = None, sampled: bool = False
     ) -> Any:
+        if self._paged:
+            return self._decode_jit_paged(window, steps, sampled)
         steps = steps or self.runtime.decode_steps_per_dispatch
         fn = self._decode_jits.get((window, steps, sampled))
         if fn is not None:
@@ -247,6 +284,62 @@ class InferenceEngine:
         self._decode_jits[(window, steps, sampled)] = fn
         return fn
 
+    def _decode_jit_paged(
+        self, window: int, steps: int | None, sampled: bool
+    ) -> Any:
+        """Decode dispatch reading/writing KV through the block tables."""
+        steps = steps or self.runtime.decode_steps_per_dispatch
+        page = self.runtime.page_size
+        wpages = -(-window // page)
+        fn = self._decode_jits.get((wpages, steps, sampled, "paged"))
+        if fn is not None:
+            return fn
+        cfg = self.config
+        attn_impl = self.runtime.attention_impl
+        if attn_impl == "auto":
+            attn_impl = "xla"
+
+        def decode(params, k, v, tables, last, lens, active,
+                   slot_keys, temp, top_k, top_p):
+            B = last.shape[0]
+            ring = (
+                jnp.zeros(
+                    (cfg.n_layers, steps, B, cfg.n_kv_heads, cfg.head_dim),
+                    k.dtype,
+                ),
+                jnp.zeros(
+                    (cfg.n_layers, steps, B, cfg.n_kv_heads, cfg.head_dim),
+                    v.dtype,
+                ),
+            )
+
+            def step(carry, t):
+                ring, last = carry
+                logits, ring = M.decode_step_ring_paged(
+                    params, cfg, last[:, None], (k, v), tables, ring, t,
+                    lens, wpages=wpages, attn_impl=attn_impl,
+                )
+                if sampled:
+                    subs = jax.vmap(jax.random.fold_in)(slot_keys, lens + t + 1)
+                    nxt = sample_slots(logits[:, -1], subs, temp, top_k, top_p)
+                else:
+                    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                nxt = jnp.where(active, nxt, last)
+                return (ring, nxt), nxt
+
+            (ring, last), toks = lax.scan(
+                step, (ring, last), jnp.arange(steps)
+            )
+            k2, v2 = M.consolidate_ring_paged(
+                (k, v), ring, tables, lens, active
+            )
+            new_lens = jnp.where(active, lens + steps, lens)
+            return k2, v2, last, new_lens, toks
+
+        fn = jax.jit(decode, donate_argnums=(1, 2))
+        self._decode_jits[(wpages, steps, sampled, "paged")] = fn
+        return fn
+
     def _short_steps(self) -> int:
         """Dispatch length while a waiting admission could actually unblock:
         a new request's time-to-prefill is bounded by one SHORT dispatch
@@ -269,9 +362,12 @@ class InferenceEngine:
 
     def _prefill_jit(self, bucket: int, rows: int, sampled: bool = False) -> Any:
         """Batched prefill: R admissions run as one [R, bucket] forward on a
-        scratch cache, then scatter into the slot rows — one dispatch per
-        admission WAVE, not per request.  The wave's per-slot sampling state
-        (keys/temp/top_k/top_p) is scattered in the same dispatch."""
+        scratch cache, then scatter into the slot rows (dense) or the
+        reserved pages (paged) — one dispatch per admission WAVE, not per
+        request.  The wave's per-slot sampling state (keys/temp/top_k/top_p)
+        and, when paged, the block-table rows are scattered in the same
+        dispatch."""
+        paged = self._paged
         fn = self._prefill_jits.get((bucket, rows, sampled))
         if fn is not None:
             return fn
@@ -281,6 +377,7 @@ class InferenceEngine:
             params, k, v, tokens, slots, true_lens,
             slot_keys, temp, top_k, top_p,  # [B] engine state
             seeds, w_temp, w_top_k, w_top_p,  # [R] wave values
+            tables=None, page_rows=None, scatter_ids=None,  # paged only
         ):
             # tokens: [R, bucket]; slots/true_lens: [R]
             R, P = tokens.shape
@@ -292,15 +389,19 @@ class InferenceEngine:
             logits, (sk, sv) = M.forward(
                 params, cfg, tokens, pos, scratch, jnp.full((R,), P, jnp.int32)
             )
-            for r in range(R):  # R is small & static: unrolled row scatter
-                k = lax.dynamic_update_slice_in_dim(
-                    k, lax.dynamic_slice_in_dim(sk, r, 1, axis=1)[:, :, :, :P],
-                    slots[r], axis=1,
-                )
-                v = lax.dynamic_update_slice_in_dim(
-                    v, lax.dynamic_slice_in_dim(sv, r, 1, axis=1)[:, :, :, :P],
-                    slots[r], axis=1,
-                )
+            if paged:
+                k, v = M.write_prefill_pages((k, v), (sk, sv), scatter_ids)
+                tables = tables.at[slots].set(page_rows)
+            else:
+                for r in range(R):  # R is small & static: unrolled row scatter
+                    k = lax.dynamic_update_slice_in_dim(
+                        k, lax.dynamic_slice_in_dim(sk, r, 1, axis=1)[:, :, :, :P],
+                        slots[r], axis=1,
+                    )
+                    v = lax.dynamic_update_slice_in_dim(
+                        v, lax.dynamic_slice_in_dim(sv, r, 1, axis=1)[:, :, :, :P],
+                        slots[r], axis=1,
+                    )
             wave_keys = jax.vmap(jax.random.key)(seeds)
             slot_keys = slot_keys.at[slots].set(wave_keys)
             temp = temp.at[slots].set(w_temp)
@@ -315,7 +416,7 @@ class InferenceEngine:
                 firsts = sample_slots(last_logits, subs, w_temp, w_top_k, w_top_p)
             else:
                 firsts = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-            return k, v, slot_keys, temp, top_k, top_p, firsts
+            return k, v, tables, slot_keys, temp, top_k, top_p, firsts
 
         fn = jax.jit(prefill, donate_argnums=(1, 2))
         self._prefill_jits[(bucket, rows, sampled)] = fn
@@ -383,6 +484,16 @@ class InferenceEngine:
             sampling=sampling,
             seed=seed,
         )
+        if self._paged:
+            # reject what the pool could NEVER serve — re-queueing it would
+            # wait (and starve everything behind it) forever
+            reserve = self._reserve_pages(request, self._bucket_of(len(prompt)))
+            usable = self._page_alloc.num_pages - 1
+            if reserve > usable:
+                raise InferenceError(
+                    f"request needs {reserve} KV pages but the pool only has "
+                    f"{usable}; lower max_new_tokens or raise num_kv_pages"
+                )
         self._pending.append(request)
         self._wake.set()
         done = False
@@ -428,6 +539,8 @@ class InferenceEngine:
         for slot, request in list(self._active.items()):
             if request.cancelled:
                 self._active.pop(slot, None)
+                if self._paged:
+                    self._page_alloc.free(slot)
                 self._free.append(slot)
                 request.slot = -1
                 request.out.put_nowait(_DONE)
@@ -465,17 +578,37 @@ class InferenceEngine:
                 return request
         return None
 
+    def _reserve_pages(self, request: GenRequest, bucket: int) -> int:
+        """Pages a request needs for its whole life: the prefill writes whole
+        bucket pages, decode grows to (prompt + max_new), capped by the
+        sequence limit."""
+        from calfkit_tpu.inference.paged import pages_needed
+
+        rt = self.runtime
+        total = min(
+            len(request.prompt) + request.max_new_tokens + 1, rt.max_seq_len
+        )
+        return min(
+            max(
+                pages_needed(bucket, rt.page_size),
+                pages_needed(total, rt.page_size),
+            ),
+            rt.pages_per_seq(),
+        )
+
+    def _bucket_of(self, prompt_len: int) -> int:
+        rt = self.runtime
+        return min(
+            -(-prompt_len // rt.prefill_chunk) * rt.prefill_chunk,
+            rt.max_seq_len,
+        )
+
     async def _admit(self) -> bool:
         admitted = False
         while self._free and self._peek_pending() is not None:
             # one admission WAVE: same-bucket requests prefill together
-            rt = self.runtime
-
             def bucket_of(req: GenRequest) -> int:
-                return min(
-                    -(-len(req.prompt) // rt.prefill_chunk) * rt.prefill_chunk,
-                    rt.max_seq_len,
-                )
+                return self._bucket_of(len(req.prompt))
 
             wave: list[GenRequest] = [self._next_pending()]
             wave_bucket = bucket_of(wave[0])
@@ -494,8 +627,39 @@ class InferenceEngine:
                 keep *= 2
             self._carry = wave[keep:] + self._carry
             wave = wave[:keep]
-            for request in wave:
-                request.slot = self._free.pop()
+            if self._paged:
+                # admission control: a request enters only with its full
+                # worst-case page footprint reserved (no mid-flight OOM);
+                # the tail of an unservable wave waits at the queue front
+                granted: list[GenRequest] = []
+                for i, request in enumerate(wave):
+                    slot = self._free.pop()
+                    pages = self._page_alloc.alloc(
+                        slot, self._reserve_pages(request, wave_bucket)
+                    )
+                    if pages is None:
+                        self._free.append(slot)
+                        self._carry = wave[i:] + self._carry
+                        break
+                    request.slot = slot
+                    request.pages = pages
+                    granted.append(request)
+                wave = granted
+                if not wave:
+                    break  # pool exhausted: wait for retirements
+                # keep jit variants power-of-two after page trimming too
+                keep = 1
+                while keep * 2 <= len(wave):
+                    keep *= 2
+                for request in wave[keep:]:
+                    self._page_alloc.free(request.slot)
+                    self._free.append(request.slot)
+                    request.slot = -1
+                self._carry = wave[keep:] + self._carry
+                wave = wave[:keep]
+            else:
+                for request in wave:
+                    request.slot = self._free.pop()
             await asyncio.to_thread(self._prefill_wave, wave, wave_bucket)
             for request in wave:
                 # a request can retire DURING its own prefill (first token
@@ -535,10 +699,7 @@ class InferenceEngine:
             sampled |= not params.is_greedy
         started = time.perf_counter()
         fn = self._prefill_jit(bucket, R, sampled)
-        (
-            self._k, self._v, self._slot_keys, self._temp, self._top_k,
-            self._top_p, firsts,
-        ) = fn(
+        args = [
             self.params,
             self._k,
             self._v,
@@ -553,7 +714,26 @@ class InferenceEngine:
             jnp.asarray(w_temp),
             jnp.asarray(w_top_k),
             jnp.asarray(w_top_p),
-        )
+        ]
+        if self._paged:
+            from calfkit_tpu.inference.paged import table_row
+
+            page = self.runtime.page_size
+            pmax = self.runtime.pages_per_seq()
+            npg = bucket // page
+            page_rows = np.zeros((R, pmax), np.int32)
+            scatter_ids = np.zeros((R, npg), np.int32)
+            for r, request in enumerate(wave):
+                page_rows[r] = table_row(request.pages, pmax)
+                # prefill writes whole bucket pages; reservation covers them
+                scatter_ids[r] = page_rows[r, :npg]
+            args += [self._tables, jnp.asarray(page_rows), jnp.asarray(scatter_ids)]
+        (
+            self._k, self._v, tables, self._slot_keys, self._temp,
+            self._top_k, self._top_p, firsts,
+        ) = fn(*args)
+        if self._paged:
+            self._tables = tables
         firsts = np.asarray(firsts)
         elapsed_ms = (time.perf_counter() - started) * 1000.0
         for r, request in enumerate(wave):
@@ -592,20 +772,37 @@ class InferenceEngine:
             for r in self._active.values()
         )
         started = time.perf_counter()
-        self._k, self._v, self._last, self._lens, toks = (
-            self._decode_jit(window, steps, sampled)(
-                self.params,
-                self._k,
-                self._v,
-                self._last,
-                self._lens,
-                jnp.asarray(active_mask),
-                self._slot_keys,
-                self._temp,
-                self._top_k,
-                self._top_p,
+        if self._paged:
+            self._k, self._v, self._last, self._lens, toks = (
+                self._decode_jit(window, steps, sampled)(
+                    self.params,
+                    self._k,
+                    self._v,
+                    self._tables,
+                    self._last,
+                    self._lens,
+                    jnp.asarray(active_mask),
+                    self._slot_keys,
+                    self._temp,
+                    self._top_k,
+                    self._top_p,
+                )
             )
-        )
+        else:
+            self._k, self._v, self._last, self._lens, toks = (
+                self._decode_jit(window, steps, sampled)(
+                    self.params,
+                    self._k,
+                    self._v,
+                    self._last,
+                    self._lens,
+                    jnp.asarray(active_mask),
+                    self._slot_keys,
+                    self._temp,
+                    self._top_k,
+                    self._top_p,
+                )
+            )
         for slot in self._active:
             self._host_lens[slot] += steps
         block = np.asarray(toks)  # [steps, B] — THE host sync per dispatch
@@ -643,6 +840,8 @@ class InferenceEngine:
             # completion, the slot is already reclaimed (no window where a
             # finished request still occupies _active)
             self._active.pop(request.slot, None)
+            if self._paged:
+                self._page_alloc.free(request.slot)
             self._free.append(request.slot)
             request.slot = -1
             self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)

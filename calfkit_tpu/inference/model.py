@@ -251,42 +251,44 @@ def forward(
     return logits, (new_k, new_v)
 
 
-def decode_step_ring(
+def _decode_step_with_ring(
     params: Params,
     config: ModelConfig,
     tokens: jax.Array,  # [B, 1]
-    kv_cache: tuple[jax.Array, jax.Array],  # main pages, READ-ONLY here
     ring: tuple[jax.Array, jax.Array],  # [L, T, B, K, hd] fresh-token ring
     t: jax.Array,  # scalar: this dispatch's step index (ring write slot)
-    base_lens: jax.Array,  # [B] kv length at dispatch start (main cache)
-    attn_window: int | None = None,
-    attn_impl: str = "xla",  # static: "xla" | "pallas" | "pallas_interpret"
+    base_lens: jax.Array,  # [B]
+    attn_source: Any,  # (i, q, ring_k_i, ring_v_i) -> attn [B, 1, H, hd]
+    scan_xs: Any,  # extra per-layer scan inputs threaded to attn_source
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
-    """One decode step in the ring-buffer scheme.
+    """The shared decode-step transformer body (ring-buffer scheme).
 
     Why a ring: per-token scatters into the main cache cost ~10ms/step on
     TPU (measured, TinyLlama bs=64) — scatter with per-row offsets is the
     single most expensive op in naive decode.  Here every step writes its
     K/V *densely* at ring slot ``t`` (same index for all rows: one cheap
     dynamic_update_index), attention merges (main cache ⊕ ring) with a
-    flash-style logsumexp combine, and :func:`consolidate_ring` writes the
+    flash-style logsumexp combine, and the consolidate function writes the
     whole dispatch's tokens back in one amortized pass.
+
+    The main-cache read is the ONLY thing the dense and paged layouts do
+    differently, so it arrives as ``attn_source`` (with its per-layer scan
+    inputs in ``scan_xs``); everything else lives once, here.
+
+    Layers run via scan: main-cache buffers are read-only scan inputs or
+    closed-over invariants (no carry round-trip), only the small ring
+    travels in the carry.  An unrolled python loop has the same memory
+    pattern but compiles ~10x slower for deep models.
     """
     eps = config.norm_eps
     positions = (base_lens + t)[:, None]  # [B, 1] absolute position
     x = params["embed"][tokens]
     cos, sin = rope_tables(positions, config.head_dim, config.rope_theta)
-    k_pages, v_pages = kv_cache
     ring_k, ring_v = ring
-    W = attn_window or k_pages.shape[3]
 
-    # layers via scan: the main cache pages are READ-ONLY scan inputs (no
-    # carry round-trip), only the small ring travels in the carry.  An
-    # unrolled python loop has the same memory pattern but compiles ~10x
-    # slower for deep models — scan keeps the HLO O(1) in depth.
     def layer_body(carry, inputs):
         x, ring_k, ring_v, i = carry
-        lp, k_page, v_page = inputs
+        lp, extra = inputs
         h = rms_norm(x, lp["attn_norm"], eps)
         q = jnp.einsum("bsd,dnh->bsnh", h, _w(lp["wq"]))
         k = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wk"]))
@@ -298,25 +300,13 @@ def decode_step_ring(
         ring_k = lax.dynamic_update_slice(ring_k, slab, (i, t, 0, 0, 0))
         slab = v[:, 0].astype(ring_v.dtype)[None, None]
         ring_v = lax.dynamic_update_slice(ring_v, slab, (i, t, 0, 0, 0))
-        attn_args = (
+        attn = attn_source(
+            i,
             q,
-            k_page[:, :, :W],
-            v_page[:, :, :W],
             lax.dynamic_index_in_dim(ring_k, i, 0, keepdims=False),
             lax.dynamic_index_in_dim(ring_v, i, 0, keepdims=False),
-            base_lens,
-            t,
+            extra,
         )
-        if attn_impl.startswith("pallas"):
-            from calfkit_tpu.inference.pallas_attention import (
-                merged_decode_attention_pallas,
-            )
-
-            attn = merged_decode_attention_pallas(
-                *attn_args, interpret=attn_impl == "pallas_interpret"
-            )
-        else:
-            attn = _merged_decode_attention(*attn_args)
         x = x + jnp.einsum("bsnh,nhd->bsd", attn, _w(lp["wo"]))
         h = rms_norm(x, lp["mlp_norm"], eps)
         gate = jnp.einsum("bsd,df->bsf", h, _w(lp["w_gate"]))
@@ -327,7 +317,7 @@ def decode_step_ring(
     (x, ring_k, ring_v, _), _ = lax.scan(
         layer_body,
         (x, ring_k, ring_v, jnp.int32(0)),
-        (params["layers"], k_pages, v_pages),
+        (params["layers"], scan_xs),
     )
     x = rms_norm(x, params["final_norm"], eps)
     head = params.get("lm_head")
@@ -336,6 +326,40 @@ def decode_step_ring(
     else:
         logits = jnp.einsum("bsd,dv->bsv", x, _w(head))
     return logits, (ring_k, ring_v)
+
+
+def decode_step_ring(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # [B, 1]
+    kv_cache: tuple[jax.Array, jax.Array],  # main pages, READ-ONLY here
+    ring: tuple[jax.Array, jax.Array],  # [L, T, B, K, hd] fresh-token ring
+    t: jax.Array,  # scalar: this dispatch's step index (ring write slot)
+    base_lens: jax.Array,  # [B] kv length at dispatch start (main cache)
+    attn_window: int | None = None,
+    attn_impl: str = "xla",  # static: "xla" | "pallas" | "pallas_interpret"
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """One decode step over the dense [L, B, K, S, hd] cache layout."""
+    k_pages, v_pages = kv_cache
+    W = attn_window or k_pages.shape[3]
+
+    def attn_source(i, q, rk, rv, extra):
+        k_page, v_page = extra
+        attn_args = (q, k_page[:, :, :W], v_page[:, :, :W], rk, rv, base_lens, t)
+        if attn_impl.startswith("pallas"):
+            from calfkit_tpu.inference.pallas_attention import (
+                merged_decode_attention_pallas,
+            )
+
+            return merged_decode_attention_pallas(
+                *attn_args, interpret=attn_impl == "pallas_interpret"
+            )
+        return _merged_decode_attention(*attn_args)
+
+    return _decode_step_with_ring(
+        params, config, tokens, ring, t, base_lens, attn_source,
+        (k_pages, v_pages),
+    )
 
 
 def _merged_decode_attention(
@@ -456,3 +480,144 @@ def make_empty_cache(
     dtype = dtype or jnp.dtype(config.dtype)
     shape = (config.n_layers, batch, config.n_kv_heads, max_seq, config.head_dim)
     return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+# --------------------------------------------------------------------------- #
+# paged KV cache (block-table indirection; see inference/paged.py)
+# --------------------------------------------------------------------------- #
+
+
+def make_page_pool(
+    config: ModelConfig, num_pages: int, page_size: int, dtype: Any = None
+) -> tuple[jax.Array, jax.Array]:
+    """KV page pool [L, N, K, page, hd]; page 0 is the trash page."""
+    dtype = dtype or jnp.dtype(config.dtype)
+    shape = (
+        config.n_layers, num_pages, config.n_kv_heads, page_size,
+        config.head_dim,
+    )
+    return jnp.zeros(shape, dtype), jnp.zeros(shape, dtype)
+
+
+def gather_window_paged(
+    pool_layer: jax.Array,  # [N, K, page, hd] one layer's pages
+    tables: jax.Array,  # [B, Pmax] int32 block tables
+    wpages: int,  # static: pages per attention window
+) -> jax.Array:
+    """Materialize each row's window from its pages → [B, K, wp·page, hd].
+
+    The XLA fallback read path: one gather per (layer, step) — correct
+    everywhere, but doubles attention HBM traffic vs the Pallas paged
+    kernel, which DMAs pages in place.
+    """
+    B = tables.shape[0]
+    page = pool_layer.shape[2]
+    gathered = pool_layer[tables[:, :wpages]]  # [B, wp, K, page, hd]
+    gathered = jnp.transpose(gathered, (0, 2, 1, 3, 4))
+    return gathered.reshape(B, pool_layer.shape[1], wpages * page, -1)
+
+
+def decode_step_ring_paged(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # [B, 1]
+    pool: tuple[jax.Array, jax.Array],  # [L, N, K, page, hd] READ-ONLY here
+    tables: jax.Array,  # [B, Pmax] block tables
+    ring: tuple[jax.Array, jax.Array],  # [L, T, B, K, hd]
+    t: jax.Array,  # scalar step index
+    base_lens: jax.Array,  # [B]
+    wpages: int,  # static: window bucket in pages
+    attn_impl: str = "xla",
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """One decode step reading KV through the block tables.
+
+    Shares the transformer body with :func:`decode_step_ring`; only the
+    main-cache read differs.  The pool is a scan *invariant* (closed over,
+    indexed per layer), never a carry — its bytes move once per read, not
+    per scan round-trip.
+    """
+    pool_k, pool_v = pool
+
+    def attn_source(i, q, rk, rv, extra):
+        if attn_impl.startswith("pallas"):
+            from calfkit_tpu.inference.pallas_attention import (
+                merged_paged_decode_attention_pallas,
+            )
+
+            return merged_paged_decode_attention_pallas(
+                q, pool_k, pool_v, i, tables, rk, rv, base_lens, t,
+                wpages=wpages, interpret=attn_impl == "pallas_interpret",
+            )
+        kl = lax.dynamic_index_in_dim(pool_k, i, 0, keepdims=False)
+        vl = lax.dynamic_index_in_dim(pool_v, i, 0, keepdims=False)
+        return _merged_decode_attention(
+            q,
+            gather_window_paged(kl, tables, wpages),
+            gather_window_paged(vl, tables, wpages),
+            rk, rv, base_lens, t,
+        )
+
+    return _decode_step_with_ring(
+        params, config, tokens, ring, t, base_lens, attn_source, None
+    )
+
+
+def consolidate_ring_paged(
+    pool: tuple[jax.Array, jax.Array],  # [L, N, K, page, hd] (donated)
+    ring: tuple[jax.Array, jax.Array],  # [L, T, B, K, hd]
+    tables: jax.Array,  # [B, Pmax]
+    base_lens: jax.Array,  # [B]
+    active: jax.Array,  # [B] bool — inactive rows scatter to the trash page
+) -> tuple[jax.Array, jax.Array]:
+    """Write the dispatch's ring tokens through the block tables.
+
+    One scatter per dispatch.  Inactive rows are redirected to page 0 (the
+    trash page): a retired slot's pages may already belong to a NEW request,
+    so letting its stale row write through its old table entries would
+    corrupt a neighbor — the dense layout tolerated garbage-beyond-length,
+    the paged layout must not.
+    """
+    pool_k, pool_v = pool
+    ring_k, ring_v = ring
+    T = ring_k.shape[1]
+    page = pool_k.shape[3]
+
+    pos = base_lens[:, None] + jnp.arange(T)[None, :]  # [B, T]
+    logical = pos // page  # which table entry
+    pmax = tables.shape[1]
+    in_range = logical < pmax  # a dispatch can overshoot a retiring row's cap
+    page_ids = jnp.take_along_axis(
+        tables, jnp.minimum(logical, pmax - 1), axis=1
+    )  # [B, T]
+    page_ids = jnp.where(active[:, None] & in_range, page_ids, 0)
+    offsets = pos % page  # [B, T]
+
+    # advanced indexing: pool[:, idx, :, off] with idx/off of shape [B, T] —
+    # the index arrays are NON-adjacent, so numpy semantics move their
+    # broadcast dims to the FRONT: values must be [B, T, L, K, hd]
+    def write(pool_side: jax.Array, r: jax.Array) -> jax.Array:
+        vals = jnp.transpose(r, (2, 1, 0, 3, 4)).astype(pool_side.dtype)
+        return pool_side.at[:, page_ids, :, offsets].set(vals)
+
+    return write(pool_k, ring_k), write(pool_v, ring_v)
+
+
+def write_prefill_pages(
+    pool: tuple[jax.Array, jax.Array],  # [L, N, K, page, hd] (donated)
+    scratch: tuple[jax.Array, jax.Array],  # [L, R, K, P, hd] prefill K/V
+    page_ids: jax.Array,  # [R, P // page] int32 destination pages
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter whole prefill pages into the pool (page-granular writes)."""
+    pool_k, pool_v = pool
+    sk, sv = scratch
+    L, R, K, P, hd = sk.shape
+    page = pool_k.shape[3]
+    npg = P // page
+
+    def write(pool_side: jax.Array, s: jax.Array) -> jax.Array:
+        # [L, R, K, np*page, hd] -> [L, R, np, K, page, hd] -> [L, R*np, ...]
+        blocks = s.reshape(L, R, K, npg, page, hd).transpose(0, 1, 3, 2, 4, 5)
+        blocks = blocks.reshape(L, R * npg, K, page, hd).astype(pool_side.dtype)
+        return pool_side.at[:, page_ids.reshape(-1)].set(blocks)
+
+    return write(pool_k, sk), write(pool_v, sv)

@@ -1,0 +1,76 @@
+"""Paged KV-cache management (host side).
+
+Why paging (reference anchor: SURVEY.md §5 long-context — "Ragged Paged
+Attention for TPU"; VERDICT r1 weak #4): the dense cache allocates
+``[L, B, K, max_seq, hd]`` up front — Llama-3-8B at B=128, S=1024 is ~17 GB
+of KV, over a 16 GB chip before weights.  Paging allocates a fixed pool of
+``page_size``-token pages and gives each request only the pages its actual
+(prompt + requested max_new) footprint needs, so many short streams fit
+where few dense rows would.
+
+Design decisions:
+
+- **Page 0 is the trash page.**  Never allocated.  Block-table rows start
+  as zeros, and consolidation scatters from *inactive* batch rows into page
+  0 — a retired slot's stale row can keep "writing" harmlessly even after
+  its real pages were reused by another request.
+- **Reserve at admission.**  A request's full worst-case footprint
+  (``prompt + max_new`` tokens, capped by ``max_seq``) is allocated before
+  prefill; if the pool can't cover it the request waits in the queue.  No
+  mid-flight OOM, no preemption machinery.  (On-demand growth would pack
+  tighter when generations stop early at EOS; noted as future work.)
+- The allocator is plain host Python.  It is only touched from the engine's
+  scheduler flow (admission on the event loop, retirement on the decode
+  thread — never concurrently, same discipline as the slot free-list).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+TRASH_PAGE = 0
+
+
+class PageAllocator:
+    """Fixed pool of KV pages; page 0 reserved as the trash page."""
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is reserved)")
+        self.num_pages = num_pages
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))
+        self._held: dict[int, list[int]] = {}  # slot -> pages
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def held_slots(self) -> dict[int, int]:
+        """slot -> page count currently reserved (public, for stats/tests)."""
+        return {slot: len(pages) for slot, pages in self._held.items()}
+
+    def alloc(self, slot: int, n: int) -> list[int] | None:
+        """Reserve ``n`` pages for ``slot``; None if the pool can't cover it."""
+        if slot in self._held:
+            raise ValueError(f"slot {slot} already holds pages")
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._held[slot] = pages
+        return pages
+
+    def free(self, slot: int) -> None:
+        """Return ``slot``'s pages to the pool (idempotent)."""
+        self._free.extend(self._held.pop(slot, ()))
+
+
+def pages_needed(total_tokens: int, page_size: int) -> int:
+    return -(-total_tokens // page_size)
+
+
+def table_row(pages: list[int], max_pages: int) -> np.ndarray:
+    """A block-table row: allocated page ids, padded with the trash page."""
+    row = np.full((max_pages,), TRASH_PAGE, np.int32)
+    row[: len(pages)] = pages
+    return row

@@ -27,6 +27,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 
 
 def _decode_attn_kernel(lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, z_ref):
@@ -93,6 +94,165 @@ def decode_attention_pallas(
         out_shape=out_shapes,
         interpret=interpret,
     )(base_lens, q, k_cache, v_cache)
+
+
+def _paged_attn_kernel(
+    layer_ref, tables_ref, lens_ref,  # scalar-prefetch (SMEM)
+    q_ref, k_ref, v_ref,  # tensor blocks (VMEM)
+    o_ref, m_ref, z_ref,  # outputs
+    acc, m_s, z_s,  # VMEM scratch carried across the page grid dim
+):
+    """One (batch row, kv head, page) program with flash accumulation.
+
+    The page grid dimension is innermost (sequential on TPU), so the
+    VMEM scratch carries softmax statistics across a row's pages; the block
+    table is scalar-prefetched and drives the K/V BlockSpec index_map — each
+    program DMAs exactly one page, nothing is gathered/materialized.
+    """
+    import jax.lax as lax
+
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    page = k_ref.shape[3]
+
+    @pl.when(p == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_s[...] = jnp.full_like(m_s, -1e30)
+        z_s[...] = jnp.zeros_like(z_s)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [G, hd]
+    k = k_ref[0, 0, 0].astype(jnp.float32)  # [page, hd]
+    v = v_ref[0, 0, 0].astype(jnp.float32)  # [page, hd]
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    scores = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [G, page]
+    pos = p * page + lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    scores = jnp.where(pos < lens_ref[b], scores, -1e30)
+
+    m_new = jnp.maximum(m_s[...], jnp.max(scores, axis=-1, keepdims=True))
+    m_new = jnp.maximum(m_new, -1e29)  # fresh rows stay finite
+    alpha = jnp.exp(m_s[...] - m_new)
+    pexp = jnp.exp(scores - m_new)
+    z_s[...] = z_s[...] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+    acc[...] = acc[...] * alpha + lax.dot_general(
+        pexp, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_s[...] = m_new
+
+    @pl.when(p == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0, 0] = acc[...]
+        m_ref[0, 0] = m_s[...][:, 0]
+        z_ref[0, 0] = z_s[...][:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("wpages", "interpret"))
+def paged_decode_attention_pallas(
+    q: jax.Array,  # [B, K, G, hd]
+    pool_k: jax.Array,  # [L, N, K, page, hd] the WHOLE pool (no slicing)
+    pool_v: jax.Array,
+    layer: jax.Array,  # scalar int32 — which layer's pages to read
+    tables: jax.Array,  # [B, Pmax] int32 block tables
+    base_lens: jax.Array,  # [B]
+    *,
+    wpages: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Paged decode attention: block tables drive page DMA via scalar
+    prefetch → (o unnormalized, m, z), same contract as the dense kernel.
+
+    Taking the full pool (not a sliced layer) matters: slicing
+    ``pool[layer]`` in XLA before a pallas_call would materialize a copy of
+    the layer's pages every (layer, step); here the layer index rides the
+    index_map and only the addressed pages move.
+    """
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, K, G, hd = q.shape
+    page = pool_k.shape[3]
+
+    grid = (B, K, wpages)
+    kv_spec = pl.BlockSpec(
+        (1, 1, 1, page, hd),
+        lambda b, k, p, layer_ref, tables_ref, lens_ref: (
+            layer_ref[0], tables_ref[b, p], k, 0, 0
+        ),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, G, hd),
+                lambda b, k, p, *_refs: (b, k, 0, 0),
+            ),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, G, hd), lambda b, k, p, *_refs: (b, k, 0, 0)
+            ),
+            pl.BlockSpec((1, 1, G), lambda b, k, p, *_refs: (b, k, 0)),
+            pl.BlockSpec((1, 1, G), lambda b, k, p, *_refs: (b, k, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((G, hd), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+        ],
+    )
+    out_shapes = (
+        jax.ShapeDtypeStruct((B, K, G, hd), jnp.float32),
+        jax.ShapeDtypeStruct((B, K, G), jnp.float32),
+        jax.ShapeDtypeStruct((B, K, G), jnp.float32),
+    )
+    return pl.pallas_call(
+        _paged_attn_kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        tables.astype(jnp.int32),
+        base_lens.astype(jnp.int32),
+        q, pool_k, pool_v,
+    )
+
+
+def merged_paged_decode_attention_pallas(
+    q: jax.Array,  # [B, 1, H, hd]
+    pool_k: jax.Array,  # [L, N, K, page, hd]
+    pool_v: jax.Array,
+    layer: jax.Array,  # scalar int32
+    tables: jax.Array,  # [B, Pmax]
+    ring_k: jax.Array,  # [T, B, K, hd]
+    ring_v: jax.Array,
+    base_lens: jax.Array,  # [B]
+    t: jax.Array,
+    *,
+    wpages: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged analog of :func:`merged_decode_attention_pallas`: main-cache
+    source from the paged kernel, ring folded in via the shared merge."""
+    from calfkit_tpu.inference.model import logsumexp_merge, ring_attention_source
+
+    B, _, H, hd = q.shape
+    K = pool_k.shape[2]
+    G = H // K
+    qg = q.reshape(B, K, G, hd)
+
+    o1, m1, z1 = paged_decode_attention_pallas(
+        qg, pool_k, pool_v, layer, tables, base_lens,
+        wpages=wpages, interpret=interpret,
+    )
+    o2, m2, z2 = ring_attention_source(qg, ring_k, ring_v, t)
+    out = logsumexp_merge((o1, m1[..., None], z1[..., None]), (o2, m2, z2))
+    return out.reshape(B, 1, H, hd).astype(q.dtype)
 
 
 def merged_decode_attention_pallas(

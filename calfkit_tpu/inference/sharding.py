@@ -105,6 +105,29 @@ def cache_sharding(config: ModelConfig, mesh: Mesh, batch: int) -> NamedSharding
     )
 
 
+def pool_sharding(config: ModelConfig, mesh: Mesh) -> NamedSharding:
+    """Paged KV pool [L, N, K, page, hd]: kv heads over tp.
+
+    Pages are NOT split over dp — block tables address the whole pool, and
+    proving page locality to GSPMD isn't worth it at current dp targets
+    (paged mode exists to fit one big replica; dp replicas each hold a
+    pool).
+    """
+    return NamedSharding(
+        mesh,
+        _spec(
+            mesh,
+            [
+                (config.n_layers, None),
+                (1, None),
+                (config.n_kv_heads, "tp"),
+                (1, None),
+                (config.head_dim, None),
+            ],
+        ),
+    )
+
+
 def batch_sharding(mesh: Mesh, batch: int) -> NamedSharding:
     return NamedSharding(mesh, _spec(mesh, [(batch, "dp")]))
 
