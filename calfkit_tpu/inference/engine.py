@@ -772,37 +772,21 @@ class InferenceEngine:
             for r in self._active.values()
         )
         started = time.perf_counter()
+        args = [self.params, self._k, self._v]
         if self._paged:
-            self._k, self._v, self._last, self._lens, toks = (
-                self._decode_jit(window, steps, sampled)(
-                    self.params,
-                    self._k,
-                    self._v,
-                    self._tables,
-                    self._last,
-                    self._lens,
-                    jnp.asarray(active_mask),
-                    self._slot_keys,
-                    self._temp,
-                    self._top_k,
-                    self._top_p,
-                )
-            )
-        else:
-            self._k, self._v, self._last, self._lens, toks = (
-                self._decode_jit(window, steps, sampled)(
-                    self.params,
-                    self._k,
-                    self._v,
-                    self._last,
-                    self._lens,
-                    jnp.asarray(active_mask),
-                    self._slot_keys,
-                    self._temp,
-                    self._top_k,
-                    self._top_p,
-                )
-            )
+            args.append(self._tables)
+        args += [
+            self._last,
+            self._lens,
+            jnp.asarray(active_mask),
+            self._slot_keys,
+            self._temp,
+            self._top_k,
+            self._top_p,
+        ]
+        self._k, self._v, self._last, self._lens, toks = (
+            self._decode_jit(window, steps, sampled)(*args)
+        )
         for slot in self._active:
             self._host_lens[slot] += steps
         block = np.asarray(toks)  # [steps, B] — THE host sync per dispatch

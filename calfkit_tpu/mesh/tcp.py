@@ -212,6 +212,7 @@ class TcpMesh(MeshTransport):
 
         conns: list[_Conn] = []
         tasks: list[asyncio.Task[None]] = []
+        stopping = asyncio.Event()
         mode = "latest" if from_latest else "earliest"
         for name in topics:
             conn = _Conn(self._host, self._port)
@@ -222,18 +223,27 @@ class TcpMesh(MeshTransport):
             self._sub_conns.append(conn)
             tasks.append(
                 asyncio.get_running_loop().create_task(
-                    self._pump(conn, sub_id, name, group_id, mode, deliver),
+                    self._pump(conn, sub_id, name, group_id, mode, deliver,
+                               stopping),
                     name=f"tcp-pump-{name}",
                 )
             )
         self._pumps.extend(tasks)
 
         async def stop_fn() -> None:
-            for task in tasks:
-                task.cancel()
-            for task in tasks:
-                with contextlib.suppress(asyncio.CancelledError, Exception):
-                    await task
+            # GRACEFUL leave: let each pump finish its in-flight POLL and
+            # deliver what the broker already ack-committed to us — a
+            # mid-response cancel would turn a clean unsubscribe into
+            # record loss (the crash path, which is documented at-most-once)
+            stopping.set()
+            grace = self._poll_timeout_ms / 1000.0 + 2.0
+            if tasks:
+                done, pending = await asyncio.wait(tasks, timeout=grace)
+                for task in pending:
+                    task.cancel()
+                for task in tasks:  # retrieve exceptions from done pumps too
+                    with contextlib.suppress(asyncio.CancelledError, Exception):
+                        await task
             for conn in conns:
                 await conn.close()  # broker rebalances on disconnect
                 if conn in self._sub_conns:
@@ -253,8 +263,9 @@ class TcpMesh(MeshTransport):
         group_id: str | None,
         mode: str,
         deliver: RecordHandler,
+        stopping: asyncio.Event,
     ) -> None:
-        while True:
+        while not stopping.is_set():
             try:
                 lines = await conn.request_multi(
                     f"POLL {sub_id} 64 {self._poll_timeout_ms}"

@@ -171,13 +171,14 @@ bool read_line(int fd, std::string& buf, std::string& line) {
   }
 }
 
-void write_all(int fd, const std::string& s) {
+bool write_all(int fd, const std::string& s) {
   size_t off = 0;
   while (off < s.size()) {
     ssize_t n = write(fd, s.data() + off, s.size() - off);
-    if (n <= 0) return;
+    if (n <= 0) return false;
     off += size_t(n);
   }
+  return true;
 }
 
 std::string field(const std::string& s) { return s == "-" ? "" : b64decode(s); }
@@ -255,6 +256,10 @@ void serve(int fd) {
       int64_t id, maxn, timeout_ms;
       in >> id >> maxn >> timeout_ms;
       std::vector<std::string> lines;
+      // per-partition (start, end) cursor ranges this batch committed —
+      // kept so a failed response write can UN-commit (a member leaving
+      // mid-poll must not swallow records for the whole group)
+      std::map<int, std::pair<int64_t, int64_t>> taken;
       {
         std::unique_lock<std::mutex> lk(g_mu);
         auto deadline = std::chrono::steady_clock::now() +
@@ -270,6 +275,7 @@ void serve(int fd) {
                               : &sub.cursors;
           for (int p : assigned_partitions(sub, id)) {
             auto& part = topic.parts[size_t(p)];
+            int64_t start = (*cursors)[size_t(p)];
             while ((*cursors)[size_t(p)] < int64_t(part.size()) &&
                    int64_t(lines.size()) < maxn) {
               const Record& r = part[size_t((*cursors)[size_t(p)])];
@@ -279,6 +285,8 @@ void serve(int fd) {
                               " " + unfield(r.value) + " " +
                               unfield(r.headers) + "\n");
             }
+            if ((*cursors)[size_t(p)] > start)
+              taken[p] = {start, (*cursors)[size_t(p)]};
             if (int64_t(lines.size()) >= maxn) break;
           }
           if (!lines.empty() || timeout_ms == 0) break;
@@ -287,7 +295,23 @@ void serve(int fd) {
       }
       std::string out = "N " + std::to_string(lines.size()) + "\n";
       for (auto& l : lines) out += l;
-      write_all(fd, out);
+      if (!write_all(fd, out) && !taken.empty()) {
+        // consumer vanished between commit and delivery: roll each cursor
+        // back IF nobody advanced it further meanwhile (otherwise a
+        // rollback would re-deliver a peer's records; accept the rare loss)
+        std::lock_guard<std::mutex> lk(g_mu);
+        auto it = g_subs.find(id);
+        if (it != g_subs.end()) {
+          Sub& sub = it->second;
+          auto* cursors = !sub.group.empty()
+                              ? &g_groups[{sub.topic, sub.group}].cursors
+                              : &sub.cursors;
+          for (auto& [p, range] : taken)
+            if ((*cursors)[size_t(p)] == range.second)
+              (*cursors)[size_t(p)] = range.first;
+          g_cv.notify_all();
+        }
+      }
     } else if (op == "ENDS") {
       std::string t;
       in >> t;
