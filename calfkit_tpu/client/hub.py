@@ -108,7 +108,7 @@ class InvocationHandle(Generic[OutputT]):
                 f"within {timeout}s"
             ) from None
         if isinstance(terminal, RunFailed):
-            raise NodeFaultError(terminal.report)
+            raise NodeFaultError(terminal.report, terminal.envelope)
         return InvocationResult.from_envelope(
             terminal.envelope,
             self._output_type,
@@ -155,7 +155,7 @@ class InvocationHandle(Generic[OutputT]):
                 yield self._channel.steps.get_nowait()
             terminal = self._channel.terminal.result()
             if isinstance(terminal, RunFailed):
-                raise NodeFaultError(terminal.report)
+                raise NodeFaultError(terminal.report, terminal.envelope)
             yield InvocationResult.from_envelope(
                 terminal.envelope,
                 self._output_type,
@@ -239,6 +239,7 @@ class Hub:
                     report=ErrorReport.build_safe(
                         FaultTypes.DESERIALIZATION_ERROR,
                         "terminal record carried no reply",
-                    )
+                    ),
+                    envelope=envelope,
                 )
             )

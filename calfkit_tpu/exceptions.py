@@ -6,6 +6,7 @@ from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:
     from calfkit_tpu.models.error_report import ErrorReport
+    from calfkit_tpu.models.session_context import Envelope
 
 
 class CalfkitError(Exception):
@@ -19,8 +20,13 @@ class NodeFaultError(CalfkitError):
     ``FaultMessage``; catching it at the client surfaces the ErrorReport.
     """
 
-    def __init__(self, report: "ErrorReport"):
+    def __init__(
+        self, report: "ErrorReport", envelope: "Envelope | None" = None
+    ):
         self.report = report
+        # the terminal fault envelope when available (client side): exposes
+        # degradation facts like state_elided to callers
+        self.envelope = envelope
         super().__init__(report.describe())
 
 

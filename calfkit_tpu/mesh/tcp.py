@@ -236,7 +236,11 @@ class TcpMesh(MeshTransport):
             # mid-response cancel would turn a clean unsubscribe into
             # record loss (the crash path, which is documented at-most-once)
             stopping.set()
-            grace = self._poll_timeout_ms / 1000.0 + 2.0
+            # the window must cover the in-flight poll AND delivering its
+            # whole batch through dispatcher backpressure — a mid-delivery
+            # cancel drops broker-committed records; only a genuinely hung
+            # handler forfeits that guarantee
+            grace = self._poll_timeout_ms / 1000.0 + 30.0
             if tasks:
                 done, pending = await asyncio.wait(tasks, timeout=grace)
                 for task in pending:

@@ -9,6 +9,16 @@ import inspect
 import pytest
 
 
+_ASYNC_FINALIZERS: list = []
+
+
+def register_async_finalizer(factory) -> None:
+    """Queue an async callable to run on the test's OWN loop after the test
+    body finishes (pass or fail) — sync fixtures can't await, and the loop
+    is gone by normal fixture teardown time."""
+    _ASYNC_FINALIZERS.append(factory)
+
+
 @pytest.hookimpl(tryfirst=True)
 def pytest_pyfunc_call(pyfuncitem: pytest.Function):
     """Run ``async def`` tests on a fresh event loop per test."""
@@ -25,5 +35,13 @@ def pytest_pyfunc_call(pyfuncitem: pytest.Function):
     try:
         loop.run_until_complete(asyncio.wait_for(fn(**kwargs), timeout=60))
     finally:
+        while _ASYNC_FINALIZERS:
+            finalizer = _ASYNC_FINALIZERS.pop()
+            try:
+                loop.run_until_complete(
+                    asyncio.wait_for(finalizer(), timeout=10)
+                )
+            except Exception:  # noqa: BLE001 - cleanup is best-effort
+                pass
         loop.close()
     return True

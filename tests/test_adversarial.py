@@ -1,0 +1,293 @@
+"""Adversarial kernel tests (VERDICT r1 item 6).
+
+Reference anchors:
+- oversized-fault elision over a real transport:
+  /root/reference/tests/integration/test_fault_escalation_kafka.py
+- hostile ``__str__``/``__repr__`` through the report harvester:
+  calfkit/models/error_report.py:611 and its dedicated tests
+- fan-out crash-mid-batch resume across worker instances:
+  /root/reference/tests/integration/test_fault_stress_kafka.py (durable
+  batch survival is the point of the compacted-table store)
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from calfkit_tpu.exceptions import NodeFaultError
+from calfkit_tpu.mesh.tcp import TcpMesh, find_meshd, spawn_meshd
+from calfkit_tpu.models import (
+    Call,
+    DataPart,
+    ErrorReport,
+    FaultTypes,
+    ReturnCall,
+    TextPart,
+)
+from calfkit_tpu.models.error_report import safe_str
+from calfkit_tpu.models.marker import ToolCallMarker
+
+meshd_missing = find_meshd() is None
+
+PORT = 19877
+
+
+@pytest.fixture(scope="module")
+def broker():
+    if meshd_missing:
+        yield None
+        return
+    proc = spawn_meshd(PORT)
+    yield proc
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+# --------------------------------------------------------------------------- #
+# hostile objects through the report harvester
+# --------------------------------------------------------------------------- #
+
+
+class _HostileStr:
+    def __str__(self):
+        raise RuntimeError("str is a trap")
+
+    def __repr__(self):
+        raise ValueError("repr is a trap too")
+
+
+class _HostileException(Exception):
+    def __str__(self):
+        raise RuntimeError("exception str explodes")
+
+
+class _HostileTypeName(Exception):
+    pass
+
+
+_HostileTypeName.__name__ = "x" * 10_000  # absurd type name
+
+
+class TestHostileObjects:
+    def test_safe_str_survives_everything(self):
+        assert "object" in safe_str(_HostileStr()) or "unprintable" in safe_str(
+            _HostileStr()
+        )
+        assert len(safe_str("y" * 100_000)) <= 4096
+
+    def test_build_safe_with_hostile_exception(self):
+        report = ErrorReport.build_safe(
+            FaultTypes.NODE_ERROR, exc=_HostileException("unreachable")
+        )
+        assert report.error_type == FaultTypes.NODE_ERROR
+        assert report.exception is not None
+        # message fell back to something printable, never raised
+        assert isinstance(report.exception.message, str)
+        assert report.model_dump_json()  # must serialize
+
+    def test_build_safe_with_hostile_type_name_and_data(self):
+        report = ErrorReport.build_safe(
+            FaultTypes.NODE_ERROR,
+            exc=_HostileTypeName("boom"),
+            data={"weird": _HostileStr(), "k" * 5000: 1},
+        )
+        assert len(report.exception.type) <= 256
+        assert report.model_dump_json()
+
+    async def test_hostile_exception_through_full_agent_path(self):
+        """A tool raising a hostile exception must land as a typed fault at
+        the client — not crash the worker or wedge the run."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.models.messages import ModelResponse, ToolCallOutput
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        @agent_tool
+        def landmine() -> str:
+            raise _HostileException("never printable")
+
+        def scripted(messages, params):
+            return ModelResponse(parts=[
+                ToolCallOutput(tool_call_id="t", tool_name="landmine", args={})
+            ])
+
+        mesh = InMemoryMesh()
+        agent = Agent(
+            "hostile_agent", model=FunctionModelClient(scripted),
+            tools=[landmine],
+        )
+        async with Worker([agent, landmine], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            with pytest.raises(NodeFaultError) as exc_info:
+                await client.agent("hostile_agent").execute("go", timeout=15)
+            assert exc_info.value.report.error_type == FaultTypes.CALLEE_FAULT
+            # worker is still alive: a normal run succeeds afterwards
+            def fine(messages, params):
+                from calfkit_tpu.models.messages import TextOutput
+
+                return ModelResponse(parts=[TextOutput(text="alive")])
+
+            agent2 = Agent("second_agent", model=FunctionModelClient(fine))
+            # second agent joins the same (running) worker's mesh via a
+            # second worker to prove the broker + client survived
+            async with Worker([agent2], mesh=mesh):
+                result = await client.agent("second_agent").execute(
+                    "x", timeout=15
+                )
+                assert result.output == "alive"
+            await client.close()
+
+
+# --------------------------------------------------------------------------- #
+# oversized-fault elision, end-to-end over the native broker
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.skipif(meshd_missing, reason="meshd not built (make -C native)")
+class TestElisionOverTcp:
+    async def test_third_rung_state_elided_reaches_client(self, broker):
+        """Force the elision ladder's last rung across a REAL transport:
+        budget fits the call but not (report + state) → the client still
+        gets a typed fault, with state_elided set."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        BUDGET = 6000
+
+        def exploding_model(messages, params):
+            raise RuntimeError("x" * 20_000)  # giant message + traceback
+
+        worker_mesh = TcpMesh(f"127.0.0.1:{PORT}", max_message_bytes=BUDGET)
+        await worker_mesh.start()
+        client_mesh = TcpMesh(f"127.0.0.1:{PORT}", max_message_bytes=BUDGET)
+        await client_mesh.start()
+        agent = Agent("elide_agent", model=FunctionModelClient(exploding_model))
+        async with Worker([agent], mesh=worker_mesh):
+            client = Client.connect(client_mesh)
+            # ~3 KB of conversation state: call fits the 6 KB budget, the
+            # fault (report ≥ 4 KB message even without traceback) does not
+            with pytest.raises(NodeFaultError) as exc_info:
+                await client.agent("elide_agent").execute(
+                    "y" * 3000, timeout=20
+                )
+            err = exc_info.value
+            assert err.report.error_type == FaultTypes.NODE_ERROR
+            assert err.envelope is not None
+            assert err.envelope.state_elided is True
+            assert err.envelope.context.state.message_history == []
+            await client.close()
+        await worker_mesh.stop()
+        await client_mesh.stop()
+
+
+# --------------------------------------------------------------------------- #
+# fan-out batch survives a worker crash mid-batch (durable tables on meshd)
+# --------------------------------------------------------------------------- #
+
+
+@pytest.mark.skipif(meshd_missing, reason="meshd not built (make -C native)")
+class TestFanoutCrashResume:
+    async def test_worker_crash_mid_batch_second_worker_closes(self, broker):
+        """Worker A opens a durable fan-out batch and dies before any fold;
+        worker B (same node, same group) folds the sibling replies against
+        the compacted tables and finishes the run."""
+        from calfkit_tpu import protocol
+        from calfkit_tpu.nodes import agent_tool, handler
+        from calfkit_tpu.nodes.base import BaseNodeDef
+        from calfkit_tpu.worker import Worker
+        from tests.kernel_harness import Caller
+
+        resumed_on: list[str] = []
+
+        class FanNode(BaseNodeDef):
+            kind = "agent"
+
+            def __init__(self, name, worker_tag):
+                super().__init__(name)
+                self.worker_tag = worker_tag
+
+            def input_topics(self):
+                return [protocol.agent_input_topic(self.name)]
+
+            def return_topic(self):
+                return protocol.agent_return_topic(self.name)
+
+            def publish_topic(self):
+                return protocol.agent_publish_topic(self.name)
+
+            @handler("run")
+            async def run(self, ctx):
+                if ctx.delivery_kind == "call":
+                    return [
+                        Call(
+                            target_topic="tool.slow_double.input",
+                            route="run",
+                            parts=[DataPart(data={"x": i})],
+                            tag=f"tc-{i}",
+                            marker=ToolCallMarker(
+                                tool_call_id=f"tc-{i}", tool_name="slow_double"
+                            ),
+                        )
+                        for i in range(3)
+                    ]
+                resumed_on.append(self.worker_tag)
+                results = sorted(
+                    ctx.state.tool_results[k].content for k in ctx.state.tool_results
+                )
+                return ReturnCall(parts=[TextPart(text=",".join(results))])
+
+        @agent_tool
+        def slow_double(x: int) -> int:
+            """Double, slowly.
+
+            Args:
+                x: Input.
+            """
+            import time
+
+            time.sleep(1.0)  # slow enough that worker A dies before folds
+            return x * 2
+
+        fan_mesh_a = TcpMesh(f"127.0.0.1:{PORT}")
+        await fan_mesh_a.start()
+        tool_mesh = TcpMesh(f"127.0.0.1:{PORT}")
+        await tool_mesh.start()
+        caller_mesh = TcpMesh(f"127.0.0.1:{PORT}")
+        await caller_mesh.start()
+
+        tool_worker = Worker([slow_double], mesh=tool_mesh)
+        await tool_worker.start()
+
+        worker_a = Worker([FanNode("crashfan", "A")], mesh=fan_mesh_a)
+        await worker_a.start()
+
+        caller = Caller(caller_mesh)
+        await caller.start()
+        await caller.call("agent.crashfan.private.input", [])
+
+        # give worker A just enough time to OPEN the batch + dispatch
+        await asyncio.sleep(0.5)
+        await worker_a.stop()  # "crash": no folds processed on A
+        await fan_mesh_a.stop()
+
+        fan_mesh_b = TcpMesh(f"127.0.0.1:{PORT}")
+        await fan_mesh_b.start()
+        worker_b = Worker([FanNode("crashfan", "B")], mesh=fan_mesh_b)
+        await worker_b.start()
+
+        headers, env = await caller.wait_reply(timeout=30)
+        assert headers[protocol.HDR_KIND] == "return"
+        assert env.reply.parts[0].text == "0,2,4"
+        assert resumed_on == ["B"]  # the close happened on the second worker
+
+        await worker_b.stop()
+        await tool_worker.stop()
+        await fan_mesh_b.stop()
+        await tool_mesh.stop()
+        await caller_mesh.stop()

@@ -84,6 +84,19 @@ def transport(request, meshd_broker):
         made.append(mesh)
         return mesh
 
+    # leaked meshes (e.g. an assertion before mesh.stop()) are stopped on
+    # the test's own loop before it closes
+    from tests.conftest import register_async_finalizer
+
+    async def _cleanup():
+        for mesh in made:
+            try:
+                await mesh.stop()
+            except Exception:  # noqa: BLE001 - already stopped is fine
+                pass
+
+    register_async_finalizer(_cleanup)
+
     # shared-broker transports need per-test-unique names; memory is isolated
     unique = kind != "memory"
     yield make, (lambda base: f"{base}.{uuid.uuid4().hex[:8]}" if unique else base)
