@@ -14,6 +14,12 @@ import click
 MESH_URL_ENV = "CALFKIT_MESH_URL"
 
 
+def is_file_spec(module_part: str) -> bool:
+    """The single authority for file-vs-module spec classification —
+    shared by the loader, the reload watcher, and daemon absolutization."""
+    return module_part.endswith(".py") or "/" in module_part
+
+
 def load_object(spec: str) -> Any:
     """Load ``module:attr`` or ``path/to/file.py:attr``."""
     if ":" not in spec:
@@ -21,7 +27,7 @@ def load_object(spec: str) -> Any:
             f"node spec {spec!r} must be 'module:attr' or 'file.py:attr'"
         )
     module_part, attr = spec.rsplit(":", 1)
-    if module_part.endswith(".py") or "/" in module_part:
+    if is_file_spec(module_part):
         path = Path(module_part).resolve()
         if not path.exists():
             raise click.ClickException(f"no such file: {path}")

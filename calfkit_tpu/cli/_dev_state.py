@@ -1,0 +1,272 @@
+"""Dev-loop state: the managed broker and detached agent daemons.
+
+Reference anchors: connect-or-spawn with a spawn-race file lock
+(/root/reference/calfkit/cli/_dev_broker.py:1-22) and detached agent
+daemons with status/stop/down (/root/reference/calfkit/cli/_dev_agents.py,
+cli/dev.py:41-51).
+
+All state lives under ``$CALFKIT_DEV_DIR`` (default ``~/.calfkit_tpu/dev``):
+``broker.json`` + ``broker.lock`` for the managed meshd, and
+``agents/<name>.json`` + ``agents/<name>.log`` per detached daemon.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+DEFAULT_DEV_PORT = 19092
+
+
+def dev_dir() -> Path:
+    root = os.environ.get("CALFKIT_DEV_DIR") or os.path.expanduser(
+        "~/.calfkit_tpu/dev"
+    )
+    path = Path(root)
+    (path / "agents").mkdir(parents=True, exist_ok=True)
+    return path
+
+
+def _pid_alive(pid: int) -> bool:
+    """Liveness that treats zombies as dead (a spawner that dropped its
+    Popen handle never reaps; ``os.kill(pid, 0)`` still succeeds)."""
+    with contextlib.suppress(ChildProcessError, OSError):
+        os.waitpid(pid, os.WNOHANG)  # reap if it's our own child
+    try:
+        os.kill(pid, 0)
+    except (ProcessLookupError, PermissionError):
+        return False
+    with contextlib.suppress(OSError, IndexError):
+        stat = Path(f"/proc/{pid}/stat").read_text()
+        if stat.rsplit(")", 1)[1].split()[0] == "Z":
+            return False
+    return True
+
+
+def _pid_is_ours(pid: int, needle: str) -> bool:
+    """Never signal a recycled pid: the process must still look like the
+    one this registry started."""
+    with contextlib.suppress(OSError):
+        cmdline = Path(f"/proc/{pid}/cmdline").read_bytes().replace(b"\0", b" ")
+        return needle.encode() in cmdline
+    return False
+
+
+def _port_open(port: int, timeout: float = 0.5) -> bool:
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+# --------------------------------------------------------------------------- #
+# broker: connect-or-spawn with a spawn-race file lock
+# --------------------------------------------------------------------------- #
+
+
+@dataclass(frozen=True)
+class BrokerInfo:
+    port: int
+    pid: int | None  # None = pre-existing broker we merely connected to
+    spawned: bool
+
+    @property
+    def url(self) -> str:
+        return f"tcp://127.0.0.1:{self.port}"
+
+
+def ensure_broker(port: int = DEFAULT_DEV_PORT) -> BrokerInfo:
+    """Connect to a live dev broker, or spawn one — exactly one, even when
+    multiple ``ck dev`` invocations race (the reference's file-lock
+    discipline, cli/_dev_broker.py:1-22)."""
+    if _port_open(port):
+        return BrokerInfo(port=port, pid=_read_broker_pid(port), spawned=False)
+    lock_path = dev_dir() / "broker.lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)  # losers wait here while one spawns
+        try:
+            if _port_open(port):  # the winner got it up while we waited
+                return BrokerInfo(
+                    port=port, pid=_read_broker_pid(port), spawned=False
+                )
+            from calfkit_tpu.mesh.tcp import spawn_meshd
+
+            # own session: a ctrl-c aimed at the CLI must not take the
+            # broker (daemons pointed at it) down with it
+            proc = spawn_meshd(port, start_new_session=True)
+            (dev_dir() / "broker.json").write_text(
+                json.dumps({"port": port, "pid": proc.pid})
+            )
+            return BrokerInfo(port=port, pid=proc.pid, spawned=True)
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def _read_broker_pid(port: int) -> int | None:
+    with contextlib.suppress(Exception):
+        meta = json.loads((dev_dir() / "broker.json").read_text())
+        if meta.get("port") == port and _pid_alive(meta.get("pid", -1)):
+            return int(meta["pid"])
+    return None
+
+
+def broker_status(port: int = DEFAULT_DEV_PORT) -> dict:
+    return {
+        "port": port,
+        "up": _port_open(port),
+        "pid": _read_broker_pid(port),
+    }
+
+
+def stop_broker(port: int = DEFAULT_DEV_PORT) -> bool:
+    """Stop the MANAGED broker (one we spawned and recorded); a broker this
+    registry doesn't own — or a recycled pid — is left alone."""
+    pid = _read_broker_pid(port)
+    if pid is None:
+        return False
+    if _pid_is_ours(pid, "meshd"):
+        with contextlib.suppress(ProcessLookupError):
+            os.kill(pid, signal.SIGTERM)
+        for _ in range(50):
+            if not _pid_alive(pid):
+                break
+            time.sleep(0.1)
+    with contextlib.suppress(FileNotFoundError):
+        (dev_dir() / "broker.json").unlink()
+    return True
+
+
+# --------------------------------------------------------------------------- #
+# detached agent daemons
+# --------------------------------------------------------------------------- #
+
+
+@dataclass(frozen=True)
+class DaemonInfo:
+    name: str
+    pid: int
+    specs: list[str]
+    mesh_url: str
+    log_path: str
+
+    @property
+    def alive(self) -> bool:
+        return _pid_alive(self.pid)
+
+
+def _daemon_meta(name: str) -> Path:
+    return dev_dir() / "agents" / f"{name}.json"
+
+
+def spawn_daemon(
+    name: str, specs: list[str], mesh_url: str
+) -> DaemonInfo:
+    """Detach a ``ck run`` worker serving ``specs`` against ``mesh_url``.
+
+    Guarded by a per-name file lock (two terminals racing the same name
+    must not leave an untracked second worker) and a short post-spawn
+    liveness check (an immediately-crashing daemon is reported, not
+    recorded as 'up')."""
+    lock_path = dev_dir() / "agents" / f"{name}.lock"
+    with open(lock_path, "w") as lock:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        try:
+            if (existing := get_daemon(name)) is not None and existing.alive:
+                raise RuntimeError(
+                    f"daemon {name!r} already running (pid {existing.pid})"
+                )
+            log_path = dev_dir() / "agents" / f"{name}.log"
+            log = open(log_path, "ab")
+            specs = [_absolutize(spec) for spec in specs]
+            proc = subprocess.Popen(
+                [
+                    sys.executable, "-m", "calfkit_tpu.cli.main", "run",
+                    *specs, "--mesh", mesh_url,
+                ],
+                stdout=log,
+                stderr=log,
+                stdin=subprocess.DEVNULL,
+                start_new_session=True,  # survives the spawning terminal
+            )
+            log.close()
+            # wait for the child's startup verdict: "serving" in the log
+            # (ck run prints it once nodes load) or an early exit.  Bounded
+            # so a pathological environment can't hang the CLI.
+            log_start = log_path.stat().st_size if log_path.exists() else 0
+            for _ in range(80):
+                time.sleep(0.1)
+                if proc.poll() is not None:
+                    tail = ""
+                    with contextlib.suppress(OSError):
+                        tail = log_path.read_bytes()[-500:].decode(
+                            errors="replace"
+                        )
+                    raise RuntimeError(
+                        f"daemon {name!r} exited during startup "
+                        f"(code {proc.returncode}); log tail:\n{tail}"
+                    )
+                with contextlib.suppress(OSError):
+                    new = log_path.read_bytes()[log_start:]
+                    if b"serving" in new:
+                        break
+            info = DaemonInfo(
+                name=name, pid=proc.pid, specs=list(specs),
+                mesh_url=mesh_url, log_path=str(log_path),
+            )
+            _daemon_meta(name).write_text(json.dumps(info.__dict__))
+            return info
+        finally:
+            fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def _absolutize(spec: str) -> str:
+    """File-based specs must survive the daemon's different cwd."""
+    from calfkit_tpu.cli._common import is_file_spec
+
+    module_part, _, attr = spec.rpartition(":")
+    if module_part and is_file_spec(module_part):
+        return f"{Path(module_part).resolve()}:{attr}"
+    return spec
+
+
+def get_daemon(name: str) -> DaemonInfo | None:
+    with contextlib.suppress(Exception):
+        return DaemonInfo(**json.loads(_daemon_meta(name).read_text()))
+    return None
+
+
+def list_daemons() -> list[DaemonInfo]:
+    out = []
+    for meta in sorted((dev_dir() / "agents").glob("*.json")):
+        with contextlib.suppress(Exception):
+            out.append(DaemonInfo(**json.loads(meta.read_text())))
+    return out
+
+
+def stop_daemon(name: str, *, timeout: float = 10.0) -> bool:
+    info = get_daemon(name)
+    if info is None:
+        return False
+    # recycled-pid guard: only signal a process that is still OUR daemon
+    if info.alive and _pid_is_ours(info.pid, "calfkit_tpu"):
+        with contextlib.suppress(ProcessLookupError):
+            os.kill(info.pid, signal.SIGTERM)
+        deadline = time.time() + timeout
+        while time.time() < deadline and _pid_alive(info.pid):
+            time.sleep(0.1)
+        if _pid_alive(info.pid):
+            with contextlib.suppress(ProcessLookupError):
+                os.kill(info.pid, signal.SIGKILL)
+    with contextlib.suppress(FileNotFoundError):
+        _daemon_meta(name).unlink()
+    return True

@@ -1,8 +1,13 @@
 """``ck dev`` — the zero-setup dev loop (reference: cli/dev.py:41-51).
 
-The reference spawns a bundled single-binary broker; this build's dev mesh is
-the in-process :class:`InMemoryMesh`, so ``ck dev run`` hosts the nodes AND
-the chat REPL in one process — no broker, no setup.
+Two modes:
+
+- **Single-process**: ``ck dev run file.py:agent`` hosts the nodes AND the
+  chat REPL in one process on an in-memory mesh — no broker, no setup.
+- **Multi-process**: a managed native meshd broker (connect-or-spawn with a
+  spawn-race file lock) plus detached agent daemons —
+  ``ck dev serve file.py:agent`` detaches a worker, ``ck dev chat`` talks
+  to it, ``ck dev status`` / ``stop`` / ``down`` manage the fleet.
 """
 
 from __future__ import annotations
@@ -12,7 +17,7 @@ import asyncio
 import click
 
 
-@click.group("dev", help="single-process dev mesh: serve + chat, no broker")
+@click.group("dev", help="dev mesh: serve + chat, managed broker + daemons")
 def dev_group() -> None:
     pass
 
@@ -21,7 +26,7 @@ def dev_group() -> None:
 @click.argument("specs", nargs=-1, required=True)
 @click.option("--agent", "agent_name", default=None)
 def dev_run(specs: tuple[str, ...], agent_name: str | None) -> None:
-    """Serve nodes on an in-memory mesh and chat with them."""
+    """Serve nodes on an in-memory mesh and chat with them (one process)."""
     from calfkit_tpu.cli._common import load_nodes
     from calfkit_tpu.cli.chat import repl
     from calfkit_tpu.client import Client
@@ -49,34 +54,126 @@ def dev_run(specs: tuple[str, ...], agent_name: str | None) -> None:
 
 @dev_group.command("mesh")
 @click.option("--port", default=19092, show_default=True)
-def dev_mesh(port: int) -> None:
-    """Run the native multi-process dev broker (meshd).
+@click.option("--detach", is_flag=True, help="leave the broker running and return")
+def dev_mesh(port: int, detach: bool) -> None:
+    """Ensure the native dev broker (meshd) is up — connect-or-spawn.
 
-    Then serve/chat from other terminals with --mesh tcp://127.0.0.1:PORT.
+    Safe to run from several terminals at once: a file lock guarantees
+    exactly one spawn wins and the rest connect.
     """
-    from calfkit_tpu.mesh.tcp import spawn_meshd
+    from calfkit_tpu.cli._dev_state import ensure_broker
 
     try:
-        proc = spawn_meshd(port)
+        info = ensure_broker(port)
     except (FileNotFoundError, RuntimeError, TimeoutError) as exc:
         raise click.ClickException(str(exc)) from exc
+    verb = "spawned" if info.spawned else "already up"
     click.echo(
-        f"meshd up on tcp://127.0.0.1:{port} — export "
-        f"CALFKIT_MESH_URL=tcp://127.0.0.1:{port} (ctrl-c to stop)"
+        f"meshd {verb} on {info.url} — export CALFKIT_MESH_URL={info.url}"
     )
+    if detach or not info.spawned:
+        return
+    click.echo("(ctrl-c to stop)")
+    import signal
+
     try:
-        proc.wait()
+        signal.pause()
     except KeyboardInterrupt:
-        proc.terminate()
+        from calfkit_tpu.cli._dev_state import stop_broker
+
+        stop_broker(port)
         click.echo("meshd stopped")
 
 
-@dev_group.command("status")
-def dev_status() -> None:
-    """Explain the dev-mesh model."""
+@dev_group.command("serve")
+@click.argument("specs", nargs=-1, required=True)
+@click.option("--name", "daemon_name", default=None,
+              help="daemon name (default: first spec's attr)")
+@click.option("--port", default=19092, show_default=True)
+def dev_serve(specs: tuple[str, ...], daemon_name: str | None, port: int) -> None:
+    """Detach a worker daemon serving SPECS on the managed dev broker."""
+    from calfkit_tpu.cli._dev_state import ensure_broker, spawn_daemon
+
+    try:
+        broker = ensure_broker(port)
+    except (FileNotFoundError, RuntimeError, TimeoutError) as exc:
+        raise click.ClickException(str(exc)) from exc
+    name = daemon_name or specs[0].rsplit(":", 1)[-1]
+    try:
+        info = spawn_daemon(name, list(specs), broker.url)
+    except RuntimeError as exc:
+        raise click.ClickException(str(exc)) from exc
     click.echo(
-        "Single-process: `ck dev run file.py:agent` (memory:// — serve + chat "
-        "in one process, zero setup).\nMulti-process: `ck dev mesh` runs the "
-        "native meshd broker; point --mesh/CALFKIT_MESH_URL at "
-        "tcp://127.0.0.1:19092.\nProduction: kafka://host:port."
+        f"daemon {info.name!r} up (pid {info.pid}) on {broker.url}; "
+        f"logs: {info.log_path}"
     )
+
+
+@dev_group.command("chat")
+@click.option("--agent", "agent_name", default=None)
+@click.option("--port", default=19092, show_default=True)
+def dev_chat(agent_name: str | None, port: int) -> None:
+    """Chat with the detached dev-mesh agents."""
+    from calfkit_tpu.cli._dev_state import broker_status
+    from calfkit_tpu.cli.chat import _chat
+    from calfkit_tpu.mesh.tcp import TcpMesh
+
+    if not broker_status(port)["up"]:
+        raise click.ClickException(
+            f"dev broker is down on port {port} — start it with "
+            "`ck dev mesh` (or `ck dev serve file.py:agent`)"
+        )
+    try:
+        asyncio.run(_chat(TcpMesh(f"127.0.0.1:{port}"), agent_name))
+    except OSError as exc:
+        raise click.ClickException(f"mesh connection failed: {exc}") from exc
+
+
+@dev_group.command("status")
+@click.option("--port", default=19092, show_default=True)
+def dev_status(port: int) -> None:
+    """Broker + daemon liveness."""
+    from calfkit_tpu.cli._dev_state import broker_status, list_daemons
+
+    broker = broker_status(port)
+    state = "up" if broker["up"] else "down"
+    owner = f" (managed pid {broker['pid']})" if broker["pid"] else ""
+    click.echo(f"broker tcp://127.0.0.1:{port}: {state}{owner}")
+    daemons = list_daemons()
+    if not daemons:
+        click.echo("daemons: none")
+        return
+    for d in daemons:
+        mark = "alive" if d.alive else "DEAD"
+        click.echo(f"  {d.name}: {mark} pid {d.pid} specs={','.join(d.specs)}")
+
+
+@dev_group.command("stop")
+@click.argument("names", nargs=-1)
+def dev_stop(names: tuple[str, ...]) -> None:
+    """Stop named daemons (or all of them with no argument)."""
+    from calfkit_tpu.cli._dev_state import list_daemons, stop_daemon
+
+    targets = list(names) or [d.name for d in list_daemons()]
+    if not targets:
+        click.echo("no daemons to stop")
+        return
+    for name in targets:
+        click.echo(
+            f"{name}: {'stopped' if stop_daemon(name) else 'not found'}"
+        )
+
+
+@dev_group.command("down")
+@click.option("--port", default=19092, show_default=True)
+def dev_down(port: int) -> None:
+    """Stop every daemon AND the managed broker."""
+    from calfkit_tpu.cli._dev_state import list_daemons, stop_broker, stop_daemon
+
+    for d in list_daemons():
+        stop_daemon(d.name)
+        click.echo(f"daemon {d.name}: stopped")
+    if stop_broker(port):
+        click.echo("broker: stopped")
+    else:
+        click.echo("broker: not managed here (left alone)")

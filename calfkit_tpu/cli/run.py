@@ -9,12 +9,34 @@ from calfkit_tpu.cli._common import load_nodes, resolve_mesh
 
 @click.command("run")
 @click.argument("specs", nargs=-1, required=True)
-@click.option("--mesh", "mesh_url", default=None, help="memory:// or kafka://host:port")
+@click.option("--mesh", "mesh_url", default=None, help="memory:// | tcp://host:port | kafka://host:port")
 @click.option("--max-workers", default=8, show_default=True)
 @click.option("--group-id", default=None, help="override per-node consumer groups")
+@click.option("--reload", "reload_", is_flag=True,
+              help="restart when watched .py files change (dev loop)")
 def run_command(specs: tuple[str, ...], mesh_url: str | None, max_workers: int,
-                group_id: str | None) -> None:
+                group_id: str | None, reload_: bool) -> None:
     """Serve the given nodes until interrupted."""
+    if reload_:
+        from calfkit_tpu.cli._reload import (
+            reload_child_argv,
+            serve_with_reload,
+            watch_roots_for_specs,
+        )
+
+        passthrough = ["--max-workers", str(max_workers)]
+        if mesh_url:
+            passthrough += ["--mesh", mesh_url]
+        if group_id:
+            passthrough += ["--group-id", group_id]
+        roots = watch_roots_for_specs(specs)
+        click.echo(f"watching {', '.join(str(r) for r in roots)} for changes")
+        raise SystemExit(
+            serve_with_reload(
+                reload_child_argv(specs, passthrough), roots, echo=click.echo
+            )
+        )
+
     from calfkit_tpu.worker import Worker
 
     nodes = load_nodes(specs)

@@ -435,8 +435,14 @@ def find_meshd() -> str | None:
     return str(candidate) if candidate.exists() else None
 
 
-def spawn_meshd(port: int = DEFAULT_PORT) -> subprocess.Popen:
-    """Spawn the native broker and wait for readiness."""
+def spawn_meshd(
+    port: int = DEFAULT_PORT, *, start_new_session: bool = False
+) -> subprocess.Popen:
+    """Spawn the native broker and wait for readiness.
+
+    ``start_new_session=True`` detaches it from the caller's terminal
+    (managed dev brokers must survive a ctrl-c aimed at the CLI).
+    """
     binary = find_meshd()
     if binary is None:
         raise FileNotFoundError(
@@ -446,6 +452,7 @@ def spawn_meshd(port: int = DEFAULT_PORT) -> subprocess.Popen:
         [binary, str(port)],
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
+        start_new_session=start_new_session,
     )
     deadline = time.time() + 10
     import socket

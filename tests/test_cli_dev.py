@@ -1,0 +1,186 @@
+"""CLI dev loop: --reload, connect-or-spawn broker lock, detached daemons.
+
+Reference anchors: /root/reference/calfkit/cli/run.py:37 (--reload),
+cli/_dev_broker.py:1-22 (spawn-race file lock), cli/_dev_agents.py +
+cli/dev.py:41-51 (daemon status/stop/down).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import textwrap
+import time
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+import pytest
+
+from calfkit_tpu.mesh.tcp import find_meshd
+
+meshd_missing = find_meshd() is None
+
+PORT = 19878
+
+
+@pytest.fixture
+def dev_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("CALFKIT_DEV_DIR", str(tmp_path / "devstate"))
+    return tmp_path
+
+
+class TestReload:
+    def test_restart_on_file_change(self, tmp_path):
+        from calfkit_tpu.cli._reload import serve_with_reload
+
+        watched = tmp_path / "app.py"
+        watched.write_text("x = 1\n")
+        marker = tmp_path / "starts.txt"
+        child = tmp_path / "child.py"
+        child.write_text(textwrap.dedent(f"""
+            import time
+            with open({str(marker)!r}, "a") as f:
+                f.write("start\\n")
+            if open({str(marker)!r}).read().count("start") >= 2:
+                raise SystemExit(0)  # restarted successfully: exit clean
+            time.sleep(60)
+        """))
+
+        def touch_later():
+            # wait for the child to have started once, then edit the file
+            for _ in range(100):
+                if marker.exists() and marker.read_text().count("start") >= 1:
+                    break
+                time.sleep(0.05)
+            watched.write_text("x = 2\n")
+
+        with ThreadPoolExecutor(1) as pool:
+            pool.submit(touch_later)
+            code = serve_with_reload(
+                [sys.executable, str(child)],
+                [tmp_path],
+                poll_interval=0.1,
+                echo=lambda *_: None,
+            )
+        assert code == 0
+        assert marker.read_text().count("start") >= 2  # original + restart
+
+    def test_snapshot_skips_hidden_and_pycache(self, tmp_path):
+        from calfkit_tpu.cli._reload import snapshot
+
+        (tmp_path / "real.py").write_text("1")
+        (tmp_path / "__pycache__").mkdir()
+        (tmp_path / "__pycache__" / "real.cpython-312.pyc.py").write_text("1")
+        (tmp_path / ".hidden").mkdir()
+        (tmp_path / ".hidden" / "x.py").write_text("1")
+        seen = snapshot([tmp_path])
+        assert list(seen) == [str(tmp_path / "real.py")]
+
+    def test_watch_roots_for_specs(self, tmp_path):
+        from calfkit_tpu.cli._reload import watch_roots_for_specs
+
+        nested = tmp_path / "pkg"
+        nested.mkdir()
+        (tmp_path / "a.py").write_text("1")
+        (nested / "b.py").write_text("1")
+        roots = watch_roots_for_specs(
+            [f"{tmp_path}/a.py:agent", f"{nested}/b.py:agent"]
+        )
+        assert roots == [tmp_path]  # parent swallows child
+
+
+@pytest.mark.skipif(meshd_missing, reason="meshd not built (make -C native)")
+class TestBrokerLock:
+    def test_concurrent_ensure_broker_spawns_exactly_one(self, dev_env):
+        from calfkit_tpu.cli._dev_state import ensure_broker, stop_broker
+
+        try:
+            with ThreadPoolExecutor(4) as pool:
+                infos = list(
+                    pool.map(lambda _: ensure_broker(PORT), range(4))
+                )
+            assert sum(info.spawned for info in infos) == 1
+            assert all(info.port == PORT for info in infos)
+        finally:
+            stop_broker(PORT)
+
+    def test_stop_broker_only_stops_managed(self, dev_env):
+        from calfkit_tpu.cli._dev_state import (
+            broker_status,
+            ensure_broker,
+            stop_broker,
+        )
+
+        info = ensure_broker(PORT)
+        assert info.spawned
+        assert broker_status(PORT)["up"]
+        assert stop_broker(PORT) is True
+        for _ in range(50):
+            if not broker_status(PORT)["up"]:
+                break
+            time.sleep(0.1)
+        assert not broker_status(PORT)["up"]
+        assert stop_broker(PORT) is False  # nothing managed anymore
+
+
+@pytest.mark.skipif(meshd_missing, reason="meshd not built (make -C native)")
+class TestDaemons:
+    async def test_daemon_serve_status_stop(self, dev_env, tmp_path):
+        from calfkit_tpu.cli._dev_state import (
+            ensure_broker,
+            get_daemon,
+            list_daemons,
+            spawn_daemon,
+            stop_broker,
+            stop_daemon,
+        )
+
+        agent_file = tmp_path / "devagent.py"
+        agent_file.write_text(textwrap.dedent("""
+            from calfkit_tpu.engine import TestModelClient
+            from calfkit_tpu.nodes import Agent
+
+            agent = Agent(
+                "daemon_agent",
+                model=TestModelClient(custom_output_text="from-daemon"),
+            )
+        """))
+        try:
+            broker = ensure_broker(PORT)
+            info = spawn_daemon(
+                "daemon_agent", [f"{agent_file}:agent"], broker.url
+            )
+            assert info.alive
+            assert [d.name for d in list_daemons()] == ["daemon_agent"]
+
+            # the daemon actually serves: execute through a fresh client
+            from calfkit_tpu.client import Client
+            from calfkit_tpu.mesh.tcp import TcpMesh
+
+            mesh = TcpMesh(f"127.0.0.1:{PORT}")
+            await mesh.start()
+            client = Client.connect(mesh)
+            result = None
+            for attempt in range(40):  # daemon boot is async
+                try:
+                    result = await client.agent("daemon_agent").execute(
+                        "hi", timeout=5
+                    )
+                    break
+                except Exception:
+                    await asyncio.sleep(0.5)
+            assert result is not None and result.output == "from-daemon"
+            await client.close()
+            await mesh.stop()
+
+            # duplicate name is rejected while alive
+            with pytest.raises(RuntimeError, match="already running"):
+                spawn_daemon("daemon_agent", [f"{agent_file}:agent"], broker.url)
+
+            assert stop_daemon("daemon_agent") is True
+            assert get_daemon("daemon_agent") is None
+            assert Path(info.log_path).exists()
+        finally:
+            for d in list_daemons():
+                stop_daemon(d.name)
+            stop_broker(PORT)
