@@ -1,0 +1,101 @@
+"""The frozen connection profile threaded to every Kafka client.
+
+Reference: calfkit/client/_connection.py:39-110 — one validated object owns
+bootstrap + security + message budget, and every producer/consumer/admin
+derives its kwargs from it, so the coordinated knobs cannot drift apart:
+
+- ``max_message_bytes`` is BOTH the producer guard (``max_request_size``)
+  and the consumer fetch floor (``max_partition_fetch_bytes`` and
+  ``fetch_max_bytes`` are raised to at least the budget, so a max-size
+  message can always be fetched — a producer-side-only budget deadlocks
+  consumption of the biggest legal message).
+- ``enable_idempotence`` is tri-state (None = broker default) and reaches
+  every producer.
+- Raw kwargs that would bypass a coordinated knob are **rejected by name**
+  (reference: caller.py:148-165) with a pointer at the right knob.
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Mapping
+
+DEFAULT_MAX_MESSAGE_BYTES = 5 * 1024 * 1024
+_AIOKAFKA_DEFAULT_FETCH_MAX = 50 * 1024 * 1024
+
+# kwarg name -> the knob that owns it
+REJECTED_SECURITY_KWARGS: dict[str, str] = {
+    "max_request_size": "max_message_bytes",
+    "max_partition_fetch_bytes": "max_message_bytes",
+    "fetch_max_bytes": "max_message_bytes",
+    "enable_idempotence": "enable_idempotence",
+    "acks": "the framework (acks=all is load-bearing for the fault rail)",
+    "bootstrap_servers": "the positional bootstrap argument",
+    "client_id": "client_id",
+    "group_id": "subscribe(group_id=...)",
+    "auto_offset_reset": "subscribe(from_latest=...)",
+    "enable_auto_commit": "the framework (commit cadence is load-bearing)",
+}
+
+
+@dataclass(frozen=True)
+class ConnectionProfile:
+    """Validated once; derives kwargs for every client kind."""
+
+    bootstrap_servers: str
+    max_message_bytes: int = DEFAULT_MAX_MESSAGE_BYTES
+    enable_idempotence: bool | None = None
+    client_id: str = field(
+        default_factory=lambda: f"calfkit-{uuid.uuid4().hex[:8]}"
+    )
+    security: Mapping[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        # own copy: a caller mutating its dict after construction must not
+        # bypass the reject-by-name validation below
+        object.__setattr__(self, "security", dict(self.security))
+        if self.max_message_bytes <= 0:
+            raise ValueError("max_message_bytes must be positive")
+        bad = sorted(set(self.security) & set(REJECTED_SECURITY_KWARGS))
+        if bad:
+            hints = "; ".join(
+                f"{name!r} is owned by {REJECTED_SECURITY_KWARGS[name]}"
+                for name in bad
+            )
+            raise ValueError(
+                f"security= must not carry coordinated kwargs: {hints}"
+            )
+
+    # ------------------------------------------------------------- kwargs
+    def common_kwargs(self) -> dict[str, Any]:
+        return {"bootstrap_servers": self.bootstrap_servers, **self.security}
+
+    def producer_kwargs(self) -> dict[str, Any]:
+        kwargs = dict(
+            self.common_kwargs(),
+            client_id=self.client_id,
+            max_request_size=self.max_message_bytes,  # producer guard
+            acks="all",
+        )
+        if self.enable_idempotence is not None:
+            kwargs["enable_idempotence"] = self.enable_idempotence
+        return kwargs
+
+    def consumer_kwargs(
+        self, *, group_id: str | None, from_latest: bool
+    ) -> dict[str, Any]:
+        return dict(
+            self.common_kwargs(),
+            group_id=group_id,
+            auto_offset_reset="latest" if from_latest else "earliest",
+            enable_auto_commit=group_id is not None,
+            # consumer fetch FLOOR: both bounds at least the budget
+            max_partition_fetch_bytes=self.max_message_bytes,
+            fetch_max_bytes=max(
+                self.max_message_bytes, _AIOKAFKA_DEFAULT_FETCH_MAX
+            ),
+        )
+
+    def admin_kwargs(self) -> dict[str, Any]:
+        return self.common_kwargs()

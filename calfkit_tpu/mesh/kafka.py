@@ -20,10 +20,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import uuid
 from typing import Awaitable, Callable
 
 from calfkit_tpu.exceptions import MeshUnavailableError
+from calfkit_tpu.mesh.connection import ConnectionProfile
 from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
 from calfkit_tpu.mesh.tables import TableReader, TableWriter
 from calfkit_tpu.mesh.transport import (
@@ -55,19 +55,48 @@ class KafkaMesh(MeshTransport):
 
     def __init__(
         self,
-        bootstrap_servers: str,
+        bootstrap_servers: str | None = None,
         *,
+        profile: "ConnectionProfile | None" = None,
         max_message_bytes: int = 5 * 1024 * 1024,
         enable_idempotence: bool | None = None,
         security: dict | None = None,
         client_id: str | None = None,
     ):
         _aiokafka()
-        self._bootstrap = bootstrap_servers
-        self._max_bytes = max_message_bytes
-        self._idempotence = enable_idempotence
-        self._security = dict(security or {})
-        self._client_id = client_id or f"calfkit-{uuid.uuid4().hex[:8]}"
+        if profile is None:
+            if bootstrap_servers is None:
+                raise ValueError("bootstrap_servers (or profile=) required")
+            kwargs: dict = dict(
+                bootstrap_servers=bootstrap_servers,
+                max_message_bytes=max_message_bytes,
+                enable_idempotence=enable_idempotence,
+                security=dict(security or {}),
+            )
+            if client_id is not None:
+                kwargs["client_id"] = client_id
+            profile = ConnectionProfile(**kwargs)
+        else:
+            # profile= owns every connection knob; silently ignoring a
+            # conflicting legacy kwarg would contradict reject-by-name
+            conflicts = [
+                name
+                for name, value, default in (
+                    ("bootstrap_servers", bootstrap_servers, None),
+                    ("max_message_bytes", max_message_bytes, 5 * 1024 * 1024),
+                    ("enable_idempotence", enable_idempotence, None),
+                    ("security", security, None),
+                    ("client_id", client_id, None),
+                )
+                if value != default
+            ]
+            if conflicts:
+                raise ValueError(
+                    f"profile= conflicts with {conflicts}: set these on the "
+                    "ConnectionProfile instead"
+                )
+        self._profile = profile
+        self._max_bytes = profile.max_message_bytes
         self._producer = None
         self._tasks: list[asyncio.Task[None]] = []
         self._consumers: list = []
@@ -78,23 +107,21 @@ class KafkaMesh(MeshTransport):
     def max_message_bytes(self) -> int:
         return self._max_bytes
 
+    @property
+    def profile(self) -> "ConnectionProfile":
+        return self._profile
+
     def _common_kwargs(self) -> dict:
-        return {"bootstrap_servers": self._bootstrap, **self._security}
+        return self._profile.common_kwargs()
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
         if self._started:
             return
         aiokafka = _aiokafka()
-        producer_kwargs = dict(
-            self._common_kwargs(),
-            client_id=self._client_id,
-            max_request_size=self._max_bytes,
-            acks="all",
+        self._producer = aiokafka.AIOKafkaProducer(
+            **self._profile.producer_kwargs()
         )
-        if self._idempotence is not None:
-            producer_kwargs["enable_idempotence"] = self._idempotence
-        self._producer = aiokafka.AIOKafkaProducer(**producer_kwargs)
         await self._producer.start()
         self._started = True
 
@@ -128,19 +155,41 @@ class KafkaMesh(MeshTransport):
     async def ensure_topics(self, names: list[str], *, compacted: bool = False) -> None:
         from aiokafka.admin import AIOKafkaAdminClient, NewTopic  # type: ignore
 
-        admin = AIOKafkaAdminClient(**self._common_kwargs())
+        admin = AIOKafkaAdminClient(**self._profile.admin_kwargs())
         await admin.start()
         try:
             configs = {"cleanup.policy": "compact"} if compacted else {}
-            topics = [
-                NewTopic(name=n, num_partitions=16, replication_factor=-1, topic_configs=configs)
-                for n in names
-            ]
+
+            def new_topic(name: str) -> "NewTopic":
+                return NewTopic(
+                    name=name, num_partitions=16, replication_factor=-1,
+                    topic_configs=configs,
+                )
+
+            def is_exists(exc: BaseException) -> bool:
+                return (
+                    "TopicAlreadyExists" in type(exc).__name__
+                    or "exists" in str(exc).lower()
+                )
+
             try:
-                await admin.create_topics(topics, validate_only=False)
-            except Exception as exc:  # noqa: BLE001 - existing topics are fine
-                if "TopicAlreadyExists" not in type(exc).__name__ and "exists" not in str(exc).lower():
+                # the happy path is ONE admin round trip for the whole set
+                await admin.create_topics(
+                    [new_topic(n) for n in names], validate_only=False
+                )
+            except Exception as batch_exc:  # noqa: BLE001
+                if not is_exists(batch_exc):
                     raise
+                # a pre-existing topic aborted the batch: create the rest
+                # individually so it can't mask genuinely-missing siblings
+                for name in names:
+                    try:
+                        await admin.create_topics(
+                            [new_topic(name)], validate_only=False
+                        )
+                    except Exception as exc:  # noqa: BLE001
+                        if not is_exists(exc):
+                            raise
         finally:
             await admin.close()
 
@@ -181,11 +230,9 @@ class KafkaMesh(MeshTransport):
             from_latest = group_id is None
         consumer = aiokafka.AIOKafkaConsumer(
             *topics,
-            **self._common_kwargs(),
-            group_id=group_id,
-            auto_offset_reset="latest" if from_latest else "earliest",
-            enable_auto_commit=group_id is not None,
-            max_partition_fetch_bytes=self._max_bytes,
+            **self._profile.consumer_kwargs(
+                group_id=group_id, from_latest=from_latest
+            ),
         )
         await consumer.start()
         self._consumers.append(consumer)

@@ -1,17 +1,27 @@
-"""Topic-set derivation + idempotent creation.
+"""Topic-set derivation + idempotent creation with error classification.
 
 Reference: calfkit/provisioning/provisioner.py:28-73 (``topics_for_nodes`` /
-``framework_topics_for_nodes``) and the created/existing/unauthorized
+``framework_topics_for_nodes``) and the created/existing/unauthorized/retry
 classification at :13-18.  The transport's ``ensure_topics`` performs the
-actual creation; this module owns which topics exist and why.
+actual creation; this module owns which topics exist, why, and how their
+creation failures are treated:
+
+- **existing** — another worker won the race; success.
+- **retry** — transient broker trouble (timeouts, leader elections,
+  connection loss); bounded backoff, then give up loudly.
+- **unauthorized** — an ACL problem no retry will fix; fail immediately
+  with a message that says so (the reference's most important distinction:
+  an unauthorized cluster must not look like a flaky one).
+- **fatal** — everything else; fail immediately.
 """
 
 from __future__ import annotations
 
+import asyncio
 import logging
 from typing import Iterable
 
-from pydantic import BaseModel
+from pydantic import BaseModel, Field
 
 from calfkit_tpu import protocol
 from calfkit_tpu.exceptions import ProvisioningError
@@ -24,6 +34,44 @@ logger = logging.getLogger(__name__)
 class ProvisioningConfig(BaseModel):
     enabled: bool = True
     include_framework: bool = True
+    max_attempts: int = Field(3, ge=1)
+    retry_backoff_s: float = Field(0.5, ge=0.0)
+
+
+_EXISTING_MARKERS = ("alreadyexists", "already exists")
+_UNAUTHORIZED_MARKERS = (
+    "authorization", "authentication", "unauthorized", "accessdenied",
+    "saslauthentication", "aclauthorization",
+)
+_RETRIABLE_MARKERS = (
+    "timeout", "timedout", "connection", "notcontroller", "retriable",
+    "unavailable", "leadernotavailable", "notcoordinator", "networkerror",
+    "nodenotready", "brokerresponseerror",
+)
+
+
+def classify_topic_error(exc: BaseException) -> str:
+    """→ "existing" | "unauthorized" | "retry" | "fatal".
+
+    Matching is by exception type name and message (transport-agnostic: the
+    kafka client's error class names carry the semantics; other transports
+    raise stdlib TimeoutError/ConnectionError which land in "retry").
+    """
+    haystack = f"{type(exc).__name__} {exc}".lower()
+    if isinstance(exc, (PermissionError,)):
+        return "unauthorized"
+    for marker in _UNAUTHORIZED_MARKERS:
+        if marker in haystack:
+            return "unauthorized"
+    for marker in _EXISTING_MARKERS:
+        if marker in haystack:
+            return "existing"
+    if isinstance(exc, (TimeoutError, ConnectionError, OSError)):
+        return "retry"
+    for marker in _RETRIABLE_MARKERS:
+        if marker in haystack:
+            return "retry"
+    return "fatal"
 
 
 def topics_for_nodes(nodes: Iterable[BaseNodeDef]) -> list[str]:
@@ -57,12 +105,54 @@ async def provision(
     nodes = list(nodes)
     plain = topics_for_nodes(nodes)
     compacted = framework_topics_for_nodes(nodes) if config.include_framework else []
-    try:
-        await transport.ensure_topics(plain)
-        if compacted:
-            await transport.ensure_topics(compacted, compacted=True)
-    except Exception as exc:  # noqa: BLE001
-        raise ProvisioningError(f"topic provisioning failed: {exc}") from exc
+
+    class _ExistsInBatch(Exception):
+        """Batch create hit an already-exists: fall back to per-topic."""
+
+    async def attempt(names: list[str], *, compact: bool) -> None:
+        for attempt in range(1, config.max_attempts + 1):
+            try:
+                await transport.ensure_topics(names, compacted=compact)
+                return
+            except Exception as exc:  # noqa: BLE001 - classified below
+                kind = classify_topic_error(exc)
+                if kind == "existing":
+                    if len(names) > 1:
+                        # one existing topic must not mask missing siblings
+                        raise _ExistsInBatch from exc
+                    return  # a racing worker created it: success
+                if kind == "retry" and attempt < config.max_attempts:
+                    delay = config.retry_backoff_s * (2 ** (attempt - 1))
+                    logger.warning(
+                        "topic provisioning attempt %d/%d failed (%s); "
+                        "retrying in %.1fs: %s",
+                        attempt, config.max_attempts, kind, delay, exc,
+                    )
+                    await asyncio.sleep(delay)
+                    continue
+                if kind == "unauthorized":
+                    raise ProvisioningError(
+                        "topic provisioning UNAUTHORIZED (no retry will "
+                        f"fix this — grant create-topics ACLs or pre-create "
+                        f"{names}): {exc}"
+                    ) from exc
+                raise ProvisioningError(
+                    f"topic provisioning failed ({kind}, "
+                    f"attempt {attempt}/{config.max_attempts}): {exc}"
+                ) from exc
+
+    async def ensure(names: list[str], *, compact: bool) -> None:
+        if not names:
+            return
+        try:
+            await attempt(names, compact=compact)  # one round trip, usually
+        except _ExistsInBatch:
+            for name in names:  # fallback: per-topic, each one classified
+                await attempt([name], compact=compact)
+
+    await ensure(plain, compact=False)
+    if compacted:
+        await ensure(compacted, compact=True)
     logger.info(
         "provisioned %d topics (%d compacted)", len(plain) + len(compacted),
         len(compacted),

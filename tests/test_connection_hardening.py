@@ -1,0 +1,222 @@
+"""ConnectionProfile discipline + provisioning error classification.
+
+Reference anchors: calfkit/client/_connection.py:39-110 (profile threading,
+producer guard + consumer fetch floor), caller.py:148-165 (reject-by-name),
+calfkit/provisioning/provisioner.py:13-18 (created/existing/unauthorized/
+retry classification).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from calfkit_tpu.exceptions import ProvisioningError
+from calfkit_tpu.mesh.connection import ConnectionProfile
+from calfkit_tpu.provisioning.provisioner import (
+    ProvisioningConfig,
+    classify_topic_error,
+    provision,
+)
+
+
+class TestConnectionProfile:
+    def test_producer_guard_and_consumer_floor(self):
+        profile = ConnectionProfile("host:9092", max_message_bytes=10_000_000)
+        prod = profile.producer_kwargs()
+        assert prod["max_request_size"] == 10_000_000
+        assert prod["acks"] == "all"
+        cons = profile.consumer_kwargs(group_id="g", from_latest=False)
+        assert cons["max_partition_fetch_bytes"] == 10_000_000
+        # floor: never below the budget, never below the client default
+        assert cons["fetch_max_bytes"] >= 10_000_000
+        big = ConnectionProfile("host:9092", max_message_bytes=100_000_000)
+        assert big.consumer_kwargs(group_id=None, from_latest=True)[
+            "fetch_max_bytes"
+        ] == 100_000_000
+
+    def test_idempotence_tristate(self):
+        default = ConnectionProfile("h:9")
+        assert "enable_idempotence" not in default.producer_kwargs()
+        on = ConnectionProfile("h:9", enable_idempotence=True)
+        assert on.producer_kwargs()["enable_idempotence"] is True
+        off = ConnectionProfile("h:9", enable_idempotence=False)
+        assert off.producer_kwargs()["enable_idempotence"] is False
+
+    def test_security_threads_to_every_client(self):
+        profile = ConnectionProfile(
+            "h:9",
+            security={"security_protocol": "SASL_SSL", "sasl_mechanism": "PLAIN"},
+        )
+        for kwargs in (
+            profile.producer_kwargs(),
+            profile.consumer_kwargs(group_id="g", from_latest=False),
+            profile.admin_kwargs(),
+        ):
+            assert kwargs["security_protocol"] == "SASL_SSL"
+            assert kwargs["sasl_mechanism"] == "PLAIN"
+
+    @pytest.mark.parametrize(
+        "kwarg",
+        ["max_request_size", "enable_idempotence", "acks", "group_id",
+         "auto_offset_reset", "enable_auto_commit", "fetch_max_bytes"],
+    )
+    def test_coordinated_kwargs_rejected_by_name(self, kwarg):
+        with pytest.raises(ValueError, match=kwarg):
+            ConnectionProfile("h:9", security={kwarg: "x"})
+
+    def test_group_semantics(self):
+        profile = ConnectionProfile("h:9")
+        tap = profile.consumer_kwargs(group_id=None, from_latest=True)
+        assert tap["auto_offset_reset"] == "latest"
+        assert tap["enable_auto_commit"] is False
+        member = profile.consumer_kwargs(group_id="g", from_latest=False)
+        assert member["auto_offset_reset"] == "earliest"
+        assert member["enable_auto_commit"] is True
+
+
+class _NamedError(Exception):
+    pass
+
+
+def _named(name: str, message: str = "") -> Exception:
+    err_type = type(name, (_NamedError,), {})
+    return err_type(message)
+
+
+class TestClassification:
+    def test_existing(self):
+        assert classify_topic_error(_named("TopicAlreadyExistsError")) == "existing"
+        assert classify_topic_error(Exception("Topic already exists")) == "existing"
+
+    def test_unauthorized(self):
+        assert (
+            classify_topic_error(_named("TopicAuthorizationFailedError"))
+            == "unauthorized"
+        )
+        assert (
+            classify_topic_error(_named("ClusterAuthorizationFailedError"))
+            == "unauthorized"
+        )
+        assert classify_topic_error(PermissionError("no")) == "unauthorized"
+
+    def test_retriable(self):
+        assert classify_topic_error(_named("RequestTimedOutError")) == "retry"
+        assert classify_topic_error(_named("NotControllerError")) == "retry"
+        assert classify_topic_error(_named("LeaderNotAvailableError")) == "retry"
+        assert classify_topic_error(TimeoutError()) == "retry"
+        assert classify_topic_error(ConnectionRefusedError()) == "retry"
+
+    def test_fatal(self):
+        assert classify_topic_error(_named("InvalidTopicError")) == "fatal"
+        assert classify_topic_error(ValueError("bad")) == "fatal"
+
+
+class _FlakyTransport:
+    """ensure_topics fails ``failures`` times, then succeeds."""
+
+    def __init__(self, failures: int, exc: Exception):
+        self.failures = failures
+        self.exc = exc
+        self.calls = 0
+        self.ensured: list[list[str]] = []
+
+    async def ensure_topics(self, names, *, compacted=False):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc
+        self.ensured.append(list(names))
+
+
+class _Node:
+    def __init__(self, name):
+        self.name = name
+        self.node_id = f"agent.{name}"
+
+    def all_topics(self):
+        return [f"agent.{self.name}.private.input"]
+
+
+class TestProvisionRetry:
+    async def test_transient_errors_retried_to_success(self):
+        transport = _FlakyTransport(2, _named("RequestTimedOutError", "slow"))
+        result = await provision(
+            transport, [_Node("a")],
+            ProvisioningConfig(retry_backoff_s=0.01, include_framework=False),
+        )
+        assert transport.calls == 3
+        assert result["plain"] == ["agent.a.private.input"]
+
+    async def test_transient_errors_exhaust_loudly(self):
+        transport = _FlakyTransport(99, TimeoutError("down"))
+        with pytest.raises(ProvisioningError, match="retry"):
+            await provision(
+                transport, [_Node("a")],
+                ProvisioningConfig(
+                    retry_backoff_s=0.01, include_framework=False
+                ),
+            )
+        assert transport.calls == 3  # bounded
+
+    async def test_unauthorized_fails_immediately_no_retry(self):
+        transport = _FlakyTransport(
+            99, _named("TopicAuthorizationFailedError", "denied")
+        )
+        with pytest.raises(ProvisioningError, match="UNAUTHORIZED"):
+            await provision(
+                transport, [_Node("a")],
+                ProvisioningConfig(
+                    retry_backoff_s=0.01, include_framework=False
+                ),
+            )
+        assert transport.calls == 1  # no retry on ACL problems
+
+    async def test_existing_is_success(self):
+        transport = _FlakyTransport(99, _named("TopicAlreadyExistsError"))
+        result = await provision(
+            transport, [_Node("a")],
+            ProvisioningConfig(include_framework=False),
+        )
+        assert transport.calls == 1
+        assert result["plain"] == ["agent.a.private.input"]
+
+
+class TestReviewRegressions:
+    def test_security_dict_mutation_cannot_bypass_validation(self):
+        sec: dict = {}
+        profile = ConnectionProfile("h:9", security=sec)
+        sec["acks"] = 0  # mutate AFTER construction
+        assert "acks" not in profile.producer_kwargs() or (
+            profile.producer_kwargs()["acks"] == "all"
+        )
+
+    def test_max_attempts_lower_bound(self):
+        with pytest.raises(Exception):
+            ProvisioningConfig(max_attempts=0)
+
+    async def test_batch_exists_falls_back_per_topic(self):
+        """An already-exists on the batch must not mask missing siblings."""
+
+        class BatchExistsTransport:
+            def __init__(self):
+                self.created: list[str] = []
+
+            async def ensure_topics(self, names, *, compacted=False):
+                if len(names) > 1:
+                    raise _named("TopicAlreadyExistsError", "t1 exists")
+                if names[0] in self.created:
+                    raise _named("TopicAlreadyExistsError")
+                self.created.extend(names)
+
+        class TwoTopicNode(_Node):
+            def all_topics(self):
+                return [f"agent.{self.name}.private.input",
+                        f"agent.{self.name}.private.return"]
+
+        transport = BatchExistsTransport()
+        transport.created.append("agent.a.private.input")  # pre-existing
+        result = await provision(
+            transport, [TwoTopicNode("a")],
+            ProvisioningConfig(include_framework=False),
+        )
+        assert "agent.a.private.return" in transport.created  # NOT masked
+        assert len(result["plain"]) == 2
