@@ -23,7 +23,10 @@ import logging
 from typing import Awaitable, Callable
 
 from calfkit_tpu.exceptions import MeshUnavailableError
-from calfkit_tpu.mesh.connection import ConnectionProfile
+from calfkit_tpu.mesh.connection import (
+    DEFAULT_MAX_MESSAGE_BYTES,
+    ConnectionProfile,
+)
 from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
 from calfkit_tpu.mesh.tables import TableReader, TableWriter
 from calfkit_tpu.mesh.transport import (
@@ -58,7 +61,10 @@ class KafkaMesh(MeshTransport):
         bootstrap_servers: str | None = None,
         *,
         profile: "ConnectionProfile | None" = None,
-        max_message_bytes: int = 5 * 1024 * 1024,
+        # None = "not passed" for every legacy kwarg, so the profile=
+        # conflict check can't false-positive on a value that happens to
+        # equal a default (security={} is benign; 5 MiB is the default)
+        max_message_bytes: int | None = None,
         enable_idempotence: bool | None = None,
         security: dict | None = None,
         client_id: str | None = None,
@@ -69,7 +75,11 @@ class KafkaMesh(MeshTransport):
                 raise ValueError("bootstrap_servers (or profile=) required")
             kwargs: dict = dict(
                 bootstrap_servers=bootstrap_servers,
-                max_message_bytes=max_message_bytes,
+                max_message_bytes=(
+                    max_message_bytes
+                    if max_message_bytes is not None
+                    else DEFAULT_MAX_MESSAGE_BYTES
+                ),
                 enable_idempotence=enable_idempotence,
                 security=dict(security or {}),
             )
@@ -81,14 +91,14 @@ class KafkaMesh(MeshTransport):
             # conflicting legacy kwarg would contradict reject-by-name
             conflicts = [
                 name
-                for name, value, default in (
-                    ("bootstrap_servers", bootstrap_servers, None),
-                    ("max_message_bytes", max_message_bytes, 5 * 1024 * 1024),
-                    ("enable_idempotence", enable_idempotence, None),
-                    ("security", security, None),
-                    ("client_id", client_id, None),
+                for name, value in (
+                    ("bootstrap_servers", bootstrap_servers),
+                    ("max_message_bytes", max_message_bytes),
+                    ("enable_idempotence", enable_idempotence),
+                    ("security", security),
+                    ("client_id", client_id),
                 )
-                if value != default
+                if value is not None
             ]
             if conflicts:
                 raise ValueError(

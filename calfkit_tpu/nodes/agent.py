@@ -45,7 +45,11 @@ from calfkit_tpu.models.payload import (
 )
 from calfkit_tpu.models.tool_dispatch import ToolBinding, ToolCallRef
 from calfkit_tpu.nodes.base import BaseNodeDef, NodeRunContext, handler
-from calfkit_tpu.nodes.projection import project
+from calfkit_tpu.nodes.projection import (
+    project,
+    step_preamble,
+    structured_output_preamble,
+)
 from calfkit_tpu.nodes.steps import (
     DeniedCall,
     Fact,
@@ -298,7 +302,9 @@ class BaseAgentNodeDef(BaseNodeDef):
         state.uncommitted_message = None
         state.clear_inflight()
 
-        text = outcome.response.text()
+        # what the hop SAID: final-response text only (internal output-retry
+        # chatter never surfaces as a step)
+        text = step_preamble(outcome.new_messages)
         if text:
             facts.append(Said(text=text, author=self.name))
 
@@ -606,7 +612,14 @@ class BaseAgentNodeDef(BaseNodeDef):
         output = outcome.output
         if self.output_type is str:
             return ReturnCall(parts=[TextPart(text=output or "")])
-        return ReturnCall(parts=[DataPart(data=to_jsonable_python(output))])
+        # a structured result keeps the text said alongside it (message-
+        # aware preamble: only when the answer rode a final_result call)
+        parts: list[Any] = []
+        preamble = structured_output_preamble(outcome.new_messages)
+        if preamble:
+            parts.append(TextPart(text=preamble))
+        parts.append(DataPart(data=to_jsonable_python(output)))
+        return ReturnCall(parts=parts)
 
 
 class _AllCallsRejected(Exception):

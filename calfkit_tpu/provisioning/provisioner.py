@@ -144,6 +144,10 @@ async def provision(
     async def ensure(names: list[str], *, compact: bool) -> None:
         if not names:
             return
+        # layering note: in-repo transports implement ensure_topics
+        # idempotently (KafkaMesh does its own batch→per-topic exists
+        # handling), so this fallback is the cross-transport safety net for
+        # implementations that DO surface already-exists errors
         try:
             await attempt(names, compact=compact)  # one round trip, usually
         except _ExistsInBatch:

@@ -185,9 +185,14 @@ class TestReviewRegressions:
         sec: dict = {}
         profile = ConnectionProfile("h:9", security=sec)
         sec["acks"] = 0  # mutate AFTER construction
-        assert "acks" not in profile.producer_kwargs() or (
-            profile.producer_kwargs()["acks"] == "all"
+        # the profile holds its OWN copy: the leaked key must be absent from
+        # every derived kwargs dict (admin/consumer don't re-override acks,
+        # so they are the observable surface for this guard)
+        assert "acks" not in profile.admin_kwargs()
+        assert "acks" not in profile.consumer_kwargs(
+            group_id="g", from_latest=False
         )
+        assert profile.producer_kwargs()["acks"] == "all"
 
     def test_max_attempts_lower_bound(self):
         with pytest.raises(Exception):

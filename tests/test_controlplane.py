@@ -253,13 +253,13 @@ class TestProjection:
         assert mine[2].parts[0].kind == "tool_return"
         # foreign turn rendered as attributed user text
         assert mine[3].role == "request"
-        assert "[other]" in mine[3].parts[0].content
+        assert "<other>" in mine[3].parts[0].content
 
         theirs = project(history, "other")
         # my tool call/return stripped from their view; my text attributed
         flat = [p.kind for m in theirs if m.role == "request" for p in m.parts]
         assert "tool_return" not in flat
-        assert any("[me]" in str(getattr(p, "content", ""))
+        assert any("<me>" in str(getattr(p, "content", ""))
                    for m in theirs if m.role == "request" for p in m.parts)
 
 
@@ -321,7 +321,12 @@ class TestHandoffRegressions:
             client = Client.connect(mesh)
             result = await client.agent("fronter2").execute("the prompt", timeout=15)
             assert result.output == "done"
-            assert seen["user_texts"].count("the prompt") == 1
+            # exactly once — possibly <user>-attributed (the handed-off view
+            # is multi-participant), and the handoff briefing surfaces too
+            hits = sum(
+                text.count("the prompt") for text in seen["user_texts"]
+            )
+            assert hits == 1
             await client.close()
 
     async def test_losing_handoff_calls_are_closed_in_history(self):

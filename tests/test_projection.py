@@ -1,0 +1,192 @@
+"""POV projection attribution corners + the message-aware preambles.
+
+Reference anchors: calfkit/nodes/_projection.py:88-326 and the VERDICT r1
+item 9 corner list (interleaved foreign tool calls, retry parts from
+foreign agents, transparent single-participant mode, surfaced briefings).
+"""
+
+from __future__ import annotations
+
+from calfkit_tpu.engine.turn import FINAL_RESULT_TOOL
+from calfkit_tpu.models.messages import (
+    ModelRequest,
+    ModelResponse,
+    RetryPart,
+    SystemPart,
+    TextOutput,
+    ToolCallOutput,
+    ToolReturnPart,
+    UserPart,
+)
+from calfkit_tpu.nodes.projection import (
+    project,
+    step_preamble,
+    structured_output_preamble,
+)
+from calfkit_tpu.peers.handoff import HANDOFF_TOOL
+
+
+def _resp(author, *parts):
+    return ModelResponse(parts=list(parts), author=author)
+
+
+class TestTransparentMode:
+    def test_single_agent_history_passes_through_unprefixed(self):
+        history = [
+            ModelRequest(parts=[UserPart(content="hi", author="alice")]),
+            _resp("me", TextOutput(text="hello")),
+            ModelRequest(parts=[UserPart(content="more")]),
+        ]
+        out = project(history, "me")
+        assert len(out) == 3
+        # no prefixes anywhere (prompt-cache stability), authors stripped
+        assert out[0].parts[0].content == "hi"
+        assert out[0].parts[0].author is None
+        assert out[1].author is None
+        assert out[1].text() == "hello"
+
+    def test_own_tool_exchange_stays_verbatim(self):
+        history = [
+            ModelRequest(parts=[UserPart(content="go")]),
+            _resp("me", ToolCallOutput(tool_call_id="t1", tool_name="f", args={})),
+            ModelRequest(parts=[
+                ToolReturnPart(tool_call_id="t1", tool_name="f", content="r")
+            ]),
+        ]
+        out = project(history, "me")
+        assert out[1].tool_calls()[0].tool_call_id == "t1"
+        assert out[2].parts[0].tool_call_id == "t1"
+
+
+class TestMultiParticipant:
+    def test_interleaved_foreign_tool_calls_stripped(self):
+        """A foreign agent's ordinary tool calls AND their returns/retries
+        vanish from my view, even interleaved with my own exchange."""
+        history = [
+            ModelRequest(parts=[UserPart(content="start")]),
+            _resp("me", ToolCallOutput(tool_call_id="mine", tool_name="a", args={})),
+            _resp("other", ToolCallOutput(tool_call_id="theirs", tool_name="b",
+                                          args={"x": 1})),
+            ModelRequest(parts=[
+                ToolReturnPart(tool_call_id="theirs", tool_name="b", content="fb"),
+                ToolReturnPart(tool_call_id="mine", tool_name="a", content="fa"),
+            ]),
+        ]
+        out = project(history, "me")
+        ids = [
+            p.tool_call_id
+            for m in out
+            for p in m.parts
+            if isinstance(p, ToolReturnPart)
+        ]
+        assert ids == ["mine"]  # foreign return stripped, order preserved
+        # the foreign dispatch-only turn has no public surface → omitted
+        assert not any(
+            isinstance(m, ModelResponse) and m.author == "other" for m in out
+        )
+        assert not any(
+            "theirs" in str(m.model_dump()) for m in out
+        )  # the foreign id never leaks in any form
+
+    def test_retry_part_from_foreign_agent_stripped(self):
+        history = [
+            _resp("other", ToolCallOutput(tool_call_id="ft", tool_name="x", args={})),
+            _resp("me", ToolCallOutput(tool_call_id="mt", tool_name="y", args={})),
+            ModelRequest(parts=[
+                RetryPart(content="try again", tool_call_id="ft", tool_name="x"),
+                RetryPart(content="mine failed", tool_call_id="mt", tool_name="y"),
+            ]),
+        ]
+        out = project(history, "me")
+        retries = [
+            p for m in out for p in m.parts if isinstance(p, RetryPart)
+        ]
+        assert [r.tool_call_id for r in retries] == ["mt"]
+
+    def test_foreign_final_result_and_handoff_args_surface(self):
+        """A peer's structured answer and handoff briefing ARE its public
+        surface; its ordinary tool calls are not."""
+        history = [
+            _resp("me", TextOutput(text="waiting")),
+            _resp(
+                "peer",
+                TextOutput(text="done deliberating"),
+                ToolCallOutput(tool_call_id="f1", tool_name=FINAL_RESULT_TOOL,
+                               args={"answer": 42}),
+                ToolCallOutput(tool_call_id="h1", tool_name=HANDOFF_TOOL,
+                               args={"agent_name": "me", "message": "take over"}),
+                ToolCallOutput(tool_call_id="x1", tool_name="internal_tool",
+                               args={"secret": True}),
+            ),
+        ]
+        out = project(history, "me")
+        surfaced = [
+            str(p.content)
+            for m in out
+            for p in m.parts
+            if isinstance(p, UserPart)
+        ]
+        joined = "\n".join(surfaced)
+        assert "<peer>" in joined
+        assert "done deliberating" in joined
+        assert '"answer":42' in joined
+        assert "take over" in joined
+        assert "secret" not in joined  # internal tools stay internal
+
+    def test_multiple_named_humans_are_attributed(self):
+        history = [
+            ModelRequest(parts=[UserPart(content="hi", author="alice")]),
+            ModelRequest(parts=[UserPart(content="yo", author="bob")]),
+            _resp("me", TextOutput(text="hey both")),
+        ]
+        out = project(history, "me")
+        assert out[0].parts[0].content == "<user:alice> hi"
+        assert out[1].parts[0].content == "<user:bob> yo"
+
+    def test_system_parts_survive_projection(self):
+        history = [
+            ModelRequest(parts=[SystemPart(content="be brief")]),
+            _resp("other", TextOutput(text="chatty")),
+        ]
+        out = project(history, "me")
+        assert any(
+            isinstance(p, SystemPart) and p.content == "be brief"
+            for m in out
+            for p in m.parts
+        )
+
+    def test_input_never_mutated(self):
+        history = [
+            ModelRequest(parts=[UserPart(content="hi", author="alice")]),
+            _resp("other", TextOutput(text="x")),
+        ]
+        snapshot = [m.model_dump() for m in history]
+        project(history, "me")
+        assert [m.model_dump() for m in history] == snapshot
+
+
+class TestPreambles:
+    def test_structured_preamble_only_with_final_result_call(self):
+        with_call = [
+            _resp(
+                "me",
+                TextOutput(text="here is my reasoning"),
+                ToolCallOutput(tool_call_id="f", tool_name=FINAL_RESULT_TOOL,
+                               args={"v": 1}),
+            )
+        ]
+        assert structured_output_preamble(with_call) == "here is my reasoning"
+        # prompted mode: the text IS the answer — no preamble
+        prompted = [_resp("me", TextOutput(text='{"v": 1}'))]
+        assert structured_output_preamble(prompted) == ""
+        assert structured_output_preamble([]) == ""
+
+    def test_step_preamble_is_final_response_only(self):
+        messages = [
+            _resp("me", TextOutput(text="first try (invalid)")),
+            ModelRequest(parts=[RetryPart(content="retry", tool_call_id="f",
+                                          tool_name=FINAL_RESULT_TOOL)]),
+            _resp("me", TextOutput(text="second try")),
+        ]
+        assert step_preamble(messages) == "second try"
+        assert step_preamble([]) == ""
