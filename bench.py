@@ -28,11 +28,30 @@ def _bench_config():
 
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
-    if platform == "cpu":
+    choice = os.environ.get("CALFKIT_BENCH_CONFIG", "auto")
+    if choice not in ("auto", "smoke", "tinyllama", "llama8b"):
+        raise ValueError(
+            f"CALFKIT_BENCH_CONFIG={choice!r} "
+            "(want auto | smoke | tinyllama | llama8b)"
+        )
+    if choice == "auto":
+        choice = "smoke" if platform == "cpu" else "tinyllama"
+    if choice == "smoke":
         # offline smoke mode: tiny model, tiny workload
         return dict(
             preset="debug", bs=8, max_seq=256, prefill_chunk=32,
             steps=8, requests=8, new_tokens=32, prompt_len=16,
+        )
+    if choice == "llama8b":
+        # BASELINE north star shape: Llama-3-8B, int8 weights (~8 GB),
+        # paged KV (dense at this batch would not fit 16 GB), random
+        # int8-shaped params built host-side (no checkpoint in image)
+        return dict(
+            preset="llama-3-8b", bs=32, max_seq=1024, prefill_chunk=128,
+            steps=32, requests=40, new_tokens=128, prompt_len=64,
+            quantization="int8", kv_layout="paged", random_quantized=True,
+            # 32 slots x 4 pages reserve (64+128+1 tokens) + headroom
+            num_kv_pages=32 * 4 + 65,
         )
     return dict(
         # requests > bs: the measured region exercises real continuous
@@ -60,8 +79,18 @@ async def run() -> dict:
         tp=1,
         dp=1,
         quantization=cfg.get("quantization"),
+        kv_layout=cfg.get("kv_layout", "dense"),
+        num_kv_pages=cfg.get("num_kv_pages", 0),
     )
-    engine = InferenceEngine(model, runtime)
+    params = None
+    if cfg.get("random_quantized"):
+        # big-model bench without a checkpoint: int8 params built on host
+        # (a device-side random init would transiently need the full bf16
+        # tree — the whole chip for 8B)
+        from calfkit_tpu.inference.quant import random_quantized_params_host
+
+        params = random_quantized_params_host(model)
+    engine = InferenceEngine(model, runtime, params=params)
     await engine.start()
 
     # warm every specialization the measured run will touch: each power-of-
@@ -125,7 +154,8 @@ async def run() -> dict:
     return {
         "metric": (
             f"decode_tok_s_per_chip[{model.name} bs={cfg['bs']}"
-            f"{' ' + cfg['quantization'] if cfg.get('quantization') else ''} "
+            f"{' ' + cfg['quantization'] if cfg.get('quantization') else ''}"
+            f"{' paged-kv' if cfg.get('kv_layout') == 'paged' else ''} "
             f"continuous-batching wall]"
         ),
         "value": round(wall_tps, 1),
@@ -301,8 +331,15 @@ def main() -> None:
         error = f"accelerator unavailable: {info}"
 
     # ---- CPU fallback smoke: a real number from the same engine code path
+    # (pin the smoke config: an inherited CALFKIT_BENCH_CONFIG=llama8b must
+    # not turn the guaranteed-small fallback into an 8B build on CPU)
     rc, out, err = _run_sub(
-        {"CALFKIT_BENCH_INNER": "1", "JAX_PLATFORMS": "cpu"}, timeout_s=900
+        {
+            "CALFKIT_BENCH_INNER": "1",
+            "JAX_PLATFORMS": "cpu",
+            "CALFKIT_BENCH_CONFIG": "smoke",
+        },
+        timeout_s=900,
     )
     result = _last_json_line(out) if rc == 0 else None
     if result is None:

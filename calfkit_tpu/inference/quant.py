@@ -14,6 +14,7 @@ use in :mod:`calfkit_tpu.inference.model`.
 
 from __future__ import annotations
 
+import math
 from typing import Any
 
 import jax
@@ -133,3 +134,61 @@ def quantize_shardings(shardings: Params) -> Params:
     if "lm_head" in shardings:
         out["lm_head"] = expand(shardings["lm_head"], LM_HEAD_REDUCTION_AXES)
     return out
+
+
+def random_quantized_params_host(
+    config: Any, seed: int = 0, dtype: Any = None
+) -> Params:
+    """Random 8B-SHAPED params built quantized on the host.
+
+    For benchmarking big models without a checkpoint: a device-side random
+    init would transiently hold the full bf16 tree (~16 GB for Llama-3-8B —
+    the whole chip), so instead generate int8 weights + unit-ish scales in
+    numpy, one tensor at a time, and let the caller device_put them into
+    quantized shardings.  Values are meaningless; shapes, dtypes, and HBM
+    traffic are exactly the serving path's.
+    """
+    import ml_dtypes  # jax dependency: numpy bfloat16 support
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    np_dtype = np.dtype(dtype) if dtype else np.dtype(ml_dtypes.bfloat16)
+
+    L, D, H, K, hd, F, V = (
+        config.n_layers, config.d_model, config.n_heads, config.n_kv_heads,
+        config.head_dim, config.d_ff, config.vocab_size,
+    )
+
+    def q(shape, reduction_axes):
+        q8 = rng.integers(-127, 128, size=shape, dtype=np.int8)
+        scale_shape = tuple(
+            1 if i in reduction_axes else s for i, s in enumerate(shape)
+        )
+        fan_in = math.prod(shape[a] for a in reduction_axes)
+        scale = np.full(
+            scale_shape, 1.0 / (127.0 * np.sqrt(fan_in)), np.float32
+        )
+        return {"q8": q8, "scale": scale}
+
+    def dense(shape, fan_in):
+        return (rng.standard_normal(shape, dtype=np.float32)
+                / np.sqrt(fan_in)).astype(np_dtype)
+
+    params: Params = {
+        "embed": dense((V, D), D),
+        "layers": {
+            "wq": q((L, D, H, hd), LAYER_REDUCTION_AXES["wq"]),
+            "wk": q((L, D, K, hd), LAYER_REDUCTION_AXES["wk"]),
+            "wv": q((L, D, K, hd), LAYER_REDUCTION_AXES["wv"]),
+            "wo": q((L, H, hd, D), LAYER_REDUCTION_AXES["wo"]),
+            "w_gate": q((L, D, F), LAYER_REDUCTION_AXES["w_gate"]),
+            "w_up": q((L, D, F), LAYER_REDUCTION_AXES["w_up"]),
+            "w_down": q((L, F, D), LAYER_REDUCTION_AXES["w_down"]),
+            "attn_norm": np.ones((L, D), np_dtype),
+            "mlp_norm": np.ones((L, D), np_dtype),
+        },
+        "final_norm": np.ones((D,), np_dtype),
+    }
+    if not config.tie_embeddings:
+        params["lm_head"] = q((D, V), LM_HEAD_REDUCTION_AXES)
+    return params

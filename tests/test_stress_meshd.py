@@ -1,0 +1,199 @@
+"""Stress + integration depth over the native broker.
+
+Reference anchors: tests/integration/test_fault_stress_kafka.py (concurrent
+faulting runs against a real broker), tests/integration/ MCP round-trips
+against an in-repo stdio server over a real transport.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+import pytest
+
+from calfkit_tpu.exceptions import NodeFaultError
+from calfkit_tpu.mesh.tcp import TcpMesh, find_meshd, spawn_meshd
+from calfkit_tpu.models import FaultTypes
+from calfkit_tpu.models.messages import (
+    ModelResponse,
+    TextOutput,
+    ToolCallOutput,
+)
+
+pytestmark = pytest.mark.skipif(
+    find_meshd() is None, reason="meshd not built (make -C native)"
+)
+
+PORT = 19879
+MCP_SERVER = [sys.executable, str(Path(__file__).parent / "_mcp_server.py")]
+
+
+@pytest.fixture(scope="module")
+def broker():
+    proc = spawn_meshd(PORT)
+    yield proc
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+async def _mesh():
+    mesh = TcpMesh(f"127.0.0.1:{PORT}")
+    await mesh.start()
+    return mesh
+
+
+class TestMCPOverTcp:
+    async def test_mcp_roundtrip_worker_and_client_separate_connections(
+        self, broker
+    ):
+        """The reference's MCP round-trip, over a real transport: stdio MCP
+        server subprocess -> toolbox node -> capability view -> agent ->
+        client, with worker and client on separate broker connections."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.mcp import MCPServerSpec, MCPToolboxNode, Toolbox
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        toolbox = MCPToolboxNode(MCPServerSpec(name="tcpcalc", command=MCP_SERVER))
+        turn = {"n": 0}
+
+        def model(messages, params):
+            turn["n"] += 1
+            if turn["n"] == 1:
+                assert any(
+                    t.name == "toolbox.tcpcalc__add" for t in params.tool_defs
+                )
+                return ModelResponse(parts=[ToolCallOutput(
+                    tool_call_id="c1", tool_name="toolbox.tcpcalc__add",
+                    args={"a": 40, "b": 2},
+                )])
+            return ModelResponse(parts=[TextOutput(text="sum says 42")])
+
+        agent = Agent(
+            "tcp_mathy", model=FunctionModelClient(model),
+            tools=Toolbox("tcpcalc"),
+        )
+        worker_mesh = await _mesh()
+        client_mesh = await _mesh()
+        async with Worker([agent, toolbox], mesh=worker_mesh):
+            client = Client.connect(client_mesh)
+            result = await client.agent("tcp_mathy").execute(
+                "add 40 and 2", timeout=30
+            )
+            assert result.output == "sum says 42"
+            await client.close()
+        await worker_mesh.stop()
+        await client_mesh.stop()
+
+
+class TestFaultStress:
+    async def test_concurrent_mixed_success_and_fault_runs(self, broker):
+        """24 concurrent runs, half faulting through a raising tool: every
+        run terminates correctly (right output XOR typed fault, no hangs,
+        no cross-run bleed) — the reference's fault-stress shape."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        @agent_tool
+        def stressed(x: int) -> str:
+            """Succeed on even, explode on odd.
+
+            Args:
+                x: Input.
+            """
+            if x % 2:
+                raise RuntimeError(f"boom-{x}")
+            return f"ok-{x}"
+
+        def model(messages, params):
+            # turn 1: call the tool with the number from the prompt;
+            # turn 2: report the tool result verbatim
+            last = messages[-1]
+            for part in last.parts:
+                if part.kind == "user":
+                    n = int(str(part.content).split()[-1])
+                    return ModelResponse(parts=[ToolCallOutput(
+                        tool_call_id=f"t{n}", tool_name="stressed",
+                        args={"x": n},
+                    )])
+            returns = [p for p in last.parts if p.kind == "tool_return"]
+            return ModelResponse(parts=[TextOutput(
+                text=str(returns[0].content)
+            )])
+
+        agent = Agent(
+            "stress_agent", model=FunctionModelClient(model), tools=[stressed]
+        )
+        worker_mesh = await _mesh()
+        client_mesh = await _mesh()
+        async with Worker([agent, stressed], mesh=worker_mesh, max_workers=16):
+            client = Client.connect(client_mesh)
+
+            async def one(i: int):
+                try:
+                    result = await client.agent("stress_agent").execute(
+                        f"run {i}", timeout=60
+                    )
+                    return ("ok", i, result.output)
+                except NodeFaultError as exc:
+                    return ("fault", i, exc.report)
+
+            outcomes = await asyncio.gather(*[one(i) for i in range(24)])
+            for kind, i, payload in outcomes:
+                if i % 2 == 0:
+                    assert kind == "ok", (i, payload)
+                    assert payload == f"ok-{i}"  # no cross-run bleed
+                else:
+                    assert kind == "fault", (i, payload)
+                    assert payload.error_type == FaultTypes.CALLEE_FAULT
+                    assert f"boom-{i}" in payload.root_cause().message
+            await client.close()
+        await worker_mesh.stop()
+        await client_mesh.stop()
+
+    async def test_steps_stay_run_scoped_under_load(self, broker):
+        """Concurrent runs' step streams never leak across handles."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        def model(messages, params):
+            for part in messages[-1].parts:
+                if part.kind == "user":
+                    return ModelResponse(parts=[TextOutput(
+                        text=f"echo {part.content}"
+                    )])
+            return ModelResponse(parts=[TextOutput(text="?")])
+
+        agent = Agent("steppy", model=FunctionModelClient(model))
+        worker_mesh = await _mesh()
+        client_mesh = await _mesh()
+        async with Worker([agent], mesh=worker_mesh):
+            client = Client.connect(client_mesh)
+
+            async def one(i: int):
+                handle = await client.agent("steppy").start(
+                    f"msg-{i}", timeout=30
+                )
+                texts = []
+                async for event in handle.stream():
+                    step = getattr(event, "step", None)
+                    if step is not None and getattr(step, "text", None):
+                        texts.append(step.text)
+                result = await handle.result(timeout=30)
+                return i, texts, result.output
+
+            results = await asyncio.gather(*[one(i) for i in range(12)])
+            for i, texts, output in results:
+                assert output == f"echo msg-{i}"
+                for text in texts:
+                    assert f"msg-{i}" in text  # only OWN steps observed
+            await client.close()
+        await worker_mesh.stop()
+        await client_mesh.stop()
