@@ -1,0 +1,105 @@
+"""Structured output + parallel tool fan-out in one run.
+
+The agent calls BOTH tools in one model turn (a durable fan-out batch: the
+folds survive worker crashes), then returns a typed ``TripPlan`` — the
+client gets a validated pydantic object, not prose.
+
+Run:  python examples/structured_fanout/trip_planner.py
+"""
+
+import asyncio
+
+from pydantic import BaseModel
+
+from calfkit_tpu import Agent, Client, Worker
+from calfkit_tpu.engine import FunctionModelClient
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models.messages import ModelResponse, TextOutput, ToolCallOutput
+from calfkit_tpu.nodes import agent_tool
+
+
+class TripPlan(BaseModel):
+    city: str
+    forecast: str
+    budget_eur: int
+
+
+@agent_tool
+def check_weather(city: str) -> str:
+    """Forecast for a city.
+
+    Args:
+        city: Where.
+    """
+    return f"sunny in {city}"
+
+
+@agent_tool
+def estimate_budget(city: str, days: int) -> int:
+    """Rough budget in EUR.
+
+    Args:
+        city: Where.
+        days: How long.
+    """
+    return 120 * days
+
+
+def plan_model(messages, params):
+    """A deterministic 'model': fan out both tools, then emit the plan.
+
+    Swap for JaxLocalModelClient(...) to serve a real model on TPU.
+    """
+    last = messages[-1]
+    returns = {
+        p.tool_name: p.content
+        for p in last.parts
+        if getattr(p, "kind", "") == "tool_return"
+    }
+    if not returns:  # first turn: one model turn, TWO tool calls → fan-out
+        return ModelResponse(parts=[
+            ToolCallOutput(tool_call_id="w1", tool_name="check_weather",
+                           args={"city": "Lisbon"}),
+            ToolCallOutput(tool_call_id="b1", tool_name="estimate_budget",
+                           args={"city": "Lisbon", "days": 4}),
+        ])
+    return ModelResponse(parts=[
+        TextOutput(text="Here is the plan."),
+        ToolCallOutput(
+            tool_call_id="f1", tool_name="final_result",
+            args={
+                "city": "Lisbon",
+                "forecast": str(returns["check_weather"]),
+                "budget_eur": int(returns["estimate_budget"]),
+            },
+        ),
+    ])
+
+
+planner = Agent(
+    "planner",
+    model=FunctionModelClient(plan_model),
+    tools=[check_weather, estimate_budget],
+    output_type=TripPlan,
+)
+
+
+async def main() -> None:
+    mesh = InMemoryMesh()
+    async with Worker([planner, check_weather, estimate_budget], mesh=mesh,
+                      owns_transport=True):
+        client = Client.connect(mesh)
+        gateway = client.agent("planner", output_type=TripPlan)
+        handle = await gateway.start("Plan 4 days in Lisbon")
+        async for event in handle.stream():
+            kind = getattr(getattr(event, "step", None), "kind", "?")
+            print(f"  [step] {kind}")
+        result = await handle.result(timeout=30)
+        plan = result.output
+        assert isinstance(plan, TripPlan)
+        print(f"PLAN: {plan.city}: {plan.forecast}, ~{plan.budget_eur} EUR")
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -137,7 +137,7 @@ class TestFaultStress:
             async def one(i: int):
                 try:
                     result = await client.agent("stress_agent").execute(
-                        f"run {i}", timeout=60
+                        f"run {i}", timeout=25
                     )
                     return ("ok", i, result.output)
                 except NodeFaultError as exc:
