@@ -132,6 +132,48 @@ def _gqa_scores_mask(
     return causal & valid
 
 
+def attn_qkv(
+    x: jax.Array,  # [B, S, D]
+    lp: Params,  # one layer's params
+    cos: jax.Array,
+    sin: jax.Array,
+    eps: float,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The block's attention front half: norm → QKV projections → rope.
+
+    Shared by prefill, decode, and the sequence-parallel ring — ONE place
+    for the projection math.
+    """
+    h = rms_norm(x, lp["attn_norm"], eps)
+    q = jnp.einsum("bsd,dnh->bsnh", h, _w(lp["wq"]))
+    k = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wk"]))
+    v = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wv"]))
+    return apply_rope(q, cos, sin), apply_rope(k, cos, sin), v
+
+
+def attn_out_mlp(
+    x: jax.Array,  # [B, S, D] residual stream
+    attn: jax.Array,  # [B, S, H, hd]
+    lp: Params,
+    eps: float,
+) -> jax.Array:
+    """The block's back half: output projection + residual + SwiGLU MLP."""
+    x = x + jnp.einsum("bsnh,nhd->bsd", attn, _w(lp["wo"]))
+    h = rms_norm(x, lp["mlp_norm"], eps)
+    gate = jnp.einsum("bsd,df->bsf", h, _w(lp["w_gate"]))
+    up = jnp.einsum("bsd,df->bsf", h, _w(lp["w_up"]))
+    return x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, _w(lp["w_down"]))
+
+
+def lm_logits(x: jax.Array, params: Params, eps: float) -> jax.Array:
+    """Final norm + (tied or untied) LM head."""
+    x = rms_norm(x, params["final_norm"], eps)
+    head = params.get("lm_head")
+    if head is None:
+        return jnp.einsum("bsd,vd->bsv", x, params["embed"])
+    return jnp.einsum("bsd,dv->bsv", x, _w(head))
+
+
 def attention_xla(
     q: jax.Array,  # [B, Sq, H, hd]
     k_cache: jax.Array,  # [B, K, Skv, hd]  (kv-head-major: contiguous scans)
@@ -204,23 +246,13 @@ def forward(
 
         The caller owns how pages are read/written (scan carry vs static).
         """
-        h = rms_norm(x, lp["attn_norm"], eps)
-        q = jnp.einsum("bsd,dnh->bsnh", h, _w(lp["wq"]))
-        k = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wk"]))
-        v = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wv"]))
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = attn_qkv(x, lp, cos, sin, eps)
         k_page = _insert_chunk(k_page, k, insert_at)
         v_page = _insert_chunk(v_page, v, insert_at)
         attn = attention_xla(
             q, k_page[:, :, :W], v_page[:, :, :W], positions, seq_lens
         )
-        x = x + jnp.einsum("bsnh,nhd->bsd", attn, _w(lp["wo"]))
-        h = rms_norm(x, lp["mlp_norm"], eps)
-        gate = jnp.einsum("bsd,df->bsf", h, _w(lp["w_gate"]))
-        up = jnp.einsum("bsd,df->bsf", h, _w(lp["w_up"]))
-        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, _w(lp["w_down"]))
-        return x, k_page, v_page
+        return attn_out_mlp(x, attn, lp, eps), k_page, v_page
 
     if unroll:
         new_k, new_v = k_pages, v_pages
@@ -242,12 +274,7 @@ def forward(
         (x, new_k, new_v, _), _ = lax.scan(
             layer_body, (x, k_pages, v_pages, jnp.int32(0)), layer_params
         )
-    x = rms_norm(x, params["final_norm"], eps)
-    head = params.get("lm_head")
-    if head is None:
-        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
-    else:
-        logits = jnp.einsum("bsd,dv->bsv", x, _w(head))
+    logits = lm_logits(x, params, eps)
     return logits, (new_k, new_v)
 
 
@@ -289,12 +316,7 @@ def _decode_step_with_ring(
     def layer_body(carry, inputs):
         x, ring_k, ring_v, i = carry
         lp, extra = inputs
-        h = rms_norm(x, lp["attn_norm"], eps)
-        q = jnp.einsum("bsd,dnh->bsnh", h, _w(lp["wq"]))
-        k = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wk"]))
-        v = jnp.einsum("bsd,dkh->bskh", h, _w(lp["wv"]))
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = attn_qkv(x, lp, cos, sin, eps)
         # dense ring write at (layer i, slot t) — no scatter anywhere
         slab = k[:, 0].astype(ring_k.dtype)[None, None]
         ring_k = lax.dynamic_update_slice(ring_k, slab, (i, t, 0, 0, 0))
@@ -307,24 +329,14 @@ def _decode_step_with_ring(
             lax.dynamic_index_in_dim(ring_v, i, 0, keepdims=False),
             extra,
         )
-        x = x + jnp.einsum("bsnh,nhd->bsd", attn, _w(lp["wo"]))
-        h = rms_norm(x, lp["mlp_norm"], eps)
-        gate = jnp.einsum("bsd,df->bsf", h, _w(lp["w_gate"]))
-        up = jnp.einsum("bsd,df->bsf", h, _w(lp["w_up"]))
-        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, _w(lp["w_down"]))
-        return (x, ring_k, ring_v, i + 1), None
+        return (attn_out_mlp(x, attn, lp, eps), ring_k, ring_v, i + 1), None
 
     (x, ring_k, ring_v, _), _ = lax.scan(
         layer_body,
         (x, ring_k, ring_v, jnp.int32(0)),
         (params["layers"], scan_xs),
     )
-    x = rms_norm(x, params["final_norm"], eps)
-    head = params.get("lm_head")
-    if head is None:
-        logits = jnp.einsum("bsd,vd->bsv", x, params["embed"])
-    else:
-        logits = jnp.einsum("bsd,dv->bsv", x, _w(head))
+    logits = lm_logits(x, params, eps)
     return logits, (ring_k, ring_v)
 
 

@@ -1,0 +1,166 @@
+"""Ring attention / sequence-parallel prefill: numerical parity on the
+virtual 8-device CPU mesh (the repo's multi-chip test contract)."""
+
+from __future__ import annotations
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from calfkit_tpu.inference import model as M
+from calfkit_tpu.inference.config import preset
+from calfkit_tpu.inference.ring_attention import (
+    prefill_sequence_parallel,
+    ring_attention,
+    single_device_causal_attention,
+)
+
+
+def _sp_mesh(n: int) -> Mesh:
+    devices = np.array(jax.devices()[:n])
+    return Mesh(devices, ("sp",))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("sp", [2, 4, 8])
+    def test_matches_single_device(self, sp):
+        mesh = _sp_mesh(sp)
+        B, S, H, K, hd = 2, 64, 4, 2, 16
+        ks = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(ks[0], (B, S, H, hd), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, K, hd), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, K, hd), jnp.float32)
+        want = single_device_causal_attention(q, k, v)
+        got = ring_attention(q, k, v, mesh)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+        )
+
+    def test_mha_no_grouping(self):
+        mesh = _sp_mesh(4)
+        B, S, H, hd = 1, 32, 4, 8
+        ks = jax.random.split(jax.random.key(1), 3)
+        q = jax.random.normal(ks[0], (B, S, H, hd), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, H, hd), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, H, hd), jnp.float32)
+        want = single_device_causal_attention(q, k, v)
+        got = ring_attention(q, k, v, mesh)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5
+        )
+
+    def test_rejects_indivisible_sequence(self):
+        mesh = _sp_mesh(8)
+        q = jnp.zeros((1, 30, 4, 8))
+        with pytest.raises(ValueError, match="divide"):
+            ring_attention(q, q[:, :, :2], q[:, :, :2], mesh)
+
+
+class TestSequenceParallelPrefill:
+    def test_matches_single_device_forward(self):
+        """The whole sp-sharded prefill — embeddings, rope, ring attention,
+        MLP, logits, KV — must match the plain forward."""
+        config = preset(
+            "debug", n_layers=2, n_heads=4, n_kv_heads=2, d_model=64,
+            d_ff=128, max_seq_len=64,
+        )
+        params = M.init_params(config, jax.random.key(2), dtype=jnp.float32)
+        B, S = 2, 64
+        tokens = jax.random.randint(jax.random.key(3), (B, S), 0, config.vocab_size)
+
+        # reference: plain single-device forward over a scratch cache
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        cache = M.make_empty_cache(config, B, S, dtype=jnp.float32)
+        logits, (k_ref, v_ref) = M.forward(
+            params, config, tokens, positions, cache,
+            jnp.full((B,), S, jnp.int32),
+        )
+        want_last = logits[:, -1]
+
+        mesh = _sp_mesh(8)
+        got_last, (k_sp, v_sp) = prefill_sequence_parallel(
+            params, config, tokens, mesh
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_last), np.asarray(want_last), atol=2e-4, rtol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(k_sp), np.asarray(k_ref), atol=1e-5, rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(v_sp), np.asarray(v_ref), atol=1e-5, rtol=1e-5
+        )
+
+    def test_kv_stays_sequence_sharded(self):
+        """The produced cache must remain sharded over sp (context-parallel
+        decode / resharding is the caller's choice, not forced here)."""
+        config = preset(
+            "debug", n_layers=1, n_heads=4, n_kv_heads=2, d_model=64,
+            d_ff=128, max_seq_len=64,
+        )
+        params = M.init_params(config, jax.random.key(4), dtype=jnp.float32)
+        mesh = _sp_mesh(8)
+        tokens = jnp.ones((1, 64), jnp.int32)
+        _, (k_sp, _) = prefill_sequence_parallel(params, config, tokens, mesh)
+        sharding = k_sp.sharding
+        # the S axis (index 3 of [L, B, K, S, hd]) is the sharded one
+        assert "sp" in str(sharding.spec)
+
+
+class TestRaggedLengths:
+    def test_ragged_seq_lens_match_dense(self):
+        """Padded rows must ignore pad positions (review r2: validity mask)."""
+        mesh = _sp_mesh(4)
+        B, S, H, K, hd = 3, 64, 4, 2, 16
+        ks = jax.random.split(jax.random.key(9), 3)
+        q = jax.random.normal(ks[0], (B, S, H, hd), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, K, hd), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, K, hd), jnp.float32)
+        lens = jnp.array([64, 37, 5])
+        want = single_device_causal_attention(q, k, v, seq_lens=lens)
+        got = ring_attention(q, k, v, mesh, seq_lens=lens)
+        for b in range(B):
+            n = int(lens[b])
+            np.testing.assert_allclose(
+                np.asarray(got)[b, :n], np.asarray(want)[b, :n],
+                atol=1e-5, rtol=1e-5,
+            )
+
+    def test_prefill_ragged_last_logits(self):
+        """last_logits reads each row's LAST VALID position, and valid KV
+        matches the dense forward."""
+        config = preset(
+            "debug", n_layers=2, n_heads=4, n_kv_heads=2, d_model=64,
+            d_ff=128, max_seq_len=64,
+        )
+        params = M.init_params(config, jax.random.key(5), dtype=jnp.float32)
+        B, S = 2, 64
+        tokens = jax.random.randint(jax.random.key(6), (B, S), 0,
+                                    config.vocab_size)
+        lens = jnp.array([64, 40])
+
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        cache = M.make_empty_cache(config, B, S, dtype=jnp.float32)
+        logits, (k_ref, _) = M.forward(
+            params, config, tokens, positions, cache, lens
+        )
+        want = jnp.take_along_axis(
+            logits, jnp.clip(lens - 1, 0, S - 1)[:, None, None], axis=1
+        )[:, 0]
+
+        mesh = _sp_mesh(8)
+        got, (k_sp, _) = prefill_sequence_parallel(
+            params, config, tokens, mesh, seq_lens=lens
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4
+        )
+        for b in range(B):
+            n = int(lens[b])
+            np.testing.assert_allclose(
+                np.asarray(k_sp)[:, b, :, :n], np.asarray(k_ref)[:, b, :, :n],
+                atol=1e-5, rtol=1e-5,
+            )

@@ -13,7 +13,7 @@ from pathlib import Path
 
 import pytest
 
-from calfkit_tpu.exceptions import NodeFaultError
+from calfkit_tpu.exceptions import ClientTimeoutError, NodeFaultError
 from calfkit_tpu.mesh.tcp import TcpMesh, find_meshd, spawn_meshd
 from calfkit_tpu.models import FaultTypes
 from calfkit_tpu.models.messages import (
@@ -142,8 +142,12 @@ class TestFaultStress:
                     return ("ok", i, result.output)
                 except NodeFaultError as exc:
                     return ("fault", i, exc.report)
+                except ClientTimeoutError:
+                    return ("timeout", i, None)
 
             outcomes = await asyncio.gather(*[one(i) for i in range(24)])
+            timeouts = [i for kind, i, _ in outcomes if kind == "timeout"]
+            assert not timeouts, f"runs timed out (broker stall?): {timeouts}"
             for kind, i, payload in outcomes:
                 if i % 2 == 0:
                     assert kind == "ok", (i, payload)
