@@ -30,6 +30,7 @@ Design notes (tpu-first, not a port):
 
 from __future__ import annotations
 
+import functools
 import inspect
 import math
 from functools import partial
@@ -205,40 +206,51 @@ def prefill_sequence_parallel(
     leaving block-wise; the serving engine uses it when a prompt exceeds
     single-chip prefill capacity.
     """
-    from calfkit_tpu.inference import model as M
-
     B, S = tokens.shape
     sp = mesh.shape[axis]
     if S % sp:
         raise ValueError(f"prompt length {S} must divide over {axis}={sp}")
     if seq_lens is None:
         seq_lens = jnp.full((B,), S, jnp.int32)
-    eps = config.norm_eps
 
     tok_spec = P(None, axis)
     tokens = jax.device_put(tokens, NamedSharding(mesh, tok_spec))
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     positions = jax.device_put(positions, NamedSharding(mesh, tok_spec))
 
-    x = params["embed"][tokens]  # [B, S, D] sequence-sharded (gather)
-    cos, sin = M.rope_tables(positions, config.head_dim, config.rope_theta)
+    fn = _prefill_sp_jit(config, mesh, axis)
+    return fn(params, tokens, positions, seq_lens.astype(jnp.int32))
 
-    def layer_body(x, lp):
-        q, k, v = M.attn_qkv(x, lp, cos, sin, eps)
-        attn = ring_attention(q, k, v, mesh, axis=axis, seq_lens=seq_lens)
-        return M.attn_out_mlp(x, attn, lp, eps), (k, v)
 
-    x, (ks, vs) = lax.scan(layer_body, x, params["layers"])
-    # ks/vs: [L, B, S, K, hd] sequence-sharded; cache layout wants K-major
-    k_cache = jnp.swapaxes(ks, 2, 3)  # [L, B, K, S, hd]
-    v_cache = jnp.swapaxes(vs, 2, 3)
+@functools.lru_cache(maxsize=32)
+def _prefill_sp_jit(config, mesh: Mesh, axis: str):
+    """One traced+compiled sp prefill per (config, mesh, axis) — eager
+    re-tracing of the L-layer scan per call would dominate short prompts."""
+    from calfkit_tpu.inference import model as M
 
-    # gather the last-valid hidden state FIRST, then the head: computing
-    # full-sequence logits would materialize [B, S, V] (gigabytes at 128k
-    # vocab and long S) for one row each
-    idx = jnp.clip(seq_lens - 1, 0, S - 1)
-    x_last = jnp.take_along_axis(
-        x, idx[:, None, None], axis=1
-    )  # [B, 1, D]
-    last_logits = M.lm_logits(x_last, params, eps)[:, 0]
-    return last_logits, (k_cache, v_cache)
+    eps = config.norm_eps
+
+    def fn(params, tokens, positions, seq_lens):
+        S = tokens.shape[1]
+        x = params["embed"][tokens]  # [B, S, D] sequence-sharded (gather)
+        cos, sin = M.rope_tables(positions, config.head_dim, config.rope_theta)
+
+        def layer_body(x, lp):
+            q, k, v = M.attn_qkv(x, lp, cos, sin, eps)
+            attn = ring_attention(q, k, v, mesh, axis=axis, seq_lens=seq_lens)
+            return M.attn_out_mlp(x, attn, lp, eps), (k, v)
+
+        x, (ks, vs) = lax.scan(layer_body, x, params["layers"])
+        # ks/vs: [L, B, S, K, hd] sequence-sharded; cache wants K-major
+        k_cache = jnp.swapaxes(ks, 2, 3)  # [L, B, K, S, hd]
+        v_cache = jnp.swapaxes(vs, 2, 3)
+
+        # gather the last-valid hidden state FIRST, then the head:
+        # full-sequence logits would materialize [B, S, V] (gigabytes at
+        # 128k vocab and long S) for one row each
+        idx = jnp.clip(seq_lens - 1, 0, S - 1)
+        x_last = jnp.take_along_axis(x, idx[:, None, None], axis=1)
+        last_logits = M.lm_logits(x_last, params, eps)[:, 0]
+        return last_logits, (k_cache, v_cache)
+
+    return jax.jit(fn)

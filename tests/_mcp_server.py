@@ -7,6 +7,11 @@ import sys
 
 TOOLS = [
     {
+        "name": "grow",
+        "description": "Add a new tool to this server (emits list_changed).",
+        "inputSchema": {"type": "object", "properties": {}},
+    },
+    {
         "name": "add",
         "description": "Add two integers.",
         "inputSchema": {
@@ -32,7 +37,26 @@ def reply(rpc_id, result):
     sys.stdout.flush()
 
 
+GROWN = [
+    {
+        "name": "extra_shout",
+        "description": "Uppercase twice.",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"text": {"type": "string"}},
+            "required": ["text"],
+        },
+    },
+]
+
+
+def notify(method):
+    sys.stdout.write(json.dumps({"jsonrpc": "2.0", "method": method}) + "\n")
+    sys.stdout.flush()
+
+
 def main() -> None:
+    tools = list(TOOLS)
     for line in sys.stdin:
         try:
             message = json.loads(line)
@@ -47,7 +71,7 @@ def main() -> None:
                 "serverInfo": {"name": "test-mcp", "version": "0"},
             })
         elif method == "tools/list":
-            reply(rpc_id, {"tools": TOOLS})
+            reply(rpc_id, {"tools": tools})
         elif method == "tools/call":
             name = message["params"]["name"]
             args = message["params"].get("arguments", {})
@@ -55,6 +79,16 @@ def main() -> None:
                 text = str(args["a"] + args["b"])
             elif name == "shout":
                 text = str(args["text"]).upper()
+            elif name == "grow":
+                # mutate the tool list + emit the list_changed notification
+                tools = TOOLS + GROWN
+                reply(rpc_id, {"content": [{"type": "text", "text": "grown"}]})
+                notify("notifications/tools/list_changed")
+                continue
+            elif name == "extra_shout" and any(
+                t["name"] == "extra_shout" for t in tools
+            ):
+                text = str(args["text"]).upper() * 2
             else:
                 sys.stdout.write(json.dumps({
                     "jsonrpc": "2.0", "id": rpc_id,

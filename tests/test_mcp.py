@@ -1,6 +1,7 @@
 """MCP toolbox: stdio round trip through a real subprocess server, selector
 trust boundary, agent integration."""
 
+import asyncio
 import sys
 from pathlib import Path
 
@@ -22,7 +23,7 @@ class TestMCPSession:
         session = MCPSession(MCPServerSpec(name="t", command=SERVER))
         await session.start()
         tools = await session.list_tools()
-        assert {t["name"] for t in tools} == {"add", "shout"}
+        assert {t["name"] for t in tools} == {"grow", "add", "shout"}
         assert await session.call_tool("add", {"a": 2, "b": 3}) == "5"
         assert await session.call_tool("shout", {"text": "hi"}) == "HI"
         with pytest.raises(Exception):
@@ -71,4 +72,35 @@ class TestToolboxNode:
             allowed = Toolbox("locked", include=["shout"]).resolve(records)
             assert [b.tool.name for b in allowed] == ["toolbox.locked__shout"]
             everything = Toolbox("locked").resolve(records)
-            assert len(everything) == 2
+            assert len(everything) == 3
+
+
+class TestListChanged:
+    async def test_tools_list_changed_refreshes_advert(self):
+        """A server-side tools/list_changed notification re-lists off the
+        receive loop and the NEW tool appears in the capability record
+        (heartbeats re-derive the record, so the mesh view follows within
+        one interval)."""
+        toolbox = MCPToolboxNode(MCPServerSpec(name="grower", command=SERVER))
+        await toolbox.start_session()
+        try:
+            before = {t.name for t in toolbox.capability_record().tools}
+            assert "toolbox.grower__extra_shout" not in before
+
+            result = await toolbox._session.call_tool("grow", {})
+            assert "grown" in str(result)
+            # the notification arrives async; the relist follows it
+            for _ in range(100):
+                names = {t.name for t in toolbox.capability_record().tools}
+                if "toolbox.grower__extra_shout" in names:
+                    break
+                await asyncio.sleep(0.05)
+            assert "toolbox.grower__extra_shout" in names
+
+            # and the new tool is callable through the session
+            doubled = await toolbox._session.call_tool(
+                "extra_shout", {"text": "ab"}
+            )
+            assert "ABAB" in str(doubled)
+        finally:
+            await toolbox.stop_session()

@@ -201,3 +201,66 @@ class TestFaultStress:
             await client.close()
         await worker_mesh.stop()
         await client_mesh.stop()
+
+
+class TestHorizontalScaling:
+    async def test_two_workers_share_one_agents_runs(self, broker):
+        """The DP analog (SURVEY §2.4): two Worker replicas hosting the SAME
+        agent share the consumer group — runs distribute across them, each
+        run stays whole (per-key serial), and every reply is correct."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        served_by: dict[str, list[int]] = {"a": [], "b": []}
+
+        def make_agent(tag: str) -> Agent:
+            def model(messages, params):
+                for part in messages[-1].parts:
+                    if part.kind == "user":
+                        n = int(str(part.content).split()[-1])
+                        served_by[tag].append(n)
+                        return ModelResponse(parts=[TextOutput(
+                            text=f"answer {n}"
+                        )])
+                return ModelResponse(parts=[TextOutput(text="?")])
+
+            return Agent("scaled_agent", model=FunctionModelClient(model))
+
+        mesh_a = await _mesh()
+        mesh_b = await _mesh()
+        client_mesh = await _mesh()
+        worker_a = Worker([make_agent("a")], mesh=mesh_a)
+        worker_b = Worker([make_agent("b")], mesh=mesh_b)
+        await worker_a.start()
+        await worker_b.start()
+        try:
+            client = Client.connect(client_mesh)
+            # warm-up: poll until BOTH members actually serve (fixed sleeps
+            # flake on loaded CI; rebalance timing is the broker's business)
+            probe = 1000
+            deadline = asyncio.get_event_loop().time() + 20
+            while not (served_by["a"] and served_by["b"]):
+                assert asyncio.get_event_loop().time() < deadline, served_by
+                await client.agent("scaled_agent").execute(
+                    f"q {probe}", timeout=25
+                )
+                probe += 1
+            results = await asyncio.gather(*[
+                client.agent("scaled_agent").execute(f"q {i}", timeout=25)
+                for i in range(24)
+            ])
+            for i, result in enumerate(results):
+                assert result.output == f"answer {i}"
+            served = sorted(
+                n for n in served_by["a"] + served_by["b"] if n < 1000
+            )
+            assert served == list(range(24))
+            await client.close()
+        finally:
+            await worker_a.stop()
+            await worker_b.stop()
+            await mesh_a.stop()
+            await mesh_b.stop()
+            await client_mesh.stop()
