@@ -218,7 +218,12 @@ def prefill_sequence_parallel(
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     positions = jax.device_put(positions, NamedSharding(mesh, tok_spec))
 
-    fn = _prefill_sp_jit(config, mesh, axis)
+    try:
+        fn = _prefill_sp_jit(config, mesh, axis)
+    except TypeError:
+        # unhashable config/mesh: fall back to an uncached jit (correct,
+        # just re-traced per call) rather than narrowing the contract
+        fn = _build_prefill_sp(config, mesh, axis)
     return fn(params, tokens, positions, seq_lens.astype(jnp.int32))
 
 
@@ -226,6 +231,10 @@ def prefill_sequence_parallel(
 def _prefill_sp_jit(config, mesh: Mesh, axis: str):
     """One traced+compiled sp prefill per (config, mesh, axis) — eager
     re-tracing of the L-layer scan per call would dominate short prompts."""
+    return _build_prefill_sp(config, mesh, axis)
+
+
+def _build_prefill_sp(config, mesh: Mesh, axis: str):
     from calfkit_tpu.inference import model as M
 
     eps = config.norm_eps

@@ -1,6 +1,8 @@
 """A tiny in-repo stdio MCP server for round-trip tests (the analog of the
 reference's tests/integration/_mcp_roundtrip_server.py): newline-delimited
-JSON-RPC with two tools."""
+JSON-RPC with three static tools (grow/add/shout); calling ``grow`` adds a
+fourth (``extra_shout``) and emits notifications/tools/list_changed — the
+per-connection mutable list exercises the toolbox relist path."""
 
 import json
 import sys

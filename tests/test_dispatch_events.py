@@ -1,0 +1,165 @@
+"""Direct unit coverage for the concurrency substrate: the key-ordered
+dispatcher, the firehose stream, and client handle cancel-safety.
+
+Reference anchors: the key-ordered subscriber semantics
+(calfkit/_faststream_ext/_subscriber.py:102-350 — lanes, serial-per-key,
+bounded in-flight, graceful drain, keyless warning, semaphore tripwire) and
+the firehose (client/events.py:26-157 — bounded drop-oldest + counter).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
+from calfkit_tpu.mesh.transport import Record
+
+
+def _rec(key: bytes | None, value: bytes = b"") -> Record:
+    return Record(topic="t", key=key, value=value)
+
+
+class TestKeyOrderedDispatcher:
+    async def test_serial_per_key_parallel_across_keys(self):
+        """A slow key must not block other keys; per-key order holds."""
+        order: dict[bytes, list[int]] = {}
+        slow_started = asyncio.Event()
+        release_slow = asyncio.Event()
+
+        async def handler(record: Record) -> None:
+            if record.key == b"slow":
+                slow_started.set()
+                await release_slow.wait()
+            order.setdefault(record.key, []).append(int(record.value))
+
+        dispatcher = KeyOrderedDispatcher(handler, max_workers=4)
+        dispatcher.start()
+        await dispatcher.submit(_rec(b"slow", b"0"))
+        await slow_started.wait()
+        for i in range(5):
+            await dispatcher.submit(_rec(b"fast", str(i).encode()))
+        for _ in range(100):
+            if len(order.get(b"fast", [])) == 5:
+                break
+            await asyncio.sleep(0.02)
+        assert order[b"fast"] == [0, 1, 2, 3, 4]  # progressed AND ordered
+        assert order.get(b"slow", []) == []  # still parked
+        release_slow.set()
+        await dispatcher.stop()
+        assert order[b"slow"] == [0]
+
+    async def test_same_key_never_interleaves(self):
+        active = {"n": 0, "max": 0}
+        out: list[int] = []
+
+        async def handler(record: Record) -> None:
+            active["n"] += 1
+            active["max"] = max(active["max"], active["n"])
+            await asyncio.sleep(0.001)
+            out.append(int(record.value))
+            active["n"] -= 1
+
+        dispatcher = KeyOrderedDispatcher(handler, max_workers=4)
+        dispatcher.start()
+        for i in range(20):
+            await dispatcher.submit(_rec(b"k", str(i).encode()))
+        await dispatcher.stop()
+        assert out == list(range(20))
+        assert active["max"] == 1  # strictly serial for one key
+
+    async def test_stop_drains_in_flight(self):
+        done: list[int] = []
+
+        async def handler(record: Record) -> None:
+            await asyncio.sleep(0.01)
+            done.append(int(record.value))
+
+        dispatcher = KeyOrderedDispatcher(handler, max_workers=2)
+        dispatcher.start()
+        for i in range(6):
+            await dispatcher.submit(_rec(f"k{i}".encode(), str(i).encode()))
+        await dispatcher.stop()
+        assert sorted(done) == list(range(6))  # nothing abandoned
+
+    async def test_handler_exception_does_not_kill_lane(self):
+        seen: list[int] = []
+
+        async def handler(record: Record) -> None:
+            n = int(record.value)
+            if n == 1:
+                raise RuntimeError("hostile delivery")
+            seen.append(n)
+
+        dispatcher = KeyOrderedDispatcher(handler, max_workers=2)
+        dispatcher.start()
+        for i in range(4):
+            await dispatcher.submit(_rec(b"k", str(i).encode()))
+        await dispatcher.stop()
+        assert seen == [0, 2, 3]  # the lane survived the raise
+
+
+class TestEventStream:
+    async def test_drop_oldest_with_counter(self):
+        from calfkit_tpu.client.events import EventStream
+
+        stream = EventStream(buffer=3)
+        for i in range(10):
+            stream.push(i)  # type: ignore[arg-type]
+        assert stream.dropped > 0
+        stream.close()
+        got = [e async for e in stream]
+        assert len(got) <= 4
+        assert got[-1] == 9  # newest survives, oldest dropped
+
+    async def test_close_wakes_parked_consumer(self):
+        from calfkit_tpu.client.events import EventStream
+
+        stream = EventStream(buffer=4)
+
+        async def consume():
+            return [e async for e in stream]
+
+        task = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)  # consumer parks on an empty queue
+        stream.push("only")  # type: ignore[arg-type]
+        await asyncio.sleep(0.05)
+        stream.close()
+        got = await asyncio.wait_for(task, timeout=5)
+        assert got == ["only"]
+
+    async def test_push_after_close_is_noop(self):
+        from calfkit_tpu.client.events import EventStream
+
+        stream = EventStream(buffer=4)
+        stream.close()
+        stream.push("late")  # type: ignore[arg-type]
+        assert [e async for e in stream] == []
+
+
+class TestHandleCancelSafety:
+    async def test_cancelled_result_waiter_does_not_poison_handle(self):
+        """Cancel one result() waiter mid-wait; a later result() on the same
+        handle must still complete (reference: hub.py cancel-safe channel)."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        mesh = InMemoryMesh()
+        agent = Agent(
+            "cancelsafe", model=TestModelClient(custom_output_text="finished")
+        )
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            handle = await client.agent("cancelsafe").start("go")
+            waiter = asyncio.create_task(handle.result(timeout=30))
+            await asyncio.sleep(0)  # let it park
+            waiter.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await waiter
+            result = await handle.result(timeout=30)
+            assert result.output == "finished"
+            await client.close()
