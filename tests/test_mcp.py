@@ -104,3 +104,83 @@ class TestListChanged:
             assert "ABAB" in str(doubled)
         finally:
             await toolbox.stop_session()
+
+
+class TestHTTPTransport:
+    async def test_http_roundtrip_json_and_sse(self):
+        """The streamable-HTTP path: initialize + tools/list as plain JSON,
+        tools/call answered as an SSE event stream (both response shapes the
+        spec allows)."""
+        import json as _json
+        import threading
+        from http.server import BaseHTTPRequestHandler, HTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = _json.loads(
+                    self.rfile.read(int(self.headers["Content-Length"]))
+                )
+                method = body.get("method")
+                rpc_id = body.get("id")
+                if rpc_id is None:  # notification
+                    self.send_response(202)
+                    self.end_headers()
+                    return
+                if method == "initialize":
+                    result = {
+                        "protocolVersion": body["params"]["protocolVersion"],
+                        "capabilities": {"tools": {}},
+                        "serverInfo": {"name": "http-mcp", "version": "0"},
+                    }
+                elif method == "tools/list":
+                    result = {"tools": [{
+                        "name": "ping",
+                        "description": "Pong.",
+                        "inputSchema": {"type": "object", "properties": {}},
+                    }]}
+                elif method == "tools/call":
+                    # answer as an SSE stream: the transport must dig the
+                    # matching id out of the data: lines
+                    payload = _json.dumps({
+                        "jsonrpc": "2.0", "id": rpc_id,
+                        "result": {"content": [{"type": "text",
+                                                "text": "pong"}]},
+                    })
+                    blob = f"event: message\ndata: {payload}\n\n".encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/event-stream")
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                    return
+                else:
+                    result = {}
+                blob = _json.dumps(
+                    {"jsonrpc": "2.0", "id": rpc_id, "result": result}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        server = HTTPServer(("127.0.0.1", 0), Handler)
+        thread = threading.Thread(target=server.serve_forever, daemon=True)
+        thread.start()
+        try:
+            url = f"http://127.0.0.1:{server.server_port}/mcp"
+            session = MCPSession(MCPServerSpec(name="httpbox", url=url))
+            await session.start()
+            try:
+                tools = await session.list_tools()
+                assert [t["name"] for t in tools] == ["ping"]
+                out = await session.call_tool("ping", {})
+                assert "pong" in str(out)
+            finally:
+                await session.stop()
+        finally:
+            server.shutdown()
+            thread.join(timeout=5)
