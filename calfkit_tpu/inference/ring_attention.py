@@ -263,3 +263,170 @@ def _build_prefill_sp(config, mesh: Mesh, axis: str):
         return last_logits, (k_cache, v_cache)
 
     return jax.jit(fn)
+
+
+# --------------------------------------------------------------------------- #
+# context-parallel decode over a sequence-sharded prefix
+# --------------------------------------------------------------------------- #
+
+
+def context_parallel_attention(
+    q: jax.Array,  # [B, 1, H, hd] one decode step's queries
+    k_prefix: jax.Array,  # [B, K, S, hd] sequence-sharded over `axis` (dim 2)
+    v_prefix: jax.Array,
+    prefix_lens: jax.Array,  # [B] valid prefix tokens
+    mesh: Mesh,
+    *,
+    axis: str = "sp",
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Decode attention over a prefix that STAYS sequence-sharded.
+
+    Each device scores its own shard (no rotation needed — a decode query
+    attends everywhere, so partial (o, m, z) merge exactly via the global
+    max + rescaled sums: two psum/pmax collectives instead of moving any
+    KV).  Returns the (unnormalized o [B,K,G,hd], m [B,K,G,1], z [B,K,G,1])
+    triple for :func:`model.logsumexp_merge` with the fresh-token source —
+    the seam that makes ring-prefilled caches directly decodable.
+    """
+    B, _, H, hd = q.shape
+    Kh = k_prefix.shape[1]
+    G = H // Kh
+    S = k_prefix.shape[2]
+    sp = mesh.shape[axis]
+    if S % sp:
+        raise ValueError(f"prefix length {S} must divide over {axis}={sp}")
+    blk = S // sp
+    scale = 1.0 / math.sqrt(hd)
+
+    q_spec = P(None, None, None, None)
+    kv_spec = P(None, None, axis, None)
+    len_spec = P(None)
+    out_spec = P(None, None, None, None)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(q_spec, kv_spec, kv_spec, len_spec),
+        out_specs=(out_spec, out_spec, out_spec),
+        check_rep=False,
+    )
+    def cp(qr, kb, vb, lens):
+        my_idx = lax.axis_index(axis)
+        qg = (qr[:, 0] * scale).astype(jnp.float32).reshape(B, Kh, G, hd)
+        pos = my_idx * blk + jnp.arange(blk)  # this shard's absolute span
+        s = jnp.einsum(
+            "bkgh,bksh->bkgs", qg, kb.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        valid = pos[None, :] < lens[:, None]  # [B, blk]
+        s = jnp.where(valid[:, None, None], s, -1e30)
+        m = jnp.max(s, axis=-1, keepdims=True)
+        m = jnp.maximum(m, -1e29)
+        p = jnp.exp(s - m)
+        z = jnp.sum(p, axis=-1, keepdims=True)
+        o = jnp.einsum(
+            "bkgs,bksh->bkgh", p, vb.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        # exact global merge: rescale every shard to the global max, sum
+        m_all = lax.pmax(m, axis)
+        w = jnp.exp(m - m_all)
+        o_all = lax.psum(o * w, axis)
+        z_all = lax.psum(z * w, axis)
+        return o_all, m_all, z_all
+
+    return cp(q, k_prefix, v_prefix, prefix_lens.astype(jnp.int32))
+
+
+def decode_with_sharded_prefix(
+    params: dict,
+    config,
+    first_token: jax.Array,  # [B] the token sampled from the prefill logits
+    prefix: tuple[jax.Array, jax.Array],  # [L, B, K, S, hd] sharded over axis
+    prefix_lens: jax.Array,  # [B]
+    mesh: Mesh,
+    steps: int,
+    *,
+    axis: str = "sp",
+) -> jax.Array:
+    """Greedy-decode ``steps`` tokens directly against a ring-prefilled,
+    still-sequence-sharded KV prefix — no resharding, no consolidation.
+
+    Fresh K/V accumulates in a small replicated cache ([L, B, K, steps, hd])
+    merged with the context-parallel prefix source via the shared logsumexp
+    law.  → [B, steps] int32 greedy tokens.  (The continuous-batching
+    engine remains the short-context path; this is the long-context serving
+    seam for prompts that had to prefill across chips.)
+    """
+    k_prefix, v_prefix = prefix
+    try:
+        fn = _decode_sp_jit(config, mesh, axis, steps, first_token.shape[0])
+    except TypeError:  # unhashable config/mesh: uncached fallback
+        fn = _build_decode_sp(config, mesh, axis, steps, first_token.shape[0])
+    return fn(params, first_token, k_prefix, v_prefix, prefix_lens)
+
+
+@functools.lru_cache(maxsize=32)
+def _decode_sp_jit(config, mesh: Mesh, axis: str, steps: int, B: int):
+    """One compile per (config, mesh, axis, steps, B) — the multi-step
+    decode program is seconds of trace+compile per shape."""
+    return _build_decode_sp(config, mesh, axis, steps, B)
+
+
+def _build_decode_sp(config, mesh: Mesh, axis: str, steps: int, B: int):
+    from calfkit_tpu.inference import model as M
+
+    L = config.n_layers
+    Kh, hd, eps = config.n_kv_heads, config.head_dim, config.norm_eps
+
+    def fn(params, first_token, k_prefix, v_prefix, prefix_lens):
+        fresh = (
+            jnp.zeros((L, B, Kh, steps, hd), jnp.float32),
+            jnp.zeros((L, B, Kh, steps, hd), jnp.float32),
+        )
+
+        def one_step(carry, t):
+            token, fresh = carry
+            fresh_k, fresh_v = fresh
+            positions = (prefix_lens + t)[:, None]
+            x = params["embed"][token[:, None]]
+            cos, sin = M.rope_tables(positions, hd, config.rope_theta)
+
+            def layer_body(x, inputs):
+                lp, kp, vp, fk, fv = inputs
+                q, k, v = M.attn_qkv(x, lp, cos, sin, eps)
+                fk = lax.dynamic_update_slice(
+                    fk, jnp.swapaxes(k, 1, 2).astype(fk.dtype), (0, 0, t, 0)
+                )
+                fv = lax.dynamic_update_slice(
+                    fv, jnp.swapaxes(v, 1, 2).astype(fv.dtype), (0, 0, t, 0)
+                )
+                o1, m1, z1 = context_parallel_attention(
+                    q, kp, vp, prefix_lens, mesh, axis=axis
+                )
+                qg = q.reshape(B, Kh, -1, hd)
+                o2, m2, z2 = M.ring_attention_source(
+                    qg,
+                    jnp.transpose(fk, (2, 0, 1, 3)),  # -> [steps, B, K, hd]
+                    jnp.transpose(fv, (2, 0, 1, 3)),
+                    t,
+                )
+                attn = M.logsumexp_merge((o1, m1, z1), (o2, m2, z2))
+                attn = attn.reshape(B, 1, -1, hd).astype(x.dtype)
+                return M.attn_out_mlp(x, attn, lp, eps), (fk, fv)
+
+            x, (fresh_k, fresh_v) = lax.scan(
+                layer_body,
+                x,
+                (params["layers"], k_prefix, v_prefix, fresh_k, fresh_v),
+            )
+            logits = M.lm_logits(x, params, eps)[:, -1]
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt, (fresh_k, fresh_v)), nxt
+
+        (_, _), toks = lax.scan(
+            one_step, (first_token, fresh), jnp.arange(steps)
+        )
+        return jnp.swapaxes(toks, 0, 1)  # [B, steps]
+
+    return jax.jit(fn)
