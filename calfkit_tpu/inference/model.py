@@ -387,25 +387,38 @@ def _merged_decode_attention(
     B, _, H, hd = q.shape
     K = k_cache.shape[1]
     G = H // K
-    T = ring_k.shape[0]
-    scale = 1.0 / math.sqrt(hd)
     qg = q.reshape(B, K, G, hd)
 
     # source 1: the main cache
-    s1 = _einsum_f32("bkgh,bksh->bkgs", qg, k_cache) * scale  # [B,K,G,W]
-    valid1 = (jnp.arange(k_cache.shape[2])[None, :] < base_lens[:, None])[
-        :, None, None, :
-    ]
-    s1 = jnp.where(valid1, s1, -1e30)
-    m1 = jnp.max(s1, axis=-1, keepdims=True)
-    m1 = jnp.maximum(m1, -1e29)  # fresh rows: keep finite
-    p1 = jnp.exp(s1 - m1).astype(k_cache.dtype)
-    z1 = jnp.sum(p1.astype(jnp.float32), axis=-1, keepdims=True)
-    o1 = _einsum_f32("bkgs,bksh->bkgh", p1, v_cache)
+    valid1 = jnp.arange(k_cache.shape[2])[None, :] < base_lens[:, None]
+    o1, m1, z1 = masked_attention_source(qg, k_cache, v_cache, valid1)
 
     o2, m2, z2 = ring_attention_source(qg, ring_k, ring_v, t)
     out = logsumexp_merge((o1, m1, z1), (o2, m2, z2))
     return out.reshape(B, 1, H, hd).astype(q.dtype)
+
+
+def masked_attention_source(
+    qg: jax.Array,  # [B, K, G, hd] (unscaled)
+    k_cache: jax.Array,  # [B, K, S, hd]
+    v_cache: jax.Array,
+    valid: jax.Array,  # [B, S] bool — attendable positions
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """One masked flash-stats attention source → (o unnormalized, m, z).
+
+    The numerically delicate idiom (-1e30 mask → running max → -1e29
+    finite-floor clamp → exp/z) lives HERE once; the dense decode merge and
+    the context-parallel shard source both call it.
+    """
+    scale = 1.0 / math.sqrt(qg.shape[-1])
+    s = _einsum_f32("bkgh,bksh->bkgs", qg, k_cache) * scale
+    s = jnp.where(valid[:, None, None, :], s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    m = jnp.maximum(m, -1e29)  # fully-masked rows stay finite
+    p = jnp.exp(s - m).astype(k_cache.dtype)
+    z = jnp.sum(p.astype(jnp.float32), axis=-1, keepdims=True)
+    o = _einsum_f32("bkgs,bksh->bkgh", p, v_cache)
+    return o, m, z
 
 
 def ring_attention_source(

@@ -296,7 +296,6 @@ def context_parallel_attention(
     if S % sp:
         raise ValueError(f"prefix length {S} must divide over {axis}={sp}")
     blk = S // sp
-    scale = 1.0 / math.sqrt(hd)
 
     q_spec = P(None, None, None, None)
     kv_spec = P(None, None, axis, None)
@@ -311,23 +310,13 @@ def context_parallel_attention(
         check_rep=False,
     )
     def cp(qr, kb, vb, lens):
+        from calfkit_tpu.inference.model import masked_attention_source
+
         my_idx = lax.axis_index(axis)
-        qg = (qr[:, 0] * scale).astype(jnp.float32).reshape(B, Kh, G, hd)
+        qg = qr[:, 0].reshape(B, Kh, G, hd)
         pos = my_idx * blk + jnp.arange(blk)  # this shard's absolute span
-        s = jnp.einsum(
-            "bkgh,bksh->bkgs", qg, kb.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
         valid = pos[None, :] < lens[:, None]  # [B, blk]
-        s = jnp.where(valid[:, None, None], s, -1e30)
-        m = jnp.max(s, axis=-1, keepdims=True)
-        m = jnp.maximum(m, -1e29)
-        p = jnp.exp(s - m)
-        z = jnp.sum(p, axis=-1, keepdims=True)
-        o = jnp.einsum(
-            "bkgs,bksh->bkgh", p, vb.astype(jnp.float32),
-            preferred_element_type=jnp.float32,
-        )
+        o, m, z = masked_attention_source(qg, kb, vb, valid)
         # exact global merge: rescale every shard to the global max, sum
         m_all = lax.pmax(m, axis)
         w = jnp.exp(m - m_all)

@@ -11,19 +11,46 @@ import sys
 
 sys.path.insert(0, os.path.dirname(__file__))
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 
-# this image's sitecustomize registers an 'axon' TPU plugin and pins
-# jax.config.jax_platforms — env vars alone don't win; override the config
-# directly (safe: runs before any backend initializes)
-try:
-    import jax
+def tpu_lane_enabled() -> bool:
+    """Shared truthiness: CALFKIT_TESTS_TPU=0/false must NOT enable it."""
+    return os.environ.get("CALFKIT_TESTS_TPU", "").lower() in (
+        "1", "true", "yes",
+    )
 
-    jax.config.update("jax_platforms", "cpu")
-except ImportError:  # pragma: no cover
+
+def pytest_collection_modifyitems(config, items):
+    """With the real-chip lane enabled, a plain ``pytest`` must not send
+    the whole CPU suite at the accelerator (no virtual mesh, wedge-prone
+    backend): keep only tpu-marked tests."""
+    if not tpu_lane_enabled():
+        return
+    keep, dropped = [], []
+    for item in items:
+        (keep if item.get_closest_marker("tpu") else dropped).append(item)
+    if dropped:
+        config.hook.pytest_deselected(items=dropped)
+        items[:] = keep
+
+
+if tpu_lane_enabled():
+    # the real-chip lane (pytest -m tpu): leave the accelerator platform
+    # alone so the axon backend can serve the tests
     pass
+else:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    # this image's sitecustomize registers an 'axon' TPU plugin and pins
+    # jax.config.jax_platforms — env vars alone don't win; override the
+    # config directly (safe: runs before any backend initializes)
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:  # pragma: no cover
+        pass

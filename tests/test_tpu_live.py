@@ -1,0 +1,139 @@
+"""The real-chip lane (the reference's `live` analog, SURVEY §4).
+
+Run with:  CALFKIT_TESTS_TPU=1 python -m pytest tests/test_tpu_live.py -m tpu -q
+
+Deselected by default; each test is bounded and uses the persistent XLA
+cache so reruns start hot.  Remote-tunnel caveats (from the repo's
+environment notes): ``block_until_ready`` does not actually sync — every
+timing forces an ``np.asarray`` fetch — and per-dispatch overhead is
+~74-200 ms, so measurements amortize over many steps per dispatch.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+import importlib.util as _ilu
+from pathlib import Path as _Path
+
+_root_conftest = _ilu.spec_from_file_location(
+    "_root_conftest", _Path(__file__).parents[1] / "conftest.py"
+)
+_rc = _ilu.module_from_spec(_root_conftest)
+_root_conftest.loader.exec_module(_rc)
+tpu_lane_enabled = _rc.tpu_lane_enabled
+
+requires_tpu_env = pytest.mark.skipif(
+    not tpu_lane_enabled(),
+    reason="set CALFKIT_TESTS_TPU=1 (conftest otherwise forces the CPU platform)",
+)
+
+
+def _chip():
+    import jax
+
+    devices = jax.devices()
+    if devices[0].platform == "cpu":
+        pytest.skip("no accelerator visible")
+    return devices
+
+
+@requires_tpu_env
+class TestChipSmoke:
+    def test_matmul_alive(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        _chip()
+        x = jnp.ones((256, 256), jnp.bfloat16)
+        y = jnp.float32(x @ x)
+        assert float(np.asarray(y).sum()) == pytest.approx(256**3, rel=1e-3)
+
+    async def test_engine_generates_on_chip(self):
+        import numpy as np
+
+        from calfkit_tpu.inference.config import RuntimeConfig, preset
+        from calfkit_tpu.inference.engine import InferenceEngine
+
+        _chip()
+        engine = InferenceEngine(
+            preset("debug"),
+            RuntimeConfig(max_batch_size=2, max_seq_len=128, prefill_chunk=16,
+                          decode_steps_per_dispatch=8),
+        )
+        await engine.start()
+        out = [t async for t in engine.generate([1, 5, 9], max_new_tokens=16)]
+        assert len(out) == 16
+        again = [t async for t in engine.generate([1, 5, 9], max_new_tokens=16)]
+        assert again == out  # greedy determinism on the accelerator
+        await engine.stop()
+
+    async def test_paged_matches_dense_on_chip(self):
+        from calfkit_tpu.inference.config import RuntimeConfig, preset
+        from calfkit_tpu.inference.engine import InferenceEngine
+
+        _chip()
+        kw = dict(max_batch_size=2, max_seq_len=128, prefill_chunk=16,
+                  decode_steps_per_dispatch=8, page_size=16)
+        dense = InferenceEngine(preset("debug"), RuntimeConfig(**kw), seed=3)
+        paged = InferenceEngine(
+            preset("debug"), RuntimeConfig(kv_layout="paged", **kw), seed=3
+        )
+        await dense.start()
+        await paged.start()
+        prompt = list(range(2, 30))
+        want = [t async for t in dense.generate(prompt, max_new_tokens=16)]
+        got = [t async for t in paged.generate(prompt, max_new_tokens=16)]
+        assert got == want
+        await dense.stop()
+        await paged.stop()
+
+    def test_pallas_decode_kernel_on_chip(self):
+        """The dense Pallas kernel compiles + matches XLA on hardware, and
+        its per-call time is recorded (the profile that decides 'auto')."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from calfkit_tpu.inference.model import _merged_decode_attention
+        from calfkit_tpu.inference.pallas_attention import (
+            merged_decode_attention_pallas,
+        )
+
+        _chip()
+        B, K, G, hd, W, T = 8, 4, 8, 64, 1024, 8
+        ks = jax.random.split(jax.random.key(11), 5)
+        q = jax.random.normal(ks[0], (B, 1, K * G, hd), jnp.bfloat16)
+        kc = jax.random.normal(ks[1], (B, K, W, hd), jnp.bfloat16)
+        vc = jax.random.normal(ks[2], (B, K, W, hd), jnp.bfloat16)
+        rk = jax.random.normal(ks[3], (T, B, K, hd), jnp.bfloat16)
+        rv = jax.random.normal(ks[4], (T, B, K, hd), jnp.bfloat16)
+        lens = jnp.full((B,), W - 7, jnp.int32)
+        t = jnp.int32(3)
+
+        ref = _merged_decode_attention(q, kc, vc, rk, rv, lens, t)
+        out = merged_decode_attention_pallas(q, kc, vc, rk, rv, lens, t)
+        np.testing.assert_allclose(
+            np.asarray(jnp.float32(ref)), np.asarray(jnp.float32(out)),
+            atol=2e-2, rtol=2e-2,
+        )
+
+        def timed(fn, n=20):
+            np.asarray(jnp.float32(fn()).sum())  # warm
+            start = time.perf_counter()
+            for _ in range(n):
+                np.asarray(jnp.float32(fn()).sum())  # forced fetch per call
+            return (time.perf_counter() - start) / n * 1000.0
+
+        xla_ms = timed(lambda: _merged_decode_attention(q, kc, vc, rk, rv, lens, t))
+        pallas_ms = timed(
+            lambda: merged_decode_attention_pallas(q, kc, vc, rk, rv, lens, t)
+        )
+        print(f"\ndecode attention B={B} W={W}: xla {xla_ms:.2f} ms/call, "
+              f"pallas {pallas_ms:.2f} ms/call")
