@@ -17,6 +17,7 @@ from calfkit_tpu.controlplane.view import ControlPlaneView
 from calfkit_tpu.exceptions import MeshUnavailableError
 from calfkit_tpu.models.agents import AgentCard
 from calfkit_tpu.models.capability import CapabilityRecord
+from calfkit_tpu.models.records import EngineStatsRecord
 
 if TYPE_CHECKING:
     from calfkit_tpu.client.caller import Client
@@ -50,6 +51,9 @@ class Mesh:
             topic, record_type = {
                 "agents": (protocol.AGENTS_TOPIC, AgentCard),
                 "capabilities": (protocol.CAPABILITIES_TOPIC, CapabilityRecord),
+                "engine_stats": (
+                    protocol.ENGINE_STATS_TOPIC, EngineStatsRecord
+                ),
             }[kind]
             view = ControlPlaneView(
                 self._client.mesh,
@@ -74,6 +78,11 @@ class Mesh:
 
     async def get_capabilities(self) -> list[CapabilityRecord]:
         return (await self._view("capabilities")).records()
+
+    async def get_engine_stats(self) -> "list[EngineStatsRecord]":
+        """Live serving metrics from every worker whose agents run a local
+        inference engine (tok/s, occupancy, free slots/pages)."""
+        return (await self._view("engine_stats")).records()
 
     async def get_agent(self, name: str) -> AgentCard:
         for card in await self.get_agents():

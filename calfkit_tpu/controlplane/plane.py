@@ -72,6 +72,31 @@ class ControlPlane:
                     payload_fn=lambda n=node: n.capability_record().model_dump(),
                 )
             )
+        if (
+            hasattr(node, "engine_stats_record")
+            and (stats := node.engine_stats_record()) is not None
+        ):
+            # live serving metrics, re-derived per heartbeat tick (SURVEY
+            # §5: the TPU build surfaces tok/s, occupancy, memory)
+            def stats_payload(n=node):
+                snapshot = n.engine_stats_record()
+                if snapshot is None:
+                    # raise so the publisher's designed fallback (last good
+                    # payload) applies — publishing {} would overwrite the
+                    # compacted record with an unreadable one
+                    raise RuntimeError("engine stats unavailable this tick")
+                return snapshot
+
+            adverts.append(
+                Advert(
+                    topic=protocol.ENGINE_STATS_TOPIC,
+                    node_name=stats["node_id"],
+                    node_kind=node.kind,
+                    instance_id=node.instance_id,
+                    payload=stats,
+                    payload_fn=stats_payload,
+                )
+            )
         return adverts
 
     async def attach(self, worker: Any) -> _Attached:
@@ -93,7 +118,12 @@ class ControlPlane:
             catchup_timeout=config.catchup_timeout,
         )
         await transport.ensure_topics(
-            [protocol.AGENTS_TOPIC, protocol.CAPABILITIES_TOPIC], compacted=True
+            [
+                protocol.AGENTS_TOPIC,
+                protocol.CAPABILITIES_TOPIC,
+                protocol.ENGINE_STATS_TOPIC,
+            ],
+            compacted=True,
         )
         # views catch up BEFORE serving: a turn must not resolve against a
         # half-read directory.  Anything started before a failure is stopped

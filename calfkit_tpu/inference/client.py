@@ -246,6 +246,33 @@ class JaxLocalModelClient(ModelClient):
         if self._engine is not None:
             await self._engine.stop()
 
+    def stats_snapshot(self) -> dict:
+        """Live serving metrics (for the control-plane engine-stats advert);
+        safe before start (zeros) — construction is intentionally cheap."""
+        engine = self._engine
+        if engine is None:
+            return {"model_name": self.model_name}
+        import jax
+
+        stats = engine.stats
+        rt = engine.runtime
+        snapshot = {
+            "model_name": engine.config.name,
+            "platform": jax.devices()[0].platform,
+            "tokens_per_second": round(stats.tokens_per_second, 1),
+            "mean_occupancy": round(stats.mean_occupancy, 4),
+            "active_requests": len(engine._active),
+            "free_slots": len(engine._free),
+            "max_batch_size": rt.max_batch_size,
+            "kv_layout": rt.kv_layout,
+            "prefill_tokens": stats.prefill_tokens,
+            "decode_tokens": stats.decode_tokens,
+            "decode_dispatches": stats.decode_dispatches,
+        }
+        if engine._paged:
+            snapshot["free_pages"] = engine._page_alloc.free_pages
+        return snapshot
+
     # ------------------------------------------------------------- request
     async def request(
         self,

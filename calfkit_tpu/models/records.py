@@ -40,3 +40,27 @@ class ControlPlaneRecord(BaseModel):
     @classmethod
     def from_wire(cls, data: bytes | str) -> "ControlPlaneRecord":
         return cls.model_validate_json(data)
+
+
+class EngineStatsRecord(BaseModel):
+    """Live serving metrics for one worker's inference engine, heartbeated
+    on the control plane (SURVEY §5: the TPU build adds real metrics —
+    tok/s, batch occupancy, memory — where the reference had only logs).
+
+    Re-derived per heartbeat tick, so readers see a rolling snapshot with
+    the same staleness semantics as agent liveness.
+    """
+
+    node_id: str
+    model_name: str = ""
+    platform: str = ""
+    tokens_per_second: float = 0.0
+    mean_occupancy: float = 0.0
+    active_requests: int = 0
+    free_slots: int = 0
+    max_batch_size: int = 0
+    kv_layout: str = "dense"
+    free_pages: int | None = None  # paged layout only
+    prefill_tokens: int = 0
+    decode_tokens: int = 0
+    decode_dispatches: int = 0

@@ -168,6 +168,22 @@ class BaseAgentNodeDef(BaseNodeDef):
             structured_output=self.output_type is not str,
         )
 
+    def engine_stats_record(self) -> "dict | None":
+        """Serving metrics for the engine-stats advert, when this agent's
+        model exposes them (the local TPU backend does); None otherwise."""
+        snapshot_fn = getattr(self.model, "stats_snapshot", None)
+        if snapshot_fn is None:
+            return None
+        from calfkit_tpu.models.records import EngineStatsRecord
+
+        try:
+            return EngineStatsRecord(
+                node_id=self.node_id, **snapshot_fn()
+            ).model_dump()
+        except Exception:  # noqa: BLE001 - metrics must never fault serving
+            logger.debug("engine stats snapshot failed", exc_info=True)
+            return None
+
     # ------------------------------------------------------ tool resolution
     def _resolve_tools(self, ctx: NodeRunContext) -> list[ToolBinding]:
         """Per-turn resolution (reference: agent.py:621 — selectors resolve

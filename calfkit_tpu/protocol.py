@@ -187,6 +187,7 @@ def client_inbox_topic(client_id: str) -> str:
 
 AGENTS_TOPIC: Final = "mesh.agents"
 CAPABILITIES_TOPIC: Final = "mesh.capabilities"
+ENGINE_STATS_TOPIC: Final = "mesh.engine_stats"
 
 
 def fanout_state_topic(node_id: str) -> str:

@@ -12,17 +12,21 @@ import sys
 sys.path.insert(0, os.path.dirname(__file__))
 
 
-def tpu_lane_enabled() -> bool:
-    """Shared truthiness: CALFKIT_TESTS_TPU=0/false must NOT enable it."""
-    return os.environ.get("CALFKIT_TESTS_TPU", "").lower() in (
-        "1", "true", "yes",
-    )
+from tests._env import tpu_lane_enabled  # noqa: E402
+
+
+def pytest_configure(config):
+    """With the real-chip lane enabled, a plain ``pytest`` must run the tpu
+    lane and ONLY the tpu lane: override the default markexpr (which
+    deselects tpu) so the combination can't come up empty, and never send
+    the CPU suite at a wedge-prone accelerator backend."""
+    if tpu_lane_enabled():
+        config.option.markexpr = "tpu"
 
 
 def pytest_collection_modifyitems(config, items):
-    """With the real-chip lane enabled, a plain ``pytest`` must not send
-    the whole CPU suite at the accelerator (no virtual mesh, wedge-prone
-    backend): keep only tpu-marked tests."""
+    """Belt for the buckle above: with the lane enabled, drop anything
+    unmarked even if a caller passed an explicit -m."""
     if not tpu_lane_enabled():
         return
     keep, dropped = [], []

@@ -1,0 +1,12 @@
+"""Shared test-environment helpers (importable from conftest AND tests)."""
+
+from __future__ import annotations
+
+import os
+
+
+def tpu_lane_enabled() -> bool:
+    """Strict truthiness: CALFKIT_TESTS_TPU=0/false must NOT enable it."""
+    return os.environ.get("CALFKIT_TESTS_TPU", "").lower() in (
+        "1", "true", "yes",
+    )

@@ -419,3 +419,49 @@ class TestConcurrentMultiAgent:
                 roles = [m.role for m in r.state.message_history]
                 assert roles == ["request", "response", "request", "response"]
             await client.close()
+
+
+class TestEngineStatsOnControlPlane:
+    async def test_engine_metrics_heartbeat_to_mesh_view(self):
+        """An agent served by the local engine heartbeats live metrics
+        (tok/s, occupancy, slots) onto the control plane; clients read them
+        via mesh_directory.get_engine_stats() with normal staleness
+        semantics (SURVEY §5: the TPU build adds real metrics)."""
+        from calfkit_tpu.controlplane import ControlPlaneConfig
+        from calfkit_tpu.inference import JaxLocalModelClient
+        from calfkit_tpu.inference.config import RuntimeConfig, preset
+
+        model = JaxLocalModelClient(
+            config=preset("debug"),
+            runtime=RuntimeConfig(max_batch_size=2, max_seq_len=128,
+                                  prefill_chunk=16,
+                                  decode_steps_per_dispatch=4),
+            max_new_tokens=8,
+        )
+        mesh = InMemoryMesh()
+        agent = Agent("metered", model=model)
+        config = ControlPlaneConfig(heartbeat_interval=0.2)
+        async with Worker([agent], mesh=mesh, owns_transport=True,
+                          control_plane=config):
+            client = Client.connect(mesh)
+            result = await client.agent("metered").execute("hi", timeout=60)
+            assert result.output
+            stats = None
+            for _ in range(100):  # metrics refresh on the next heartbeat
+                records = await client.mesh_directory.get_engine_stats()
+                # a heartbeat can catch the run mid-flight; wait for the
+                # post-retirement snapshot (slot freed, tokens counted)
+                if (records and records[0].decode_tokens > 0
+                        and records[0].free_slots == 2):
+                    stats = records[0]
+                    break
+                await asyncio.sleep(0.1)
+            assert stats is not None, "engine stats never reached the view"
+            assert stats.node_id == "agent.metered"
+            assert stats.model_name == "debug"
+            assert stats.max_batch_size == 2
+            assert stats.free_slots == 2  # request retired
+            assert stats.tokens_per_second > 0
+            await client.mesh_directory.close()
+            await client.close()
+        await model.stop()

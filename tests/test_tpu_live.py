@@ -18,15 +18,7 @@ import pytest
 
 pytestmark = pytest.mark.tpu
 
-import importlib.util as _ilu
-from pathlib import Path as _Path
-
-_root_conftest = _ilu.spec_from_file_location(
-    "_root_conftest", _Path(__file__).parents[1] / "conftest.py"
-)
-_rc = _ilu.module_from_spec(_root_conftest)
-_root_conftest.loader.exec_module(_rc)
-tpu_lane_enabled = _rc.tpu_lane_enabled
+from tests._env import tpu_lane_enabled
 
 requires_tpu_env = pytest.mark.skipif(
     not tpu_lane_enabled(),
