@@ -11,7 +11,7 @@ from typing import Any
 
 import click
 
-MESH_URL_ENV = "CALFKIT_MESH_URL"
+from calfkit_tpu.mesh.urls import MESH_URL_ENV  # noqa: E402
 
 
 def is_file_spec(module_part: str) -> bool:
@@ -55,24 +55,13 @@ def load_nodes(specs: tuple[str, ...]) -> list[Any]:
 
 
 def resolve_mesh(url: str | None) -> Any:
-    """Build a transport from a mesh url.
+    """Build a transport from a mesh url (the CLI defaults to memory://
+    for the zero-setup dev loop; see calfkit_tpu.mesh.urls for the shared
+    grammar)."""
+    from calfkit_tpu.mesh.urls import mesh_from_url
 
-    - ``memory://`` (or unset) → in-process InMemoryMesh (single-process dev)
-    - ``kafka://host:port[,host:port...]`` → KafkaMesh (needs aiokafka)
-    """
     url = url or os.environ.get(MESH_URL_ENV) or "memory://"
-    if url.startswith("memory://"):
-        from calfkit_tpu.mesh import InMemoryMesh
-
-        return InMemoryMesh()
-    if url.startswith("tcp://"):
-        from calfkit_tpu.mesh.tcp import TcpMesh
-
-        return TcpMesh(url.removeprefix("tcp://"))
-    if url.startswith("kafka://"):
-        from calfkit_tpu.mesh.kafka import KafkaMesh
-
-        return KafkaMesh(url.removeprefix("kafka://"))
-    raise click.ClickException(
-        f"unsupported mesh url {url!r} (use memory://, tcp://host:port or kafka://host:port)"
-    )
+    try:
+        return mesh_from_url(url)
+    except ValueError as exc:
+        raise click.ClickException(str(exc)) from exc

@@ -63,13 +63,26 @@ class Client:
     @classmethod
     def connect(
         cls,
-        mesh: MeshTransport,
+        mesh: "MeshTransport | str | None" = None,
         *,
         client_id: str | None = None,
         default_timeout: float = DEFAULT_TIMEOUT,
     ) -> "Client":
-        """Lazy constructor: performs no I/O (reference: caller.py:102)."""
-        return cls(mesh, client_id=client_id, default_timeout=default_timeout)
+        """Lazy constructor: performs no I/O (reference: caller.py:102).
+
+        ``mesh`` may be a transport object, a url string
+        (``tcp://host:port`` / ``kafka://host:port``), or None to read
+        ``$CALFKIT_MESH_URL``.  A transport built here from a url is OWNED
+        by the client: ``close()`` stops it.
+        """
+        from calfkit_tpu.mesh.urls import resolve_mesh
+
+        transport, owned = resolve_mesh(mesh, allow_memory=False)
+        client = cls(
+            transport, client_id=client_id, default_timeout=default_timeout
+        )
+        client._owns_mesh = owned
+        return client
 
     async def _ensure_started(self) -> None:
         if self._closed:
@@ -99,6 +112,11 @@ class Client:
             with contextlib.suppress(Exception):
                 await self._subscription.stop()
             self._subscription = None
+        if getattr(self, "_owns_mesh", False):
+            # connect() built this transport from a url: stop it too, or a
+            # per-job client would leak sockets and reader tasks
+            with contextlib.suppress(Exception):
+                await self.mesh.stop()
 
     async def __aenter__(self) -> "Client":
         await self._ensure_started()

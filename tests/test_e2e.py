@@ -333,3 +333,64 @@ class TestTokenStreaming:
             assert kinds.index("token") < kinds.index("agent_message")
             await client.close()
         await model.stop()
+
+
+class TestMeshUrls:
+    async def test_client_connect_accepts_url_and_env(self, monkeypatch):
+        from calfkit_tpu.mesh.tcp import find_meshd, spawn_meshd
+
+        if find_meshd() is None:
+            pytest.skip("meshd not built")
+        proc = spawn_meshd(19884)
+        try:
+            from calfkit_tpu.mesh.urls import mesh_from_url
+
+            agent = Agent("urly", model=TestModelClient(custom_output_text="via-url"))
+            worker_mesh = mesh_from_url("tcp://127.0.0.1:19884")
+            await worker_mesh.start()
+            async with Worker([agent], mesh=worker_mesh):
+                client = Client.connect("tcp://127.0.0.1:19884")
+                result = await client.agent("urly").execute("go", timeout=20)
+                assert result.output == "via-url"
+                await client.close()
+                # env-var resolution
+                monkeypatch.setenv("CALFKIT_MESH_URL", "tcp://127.0.0.1:19884")
+                env_client = Client.connect()
+                result2 = await env_client.agent("urly").execute("again", timeout=20)
+                assert result2.output == "via-url"
+                await env_client.close()
+            await worker_mesh.stop()
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    def test_connect_without_mesh_or_env_is_loud(self, monkeypatch):
+        monkeypatch.delenv("CALFKIT_MESH_URL", raising=False)
+        with pytest.raises(ValueError, match="CALFKIT_MESH_URL"):
+            Client.connect()
+
+    def test_bad_scheme_is_loud(self):
+        with pytest.raises(ValueError, match="unsupported mesh url"):
+            Client.connect("carrier-pigeon://coop")
+
+    def test_memory_url_rejected_for_clients(self):
+        """memory:// from a URL is an isolated world — a client there can
+        only time out; reject loudly instead."""
+        with pytest.raises(ValueError, match="isolated"):
+            Client.connect("memory://")
+
+    async def test_url_client_close_stops_owned_mesh(self):
+        from calfkit_tpu.mesh.tcp import find_meshd, spawn_meshd
+
+        if find_meshd() is None:
+            pytest.skip("meshd not built")
+        proc = spawn_meshd(19886)
+        try:
+            client = Client.connect("tcp://127.0.0.1:19886")
+            await client._ensure_started()
+            assert client.mesh._started
+            await client.close()
+            assert not client.mesh._started  # owned transport stopped
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
