@@ -11,7 +11,7 @@ from typing import Any
 
 import click
 
-from calfkit_tpu.mesh.urls import MESH_URL_ENV  # noqa: E402
+from calfkit_tpu.mesh.urls import MESH_URL_ENV
 
 
 def is_file_spec(module_part: str) -> bool:
@@ -54,14 +54,14 @@ def load_nodes(specs: tuple[str, ...]) -> list[Any]:
     return nodes
 
 
-def resolve_mesh(url: str | None) -> Any:
-    """Build a transport from a mesh url (the CLI defaults to memory://
-    for the zero-setup dev loop; see calfkit_tpu.mesh.urls for the shared
-    grammar)."""
-    from calfkit_tpu.mesh.urls import mesh_from_url
+def resolve_mesh_for_cli(url: str | None) -> Any:
+    """CLI flavor of the shared grammar: memory:// default (the CLI hosts
+    the worker in-process, so an isolated mesh is meaningful), errors as
+    ClickException."""
+    from calfkit_tpu.mesh.urls import resolve_mesh
 
-    url = url or os.environ.get(MESH_URL_ENV) or "memory://"
     try:
-        return mesh_from_url(url)
+        transport, _ = resolve_mesh(url, default="memory://")
+        return transport
     except ValueError as exc:
         raise click.ClickException(str(exc)) from exc

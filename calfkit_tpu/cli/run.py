@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import click
 
-from calfkit_tpu.cli._common import load_nodes, resolve_mesh
+from calfkit_tpu.cli._common import load_nodes, resolve_mesh_for_cli
 
 
 @click.command("run")
@@ -40,7 +40,7 @@ def run_command(specs: tuple[str, ...], mesh_url: str | None, max_workers: int,
     from calfkit_tpu.worker import Worker
 
     nodes = load_nodes(specs)
-    mesh = resolve_mesh(mesh_url)
+    mesh = resolve_mesh_for_cli(mesh_url)
     click.echo(f"serving {len(nodes)} node(s): {[n.name for n in nodes]}")
     worker = Worker(
         nodes, mesh=mesh, owns_transport=True, max_workers=max_workers,

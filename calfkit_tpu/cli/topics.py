@@ -6,7 +6,7 @@ import asyncio
 
 import click
 
-from calfkit_tpu.cli._common import load_nodes, resolve_mesh
+from calfkit_tpu.cli._common import load_nodes, resolve_mesh_for_cli
 
 
 @click.group("topics", help="topic provisioning")
@@ -36,7 +36,7 @@ def provision_command(specs: tuple[str, ...], mesh_url: str | None,
         return
 
     async def main() -> None:
-        mesh = resolve_mesh(mesh_url)
+        mesh = resolve_mesh_for_cli(mesh_url)
         await mesh.start()
         result = await provision(mesh, nodes)
         click.echo(

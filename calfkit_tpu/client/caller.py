@@ -56,6 +56,7 @@ class Client:
         self._subscription: Subscription | None = None
         self._started = False
         self._closed = False
+        self._owns_mesh = False  # connect() sets it for url-built transports
         self._start_lock: asyncio.Lock | None = None
         self._mesh_view: Any = None
 
@@ -112,7 +113,7 @@ class Client:
             with contextlib.suppress(Exception):
                 await self._subscription.stop()
             self._subscription = None
-        if getattr(self, "_owns_mesh", False):
+        if self._owns_mesh:
             # connect() built this transport from a url: stop it too, or a
             # per-job client would leak sockets and reader tasks
             with contextlib.suppress(Exception):

@@ -37,8 +37,10 @@ def resolve_mesh(
     mesh: "MeshTransport | str | None",
     *,
     allow_memory: bool = True,
+    default: str | None = None,
 ) -> tuple[MeshTransport, bool]:
-    """Accept a transport, a URL string, or None (→ $CALFKIT_MESH_URL).
+    """Accept a transport, a URL string, or None (→ $CALFKIT_MESH_URL,
+    then ``default`` when given).
 
     → (transport, owned): ``owned`` is True when THIS call constructed the
     transport from a url — the caller is then responsible for stopping it.
@@ -53,7 +55,7 @@ def resolve_mesh(
     if isinstance(mesh, str):
         url = mesh
     elif mesh is None:
-        url = os.environ.get(MESH_URL_ENV) or ""
+        url = os.environ.get(MESH_URL_ENV) or default or ""
         if not url:
             raise ValueError(
                 "no mesh given and CALFKIT_MESH_URL is unset — pass a "

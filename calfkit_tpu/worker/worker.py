@@ -38,7 +38,7 @@ class Worker(LifecycleHookMixin):
         self,
         nodes: Sequence[BaseNodeDef],
         *,
-        mesh: MeshTransport,
+        mesh: "MeshTransport | str | None",
         group_id: str | None = None,
         max_workers: int = 8,
         owns_transport: bool = False,
@@ -51,10 +51,14 @@ class Worker(LifecycleHookMixin):
         if len(set(names)) != len(names):
             raise LifecycleConfigError(f"duplicate node names: {names}")
         self.nodes = list(nodes)
-        self.mesh = mesh
+        from calfkit_tpu.mesh.urls import resolve_mesh
+
+        # mesh may be a transport, a url string, or None ($CALFKIT_MESH_URL);
+        # a transport built HERE from a url is owned by the worker
+        self.mesh, owned = resolve_mesh(mesh)
         self.group_id = group_id
         self.max_workers = max_workers
-        self.owns_transport = owns_transport
+        self.owns_transport = owns_transport or owned
         # control plane default ON: pass False (or a disabled config) to opt
         # out; a ControlPlaneConfig customizes; a ControlPlane is used as-is
         from calfkit_tpu.controlplane import ControlPlane, ControlPlaneConfig

@@ -394,3 +394,24 @@ class TestMeshUrls:
         finally:
             proc.terminate()
             proc.wait(timeout=5)
+
+    async def test_worker_accepts_url_and_owns_transport(self):
+        from calfkit_tpu.mesh.tcp import find_meshd, spawn_meshd
+
+        if find_meshd() is None:
+            pytest.skip("meshd not built")
+        proc = spawn_meshd(19887)
+        try:
+            agent = Agent("wurl", model=TestModelClient(custom_output_text="wu"))
+            worker = Worker([agent], mesh="tcp://127.0.0.1:19887")
+            assert worker.owns_transport  # built from url => owned
+            await worker.start()
+            client = Client.connect("tcp://127.0.0.1:19887")
+            result = await client.agent("wurl").execute("x", timeout=20)
+            assert result.output == "wu"
+            await client.close()
+            await worker.stop()
+            assert not worker.mesh._started  # owned transport stopped
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)

@@ -1,0 +1,172 @@
+"""Wire-model robustness: randomized round-trips + forward compatibility.
+
+The wire contract says unknown fields are TOLERATED (a newer node may add
+fields an older node has not heard of — rolling upgrades over a shared
+mesh), and every model must survive a to_wire/from_wire round-trip
+bit-exactly on the fields it knows.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+from calfkit_tpu.models import (
+    DataPart,
+    ErrorReport,
+    FaultMessage,
+    FaultTypes,
+    ReturnMessage,
+    TextPart,
+)
+from calfkit_tpu.models.marker import ToolCallMarker
+from calfkit_tpu.models.session_context import (
+    CallFrame,
+    Envelope,
+    SessionContext,
+    WorkflowState,
+)
+from calfkit_tpu.models.state import State
+from calfkit_tpu.models.messages import (
+    ModelRequest,
+    ModelResponse,
+    TextOutput,
+    ToolCallOutput,
+    ToolReturnPart,
+    UserPart,
+)
+
+
+def _random_state(rng: random.Random) -> State:
+    history = []
+    for i in range(rng.randint(0, 6)):
+        if rng.random() < 0.5:
+            history.append(ModelRequest(parts=[
+                UserPart(content=f"msg {i} " + "é中\U0001f600" * rng.randint(0, 3))
+            ]))
+        else:
+            parts = [TextOutput(text=f"reply {i}")]
+            if rng.random() < 0.5:
+                parts.append(ToolCallOutput(
+                    tool_call_id=f"tc{i}", tool_name=f"tool_{i}",
+                    args={"n": i, "nested": {"deep": [1, 2, {"x": None}]}},
+                ))
+            history.append(ModelResponse(parts=parts, author=f"a{i % 2}"))
+    return State(message_history=history)
+
+
+def _random_envelope(rng: random.Random) -> Envelope:
+    frames = [
+        CallFrame(
+            target_topic=f"agent.t{i}.private.input",
+            callback_topic=f"agent.t{i-1}.private.return" if i else "client.inbox.x",
+            route="run",
+            payload=[TextPart(text=f"payload {i}"),
+                     DataPart(data={"k": list(range(i))})],
+            tag=f"tag-{i}" if rng.random() < 0.5 else None,
+            marker=ToolCallMarker(tool_call_id=f"tc-{i}", tool_name=f"t{i}")
+            if rng.random() < 0.5 else None,
+        )
+        for i in range(rng.randint(1, 8))
+    ]
+    envelope = Envelope(
+        context=SessionContext(state=_random_state(rng)),
+        workflow=WorkflowState(frames=frames),
+    )
+    if rng.random() < 0.5:
+        envelope.reply = ReturnMessage(
+            parts=[TextPart(text="done ✓")], frame_id=frames[-1].frame_id
+        )
+    elif rng.random() < 0.5:
+        envelope.reply = FaultMessage(
+            report=ErrorReport.build_safe(
+                FaultTypes.NODE_ERROR, "x" * rng.randint(0, 2000),
+                exc=ValueError("boom"),
+            ),
+            frame_id=frames[-1].frame_id,
+        )
+    return envelope
+
+
+class TestRoundTrips:
+    def test_randomized_envelope_roundtrips(self):
+        rng = random.Random(7)
+        for _ in range(50):
+            envelope = _random_envelope(rng)
+            wire = envelope.to_wire()
+            back = Envelope.from_wire(wire)
+            assert back.model_dump() == envelope.model_dump()
+            # and the round-trip is stable (no lossy normalization)
+            assert Envelope.from_wire(back.to_wire()).model_dump() == back.model_dump()
+
+    def test_deep_call_stack_roundtrips(self):
+        rng = random.Random(11)
+        frames = [
+            CallFrame(target_topic=f"agent.n{i}.private.input",
+                      callback_topic="client.inbox.deep", route="run")
+            for i in range(64)
+        ]
+        envelope = Envelope(
+            context=SessionContext(state=_random_state(rng)),
+            workflow=WorkflowState(frames=frames),
+        )
+        back = Envelope.from_wire(envelope.to_wire())
+        assert len(back.workflow.frames) == 64
+        assert back.workflow.frames[63].frame_id == frames[63].frame_id
+
+
+class TestForwardCompat:
+    def test_unknown_fields_tolerated_everywhere(self):
+        """A NEWER peer's extra fields must not break decoding (rolling
+        upgrades share topics across versions)."""
+        envelope = _random_envelope(random.Random(3))
+        doc = json.loads(envelope.to_wire())
+        doc["from_the_future"] = {"shiny": True}
+        doc["context"]["state"]["novel_memory"] = [1, 2, 3]
+        doc["workflow"]["frames"][0]["new_frame_flag"] = "yes"
+        back = Envelope.from_wire(json.dumps(doc).encode())
+        assert back.workflow.frames[0].target_topic == (
+            envelope.workflow.frames[0].target_topic
+        )
+
+    def test_unknown_part_kind_fails_loudly_not_silently(self):
+        """Unknown discriminated-union KINDS are different from unknown
+        fields: a part the decoder cannot classify must raise (it cannot be
+        safely ignored — it might be the payload), not decode to garbage."""
+        import pytest
+        from pydantic import ValidationError
+
+        envelope = _random_envelope(random.Random(5))
+        doc = json.loads(envelope.to_wire())
+        doc["workflow"]["frames"][0]["payload"] = [
+            {"kind": "hologram", "beam": "blue"}
+        ]
+        with pytest.raises(ValidationError):
+            Envelope.from_wire(json.dumps(doc).encode())
+
+    def test_error_report_unknown_fields(self):
+        report = ErrorReport.build_safe(FaultTypes.NODE_ERROR, "x")
+        doc = json.loads(report.model_dump_json())
+        doc["severity_from_v99"] = "catastrophic"
+        parsed = ErrorReport.model_validate(doc)
+        assert parsed.error_type == FaultTypes.NODE_ERROR
+
+
+class TestToolReturnContentShapes:
+    def test_tool_return_content_preserves_json_types(self):
+        """Tool results keep their JSON shape across the wire (ints stay
+        ints, nested structures intact) — the model re-reads them."""
+        request = ModelRequest(parts=[ToolReturnPart(
+            tool_call_id="t", tool_name="f",
+            content={"a": 1, "b": [True, None, 2.5], "c": {"d": "e"}},
+        )])
+        envelope = Envelope(
+            context=SessionContext(state=State(message_history=[request])),
+            workflow=WorkflowState(frames=[CallFrame(
+                target_topic="agent.x.private.input",
+                callback_topic="client.inbox.y", route="run",
+            )]),
+        )
+        back = Envelope.from_wire(envelope.to_wire())
+        part = back.context.state.message_history[0].parts[0]
+        assert part.content == {"a": 1, "b": [True, None, 2.5], "c": {"d": "e"}}
