@@ -4,14 +4,11 @@ from __future__ import annotations
 
 import importlib
 import importlib.util
-import os
 import sys
 from pathlib import Path
 from typing import Any
 
 import click
-
-from calfkit_tpu.mesh.urls import MESH_URL_ENV
 
 
 def is_file_spec(module_part: str) -> bool:
@@ -54,14 +51,22 @@ def load_nodes(specs: tuple[str, ...]) -> list[Any]:
     return nodes
 
 
-def resolve_mesh_for_cli(url: str | None) -> Any:
-    """CLI flavor of the shared grammar: memory:// default (the CLI hosts
-    the worker in-process, so an isolated mesh is meaningful), errors as
-    ClickException."""
+def resolve_mesh_for_cli(url: str | None, *, hosts_worker: bool = True) -> Any:
+    """CLI flavor of the shared grammar, errors as ClickException.
+
+    ``hosts_worker=True`` (ck run / ck dev run) defaults to memory:// — the
+    command hosts the worker in-process, so an isolated mesh is meaningful.
+    Worker-less commands (chat, topics) must point at a REAL mesh: memory://
+    there would be a silent no-op world.
+    """
     from calfkit_tpu.mesh.urls import resolve_mesh
 
     try:
-        transport, _ = resolve_mesh(url, default="memory://")
+        transport, _ = resolve_mesh(
+            url,
+            default="memory://" if hosts_worker else None,
+            allow_memory=hosts_worker,
+        )
         return transport
     except ValueError as exc:
         raise click.ClickException(str(exc)) from exc

@@ -15,7 +15,7 @@ def chat_command(mesh_url: str | None, agent_name: str | None) -> None:
     """Chat with a live agent (steps stream inline)."""
     from calfkit_tpu.cli._common import resolve_mesh_for_cli
 
-    asyncio.run(_chat(resolve_mesh_for_cli(mesh_url), agent_name))
+    asyncio.run(_chat(resolve_mesh_for_cli(mesh_url, hosts_worker=False), agent_name))
 
 
 async def _chat(mesh, agent_name: str | None) -> None:

@@ -131,8 +131,10 @@ def dev_chat(agent_name: str | None, port: int) -> None:
 
 @dev_group.command("status")
 @click.option("--port", default=19092, show_default=True)
-def dev_status(port: int) -> None:
-    """Broker + daemon liveness."""
+@click.option("--stats", is_flag=True,
+              help="also query live agents + engine metrics off the mesh")
+def dev_status(port: int, stats: bool) -> None:
+    """Broker + daemon liveness (add --stats for mesh-level detail)."""
     from calfkit_tpu.cli._dev_state import broker_status, list_daemons
 
     broker = broker_status(port)
@@ -142,10 +144,42 @@ def dev_status(port: int) -> None:
     daemons = list_daemons()
     if not daemons:
         click.echo("daemons: none")
-        return
     for d in daemons:
         mark = "alive" if d.alive else "DEAD"
         click.echo(f"  {d.name}: {mark} pid {d.pid} specs={','.join(d.specs)}")
+    if stats and broker["up"]:
+        try:
+            asyncio.run(_mesh_stats(port))
+        except Exception as exc:  # noqa: BLE001 - CLI boundary
+            raise click.ClickException(f"mesh stats unavailable: {exc}") from exc
+
+
+async def _mesh_stats(port: int) -> None:
+    from calfkit_tpu.client import Client
+
+    client = Client.connect(f"tcp://127.0.0.1:{port}")
+    try:
+        cards = await client.mesh_directory.get_agents()
+        click.echo(f"live agents: {[c.name for c in cards] or 'none'}")
+        for rec in await client.mesh_directory.get_engine_stats():
+            pages = (
+                f" free_pages={rec.free_pages}"
+                if rec.free_pages is not None else ""
+            )
+            hbm = (
+                f" hbm={rec.hbm_gb_in_use}GB"
+                if rec.hbm_gb_in_use is not None else ""
+            )
+            click.echo(
+                f"  engine {rec.node_id}: {rec.model_name} "
+                f"[{rec.kv_layout}] tok/s={rec.tokens_per_second} "
+                f"occ={rec.mean_occupancy} "
+                f"slots={rec.max_batch_size - rec.free_slots}/"
+                f"{rec.max_batch_size}{pages}{hbm}"
+            )
+    finally:
+        await client.mesh_directory.close()
+        await client.close()
 
 
 @dev_group.command("stop")

@@ -36,7 +36,7 @@ def provision_command(specs: tuple[str, ...], mesh_url: str | None,
         return
 
     async def main() -> None:
-        mesh = resolve_mesh_for_cli(mesh_url)
+        mesh = resolve_mesh_for_cli(mesh_url, hosts_worker=False)
         await mesh.start()
         result = await provision(mesh, nodes)
         click.echo(

@@ -251,7 +251,17 @@ class JaxLocalModelClient(ModelClient):
         safe before start (zeros) — construction is intentionally cheap."""
         engine = self._engine
         if engine is None:
-            return {"model_name": self.model_name}
+            # engine builds lazily on first request: report the CONFIGURED
+            # shape so directories aren't stuck showing 0/0 slots
+            from calfkit_tpu.inference.config import RuntimeConfig
+
+            runtime = self._runtime or RuntimeConfig()  # mirror _build_engine
+            return {
+                "model_name": self.model_name,
+                "max_batch_size": runtime.max_batch_size,
+                "free_slots": runtime.max_batch_size,
+                "kv_layout": runtime.kv_layout,
+            }
         import jax
 
         stats = engine.stats
@@ -271,6 +281,14 @@ class JaxLocalModelClient(ModelClient):
         }
         if engine._paged:
             snapshot["free_pages"] = engine._page_alloc.free_pages
+        try:  # accelerator memory pressure, where the backend reports it
+            mem = jax.local_devices()[0].memory_stats() or {}
+            if "bytes_in_use" in mem:
+                snapshot["hbm_gb_in_use"] = round(
+                    mem["bytes_in_use"] / 1e9, 3
+                )
+        except Exception:  # noqa: BLE001 - stats stay best-effort
+            pass
         return snapshot
 
     # ------------------------------------------------------------- request

@@ -64,3 +64,4 @@ class EngineStatsRecord(BaseModel):
     prefill_tokens: int = 0
     decode_tokens: int = 0
     decode_dispatches: int = 0
+    hbm_gb_in_use: float | None = None  # where the backend reports memory

@@ -38,7 +38,7 @@ class Worker(LifecycleHookMixin):
         self,
         nodes: Sequence[BaseNodeDef],
         *,
-        mesh: "MeshTransport | str | None",
+        mesh: "MeshTransport | str | None" = None,
         group_id: str | None = None,
         max_workers: int = 8,
         owns_transport: bool = False,
