@@ -67,6 +67,11 @@ class RuntimeConfig:
     dp: int = 1  # data/batch-parallel replicas of the serving engine
     decode_steps_per_dispatch: int = 8  # tokens generated per scheduler tick
     prefill_chunk: int = 512  # prompts pad/bucket to multiples of this
+    # interleave long-prompt prefills with decode: an admission advances one
+    # prefill_chunk per scheduler pass instead of blocking decode for the
+    # whole bucket (vLLM-style chunked prefill; inter-token latency of
+    # active streams stays bounded by one chunk + one tick)
+    chunked_prefill: bool = False
     attention_impl: str = "auto"  # auto | xla | pallas | pallas_interpret
     # decode attention window buckets (each is one jit specialization);
     # sparse buckets = few compiles, dense = tighter HBM reads

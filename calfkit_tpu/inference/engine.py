@@ -50,6 +50,45 @@ logger = logging.getLogger(__name__)
 _DONE = object()
 
 
+def _finalize_wave_math(
+    cfg, paged, sampled,
+    k, v, sk, sv, slots, true_lens, last_logits,
+    slot_keys, temp, top_k, top_p,
+    seeds, w_temp, w_top_k, w_top_p,
+    tables, page_rows, scatter_ids,
+):
+    """The wave-landing math shared by single-shot and chunked prefill:
+    scatter scratch K/V into the cache (rows or pages), install per-slot
+    sampling state, sample each row's first token from its last-position
+    logits.  Runs inside jit (all callers trace it)."""
+    R = slots.shape[0]
+    P = sk.shape[3]
+    if paged:
+        k, v = M.write_prefill_pages((k, v), (sk, sv), scatter_ids)
+        tables = tables.at[slots].set(page_rows)
+    else:
+        for r in range(R):  # R is small & static: unrolled row scatter
+            k = lax.dynamic_update_slice_in_dim(
+                k, lax.dynamic_slice_in_dim(sk, r, 1, axis=1)[:, :, :, :P],
+                slots[r], axis=1,
+            )
+            v = lax.dynamic_update_slice_in_dim(
+                v, lax.dynamic_slice_in_dim(sv, r, 1, axis=1)[:, :, :, :P],
+                slots[r], axis=1,
+            )
+    wave_keys = jax.vmap(jax.random.key)(seeds)
+    slot_keys = slot_keys.at[slots].set(wave_keys)
+    temp = temp.at[slots].set(w_temp)
+    top_k = top_k.at[slots].set(w_top_k)
+    top_p = top_p.at[slots].set(w_top_p)
+    if sampled:
+        subs = jax.vmap(jax.random.fold_in)(wave_keys, true_lens)
+        firsts = sample_slots(last_logits, subs, w_temp, w_top_k, w_top_p)
+    else:
+        firsts = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
+    return k, v, tables, slot_keys, temp, top_k, top_p, firsts
+
+
 @dataclass
 class GenRequest:
     prompt: list[int]
@@ -136,6 +175,13 @@ class InferenceEngine:
             shardings = quantize_shardings(shardings)
         elif rt.quantization is not None:
             raise ValueError(f"unsupported quantization {rt.quantization!r}")
+        if rt.chunked_prefill and rt.max_seq_len % rt.prefill_chunk:
+            # buckets cap at max_seq_len; chunked admission needs every
+            # bucket to be a whole number of chunks
+            raise ValueError(
+                "chunked_prefill requires prefill_chunk to divide "
+                f"max_seq_len ({rt.prefill_chunk} vs {rt.max_seq_len})"
+            )
         if rt.attention_impl not in ("auto", "xla", "pallas", "pallas_interpret"):
             raise ValueError(
                 f"unsupported attention_impl {rt.attention_impl!r} "
@@ -200,6 +246,7 @@ class InferenceEngine:
 
         self._free: list[int] = list(range(B))
         self._active: dict[int, GenRequest] = {}
+        self._inflight: dict | None = None  # chunked-prefill wave in flight
         self._carry: list[GenRequest] = []  # wave-trimmed, ahead of the queue
         self._pending: deque[GenRequest] = deque()
         self._wake = asyncio.Event()
@@ -389,37 +436,84 @@ class InferenceEngine:
             logits, (sk, sv) = M.forward(
                 params, cfg, tokens, pos, scratch, jnp.full((R,), P, jnp.int32)
             )
-            if paged:
-                k, v = M.write_prefill_pages((k, v), (sk, sv), scatter_ids)
-                tables = tables.at[slots].set(page_rows)
-            else:
-                for r in range(R):  # R is small & static: unrolled row scatter
-                    k = lax.dynamic_update_slice_in_dim(
-                        k, lax.dynamic_slice_in_dim(sk, r, 1, axis=1)[:, :, :, :P],
-                        slots[r], axis=1,
-                    )
-                    v = lax.dynamic_update_slice_in_dim(
-                        v, lax.dynamic_slice_in_dim(sv, r, 1, axis=1)[:, :, :, :P],
-                        slots[r], axis=1,
-                    )
-            wave_keys = jax.vmap(jax.random.key)(seeds)
-            slot_keys = slot_keys.at[slots].set(wave_keys)
-            temp = temp.at[slots].set(w_temp)
-            top_k = top_k.at[slots].set(w_top_k)
-            top_p = top_p.at[slots].set(w_top_p)
             idx = jnp.clip(true_lens - 1, 0, P - 1)
             last_logits = jnp.take_along_axis(
                 logits, idx[:, None, None], axis=1
             )[:, 0]
-            if sampled:
-                subs = jax.vmap(jax.random.fold_in)(wave_keys, true_lens)
-                firsts = sample_slots(last_logits, subs, w_temp, w_top_k, w_top_p)
-            else:
-                firsts = jnp.argmax(last_logits, axis=-1).astype(jnp.int32)
-            return k, v, tables, slot_keys, temp, top_k, top_p, firsts
+            return _finalize_wave_math(
+                cfg, paged, sampled,
+                k, v, sk, sv, slots, true_lens, last_logits,
+                slot_keys, temp, top_k, top_p,
+                seeds, w_temp, w_top_k, w_top_p,
+                tables, page_rows, scatter_ids,
+            )
 
         fn = jax.jit(prefill, donate_argnums=(1, 2))
         self._prefill_jits[(bucket, rows, sampled)] = fn
+        return fn
+
+    # ------------------------------------------------- chunked prefill jits
+    def _chunk_jit(self, chunk: int, rows: int) -> Any:
+        """One prefill CHUNK: forward [R, chunk] at a data offset into the
+        wave's scratch cache.  One compile per (chunk, R) regardless of how
+        long prompts get — the offset is data."""
+        fn = self._prefill_jits.get(("chunk", chunk, rows))
+        if fn is not None:
+            return fn
+        cfg = self.config
+
+        def chunk_step(params, sk, sv, tokens_chunk, offset):
+            R = tokens_chunk.shape[0]
+            pos = offset + jnp.broadcast_to(
+                jnp.arange(chunk, dtype=jnp.int32), (R, chunk)
+            )
+            lens = jnp.full((R,), offset + chunk, jnp.int32)
+            logits, (sk, sv) = M.forward(
+                params, cfg, tokens_chunk, pos, (sk, sv), lens
+            )
+            return sk, sv, logits  # logits [R, chunk, V]
+
+        fn = jax.jit(chunk_step, donate_argnums=(1, 2))
+        self._prefill_jits[("chunk", chunk, rows)] = fn
+        return fn
+
+    def _finalize_jit(self, bucket: int, rows: int, sampled: bool) -> Any:
+        """The chunked wave's landing: scatter the finished scratch into the
+        cache (rows or pages), install sampling state, sample first tokens
+        from the LAST chunk's logits (same-bucket admission ⇒ every row's
+        final position lives in the final chunk)."""
+        fn = self._prefill_jits.get(("final", bucket, rows, sampled))
+        if fn is not None:
+            return fn
+        cfg = self.config
+        paged = self._paged
+        chunk = min(self.runtime.prefill_chunk, bucket)
+
+        def finalize(
+            k, v, sk, sv, slots, true_lens, last_chunk_logits,
+            slot_keys, temp, top_k, top_p,
+            seeds, w_temp, w_top_k, w_top_p,
+            tables=None, page_rows=None, scatter_ids=None,
+        ):
+            # logits index local to the final chunk
+            idx = jnp.clip(true_lens - 1 - (bucket - chunk), 0, chunk - 1)
+            last_logits = jnp.take_along_axis(
+                last_chunk_logits, idx[:, None, None], axis=1
+            )[:, 0]
+            return _finalize_wave_math(
+                cfg, paged, sampled,
+                k, v, sk, sv, slots, true_lens, last_logits,
+                slot_keys, temp, top_k, top_p,
+                seeds, w_temp, w_top_k, w_top_p,
+                tables, page_rows, scatter_ids,
+            )
+
+        # donate the cache (k/v alias their outputs); sk/sv have NO
+        # same-shaped output to alias into, so donating them only emits
+        # "donated buffers were not usable" warnings — peak HBM at landing
+        # (cache + scratch) already equals the chunk-step peak either way
+        fn = jax.jit(finalize, donate_argnums=(0, 1))
+        self._prefill_jits[("final", bucket, rows, sampled)] = fn
         return fn
 
     # ------------------------------------------------------------ lifecycle
@@ -450,6 +544,10 @@ class InferenceEngine:
         for request in self._carry:
             request.out.put_nowait(_DONE)
         self._carry.clear()
+        if self._inflight is not None:
+            for request in self._inflight["wave"]:
+                request.out.put_nowait(_DONE)
+            self._inflight = None
         while self._pending:
             self._pending.popleft().out.put_nowait(_DONE)
 
@@ -514,14 +612,16 @@ class InferenceEngine:
         try:
             while self._running:
                 self._reap_cancelled()
-                admitted = await self._admit()
-                if not self._active:
-                    if not admitted:
-                        self._wake.clear()
-                        if not self._pending and not self._carry:
-                            await self._wake.wait()
-                    continue
-                await asyncio.to_thread(self._decode_tick)
+                if self.runtime.chunked_prefill:
+                    progressed = await self._admit_chunked()
+                else:
+                    progressed = await self._admit()
+                if self._active:
+                    await asyncio.to_thread(self._decode_tick)
+                elif not progressed and self._inflight is None:
+                    self._wake.clear()
+                    if not self._pending and not self._carry:
+                        await self._wake.wait()
         except Exception:  # noqa: BLE001
             logger.exception("inference engine scheduler crashed")
             self._running = False
@@ -535,7 +635,23 @@ class InferenceEngine:
         Queued entries must be drained here too — leaving them in place
         would keep ``_pending`` non-empty and turn the idle wait in
         ``_serve`` into a busy spin with no suspension point.
+
+        A chunked inflight wave whose members ALL cancelled is aborted
+        outright (slots + page reservations released, remaining chunks
+        skipped); partially-cancelled waves finish their flight and shed
+        the cancelled members at activation.
         """
+        if self._inflight is not None and all(
+            r.cancelled for r in self._inflight["wave"]
+        ):
+            for request in self._inflight["wave"]:
+                if request.slot != -1:
+                    if self._paged:
+                        self._page_alloc.free(request.slot)
+                    self._free.append(request.slot)
+                    request.slot = -1
+                request.out.put_nowait(_DONE)
+            self._inflight = None
         for slot, request in list(self._active.items()):
             if request.cancelled:
                 self._active.pop(slot, None)
@@ -603,70 +719,91 @@ class InferenceEngine:
             rt.max_seq_len,
         )
 
-    async def _admit(self) -> bool:
-        admitted = False
-        while self._free and self._peek_pending() is not None:
-            # one admission WAVE: same-bucket requests prefill together
-            def bucket_of(req: GenRequest) -> int:
-                return self._bucket_of(len(req.prompt))
+    def _form_wave(self) -> "tuple[list[GenRequest], int] | None":
+        """Scheduling only (no device work): pop a same-bucket wave, assign
+        slots (and, when paged, reserve each request's full page footprint —
+        admission control, no mid-flight OOM).  None when nothing can be
+        admitted right now."""
+        if not self._free or self._peek_pending() is None:
+            return None
 
-            wave: list[GenRequest] = [self._next_pending()]
-            wave_bucket = bucket_of(wave[0])
-            while (
-                len(wave) < len(self._free)
-                and len(wave) < 8
-                and (peeked := self._peek_pending()) is not None
-                and bucket_of(peeked) == wave_bucket
-            ):
-                wave.append(self._next_pending())
-            # wave sizes are power-of-two so each prefill bucket compiles at
-            # most 4 jit variants (R in 1,2,4,8) instead of 8; trimmed
-            # requests go to the FRONT carry list, preserving arrival order
+        def bucket_of(req: GenRequest) -> int:
+            return self._bucket_of(len(req.prompt))
+
+        wave: list[GenRequest] = [self._next_pending()]
+        wave_bucket = bucket_of(wave[0])
+        while (
+            len(wave) < len(self._free)
+            and len(wave) < 8
+            and (peeked := self._peek_pending()) is not None
+            and bucket_of(peeked) == wave_bucket
+        ):
+            wave.append(self._next_pending())
+        # wave sizes are power-of-two so each prefill bucket compiles at
+        # most 4 jit variants (R in 1,2,4,8) instead of 8; trimmed
+        # requests go to the FRONT carry list, preserving arrival order
+        keep = 1
+        while keep * 2 <= len(wave):
+            keep *= 2
+        self._carry = wave[keep:] + self._carry
+        wave = wave[:keep]
+        if self._paged:
+            # the tail of an unservable wave waits at the queue front
+            granted: list[GenRequest] = []
+            for i, request in enumerate(wave):
+                slot = self._free.pop()
+                pages = self._page_alloc.alloc(
+                    slot, self._reserve_pages(request, wave_bucket)
+                )
+                if pages is None:
+                    self._free.append(slot)
+                    self._carry = wave[i:] + self._carry
+                    break
+                request.slot = slot
+                request.pages = pages
+                granted.append(request)
+            wave = granted
+            if not wave:
+                return None  # pool exhausted: wait for retirements
+            # keep jit variants power-of-two after page trimming too
             keep = 1
             while keep * 2 <= len(wave):
                 keep *= 2
+            for request in wave[keep:]:
+                self._page_alloc.free(request.slot)
+                self._free.append(request.slot)
+                request.slot = -1
             self._carry = wave[keep:] + self._carry
             wave = wave[:keep]
-            if self._paged:
-                # admission control: a request enters only with its full
-                # worst-case page footprint reserved (no mid-flight OOM);
-                # the tail of an unservable wave waits at the queue front
-                granted: list[GenRequest] = []
-                for i, request in enumerate(wave):
-                    slot = self._free.pop()
-                    pages = self._page_alloc.alloc(
-                        slot, self._reserve_pages(request, wave_bucket)
-                    )
-                    if pages is None:
-                        self._free.append(slot)
-                        self._carry = wave[i:] + self._carry
-                        break
-                    request.slot = slot
-                    request.pages = pages
-                    granted.append(request)
-                wave = granted
-                if not wave:
-                    break  # pool exhausted: wait for retirements
-                # keep jit variants power-of-two after page trimming too
-                keep = 1
-                while keep * 2 <= len(wave):
-                    keep *= 2
-                for request in wave[keep:]:
-                    self._page_alloc.free(request.slot)
-                    self._free.append(request.slot)
-                    request.slot = -1
-                self._carry = wave[keep:] + self._carry
-                wave = wave[:keep]
-            else:
-                for request in wave:
-                    request.slot = self._free.pop()
-            await asyncio.to_thread(self._prefill_wave, wave, wave_bucket)
+        else:
             for request in wave:
-                # a request can retire DURING its own prefill (first token
-                # was a stop, or max_new_tokens == 1): _emit already freed
-                # its slot and set slot = -1 — don't resurrect it
-                if request.slot != -1:
-                    self._active[request.slot] = request
+                request.slot = self._free.pop()
+        return wave, wave_bucket
+
+    def _activate_wave(self, wave: list[GenRequest]) -> None:
+        for request in wave:
+            # a request can retire DURING its own prefill (first token
+            # was a stop, or max_new_tokens == 1): _emit already freed
+            # its slot and set slot = -1 — don't resurrect it
+            if request.slot == -1:
+                continue
+            if request.cancelled:
+                # abandoned while its (chunked) admission was in flight:
+                # release the slot + pages instead of activating a corpse
+                if self._paged:
+                    self._page_alloc.free(request.slot)
+                self._free.append(request.slot)
+                request.slot = -1
+                request.out.put_nowait(_DONE)
+                continue
+            self._active[request.slot] = request
+
+    async def _admit(self) -> bool:
+        admitted = False
+        while (formed := self._form_wave()) is not None:
+            wave, wave_bucket = formed
+            await asyncio.to_thread(self._prefill_wave, wave, wave_bucket)
+            self._activate_wave(wave)
             admitted = True
         return admitted
 
@@ -674,7 +811,8 @@ class InferenceEngine:
     def _effective_sampling(self, request: GenRequest) -> SamplingParams:
         return request.sampling if request.sampling is not None else self.sampling
 
-    def _prefill_wave(self, wave: list[GenRequest], bucket: int) -> None:
+    def _wave_arrays(self, wave: list[GenRequest], bucket: int) -> dict:
+        """Host-side array prep shared by single-shot and chunked prefill."""
         R = len(wave)
         tokens = np.zeros((R, bucket), np.int32)
         true_lens = np.zeros((R,), np.int32)
@@ -697,45 +835,42 @@ class InferenceEngine:
             w_top_k[r] = params.top_k
             w_top_p[r] = params.top_p
             sampled |= not params.is_greedy
-        started = time.perf_counter()
-        fn = self._prefill_jit(bucket, R, sampled)
-        args = [
-            self.params,
-            self._k,
-            self._v,
-            jnp.asarray(tokens),
-            jnp.asarray(slots),
-            jnp.asarray(true_lens),
+        return dict(
+            tokens=tokens, true_lens=true_lens, slots=slots, seeds=seeds,
+            w_temp=w_temp, w_top_k=w_top_k, w_top_p=w_top_p, sampled=sampled,
+        )
+
+    def _sampling_state_args(self, arrays: dict) -> list:
+        return [
             self._slot_keys,
             self._temp,
             self._top_k,
             self._top_p,
-            jnp.asarray(seeds),
-            jnp.asarray(w_temp),
-            jnp.asarray(w_top_k),
-            jnp.asarray(w_top_p),
+            jnp.asarray(arrays["seeds"]),
+            jnp.asarray(arrays["w_temp"]),
+            jnp.asarray(arrays["w_top_k"]),
+            jnp.asarray(arrays["w_top_p"]),
         ]
-        if self._paged:
-            from calfkit_tpu.inference.paged import table_row
 
-            page = self.runtime.page_size
-            pmax = self.runtime.pages_per_seq()
-            npg = bucket // page
-            page_rows = np.zeros((R, pmax), np.int32)
-            scatter_ids = np.zeros((R, npg), np.int32)
-            for r, request in enumerate(wave):
-                page_rows[r] = table_row(request.pages, pmax)
-                # prefill writes whole bucket pages; reservation covers them
-                scatter_ids[r] = page_rows[r, :npg]
-            args += [self._tables, jnp.asarray(page_rows), jnp.asarray(scatter_ids)]
-        (
-            self._k, self._v, tables, self._slot_keys, self._temp,
-            self._top_k, self._top_p, firsts,
-        ) = fn(*args)
-        if self._paged:
-            self._tables = tables
-        firsts = np.asarray(firsts)
-        elapsed_ms = (time.perf_counter() - started) * 1000.0
+    def _paged_wave_args(self, wave: list[GenRequest], bucket: int) -> list:
+        from calfkit_tpu.inference.paged import table_row
+
+        R = len(wave)
+        page = self.runtime.page_size
+        pmax = self.runtime.pages_per_seq()
+        npg = bucket // page
+        page_rows = np.zeros((R, pmax), np.int32)
+        scatter_ids = np.zeros((R, npg), np.int32)
+        for r, request in enumerate(wave):
+            page_rows[r] = table_row(request.pages, pmax)
+            # prefill writes whole bucket pages; reservation covers them
+            scatter_ids[r] = page_rows[r, :npg]
+        return [self._tables, jnp.asarray(page_rows), jnp.asarray(scatter_ids)]
+
+    def _land_wave(
+        self, wave: list[GenRequest], true_lens: np.ndarray,
+        firsts: np.ndarray, elapsed_ms: float,
+    ) -> None:
         for r, request in enumerate(wave):
             request.prefill_ms = elapsed_ms
             self.stats.prefill_tokens += int(true_lens[r])
@@ -744,6 +879,106 @@ class InferenceEngine:
             self._last = self._last.at[request.slot].set(int(firsts[r]))
             self._host_lens[request.slot] = int(true_lens[r])
             self._emit(request, int(firsts[r]))
+
+    def _prefill_wave(self, wave: list[GenRequest], bucket: int) -> None:
+        R = len(wave)
+        arrays = self._wave_arrays(wave, bucket)
+        started = time.perf_counter()
+        fn = self._prefill_jit(bucket, R, arrays["sampled"])
+        args = [
+            self.params,
+            self._k,
+            self._v,
+            jnp.asarray(arrays["tokens"]),
+            jnp.asarray(arrays["slots"]),
+            jnp.asarray(arrays["true_lens"]),
+            *self._sampling_state_args(arrays),
+        ]
+        if self._paged:
+            args += self._paged_wave_args(wave, bucket)
+        (
+            self._k, self._v, tables, self._slot_keys, self._temp,
+            self._top_k, self._top_p, firsts,
+        ) = fn(*args)
+        if self._paged:
+            self._tables = tables
+        elapsed_ms = (time.perf_counter() - started) * 1000.0
+        self._land_wave(wave, arrays["true_lens"], np.asarray(firsts), elapsed_ms)
+
+    # --------------------------------------------------- chunked admission
+    async def _admit_chunked(self) -> bool:
+        """One scheduler pass of chunked admission: start an inflight wave
+        if none, then advance it by ONE chunk (finalizing on the last).  A
+        decode tick runs between passes, so active streams' inter-token
+        latency is bounded by one chunk instead of a whole bucket."""
+        if self._inflight is None:
+            formed = self._form_wave()
+            if formed is None:
+                return False
+            wave, bucket = formed
+            chunk = min(self.runtime.prefill_chunk, bucket)
+            cfg = self.config
+            R = len(wave)
+            scratch_shape = (
+                cfg.n_layers, R, cfg.n_kv_heads, bucket, cfg.head_dim
+            )
+            dtype = self._k.dtype
+            self._inflight = dict(
+                wave=wave, bucket=bucket, chunk=chunk,
+                n_chunks=-(-bucket // chunk), idx=0,
+                arrays=self._wave_arrays(wave, bucket),
+                scratch=(
+                    jnp.zeros(scratch_shape, dtype),
+                    jnp.zeros(scratch_shape, dtype),
+                ),
+                started=time.perf_counter(),
+            )
+        finished = await asyncio.to_thread(self._advance_inflight)
+        if finished:
+            wave = self._inflight["wave"]
+            self._inflight = None
+            self._activate_wave(wave)
+        return True
+
+    def _advance_inflight(self) -> bool:
+        """Run one chunk of the inflight wave; finalize after the last.
+        Returns True when the wave landed."""
+        inf = self._inflight
+        wave, bucket, chunk = inf["wave"], inf["bucket"], inf["chunk"]
+        arrays = inf["arrays"]
+        R = len(wave)
+        idx = inf["idx"]
+        sk, sv = inf["scratch"]
+        tok_chunk = jnp.asarray(
+            arrays["tokens"][:, idx * chunk:(idx + 1) * chunk]
+        )
+        sk, sv, logits = self._chunk_jit(chunk, R)(
+            self.params, sk, sv, tok_chunk, jnp.int32(idx * chunk)
+        )
+        inf["scratch"] = (sk, sv)
+        inf["idx"] = idx + 1
+        if inf["idx"] < inf["n_chunks"]:
+            return False
+        # last chunk done: land the wave
+        fn = self._finalize_jit(bucket, R, arrays["sampled"])
+        args = [
+            self._k, self._v, sk, sv,
+            jnp.asarray(arrays["slots"]),
+            jnp.asarray(arrays["true_lens"]),
+            logits,
+            *self._sampling_state_args(arrays),
+        ]
+        if self._paged:
+            args += self._paged_wave_args(wave, bucket)
+        (
+            self._k, self._v, tables, self._slot_keys, self._temp,
+            self._top_k, self._top_p, firsts,
+        ) = fn(*args)
+        if self._paged:
+            self._tables = tables
+        elapsed_ms = (time.perf_counter() - inf["started"]) * 1000.0
+        self._land_wave(wave, arrays["true_lens"], np.asarray(firsts), elapsed_ms)
+        return True
 
     def _decode_tick(self) -> None:
         active_mask = np.zeros((self.runtime.max_batch_size,), bool)

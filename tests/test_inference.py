@@ -1,6 +1,7 @@
 """Inference backend on CPU XLA: model math, engine scheduling, client."""
 
 import asyncio
+import time
 
 import pytest
 
@@ -700,4 +701,131 @@ class TestRandomQuantizedParams:
         assert len(out) == 8
         out2 = [t async for t in engine.generate([1, 5, 9], max_new_tokens=8)]
         assert out2 == out  # deterministic through the quantized path
+        await engine.stop()
+
+
+class TestChunkedPrefill:
+    """Opt-in chunked admission: long prompts advance one chunk per
+    scheduler pass with decode ticks in between (round 2)."""
+
+    def _engine(self, layout="dense", chunk=16, **over):
+        kw = dict(
+            max_batch_size=4, max_seq_len=128, prefill_chunk=chunk,
+            decode_steps_per_dispatch=4, page_size=16, kv_layout=layout,
+            chunked_prefill=True,
+        )
+        kw.update(over)
+        return InferenceEngine(CFG, RuntimeConfig(**kw), seed=3)
+
+    async def test_chunked_matches_single_shot(self):
+        plain = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=4, max_seq_len=128, prefill_chunk=16,
+                          decode_steps_per_dispatch=4),
+            seed=3,
+        )
+        chunked = self._engine()
+        await plain.start()
+        await chunked.start()
+        # one-chunk, exact-multiple, and straddling lengths
+        for prompt in ([1, 5, 9], list(range(2, 34)), list(range(3, 60))):
+            want = [t async for t in plain.generate(prompt, max_new_tokens=16)]
+            got = [t async for t in chunked.generate(prompt, max_new_tokens=16)]
+            assert got == want, f"chunked diverged at len {len(prompt)}"
+        await plain.stop()
+        await chunked.stop()
+
+    async def test_chunked_paged_matches_dense(self):
+        dense = self._engine("dense")
+        paged = self._engine("paged")
+        await dense.start()
+        await paged.start()
+        prompt = list(range(2, 50))
+        want = [t async for t in dense.generate(prompt, max_new_tokens=12)]
+        got = [t async for t in paged.generate(prompt, max_new_tokens=12)]
+        assert got == want
+        await dense.stop()
+        await paged.stop()
+
+    async def test_decode_progresses_during_long_prefill(self):
+        """The whole point: an active stream keeps emitting while a long
+        admission is in flight."""
+        engine = self._engine(chunk=16, max_seq_len=256)
+        await engine.start()
+        # occupy a slot with an active stream
+        active = engine.generate([1, 2], max_new_tokens=200)
+        times: list[float] = []
+
+        async def consume_active():
+            async for _ in active:
+                times.append(time.perf_counter())
+
+        consumer = asyncio.create_task(consume_active())
+        await asyncio.sleep(0.5)  # stream is decoding
+        before = len(times)
+        # a LONG prompt (8 chunks): chunked admission interleaves
+        long_out = [
+            t async for t in engine.generate(
+                list(range(2, 130)), max_new_tokens=8
+            )
+        ]
+        assert len(long_out) == 8
+        during = len(times) - before
+        assert during > 0, "active stream starved during long admission"
+        consumer.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await consumer
+        await active.aclose()
+        await engine.stop()
+
+    async def test_stop_mid_inflight_releases_waiters(self):
+        engine = self._engine(chunk=16, max_seq_len=256)
+        await engine.start()
+        agen = engine.generate(list(range(2, 130)), max_new_tokens=8)
+        starter = asyncio.create_task(anext(agen))
+        await asyncio.sleep(0.05)  # admission likely mid-chunk
+        await engine.stop()
+        with pytest.raises((StopAsyncIteration, asyncio.CancelledError)):
+            await starter
+        await agen.aclose()
+
+    async def test_sampled_chunked_reproducible(self):
+        engine = self._engine()
+        await engine.start()
+        params = SamplingParams(temperature=1.1, top_k=30)
+        prompt = list(range(2, 40))
+        out1 = [t async for t in engine.generate(
+            prompt, max_new_tokens=10, sampling=params, seed=5)]
+        out2 = [t async for t in engine.generate(
+            prompt, max_new_tokens=10, sampling=params, seed=5)]
+        assert out1 == out2
+        await engine.stop()
+
+    def test_unaligned_chunking_rejected(self):
+        with pytest.raises(ValueError, match="chunked_prefill"):
+            InferenceEngine(
+                CFG,
+                RuntimeConfig(max_batch_size=2, max_seq_len=120,
+                              prefill_chunk=16, chunked_prefill=True),
+            )
+
+    async def test_fully_cancelled_inflight_wave_aborts(self):
+        engine = self._engine(chunk=16, max_seq_len=256, layout="paged")
+        await engine.start()
+        agen = engine.generate(list(range(2, 130)), max_new_tokens=8)
+        starter = asyncio.create_task(anext(agen))
+        await asyncio.sleep(0.1)  # admission in flight
+        starter.cancel()
+        with pytest.raises(asyncio.CancelledError):
+            await starter
+        await agen.aclose()
+        for _ in range(100):
+            if engine._inflight is None and not engine._page_alloc.held_slots:
+                break
+            await asyncio.sleep(0.05)
+        assert engine._inflight is None
+        assert not engine._page_alloc.held_slots  # reservation released
+        # engine still serves
+        out = [t async for t in engine.generate([4, 5], max_new_tokens=6)]
+        assert len(out) == 6
         await engine.stop()
