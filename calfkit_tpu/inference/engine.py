@@ -902,8 +902,11 @@ class InferenceEngine:
         ) = fn(*args)
         if self._paged:
             self._tables = tables
+        # sync BEFORE timing: with async dispatch, fn() returns before the
+        # device runs — prefill_ms must be real latency, not enqueue time
+        firsts = np.asarray(firsts)
         elapsed_ms = (time.perf_counter() - started) * 1000.0
-        self._land_wave(wave, arrays["true_lens"], np.asarray(firsts), elapsed_ms)
+        self._land_wave(wave, arrays["true_lens"], firsts, elapsed_ms)
 
     # --------------------------------------------------- chunked admission
     async def _admit_chunked(self) -> bool:
@@ -976,8 +979,9 @@ class InferenceEngine:
         ) = fn(*args)
         if self._paged:
             self._tables = tables
+        firsts = np.asarray(firsts)  # sync before timing (real latency)
         elapsed_ms = (time.perf_counter() - inf["started"]) * 1000.0
-        self._land_wave(wave, arrays["true_lens"], np.asarray(firsts), elapsed_ms)
+        self._land_wave(wave, arrays["true_lens"], firsts, elapsed_ms)
         return True
 
     def _decode_tick(self) -> None:

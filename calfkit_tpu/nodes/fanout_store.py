@@ -94,8 +94,16 @@ FANOUT_STORE_KEY = "fanout_store"
 class KtablesFanoutBatchStore:
     """The production store over two compacted mesh tables."""
 
-    def __init__(self, transport: MeshTransport, node_id: str):
+    def __init__(
+        self,
+        transport: MeshTransport,
+        node_id: str,
+        config: "FanoutConfig | None" = None,
+    ):
+        from calfkit_tpu.tuning import FanoutConfig
+
         self._transport = transport
+        self._config = config or FanoutConfig()
         self._state_topic = protocol.fanout_state_topic(node_id)
         self._base_topic = protocol.fanout_basestate_topic(node_id)
         self._state_reader = transport.table_reader(self._state_topic)
@@ -107,8 +115,9 @@ class KtablesFanoutBatchStore:
         await self._transport.ensure_topics(
             [self._state_topic, self._base_topic], compacted=True
         )
-        await self._base_reader.start()
-        await self._state_reader.start()
+        timeout = self._config.table.catchup_timeout_s
+        await self._base_reader.start(timeout=timeout)
+        await self._state_reader.start(timeout=timeout)
 
     async def stop(self) -> None:
         await self._state_reader.stop()
@@ -126,12 +135,16 @@ class KtablesFanoutBatchStore:
         )
 
     async def load(self, fanout_id: str) -> FanoutState | None:
-        await self._state_reader.barrier()
+        await self._state_reader.barrier(
+            timeout=self._config.table.barrier_timeout_s
+        )
         raw = self._state_reader.get(fanout_id)
         return FanoutState.model_validate_json(raw) if raw else None
 
     async def load_snapshot(self, fanout_id: str) -> EnvelopeSnapshot | None:
-        await self._base_reader.barrier()
+        await self._base_reader.barrier(
+            timeout=self._config.table.barrier_timeout_s
+        )
         raw = self._base_reader.get(fanout_id)
         return EnvelopeSnapshot.model_validate_json(raw) if raw else None
 

@@ -1,0 +1,29 @@
+"""Tuning value-objects (reference: calfkit/tuning.py:20-74).
+
+Strictly-validated knobs for the compacted-table machinery.  The important
+semantic: catch-up is a GATE (a store/view must not serve until it has read
+the table to its end) and barriers are read-your-own-writes freshness —
+both are bounded by these timeouts so a dead broker turns into a loud
+error instead of a silent hang.
+"""
+
+from __future__ import annotations
+
+from pydantic import BaseModel, Field
+
+
+class TableTuning(BaseModel):
+    """Compacted-table reader bounds (the KTableReaderTuning analog)."""
+
+    catchup_timeout_s: float = Field(30.0, gt=0)
+    barrier_timeout_s: float = Field(30.0, gt=0)
+
+
+class FanoutConfig(BaseModel):
+    """Durable fan-out store tuning, threaded via ``Worker(fanout=...)``.
+
+    Raise the timeouts on slow brokers; the write-order and fold/close
+    semantics are not configurable (they are the correctness story).
+    """
+
+    table: TableTuning = Field(default_factory=TableTuning)

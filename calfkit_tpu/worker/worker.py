@@ -43,6 +43,7 @@ class Worker(LifecycleHookMixin):
         max_workers: int = 8,
         owns_transport: bool = False,
         control_plane: Any = None,
+        fanout: Any = None,  # FanoutConfig | None
     ):
         super().__init__()
         if not nodes:
@@ -59,6 +60,13 @@ class Worker(LifecycleHookMixin):
         self.group_id = group_id
         self.max_workers = max_workers
         self.owns_transport = owns_transport or owned
+        from calfkit_tpu.tuning import FanoutConfig
+
+        if fanout is not None and not isinstance(fanout, FanoutConfig):
+            raise LifecycleConfigError(
+                f"fanout must be a FanoutConfig, got {type(fanout).__name__}"
+            )
+        self.fanout_config = fanout
         # control plane default ON: pass False (or a disabled config) to opt
         # out; a ControlPlaneConfig customizes; a ControlPlane is used as-is
         from calfkit_tpu.controlplane import ControlPlane, ControlPlaneConfig
@@ -114,7 +122,9 @@ class Worker(LifecycleHookMixin):
             for key, value in self.resources.items():
                 node.resources.setdefault(key, value)
             if FANOUT_STORE_KEY not in node.resources:
-                store = KtablesFanoutBatchStore(self.mesh, node.node_id)
+                store = KtablesFanoutBatchStore(
+                    self.mesh, node.node_id, self.fanout_config
+                )
                 await store.start()
                 self._stores.append(store)
                 node.resources[FANOUT_STORE_KEY] = store

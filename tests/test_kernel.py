@@ -556,3 +556,56 @@ class TestReviewRegressions:
                  if s.kind == "agent_message"]
         assert "closing words" in texts  # close-hop facts must stream
         await mesh.stop()
+
+
+class TestFanoutTuning:
+    async def test_fanout_config_threads_to_store_timeouts(self):
+        """Worker(fanout=FanoutConfig) bounds catch-up and barriers
+        (reference: tuning.py KTableReaderTuning/FanoutConfig)."""
+        from calfkit_tpu.tuning import FanoutConfig, TableTuning
+
+        seen: dict[str, float] = {}
+
+        class SpyReader:
+            def __init__(self, inner):
+                self._inner = inner
+
+            async def start(self, *, timeout=30.0):
+                seen["catchup"] = timeout
+                await self._inner.start(timeout=timeout)
+
+            async def barrier(self, *, timeout=30.0):
+                seen["barrier"] = timeout
+                await self._inner.barrier(timeout=timeout)
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        class SpyMesh(InMemoryMesh):
+            def table_reader(self, topic):
+                return SpyReader(super().table_reader(topic))
+
+        from calfkit_tpu.nodes.fanout_store import KtablesFanoutBatchStore
+
+        mesh = SpyMesh()
+        await mesh.start()
+        config = FanoutConfig(
+            table=TableTuning(catchup_timeout_s=7.5, barrier_timeout_s=3.25)
+        )
+        store = KtablesFanoutBatchStore(mesh, "agent.tuned", config)
+        await store.start()
+        assert seen["catchup"] == 7.5
+        await store.load("nonexistent")
+        assert seen["barrier"] == 3.25
+        await store.stop()
+        await mesh.stop()
+
+    def test_worker_rejects_wrong_fanout_type(self):
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.exceptions import LifecycleConfigError
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        agent = Agent("t", model=TestModelClient())
+        with pytest.raises(LifecycleConfigError, match="FanoutConfig"):
+            Worker([agent], mesh=InMemoryMesh(), fanout={"nope": 1})
