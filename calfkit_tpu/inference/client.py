@@ -373,7 +373,10 @@ class JaxLocalModelClient(ModelClient):
         try:
             async for token in token_stream:
                 generated.append(token)
-                if len(generated) % _EMIT_EVERY:
+                # the first token is emitted immediately (it IS the TTFT
+                # moment — right after prefill); later ones batch on the
+                # re-decode cadence
+                if len(generated) % _EMIT_EVERY and len(generated) != 1:
                     continue
                 # emit only the prefix that can't change: a trailing
                 # replacement char may be a multi-byte sequence completing
