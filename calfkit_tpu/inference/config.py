@@ -73,6 +73,14 @@ class RuntimeConfig:
     # active streams stays bounded by one chunk + one tick)
     chunked_prefill: bool = False
     attention_impl: str = "auto"  # auto | xla | pallas | pallas_interpret
+    # long-context lane: prompts that cannot fit a short-lane slot
+    # (len >= max_seq_len) are served via sequence-parallel ring prefill
+    # over an `sp` mesh of ALL the engine's devices + context-parallel
+    # decode against the still-sharded prefix (greedy; one request at a
+    # time — the whole mesh cooperates on it)
+    long_context: bool = False
+    long_new_cap: int = 512  # max new tokens a long request may generate
+    long_max_prompt: int = 0  # prompt-length ceiling; 0 → 8 x max_seq_len
     # decode attention window buckets (each is one jit specialization);
     # sparse buckets = few compiles, dense = tighter HBM reads
     window_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)

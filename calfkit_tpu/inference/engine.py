@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import math
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -112,6 +113,8 @@ class EngineStats:
     decode_dispatches: int = 0
     decode_time_s: float = 0.0
     occupancy_sum: float = 0.0
+    long_requests: int = 0  # served via the sequence-parallel lane
+    long_dispatches: int = 0  # sp-lane decode dispatches (whole-mesh units)
 
     @property
     def tokens_per_second(self) -> float:
@@ -249,6 +252,10 @@ class InferenceEngine:
         self._inflight: dict | None = None  # chunked-prefill wave in flight
         self._carry: list[GenRequest] = []  # wave-trimmed, ahead of the queue
         self._pending: deque[GenRequest] = deque()
+        # long-context lane (sequence-parallel; one request at a time)
+        self._long_pending: deque[GenRequest] = deque()
+        self._long: dict | None = None  # active long request's device state
+        self._sp_mesh_cache: Any = None
         self._wake = asyncio.Event()
         self._task: asyncio.Task[None] | None = None
         self._running = False
@@ -550,6 +557,11 @@ class InferenceEngine:
             self._inflight = None
         while self._pending:
             self._pending.popleft().out.put_nowait(_DONE)
+        if self._long is not None:
+            self._long["request"].out.put_nowait(_DONE)
+            self._long = None
+        while self._long_pending:
+            self._long_pending.popleft().out.put_nowait(_DONE)
 
     # -------------------------------------------------------------- submit
     async def generate(
@@ -570,10 +582,18 @@ class InferenceEngine:
         """
         if not self._running:
             raise InferenceError("engine not started")
-        if len(prompt) >= self.runtime.max_seq_len:
+        long_lane = len(prompt) >= self.runtime.max_seq_len
+        if long_lane and not self.runtime.long_context:
             raise InferenceError(
                 f"prompt of {len(prompt)} tokens exceeds max_seq_len "
-                f"{self.runtime.max_seq_len}"
+                f"{self.runtime.max_seq_len} "
+                "(enable RuntimeConfig(long_context=True) to serve it via "
+                "the sequence-parallel lane)"
+            )
+        if long_lane and len(prompt) > self._long_max_prompt():
+            raise InferenceError(
+                f"prompt of {len(prompt)} tokens exceeds long_max_prompt "
+                f"{self._long_max_prompt()}"
             )
         request = GenRequest(
             prompt=list(prompt),
@@ -582,6 +602,30 @@ class InferenceEngine:
             sampling=sampling,
             seed=seed,
         )
+        if long_lane:
+            if max_new_tokens > self.runtime.long_new_cap:
+                # the carried fresh cache is statically sized by the cap
+                request.max_new_tokens = self.runtime.long_new_cap
+                logger.warning(
+                    "long request clamped to long_new_cap=%d new tokens",
+                    self.runtime.long_new_cap,
+                )
+            if not self._effective_sampling(request).is_greedy:
+                # covers a non-greedy ENGINE default too, not just
+                # per-request settings
+                logger.warning(
+                    "long-context lane decodes greedily; sampling settings "
+                    "are ignored for this request"
+                )
+            self._long_pending.append(request)
+            self._wake.set()
+            inner = self._consume(request)
+            try:
+                async for item in inner:
+                    yield item
+            finally:
+                await inner.aclose()
+            return
         if self._paged:
             # reject what the pool could NEVER serve — re-queueing it would
             # wait (and starve everything behind it) forever
@@ -594,6 +638,18 @@ class InferenceEngine:
                 )
         self._pending.append(request)
         self._wake.set()
+        inner = self._consume(request)
+        try:
+            async for item in inner:
+                yield item
+        finally:
+            # aclose() on OUR iterator must cancel NOW, not whenever the
+            # asyncgen finalizer gets around to collecting the inner one
+            await inner.aclose()
+
+    async def _consume(self, request: GenRequest) -> AsyncIterator[int]:
+        """Drain a queued request's tokens; abandoning the iterator flags
+        cancellation for the scheduler to reap (both lanes share this)."""
         done = False
         try:
             while True:
@@ -616,11 +672,15 @@ class InferenceEngine:
                     progressed = await self._admit_chunked()
                 else:
                     progressed = await self._admit()
+                progressed |= await self._advance_long()
                 if self._active:
                     await asyncio.to_thread(self._decode_tick)
                 elif not progressed and self._inflight is None:
                     self._wake.clear()
-                    if not self._pending and not self._carry:
+                    if (
+                        not self._pending and not self._carry
+                        and not self._long_pending and self._long is None
+                    ):
                         await self._wake.wait()
         except Exception:  # noqa: BLE001
             logger.exception("inference engine scheduler crashed")
@@ -676,6 +736,17 @@ class InferenceEngine:
                 else:
                     kept_q.append(request)
             self._pending = kept_q
+        if self._long is not None and self._long["request"].cancelled:
+            self._long["request"].out.put_nowait(_DONE)
+            self._long = None
+        if any(r.cancelled for r in self._long_pending):
+            kept_l: deque[GenRequest] = deque()
+            for request in self._long_pending:
+                if request.cancelled:
+                    request.out.put_nowait(_DONE)
+                else:
+                    kept_l.append(request)
+            self._long_pending = kept_l
 
     def _next_pending(self) -> GenRequest | None:
         while self._carry or self._pending:
@@ -806,6 +877,146 @@ class InferenceEngine:
             self._activate_wave(wave)
             admitted = True
         return admitted
+
+    # ------------------------------------------------- long-context lane
+    # Prompts that cannot fit a short-lane slot are served one at a time:
+    # sequence-parallel ring prefill shards the prompt over an `sp` mesh of
+    # ALL the engine's devices, and decode runs context-parallel against
+    # the still-sharded prefix (``ring_attention.decode_sp_dispatch``).
+    # The lane interleaves with short-lane ticks in ``_serve``: one long
+    # dispatch per scheduler pass, so short streams' inter-token latency
+    # stays bounded while a long request is in flight.
+
+    def _long_max_prompt(self) -> int:
+        rt = self.runtime
+        return rt.long_max_prompt or 8 * rt.max_seq_len
+
+    def _sp_mesh(self) -> Any:
+        if self._sp_mesh_cache is None:
+            from jax.sharding import Mesh
+
+            devices = np.asarray(self.mesh.devices).reshape(-1)
+            self._sp_mesh_cache = Mesh(devices, ("sp",))
+        return self._sp_mesh_cache
+
+    def _long_fresh_cap(self) -> int:
+        """Static size of the carried fresh cache — ONE compile for every
+        long request regardless of its max_new_tokens."""
+        steps = self.runtime.decode_steps_per_dispatch
+        return -(-self.runtime.long_new_cap // steps) * steps
+
+    async def _advance_long(self) -> bool:
+        if not self.runtime.long_context:
+            return False
+        if self._long is None:
+            request = None
+            while self._long_pending:
+                candidate = self._long_pending.popleft()
+                if candidate.cancelled:
+                    candidate.out.put_nowait(_DONE)
+                    continue
+                request = candidate
+                break
+            if request is None:
+                return False
+            await asyncio.to_thread(self._long_prefill, request)
+            return True
+        await asyncio.to_thread(self._long_decode_tick)
+        return True
+
+    def _long_prefill(self, request: GenRequest) -> None:
+        from calfkit_tpu.inference.ring_attention import (
+            prefill_sequence_parallel,
+        )
+
+        rt = self.runtime
+        mesh = self._sp_mesh()
+        sp = mesh.shape["sp"]
+        n = len(request.prompt)
+        # pad to power-of-two multiples of lcm(sp, prefill_chunk): the
+        # sequence must divide over sp, and power-of-two bucketing bounds
+        # the sp-prefill compile count at log(range) shapes
+        g = math.lcm(sp, rt.prefill_chunk)
+        units = -(-n // g)
+        p2 = 1
+        while p2 < units:
+            p2 *= 2
+        padded = g * p2
+        tokens = np.zeros((1, padded), np.int32)
+        tokens[0, :n] = request.prompt
+        started = time.perf_counter()
+        last_logits, (k_prefix, v_prefix) = prefill_sequence_parallel(
+            self.params, self.config, jnp.asarray(tokens), mesh,
+            seq_lens=jnp.asarray([n], jnp.int32),
+        )
+        first = int(np.asarray(jnp.argmax(last_logits[0])))
+        request.prefill_ms = (time.perf_counter() - started) * 1000.0
+        self.stats.prefill_tokens += n
+        self.stats.long_requests += 1
+        if self._emit_long(request, first):
+            return
+        cfg = self.config
+        cap = self._long_fresh_cap()
+        fresh_shape = (cfg.n_layers, 1, cfg.n_kv_heads, cap, cfg.head_dim)
+        self._long = dict(
+            request=request,
+            prefix=(k_prefix, v_prefix),
+            prefix_len=n,
+            fresh=(
+                jnp.zeros(fresh_shape, jnp.float32),
+                jnp.zeros(fresh_shape, jnp.float32),
+            ),
+            t=0,
+            cap=cap,
+            last=jnp.asarray([first], jnp.int32),
+        )
+
+    def _long_decode_tick(self) -> None:
+        from calfkit_tpu.inference.ring_attention import decode_sp_dispatch
+
+        state = self._long
+        request = state["request"]
+        steps = min(
+            self.runtime.decode_steps_per_dispatch,
+            state["cap"] - state["t"],
+        )
+        started = time.perf_counter()
+        toks, last, fresh = decode_sp_dispatch(
+            self.params, self.config, state["last"], state["prefix"],
+            jnp.asarray([state["prefix_len"]], jnp.int32),
+            state["fresh"], state["t"], self._sp_mesh(), steps,
+        )
+        block = np.asarray(toks)[0]  # host sync per dispatch
+        elapsed = time.perf_counter() - started
+        state["fresh"] = fresh
+        state["last"] = last
+        state["t"] += steps
+        # NOT decode_dispatches: that counter is mean_occupancy's
+        # denominator, and a long dispatch uses the whole mesh, not slots
+        self.stats.long_dispatches += 1
+        self.stats.decode_time_s += elapsed
+        done = False
+        for token in block:
+            done = self._emit_long(request, int(token))
+            if done:
+                break
+        if done or state["t"] >= state["cap"]:
+            if not done:
+                self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)
+            self._long = None
+
+    def _emit_long(self, request: GenRequest, token: int) -> bool:
+        """Record one long-lane token (runs on the to_thread worker);
+        returns True when the request retired."""
+        request.generated += 1
+        hit_stop = token in request.stop_tokens
+        if not hit_stop:
+            self._loop.call_soon_threadsafe(request.out.put_nowait, token)
+            self.stats.decode_tokens += 1
+        done = hit_stop or request.generated >= request.max_new_tokens
+        if done:
+            self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)
+        return done
 
     # ------------------------------------------------------- device work
     def _effective_sampling(self, request: GenRequest) -> SamplingParams:

@@ -341,42 +341,81 @@ def decode_with_sharded_prefix(
     """Greedy-decode ``steps`` tokens directly against a ring-prefilled,
     still-sequence-sharded KV prefix — no resharding, no consolidation.
 
-    Fresh K/V accumulates in a small replicated cache ([L, B, K, steps, hd])
-    merged with the context-parallel prefix source via the shared logsumexp
-    law.  → [B, steps] int32 greedy tokens.  (The continuous-batching
-    engine remains the short-context path; this is the long-context serving
-    seam for prompts that had to prefill across chips.)
+    One-shot convenience over :func:`decode_sp_dispatch` (the serving
+    engine's carried unit): fresh K/V accumulates in a small replicated
+    cache merged with the context-parallel prefix source via the shared
+    logsumexp law.  → [B, steps] int32 greedy tokens.
     """
     k_prefix, v_prefix = prefix
+    B = first_token.shape[0]
+    L, Kh, hd = config.n_layers, config.n_kv_heads, config.head_dim
+    fresh = (
+        jnp.zeros((L, B, Kh, steps, hd), jnp.float32),
+        jnp.zeros((L, B, Kh, steps, hd), jnp.float32),
+    )
+    toks, _last, _fresh = decode_sp_dispatch(
+        params, config, first_token, (k_prefix, v_prefix), prefix_lens,
+        fresh, jnp.int32(0), mesh, steps, axis=axis,
+    )
+    return toks
+
+
+def decode_sp_dispatch(
+    params: dict,
+    config,
+    token: jax.Array,  # [B] last sampled token (enters this dispatch)
+    prefix: tuple[jax.Array, jax.Array],  # [L, B, K, S, hd] sharded over axis
+    prefix_lens: jax.Array,  # [B]
+    fresh: tuple[jax.Array, jax.Array],  # [L, B, K, cap, hd] replicated carry
+    t0: jax.Array,  # scalar int32: fresh tokens already generated
+    mesh: Mesh,
+    steps: int,
+    *,
+    axis: str = "sp",
+) -> tuple[jax.Array, jax.Array, tuple[jax.Array, jax.Array]]:
+    """One long-lane decode DISPATCH: ``steps`` greedy tokens against a
+    sequence-sharded prefix, carrying the replicated fresh cache across
+    dispatches (this is the serving engine's long-context unit of work —
+    the analog of the short lane's ring-buffer decode tick).
+
+    → (toks [B, steps], last_token [B], fresh) with fresh slots
+    [t0, t0+steps) filled; the cap bounds total generation per request.
+    """
+    k_prefix, v_prefix = prefix
+    cap = fresh[0].shape[3]
     try:
-        fn = _decode_sp_jit(config, mesh, axis, steps, first_token.shape[0])
+        fn = _decode_sp_jit(
+            config, mesh, axis, steps, token.shape[0], cap
+        )
     except TypeError:  # unhashable config/mesh: uncached fallback
-        fn = _build_decode_sp(config, mesh, axis, steps, first_token.shape[0])
-    return fn(params, first_token, k_prefix, v_prefix, prefix_lens)
+        fn = _build_decode_sp(
+            config, mesh, axis, steps, token.shape[0], cap
+        )
+    return fn(
+        params, token, k_prefix, v_prefix, prefix_lens,
+        fresh[0], fresh[1], jnp.asarray(t0, jnp.int32),
+    )
 
 
 @functools.lru_cache(maxsize=32)
-def _decode_sp_jit(config, mesh: Mesh, axis: str, steps: int, B: int):
-    """One compile per (config, mesh, axis, steps, B) — the multi-step
+def _decode_sp_jit(config, mesh: Mesh, axis: str, steps: int, B: int, cap: int):
+    """One compile per (config, mesh, axis, steps, B, cap) — the multi-step
     decode program is seconds of trace+compile per shape."""
-    return _build_decode_sp(config, mesh, axis, steps, B)
+    return _build_decode_sp(config, mesh, axis, steps, B, cap)
 
 
-def _build_decode_sp(config, mesh: Mesh, axis: str, steps: int, B: int):
+def _build_decode_sp(config, mesh: Mesh, axis: str, steps: int, B: int,
+                     cap: int):
     from calfkit_tpu.inference import model as M
 
-    L = config.n_layers
     Kh, hd, eps = config.n_kv_heads, config.head_dim, config.norm_eps
 
-    def fn(params, first_token, k_prefix, v_prefix, prefix_lens):
-        fresh = (
-            jnp.zeros((L, B, Kh, steps, hd), jnp.float32),
-            jnp.zeros((L, B, Kh, steps, hd), jnp.float32),
-        )
-
-        def one_step(carry, t):
+    def fn(params, first_token, k_prefix, v_prefix, prefix_lens,
+           fresh_k0, fresh_v0, t0):
+        def one_step(carry, i):
             token, fresh = carry
             fresh_k, fresh_v = fresh
+            t = t0 + i  # global fresh index: carries across dispatches
             positions = (prefix_lens + t)[:, None]
             x = params["embed"][token[:, None]]
             cos, sin = M.rope_tables(positions, hd, config.rope_theta)
@@ -396,7 +435,7 @@ def _build_decode_sp(config, mesh: Mesh, axis: str, steps: int, B: int):
                 qg = q.reshape(B, Kh, -1, hd)
                 o2, m2, z2 = M.ring_attention_source(
                     qg,
-                    jnp.transpose(fk, (2, 0, 1, 3)),  # -> [steps, B, K, hd]
+                    jnp.transpose(fk, (2, 0, 1, 3)),  # -> [cap, B, K, hd]
                     jnp.transpose(fv, (2, 0, 1, 3)),
                     t,
                 )
@@ -413,9 +452,9 @@ def _build_decode_sp(config, mesh: Mesh, axis: str, steps: int, B: int):
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             return (nxt, (fresh_k, fresh_v)), nxt
 
-        (_, _), toks = lax.scan(
-            one_step, (first_token, fresh), jnp.arange(steps)
+        (last, fresh), toks = lax.scan(
+            one_step, (first_token, (fresh_k0, fresh_v0)), jnp.arange(steps)
         )
-        return jnp.swapaxes(toks, 0, 1)  # [B, steps]
+        return jnp.swapaxes(toks, 0, 1), last, fresh  # toks [B, steps]
 
-    return jax.jit(fn)
+    return jax.jit(fn, donate_argnums=(5, 6))

@@ -829,3 +829,149 @@ class TestChunkedPrefill:
         out = [t async for t in engine.generate([4, 5], max_new_tokens=6)]
         assert len(out) == 6
         await engine.stop()
+
+
+class TestLongContextLane:
+    """Prompts beyond max_seq_len served through the engine's
+    sequence-parallel lane (ring prefill + context-parallel decode),
+    unified with the slot scheduler (PARITY known-gap closure)."""
+
+    @staticmethod
+    def _params():
+        return M.init_params(CFG, jax.random.key(3), dtype=jnp.float32)
+
+    def _long_engine(self, params, **rt):
+        defaults = dict(
+            max_batch_size=2, max_seq_len=64, prefill_chunk=16,
+            decode_steps_per_dispatch=4, long_context=True, long_new_cap=16,
+        )
+        defaults.update(rt)
+        return InferenceEngine(CFG, RuntimeConfig(**defaults), params=params)
+
+    async def test_long_prompt_matches_short_lane(self):
+        """The same 100-token prompt produces identical greedy tokens via
+        the long lane (max_seq_len=64 engine) and via the ordinary short
+        lane of a roomier engine — one merge law everywhere."""
+        params = self._params()
+        prompt = [(7 * i + 3) % CFG.vocab_size for i in range(100)]
+
+        long_engine = self._long_engine(params)
+        await long_engine.start()
+        got = [t async for t in long_engine.generate(prompt, max_new_tokens=8)]
+        assert long_engine.stats.long_requests == 1
+        await long_engine.stop()
+
+        ref_engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=2, max_seq_len=256, prefill_chunk=16,
+                          decode_steps_per_dispatch=4),
+            params=params,
+        )
+        await ref_engine.start()
+        want = [t async for t in ref_engine.generate(prompt, max_new_tokens=8)]
+        await ref_engine.stop()
+        assert got == want
+
+    async def test_long_and_short_interleave(self):
+        """Short requests keep streaming while a long request is served."""
+        params = self._params()
+        engine = self._long_engine(params)
+        await engine.start()
+        long_prompt = [(3 * i + 1) % CFG.vocab_size for i in range(90)]
+
+        async def long_run():
+            return [t async for t in engine.generate(long_prompt, max_new_tokens=12)]
+
+        async def short_run(i):
+            return [t async for t in engine.generate([5 + i, 6, 7], max_new_tokens=6)]
+
+        long_out, *short_outs = await asyncio.gather(
+            long_run(), short_run(0), short_run(1), short_run(2)
+        )
+        assert len(long_out) == 12
+        assert all(len(s) == 6 for s in short_outs)
+        # short lane answers are unaffected by the long company
+        solo = [t async for t in engine.generate([5, 6, 7], max_new_tokens=6)]
+        assert short_outs[0] == solo
+        await engine.stop()
+
+    async def test_long_request_cancellation_reaps(self):
+        params = self._params()
+        engine = self._long_engine(params, long_new_cap=32)
+        await engine.start()
+        prompt = [(i + 2) % CFG.vocab_size for i in range(80)]
+        agen = engine.generate(prompt, max_new_tokens=32)
+        got = [await anext(agen)]  # first token arrived: lane is active
+        await agen.aclose()  # abandon mid-generation -> cancel
+        for _ in range(100):
+            if engine._long is None and not engine._long_pending:
+                break
+            await asyncio.sleep(0.05)
+        assert engine._long is None
+        # lane still serves the next long request
+        out = [t async for t in engine.generate(prompt, max_new_tokens=4)]
+        assert len(out) == 4 and out[0] == got[0]
+        await engine.stop()
+
+    async def test_long_disabled_rejects(self):
+        engine = InferenceEngine(
+            CFG, RuntimeConfig(max_batch_size=2, max_seq_len=32, prefill_chunk=16)
+        )
+        await engine.start()
+        from calfkit_tpu.exceptions import InferenceError
+
+        with pytest.raises(InferenceError, match="long_context"):
+            async for _ in engine.generate(list(range(40))):
+                pass
+        await engine.stop()
+
+    async def test_long_prompt_ceiling_rejects(self):
+        params = self._params()
+        engine = self._long_engine(params, long_max_prompt=128)
+        await engine.start()
+        from calfkit_tpu.exceptions import InferenceError
+
+        with pytest.raises(InferenceError, match="long_max_prompt"):
+            async for _ in engine.generate(list(range(200))):
+                pass
+        await engine.stop()
+
+    async def test_long_max_new_clamped_to_cap(self):
+        params = self._params()
+        engine = self._long_engine(params, long_new_cap=8)
+        await engine.start()
+        prompt = [(i + 9) % CFG.vocab_size for i in range(70)]
+        out = [t async for t in engine.generate(prompt, max_new_tokens=1000)]
+        assert len(out) == 8  # clamped to the cap, not hung, not 1000
+        await engine.stop()
+
+    async def test_long_lane_sp8_over_full_mesh(self):
+        """On a dp=4 x tp=2 engine mesh the long lane shards the sequence
+        over ALL 8 devices (sp=8 ring) — tokens still match the short lane
+        bit-for-bit (greedy)."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the virtual 8-device mesh")
+        params = self._params()
+        engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=4, max_seq_len=64, prefill_chunk=16,
+                          decode_steps_per_dispatch=4, long_context=True,
+                          long_new_cap=8, tp=2, dp=4),
+            params=params,
+        )
+        await engine.start()
+        assert engine._sp_mesh().shape["sp"] == 8
+        prompt = [(11 * i + 5) % CFG.vocab_size for i in range(100)]
+        got = [t async for t in engine.generate(prompt, max_new_tokens=8)]
+        await engine.stop()
+
+        ref_engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=2, max_seq_len=256, prefill_chunk=16,
+                          decode_steps_per_dispatch=4),
+            params=params,
+        )
+        await ref_engine.start()
+        want = [t async for t in ref_engine.generate(prompt, max_new_tokens=8)]
+        await ref_engine.stop()
+        assert got == want
