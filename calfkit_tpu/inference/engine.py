@@ -255,6 +255,7 @@ class InferenceEngine:
         # long-context lane (sequence-parallel; one request at a time)
         self._long_pending: deque[GenRequest] = deque()
         self._long: dict | None = None  # active long request's device state
+        self._long_inflight: dict | None = None  # chunked long prefill
         self._sp_mesh_cache: Any = None
         self._wake = asyncio.Event()
         self._task: asyncio.Task[None] | None = None
@@ -560,6 +561,9 @@ class InferenceEngine:
         if self._long is not None:
             self._long["request"].out.put_nowait(_DONE)
             self._long = None
+        if self._long_inflight is not None:
+            self._long_inflight["request"].out.put_nowait(_DONE)
+            self._long_inflight = None
         while self._long_pending:
             self._long_pending.popleft().out.put_nowait(_DONE)
 
@@ -908,48 +912,47 @@ class InferenceEngine:
     async def _advance_long(self) -> bool:
         if not self.runtime.long_context:
             return False
-        if self._long is None:
-            request = None
-            while self._long_pending:
-                candidate = self._long_pending.popleft()
-                if candidate.cancelled:
-                    candidate.out.put_nowait(_DONE)
-                    continue
-                request = candidate
-                break
-            if request is None:
-                return False
-            await asyncio.to_thread(self._long_prefill, request)
+        if self._long is not None:
+            await asyncio.to_thread(self._long_decode_tick)
             return True
-        await asyncio.to_thread(self._long_decode_tick)
+        if self._long_inflight is not None:
+            await asyncio.to_thread(self._advance_long_prefill)
+            return True
+        request = None
+        while self._long_pending:
+            candidate = self._long_pending.popleft()
+            if candidate.cancelled:
+                candidate.out.put_nowait(_DONE)
+                continue
+            request = candidate
+            break
+        if request is None:
+            return False
+        if self.runtime.chunked_prefill:
+            # resumable: one chunk per scheduler pass, short decode ticks
+            # run between chunks (same latency bound as the short lane)
+            self._start_long_inflight(request)
+            return True
+        await asyncio.to_thread(self._long_prefill, request)
         return True
 
-    def _long_prefill(self, request: GenRequest) -> None:
-        from calfkit_tpu.inference.ring_attention import (
-            prefill_sequence_parallel,
-        )
-
-        rt = self.runtime
-        mesh = self._sp_mesh()
-        sp = mesh.shape["sp"]
-        n = len(request.prompt)
-        # pad to power-of-two multiples of lcm(sp, prefill_chunk): the
-        # sequence must divide over sp, and power-of-two bucketing bounds
-        # the sp-prefill compile count at log(range) shapes
-        g = math.lcm(sp, rt.prefill_chunk)
+    def _long_padded(self, n: int) -> int:
+        """Pad to power-of-two multiples of lcm(sp, prefill_chunk): the
+        sequence must divide over sp, and power-of-two bucketing bounds
+        the sp-prefill compile count at log(range) shapes."""
+        g = math.lcm(self._sp_mesh().shape["sp"], self.runtime.prefill_chunk)
         units = -(-n // g)
         p2 = 1
         while p2 < units:
             p2 *= 2
-        padded = g * p2
-        tokens = np.zeros((1, padded), np.int32)
-        tokens[0, :n] = request.prompt
-        started = time.perf_counter()
-        last_logits, (k_prefix, v_prefix) = prefill_sequence_parallel(
-            self.params, self.config, jnp.asarray(tokens), mesh,
-            seq_lens=jnp.asarray([n], jnp.int32),
-        )
-        first = int(np.asarray(jnp.argmax(last_logits[0])))
+        return g * p2
+
+    def _install_long_state(
+        self, request: GenRequest, prefix: tuple, n: int, first: int,
+        started: float,
+    ) -> None:
+        """Shared landing for both long-prefill paths: emit the first
+        token and stage the decode-phase device state."""
         request.prefill_ms = (time.perf_counter() - started) * 1000.0
         self.stats.prefill_tokens += n
         self.stats.long_requests += 1
@@ -960,7 +963,7 @@ class InferenceEngine:
         fresh_shape = (cfg.n_layers, 1, cfg.n_kv_heads, cap, cfg.head_dim)
         self._long = dict(
             request=request,
-            prefix=(k_prefix, v_prefix),
+            prefix=prefix,
             prefix_len=n,
             fresh=(
                 jnp.zeros(fresh_shape, jnp.float32),
@@ -969,6 +972,91 @@ class InferenceEngine:
             t=0,
             cap=cap,
             last=jnp.asarray([first], jnp.int32),
+        )
+
+    def _long_prefill(self, request: GenRequest) -> None:
+        from calfkit_tpu.inference.ring_attention import (
+            prefill_sequence_parallel,
+        )
+
+        mesh = self._sp_mesh()
+        n = len(request.prompt)
+        padded = self._long_padded(n)
+        tokens = np.zeros((1, padded), np.int32)
+        tokens[0, :n] = request.prompt
+        started = time.perf_counter()
+        last_logits, (k_prefix, v_prefix) = prefill_sequence_parallel(
+            self.params, self.config, jnp.asarray(tokens), mesh,
+            seq_lens=jnp.asarray([n], jnp.int32),
+        )
+        first = int(np.asarray(jnp.argmax(last_logits[0])))
+        self._install_long_state(
+            request, (k_prefix, v_prefix), n, first, started
+        )
+
+    def _start_long_inflight(self, request: GenRequest) -> None:
+        """Host-side setup of a resumable chunked long prefill: the SAME
+        chunk program as the short lane (`_chunk_jit`), running over a
+        sequence-sharded scratch sized for the padded prompt — GSPMD
+        shards the chunk's attention over `sp` and inserts the collectives.
+        Only chunks covering the true prompt run; padding is never
+        touched (it stays zero and masked)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        cfg = self.config
+        mesh = self._sp_mesh()
+        n = len(request.prompt)
+        padded = self._long_padded(n)
+        chunk = min(self.runtime.prefill_chunk, padded)
+        scratch_shape = (
+            cfg.n_layers, 1, cfg.n_kv_heads, padded, cfg.head_dim
+        )
+        sharding = NamedSharding(mesh, P(None, None, None, "sp", None))
+        tokens = np.zeros((1, padded), np.int32)
+        tokens[0, :n] = request.prompt
+        self._long_inflight = dict(
+            request=request,
+            tokens=tokens,
+            true_len=n,
+            chunk=chunk,
+            n_chunks=-(-n // chunk),  # only chunks covering the prompt
+            idx=0,
+            # sharded AT CREATION: an eager zeros would materialize the
+            # whole padded scratch on one device first — the exact OOM the
+            # sp lane exists to avoid
+            scratch=(
+                jnp.zeros(scratch_shape, self._k.dtype, device=sharding),
+                jnp.zeros(scratch_shape, self._k.dtype, device=sharding),
+            ),
+            started=time.perf_counter(),
+        )
+
+    def _advance_long_prefill(self) -> None:
+        """One chunk of the inflight long prefill; land on the last."""
+        inf = self._long_inflight
+        request = inf["request"]
+        if request.cancelled:
+            self._long_inflight = None
+            # runs on the to_thread worker: queue puts marshal to the loop
+            self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)
+            return
+        chunk, idx = inf["chunk"], inf["idx"]
+        sk, sv = inf["scratch"]
+        tok_chunk = jnp.asarray(inf["tokens"][:, idx * chunk:(idx + 1) * chunk])
+        sk, sv, logits = self._chunk_jit(chunk, 1)(
+            self.params, sk, sv, tok_chunk, jnp.int32(idx * chunk)
+        )
+        inf["scratch"] = (sk, sv)
+        inf["idx"] = idx + 1
+        if inf["idx"] < inf["n_chunks"]:
+            return
+        # last prompt-covering chunk: the final valid position lives here
+        n = inf["true_len"]
+        local = (n - 1) - (inf["n_chunks"] - 1) * chunk
+        first = int(np.asarray(jnp.argmax(logits[0, local])))
+        self._long_inflight = None
+        self._install_long_state(
+            request, (sk, sv), n, first, inf["started"]
         )
 
     def _long_decode_tick(self) -> None:
