@@ -20,7 +20,7 @@ import re
 from dataclasses import dataclass
 from typing import Any, Callable, get_type_hints
 
-from pydantic import TypeAdapter, create_model
+from pydantic import ConfigDict, TypeAdapter, create_model
 
 from calfkit_tpu.models.capability import ToolDef
 
@@ -128,7 +128,13 @@ def function_schema(
         fields[pname] = (annotation, default)
         param_names.append(pname)
 
-    model = create_model(f"{fn.__name__}_args", **fields)
+    # forbid extras: a model hallucinating an argument name must get a
+    # ValidationError (the retry trigger), not have it silently dropped
+    model = create_model(
+        f"{fn.__name__}_args",
+        __config__=ConfigDict(extra="forbid"),
+        **fields,
+    )
     adapter: TypeAdapter[Any] = TypeAdapter(model)
     schema = adapter.json_schema()
     schema.pop("title", None)

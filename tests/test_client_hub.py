@@ -1,0 +1,252 @@
+"""Client hub / run-channel / handle semantics, in isolation.
+
+Reference analogs: tests/test_caller_surface_hub.py, test_run_channel.py,
+test_wait.py, test_send.py in /root/reference/tests/ — the race-free handle
+registration and cancel-safe channel details SURVEY §7 flags as a hard part.
+"""
+
+import asyncio
+import gc
+
+import pytest
+
+from calfkit_tpu import protocol
+from calfkit_tpu.client.hub import (
+    Hub,
+    InvocationHandle,
+    RunCompleted,
+    RunFailed,
+)
+from calfkit_tpu.exceptions import ClientTimeoutError, NodeFaultError
+from calfkit_tpu.mesh.transport import Record
+from calfkit_tpu.models.error_report import ErrorReport
+from calfkit_tpu.models.payload import TextPart
+from calfkit_tpu.models.reply import FaultMessage, ReturnMessage
+from calfkit_tpu.models.session_context import Envelope
+from calfkit_tpu.models.step import AgentMessageStep, StepEvent, StepMessage
+
+
+def _return_envelope(text: str = "ok") -> Envelope:
+    return Envelope(reply=ReturnMessage(parts=[TextPart(text=text)]))
+
+
+def _fault_envelope(msg: str = "broke") -> Envelope:
+    return Envelope(
+        reply=FaultMessage(
+            report=ErrorReport.build_safe(error_type="calf.node.error", message=msg)
+        )
+    )
+
+
+def _record(
+    value: bytes, *, correlation: str, wire: str = "envelope", task: str = "t1"
+) -> Record:
+    return Record(
+        topic="client.inbox",
+        value=value,
+        headers={
+            protocol.HDR_CORRELATION: correlation,
+            protocol.HDR_TASK: task,
+            protocol.HDR_WIRE: wire,
+        },
+    )
+
+
+def _step_record(correlation: str, text: str) -> Record:
+    message = StepMessage(steps=[AgentMessageStep(text=text)], emitter="agent/a")
+    return _record(message.to_wire(), correlation=correlation, wire="step")
+
+
+class TestRunChannel:
+    async def test_result_after_terminal(self):
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        handle = InvocationHandle(channel, str)
+        channel.complete(RunCompleted(envelope=_return_envelope("hi"), headers={}))
+        result = await handle.result(timeout=1)
+        assert result.output == "hi"
+
+    async def test_result_twice_both_succeed(self):
+        """The terminal is a future, not a one-shot queue: every await
+        observes it."""
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        handle = InvocationHandle(channel, str)
+        channel.complete(RunCompleted(envelope=_return_envelope("hi"), headers={}))
+        assert (await handle.result(timeout=1)).output == "hi"
+        assert (await handle.result(timeout=1)).output == "hi"
+
+    async def test_terminal_is_first_writer_wins(self):
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        channel.complete(RunCompleted(envelope=_return_envelope("first"), headers={}))
+        channel.complete(
+            RunFailed(report=ErrorReport.build_safe("calf.node.error", "late"))
+        )
+        handle = InvocationHandle(channel, str)
+        assert (await handle.result(timeout=1)).output == "first"
+
+    async def test_timeout_then_late_terminal_still_consumable(self):
+        """wait_for is shielded: a timed-out result() must NOT cancel the
+        terminal future — a later reply still completes a retry."""
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        handle = InvocationHandle(channel, str)
+        with pytest.raises(ClientTimeoutError):
+            await handle.result(timeout=0.05)
+        channel.complete(RunCompleted(envelope=_return_envelope("late"), headers={}))
+        assert (await handle.result(timeout=1)).output == "late"
+
+    async def test_fault_raises_typed_with_report_and_envelope(self):
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        handle = InvocationHandle(channel, str)
+        env = _fault_envelope("kaput")
+        channel.complete(RunFailed(report=env.reply.report, envelope=env))
+        with pytest.raises(NodeFaultError) as exc_info:
+            await handle.result(timeout=1)
+        assert "kaput" in exc_info.value.report.message
+        assert exc_info.value.envelope is env
+
+    async def test_step_overflow_drops_oldest(self):
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        for i in range(1025):  # queue maxsize is 1024
+            channel.push_step(
+                StepEvent(
+                    correlation_id="c1",
+                    step=AgentMessageStep(text=f"s{i}"),
+                )
+            )
+        assert channel.steps.qsize() == 1024
+        first = channel.steps.get_nowait()
+        assert first.step.text == "s1"  # s0 was dropped, newest kept
+
+    async def test_stream_yields_steps_then_result(self):
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        handle = InvocationHandle(channel, str)
+        channel.push_step(
+            StepEvent(correlation_id="c1", step=AgentMessageStep(text="working"))
+        )
+        channel.complete(RunCompleted(envelope=_return_envelope("done"), headers={}))
+        items = [item async for item in handle.stream(timeout=2)]
+        assert items[0].step.text == "working"
+        assert items[-1].output == "done"
+
+    async def test_stream_drains_steps_racing_the_terminal(self):
+        """Steps enqueued before the terminal must all surface even when
+        the terminal is already set when streaming starts."""
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        handle = InvocationHandle(channel, str)
+        for i in range(5):
+            channel.push_step(
+                StepEvent(correlation_id="c1", step=AgentMessageStep(text=f"s{i}"))
+            )
+        channel.complete(RunCompleted(envelope=_return_envelope("end"), headers={}))
+        items = [item async for item in handle.stream(timeout=2)]
+        texts = [it.step.text for it in items[:-1]]
+        assert texts == [f"s{i}" for i in range(5)]
+
+    async def test_stream_timeout(self):
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        handle = InvocationHandle(channel, str)
+        with pytest.raises(ClientTimeoutError):
+            async for _ in handle.stream(timeout=0.05):
+                pass
+
+    async def test_stream_raises_on_fault(self):
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        handle = InvocationHandle(channel, str)
+        channel.complete(
+            RunFailed(report=ErrorReport.build_safe("calf.node.error", "mid"))
+        )
+        with pytest.raises(NodeFaultError):
+            async for _ in handle.stream(timeout=1):
+                pass
+
+
+class TestHubDemux:
+    async def test_reply_routes_by_correlation(self):
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        await hub.on_record(
+            _record(_return_envelope("routed").to_wire(), correlation="c1")
+        )
+        terminal = channel.terminal.result()
+        assert isinstance(terminal, RunCompleted)
+
+    async def test_step_routes_to_channel_and_taps(self):
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+
+        class Tap:
+            def __init__(self):
+                self.events = []
+
+            def push(self, event):
+                self.events.append(event)
+
+        tap = Tap()
+        hub.add_tap(tap)
+        await hub.on_record(_step_record("c1", "hello"))
+        assert channel.steps.qsize() == 1
+        assert len(tap.events) == 1
+        # a foreign run's steps hit the firehose but not this channel
+        await hub.on_record(_step_record("OTHER", "other"))
+        assert channel.steps.qsize() == 1
+        assert len(tap.events) == 2
+
+    async def test_abandoned_handle_is_weakly_dropped(self):
+        """The hub holds channels weakly: dropping the handle lets the
+        channel die, and late replies for it are ignored without error."""
+        hub = Hub()
+        channel = hub.track("c-gone", "t1")
+        del channel
+        gc.collect()
+        await hub.on_record(
+            _record(_return_envelope("too late").to_wire(), correlation="c-gone")
+        )  # must not raise
+
+    async def test_undecodable_reply_dropped_not_crashed(self):
+        hub = Hub()
+        hub.track("c1", "t1")
+        await hub.on_record(_record(b"\x00not json", correlation="c1"))
+
+    async def test_undecodable_step_dropped_not_crashed(self):
+        hub = Hub()
+        hub.track("c1", "t1")
+        await hub.on_record(
+            _record(b"\x00not json", correlation="c1", wire="step")
+        )
+
+    async def test_terminal_without_reply_is_failure_not_hang(self):
+        """An envelope with no reply slot on the inbox must complete the
+        run as a typed failure, never leave the caller hanging."""
+        hub = Hub()
+        channel = hub.track("c1", "t1")
+        await hub.on_record(
+            _record(Envelope().to_wire(), correlation="c1")
+        )
+        terminal = channel.terminal.result()
+        assert isinstance(terminal, RunFailed)
+
+    async def test_removed_tap_stops_receiving(self):
+        hub = Hub()
+
+        class Tap:
+            def __init__(self):
+                self.events = []
+
+            def push(self, event):
+                self.events.append(event)
+
+        tap = Tap()
+        hub.add_tap(tap)
+        hub.remove_tap(tap)
+        hub.remove_tap(tap)  # double-remove is harmless
+        await hub.on_record(_step_record("c1", "x"))
+        assert tap.events == []

@@ -1,0 +1,148 @@
+"""Schema extraction corners: signature → JSON schema → validated call.
+
+Reference analogs: tests/test_args_schema.py,
+test_schema_roundtrip_validation.py, test_model_settings.py — the vendored
+``function_schema`` behaviors the owned extractor must keep.
+"""
+
+from typing import Literal, Optional
+
+import pytest
+from pydantic import BaseModel
+
+from calfkit_tpu.engine.schema import (
+    ToolSchemaError,
+    function_schema,
+    output_tool_def,
+)
+
+
+class TestSignatureExtraction:
+    def test_defaults_become_optional(self):
+        def f(city: str, units: str = "metric") -> str:
+            return city
+
+        schema = function_schema(f)
+        params = schema.tool_def.parameters_schema
+        assert params["required"] == ["city"]
+        assert params["properties"]["units"]["default"] == "metric"
+
+    def test_optional_annotation(self):
+        def f(q: str, limit: Optional[int] = None) -> str:
+            return q
+
+        params = function_schema(f).tool_def.parameters_schema
+        assert "limit" in params["properties"]
+        assert params["required"] == ["q"]
+
+    def test_literal_becomes_enum(self):
+        def f(mode: Literal["fast", "slow"]) -> str:
+            return mode
+
+        params = function_schema(f).tool_def.parameters_schema
+        assert set(params["properties"]["mode"]["enum"]) == {"fast", "slow"}
+
+    def test_nested_pydantic_model_schema(self):
+        class Filters(BaseModel):
+            tags: list[str]
+            min_score: float = 0.0
+
+        def f(filters: Filters) -> str:
+            return "ok"
+
+        params = function_schema(f).tool_def.parameters_schema
+        prop = params["properties"]["filters"]
+        # nested model surfaces as an object schema (inline or $ref)
+        assert "$ref" in prop or prop.get("type") == "object"
+
+    def test_sphinx_docstring_descriptions(self):
+        def f(city: str) -> str:
+            """Get weather.
+
+            :param city: The city to look up.
+            """
+            return city
+
+        schema = function_schema(f)
+        assert schema.tool_def.description.startswith("Get weather")
+        assert "look up" in schema.tool_def.parameters_schema["properties"]["city"][
+            "description"
+        ]
+
+    def test_google_docstring_descriptions(self):
+        def f(city: str, units: str = "metric") -> str:
+            """Get weather.
+
+            Args:
+                city: Which city.
+                units (str): Unit system.
+            """
+            return city
+
+        params = function_schema(f).tool_def.parameters_schema
+        assert params["properties"]["city"]["description"] == "Which city."
+        assert params["properties"]["units"]["description"] == "Unit system."
+
+
+class TestValidatedCall:
+    async def test_coercion_and_extra_args_rejected(self):
+        def f(n: int) -> int:
+            return n * 2
+
+        schema = function_schema(f)
+        assert schema.validate_args({"n": "21"}) == {"n": 21}  # coerced
+        with pytest.raises(Exception):
+            schema.validate_args({"n": 1, "zzz": 2})
+
+    async def test_missing_required_rejected(self):
+        def f(n: int) -> int:
+            return n
+
+        with pytest.raises(Exception):
+            function_schema(f).validate_args({})
+
+    async def test_nested_model_instantiated_not_dict(self):
+        class Point(BaseModel):
+            x: int
+            y: int
+
+        def f(p: Point) -> int:
+            assert isinstance(p, Point)
+            return p.x + p.y
+
+        schema = function_schema(f)
+        assert await schema.call({"p": {"x": 1, "y": 2}}) == 3
+
+
+class TestOutputTool:
+    def test_output_tool_from_model(self):
+        class Answer(BaseModel):
+            """The final answer."""
+
+            value: int
+
+        tool = output_tool_def(Answer)
+        assert tool.name == "final_result"
+        assert "value" in tool.parameters_schema["properties"]
+
+    def test_output_tool_custom_name(self):
+        class Answer(BaseModel):
+            value: int
+
+        assert output_tool_def(Answer, name="submit").name == "submit"
+
+
+class TestRejectedSignatures:
+    def test_var_positional_rejected(self):
+        def f(*args: int) -> int:
+            return 0
+
+        with pytest.raises(ToolSchemaError):
+            function_schema(f)
+
+    def test_var_keyword_rejected(self):
+        def f(**kwargs: int) -> int:
+            return 0
+
+        with pytest.raises(ToolSchemaError):
+            function_schema(f)

@@ -1,0 +1,233 @@
+"""The seam-chain runner and the hop step ledger, in isolation.
+
+Reference analogs: tests/test_seams.py, test_seam_registration.py,
+test_step_ledger.py, test_step_pair_law.py, test_step_construction_sealing
+(the single-mint rule) in /root/reference/tests/.
+"""
+
+import pytest
+
+from calfkit_tpu.exceptions import NodeFaultError, SeamContractError
+from calfkit_tpu.models.error_report import ErrorReport
+from calfkit_tpu.nodes.steps import (
+    DeniedCall,
+    HandedOff,
+    HopStepLedger,
+    InferenceFact,
+    Said,
+    publish_step_message,
+)
+from calfkit_tpu.nodes.seams import (
+    MintedFault,
+    run_chain,
+    run_chain_guarded,
+    validate_seam_arity,
+)
+
+
+class TestSeamArity:
+    def test_exact_arity_passes(self):
+        validate_seam_arity(lambda ctx: None, 1, name="before_node")
+        validate_seam_arity(lambda ctx, action: None, 2, name="after_node")
+
+    def test_wrong_arity_rejected_loudly(self):
+        with pytest.raises(SeamContractError, match="before_node"):
+            validate_seam_arity(lambda ctx, extra: None, 1, name="before_node")
+        with pytest.raises(SeamContractError, match="2 positional"):
+            validate_seam_arity(lambda ctx: None, 2, name="after_node")
+
+    def test_var_positional_accepted(self):
+        validate_seam_arity(lambda *args: None, 2, name="on_node_error")
+
+    def test_defaulted_params_do_not_count(self):
+        # (ctx, report=None) has ONE required positional — valid for arity 1
+        validate_seam_arity(
+            lambda ctx, report=None: None, 1, name="before_node"
+        )
+
+    def test_uninspectable_callable_trusted(self):
+        # min has no introspectable signature: arity check trusts the caller
+        validate_seam_arity(min, 2, name="after_node")
+
+
+class TestSeamChains:
+    async def test_first_non_none_wins_in_registration_order(self):
+        calls = []
+
+        def a(x):
+            calls.append("a")
+            return None
+
+        def b(x):
+            calls.append("b")
+            return "b-won"
+
+        def c(x):
+            calls.append("c")
+            return "c-never"
+
+        assert await run_chain([a, b, c], 1) == "b-won"
+        assert calls == ["a", "b"]  # c never ran
+
+    async def test_all_none_falls_through(self):
+        assert await run_chain([lambda x: None, lambda x: None], 1) is None
+        assert await run_chain([], 1) is None
+
+    async def test_async_and_sync_seams_mix(self):
+        async def slow(x):
+            return x * 2
+
+        assert await run_chain([lambda x: None, slow], 21) == 42
+
+    async def test_guarded_chain_wraps_minted_fault(self):
+        """A NodeFaultError raised in a seam is a deliberate typed-fault
+        MINT, not a seam crash — the runner must carry it out tagged."""
+        fault = NodeFaultError(
+            ErrorReport.build_safe(error_type="calf.custom", message="deliberate")
+        )
+
+        def minting(ctx, report):
+            raise fault
+
+        with pytest.raises(MintedFault) as exc_info:
+            await run_chain_guarded([minting], None, None)
+        assert exc_info.value.error is fault
+
+    async def test_guarded_chain_lets_crashes_escape_raw(self):
+        def crashing(ctx, report):
+            raise RuntimeError("oops")
+
+        with pytest.raises(RuntimeError, match="oops"):
+            await run_chain_guarded([crashing], None, None)
+
+    async def test_guarded_chain_first_result_skips_minting_seam(self):
+        def recovering(ctx, report):
+            return "recovered"
+
+        def minting(ctx, report):
+            raise NodeFaultError(
+                ErrorReport.build_safe(
+                    error_type="calf.custom", message="never reached"
+                )
+            )
+
+        result = await run_chain_guarded([recovering, minting], None, None)
+        assert result == "recovered"
+
+
+class TestHopStepLedger:
+    def test_said_becomes_agent_message(self):
+        ledger = HopStepLedger("agent/a")
+        ledger.absorb([Said(text="hi", author="a")])
+        msg = ledger.drain()
+        assert [s.kind for s in msg.steps] == ["agent_message"]
+        assert msg.steps[0].text == "hi"
+        assert msg.emitter == "agent/a"
+
+    def test_denied_call_is_born_closed_pair(self):
+        """The pair law's degenerate case: a call rejected before dispatch
+        emits its tool_call (denied) AND its tool_result (ok=False) in one
+        hop — no dangling open pairs, ever."""
+        ledger = HopStepLedger("agent/a")
+        ledger.absorb(
+            [DeniedCall(tool_call_id="t1", tool_name="f", reason="no such tool")]
+        )
+        msg = ledger.drain()
+        kinds = [s.kind for s in msg.steps]
+        assert kinds == ["tool_call", "tool_result"]
+        assert msg.steps[0].denied is True
+        assert msg.steps[1].ok is False
+        assert msg.steps[0].tool_call_id == msg.steps[1].tool_call_id == "t1"
+
+    def test_dispatch_and_fold_complete_the_pair(self):
+        ledger = HopStepLedger("agent/a")
+        ledger.note_dispatch("t9", "lookup", {"q": 1})
+        ledger.folded("t9", "lookup", {"answer": 42})
+        msg = ledger.drain()
+        kinds = [s.kind for s in msg.steps]
+        assert kinds == ["tool_call", "tool_result"]
+        assert msg.steps[1].ok is True
+
+    def test_fold_failed_closes_pair_with_report(self):
+        ledger = HopStepLedger("agent/a")
+        ledger.note_dispatch("t2", "boom", {})
+        report = ErrorReport.build_safe(
+            error_type="calf.tool.error", message="it broke"
+        )
+        ledger.fold_failed("t2", "boom", report)
+        msg = ledger.drain()
+        assert msg.steps[1].ok is False
+        assert "it broke" in msg.steps[1].content
+
+    def test_handoff_and_inference_and_token_kinds(self):
+        ledger = HopStepLedger("agent/a")
+        ledger.absorb(
+            [
+                HandedOff(to_agent="b", from_agent="a"),
+                InferenceFact(model_name="m", generated_tokens=3),
+            ]
+        )
+        ledger.token("hel", author="a")
+        msg = ledger.drain()
+        assert [s.kind for s in msg.steps] == ["handoff", "inference", "token"]
+
+    def test_drain_is_idempotent(self):
+        """Exactly-once flush per hop: the second drain yields nothing."""
+        ledger = HopStepLedger("agent/a")
+        ledger.absorb([Said(text="x")])
+        assert ledger.drain() is not None
+        assert ledger.drain() is None
+
+    def test_empty_ledger_drains_none(self):
+        assert HopStepLedger("agent/a").drain() is None
+        assert not HopStepLedger("agent/a").has_steps
+
+    def test_hostile_tool_content_is_contained(self):
+        """A tool result whose __str__ raises must not break the ledger —
+        the harvester's safe_str guard applies at the mint."""
+
+        class Hostile:
+            def __str__(self):
+                raise RuntimeError("gotcha")
+
+            def __repr__(self):
+                raise RuntimeError("gotcha2")
+
+        ledger = HopStepLedger("agent/a")
+        ledger.note_dispatch("t3", "f", {})
+        ledger.folded("t3", "f", Hostile())
+        msg = ledger.drain()
+        assert msg.steps[1].ok is True
+        assert isinstance(msg.steps[1].content, str)  # contained, not raised
+
+    def test_oversized_tool_content_truncated(self):
+        ledger = HopStepLedger("agent/a")
+        ledger.folded("t4", "f", "x" * 100_000)
+        msg = ledger.drain()
+        assert len(msg.steps[0].content) <= 2200  # budgeted, not unbounded
+
+    async def test_flush_without_root_topic_is_noop(self):
+        ledger = HopStepLedger("agent/a")
+        ledger.absorb([Said(text="x")])
+        await ledger.flush(
+            transport=None, root_topic=None, correlation_id="c", task_id="t"
+        )  # must not touch the (None) transport
+
+    async def test_flush_publishes_once_with_identity_headers(self):
+        published = []
+
+        class FakeTransport:
+            async def publish(self, topic, value, *, key=None, headers=None):
+                published.append((topic, key, dict(headers or {})))
+
+        ledger = HopStepLedger("agent/a")
+        ledger.absorb([Said(text="x")])
+        await ledger.flush(
+            FakeTransport(), "caller.inbox", correlation_id="cid", task_id="tid"
+        )
+        await ledger.flush(  # second flush: already drained, no publish
+            FakeTransport(), "caller.inbox", correlation_id="cid", task_id="tid"
+        )
+        assert len(published) == 1
+        topic, key, headers = published[0]
+        assert topic == "caller.inbox"
