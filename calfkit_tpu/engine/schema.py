@@ -157,7 +157,13 @@ def function_schema(
 
 
 def output_tool_def(output_type: type, *, name: str = "final_result") -> ToolDef:
-    """The structured-output tool: the model 'calls' it with the final answer."""
+    """The structured-output tool: the model 'calls' it with the final answer.
+
+    Deliberately does NOT force ``extra="forbid"`` the way tool-args models
+    do: args models are framework-synthesized from a signature (no user
+    config exists, so strictness is ours to choose), while the output type
+    is USER-owned — their model's own ``extra`` policy is law here.
+    """
     adapter: TypeAdapter[Any] = TypeAdapter(output_type)
     schema = adapter.json_schema()
     schema.pop("title", None)

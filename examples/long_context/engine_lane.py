@@ -1,0 +1,85 @@
+"""Long prompts through the SERVING engine: the sequence-parallel lane.
+
+Where ``ring_prefill.py`` drives the primitives directly, this demo uses the
+production surface: ``RuntimeConfig(long_context=True)`` makes the engine
+admit prompts that cannot fit a short-lane slot — ring prefill over an `sp`
+mesh of all the engine's devices, context-parallel decode against the
+still-sharded prefix — while ordinary short requests keep streaming through
+the continuous-batching lane.
+
+Run (8 virtual devices stand in for 8 chips):
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/long_context/engine_lane.py
+"""
+
+import asyncio
+import os
+import sys
+
+# runnable from a checkout without installing the package
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from calfkit_tpu.inference.config import RuntimeConfig, preset
+from calfkit_tpu.inference.engine import InferenceEngine
+
+
+async def main() -> None:
+    config = preset("debug")
+    engine = InferenceEngine(
+        config,
+        RuntimeConfig(
+            max_batch_size=4,
+            max_seq_len=64,          # the short lane's slot capacity
+            prefill_chunk=16,
+            decode_steps_per_dispatch=4,
+            tp=2, dp=4,              # 8 devices; the sp lane spans them all
+            long_context=True,       # oversized prompts -> sp lane
+            long_new_cap=32,
+            chunked_prefill=True,    # long admissions yield between chunks
+        ),
+    )
+    await engine.start()
+    print(f"engine mesh {dict(engine.mesh.shape)}; "
+          f"sp lane over {engine._sp_mesh().shape['sp']} devices")
+
+    async def short(i: int) -> list[int]:
+        return [t async for t in engine.generate([3 + i, 4, 5], max_new_tokens=8)]
+
+    # 180 tokens >> max_seq_len=64: takes the sequence-parallel lane,
+    # interleaved with the short requests below
+    long_prompt = [(7 * i + 1) % config.vocab_size for i in range(180)]
+
+    async def long_run() -> list[int]:
+        return [
+            t async for t in engine.generate(long_prompt, max_new_tokens=12)
+        ]
+
+    long_out, *short_outs = await asyncio.gather(
+        long_run(), short(0), short(1), short(2)
+    )
+    print(f"long ({len(long_prompt)}-token prompt): {long_out}")
+    for i, out in enumerate(short_outs):
+        print(f"short {i}: {out}")
+    stats = engine.stats
+    print(
+        f"stats: long_requests={stats.long_requests} "
+        f"long_dispatches={stats.long_dispatches} "
+        f"short_decode_dispatches={stats.decode_dispatches} "
+        f"prefill_tokens={stats.prefill_tokens}"
+    )
+    await engine.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,172 @@
+"""The fan-out store: pure state machine + ktables impl invariants.
+
+Reference analogs: tests/test_fanout_store.py, test_fanout_fold.py,
+test_fanout_records.py — exactly-once fold semantics over at-least-once
+delivery, provable without a broker.
+"""
+
+import pytest
+
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models.fanout import (
+    EnvelopeSnapshot,
+    FanoutOpen,
+    FanoutOutcome,
+    FanoutState,
+    SlotRef,
+)
+from calfkit_tpu.models.session_context import SessionContext, WorkflowState
+from calfkit_tpu.nodes.fanout_store import (
+    KtablesFanoutBatchStore,
+    classify_sibling,
+    fold_decision,
+    record_outcome,
+)
+
+
+def _open(*slot_ids: str) -> FanoutOpen:
+    return FanoutOpen(
+        fanout_id="f1", slots=[SlotRef(slot_id=s) for s in slot_ids]
+    )
+
+
+def _state(*slot_ids: str) -> FanoutState:
+    return FanoutState(open=_open(*slot_ids))
+
+
+def _outcome(slot_id: str) -> FanoutOutcome:
+    return FanoutOutcome(slot_id=slot_id)
+
+
+class TestClassification:
+    def test_expected_then_duplicate(self):
+        state = _state("a", "b")
+        assert classify_sibling(state, "a") == "expected"
+        state = record_outcome(state, _outcome("a"))
+        assert classify_sibling(state, "a") == "duplicate"
+        assert classify_sibling(state, "b") == "expected"
+
+    def test_unknown_slot_is_stray(self):
+        assert classify_sibling(_state("a"), "zzz") == "stray"
+
+    def test_closed_batch_is_closed(self):
+        """A reply after close (state tombstoned -> load None) classifies
+        ``closed`` — redelivery after a completed batch folds nothing."""
+        assert classify_sibling(None, "a") == "closed"
+
+    def test_fold_is_idempotent(self):
+        """Recording the same outcome twice yields the same state — the
+        at-least-once-delivery property."""
+        state = _state("a", "b")
+        once = record_outcome(state, _outcome("a"))
+        twice = record_outcome(once, _outcome("a"))
+        assert once == twice
+
+    def test_record_does_not_mutate_input(self):
+        state = _state("a")
+        record_outcome(state, _outcome("a"))
+        assert state.outcomes == {}  # pure transition
+
+
+class TestFoldDecision:
+    def test_parked_until_all_slots_folded(self):
+        state = _state("a", "b", "c")
+        for slot in ("a", "b"):
+            state = record_outcome(state, _outcome(slot))
+            assert fold_decision(state) == "parked"
+        state = record_outcome(state, _outcome("c"))
+        assert fold_decision(state) == "complete"
+
+    def test_single_slot_batch_completes_immediately(self):
+        state = record_outcome(_state("a"), _outcome("a"))
+        assert fold_decision(state) == "complete"
+
+    def test_stray_outcomes_do_not_complete_a_batch(self):
+        """Extra outcomes for unknown slots never count toward completion."""
+        state = record_outcome(_state("a", "b"), _outcome("zzz"))
+        assert fold_decision(state) == "parked"
+
+
+def _snapshot() -> EnvelopeSnapshot:
+    return EnvelopeSnapshot(
+        context=SessionContext(), workflow=WorkflowState()
+    )
+
+
+class TestKtablesStore:
+    async def test_open_then_load_roundtrip(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        store = KtablesFanoutBatchStore(mesh, "agent.a")
+        await store.start()
+        await store.open("f1", _open("a", "b"), _snapshot())
+        state = await store.load("f1")
+        assert state is not None and state.open.slot_ids() == {"a", "b"}
+        assert await store.load_snapshot("f1") is not None
+        await store.stop()
+        await mesh.stop()
+
+    async def test_close_tombstones_both_tables(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        store = KtablesFanoutBatchStore(mesh, "agent.a")
+        await store.start()
+        await store.open("f1", _open("a"), _snapshot())
+        await store.close("f1")
+        assert await store.load("f1") is None
+        assert await store.load_snapshot("f1") is None
+        await store.stop()
+        await mesh.stop()
+
+    async def test_registration_implies_snapshot(self):
+        """The write-order invariant observed from a SECOND store instance
+        (another worker): any registered batch must have a restorable
+        snapshot — basestate is written and acked before state."""
+        mesh = InMemoryMesh()
+        await mesh.start()
+        writer_store = KtablesFanoutBatchStore(mesh, "agent.a")
+        await writer_store.start()
+        await writer_store.open("f1", _open("a"), _snapshot())
+
+        observer = KtablesFanoutBatchStore(mesh, "agent.a")
+        await observer.start()
+        state = await observer.load("f1")
+        assert state is not None
+        snapshot = await observer.load_snapshot("f1")
+        assert snapshot is not None  # registered => restorable
+        await writer_store.stop()
+        await observer.stop()
+        await mesh.stop()
+
+    async def test_save_persists_folds_across_instances(self):
+        """A crash between folds loses nothing: a fresh store (new worker)
+        sees every persisted outcome."""
+        mesh = InMemoryMesh()
+        await mesh.start()
+        first = KtablesFanoutBatchStore(mesh, "agent.a")
+        await first.start()
+        await first.open("f1", _open("a", "b"), _snapshot())
+        state = await first.load("f1")
+        await first.save(record_outcome(state, _outcome("a")))
+        await first.stop()  # "crash"
+
+        second = KtablesFanoutBatchStore(mesh, "agent.a")
+        await second.start()
+        resumed = await second.load("f1")
+        assert classify_sibling(resumed, "a") == "duplicate"
+        assert classify_sibling(resumed, "b") == "expected"
+        await second.stop()
+        await mesh.stop()
+
+    async def test_stores_are_isolated_per_node(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        a = KtablesFanoutBatchStore(mesh, "agent.a")
+        b = KtablesFanoutBatchStore(mesh, "agent.b")
+        await a.start()
+        await b.start()
+        await a.open("f1", _open("x"), _snapshot())
+        assert await b.load("f1") is None  # different node, different tables
+        await a.stop()
+        await b.stop()
+        await mesh.stop()

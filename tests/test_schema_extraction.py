@@ -8,7 +8,7 @@ test_schema_roundtrip_validation.py, test_model_settings.py — the vendored
 from typing import Literal, Optional
 
 import pytest
-from pydantic import BaseModel
+from pydantic import BaseModel, ValidationError
 
 from calfkit_tpu.engine.schema import (
     ToolSchemaError,
@@ -85,20 +85,22 @@ class TestSignatureExtraction:
 
 
 class TestValidatedCall:
-    async def test_coercion_and_extra_args_rejected(self):
+    def test_coercion_and_extra_args_rejected(self):
         def f(n: int) -> int:
             return n * 2
 
         schema = function_schema(f)
         assert schema.validate_args({"n": "21"}) == {"n": 21}  # coerced
-        with pytest.raises(Exception):
+        # MUST be ValidationError specifically: ToolNodeDef.run only turns
+        # ValidationError into a model retry — anything else faults the run
+        with pytest.raises(ValidationError):
             schema.validate_args({"n": 1, "zzz": 2})
 
-    async def test_missing_required_rejected(self):
+    def test_missing_required_rejected(self):
         def f(n: int) -> int:
             return n
 
-        with pytest.raises(Exception):
+        with pytest.raises(ValidationError):
             function_schema(f).validate_args({})
 
     async def test_nested_model_instantiated_not_dict(self):

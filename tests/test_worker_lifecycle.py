@@ -1,0 +1,233 @@
+"""Worker lifecycle corners beyond the e2e happy paths.
+
+Reference analogs: tests/test_lifecycle.py, test_lifecycle_e2e.py,
+test_lifecycle_resource_fields.py, test_lifecycle_resource_injection.py,
+test_lifecycle_review_fixes.py in /root/reference/tests/.
+"""
+
+import pytest
+
+from calfkit_tpu.engine import EchoModelClient
+from calfkit_tpu.exceptions import LifecycleConfigError
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.nodes import Agent
+from calfkit_tpu.worker import Worker
+
+
+def _worker(mesh, **kw):
+    return Worker([Agent("w", model=EchoModelClient())], mesh=mesh, **kw)
+
+
+class TestResourceBag:
+    async def test_resources_injected_into_node_bags(self):
+        """Every node's resource bag sees the worker's resources (setdefault
+        — a node's own entry wins)."""
+        mesh = InMemoryMesh()
+        agent = Agent("w", model=EchoModelClient())
+        agent.resources["mine"] = "node-owned"
+        worker = Worker([agent], mesh=mesh)
+
+        @worker.resource
+        async def shared():
+            yield {"conn": 7}
+
+        @worker.resource(key="mine")
+        async def would_shadow():
+            yield "worker-owned"
+
+        await worker.start()
+        assert agent.resources["shared"] == {"conn": 7}
+        assert agent.resources["mine"] == "node-owned"  # node entry wins
+        assert agent.resources["worker"] is worker
+        await worker.stop()
+        await mesh.stop()
+
+    async def test_resource_custom_key(self):
+        mesh = InMemoryMesh()
+        worker = _worker(mesh)
+
+        @worker.resource(key="db")
+        async def make_database():
+            yield 42
+
+        await worker.start()
+        assert worker.resources["db"] == 42
+        assert "make_database" not in worker.resources
+        await worker.stop()
+        await mesh.stop()
+
+    async def test_non_asyncgen_resource_rejected_at_registration(self):
+        worker = _worker(InMemoryMesh())
+        with pytest.raises(LifecycleConfigError, match="async generator"):
+
+            @worker.resource
+            def sync_resource():
+                return 1
+
+    async def test_resources_torn_down_in_reverse_order(self):
+        mesh = InMemoryMesh()
+        worker = _worker(mesh)
+        log: list[str] = []
+
+        @worker.resource
+        async def first():
+            log.append("up-1")
+            yield 1
+            log.append("down-1")
+
+        @worker.resource
+        async def second():
+            log.append("up-2")
+            yield 2
+            log.append("down-2")
+
+        await worker.start()
+        await worker.stop()
+        assert log == ["up-1", "up-2", "down-2", "down-1"]  # LIFO teardown
+        await mesh.stop()
+
+    async def test_failing_teardown_does_not_block_others(self):
+        mesh = InMemoryMesh()
+        worker = _worker(mesh)
+        log: list[str] = []
+
+        @worker.resource
+        async def fine():
+            yield 1
+            log.append("fine-down")
+
+        @worker.resource
+        async def broken():
+            yield 2
+            raise RuntimeError("teardown boom")
+
+        await worker.start()
+        await worker.stop()  # must not raise
+        assert log == ["fine-down"]  # the earlier resource still tore down
+        await mesh.stop()
+
+
+class TestBootFailure:
+    async def test_failed_resource_rolls_back_prior_resources(self):
+        mesh = InMemoryMesh()
+        worker = _worker(mesh)
+        log: list[str] = []
+
+        @worker.resource
+        async def good():
+            log.append("up")
+            yield 1
+            log.append("down")
+
+        @worker.resource
+        async def bad():
+            raise RuntimeError("boot boom")
+            yield  # pragma: no cover
+
+        with pytest.raises(RuntimeError, match="boot boom"):
+            await worker.start()
+        assert log == ["up", "down"]  # the good one was rolled back
+        # worker is spent: single-use even after a failed boot
+        with pytest.raises(LifecycleConfigError):
+            await worker.start()
+        await mesh.stop()
+
+    async def test_failed_on_startup_hook_aborts_before_resources(self):
+        mesh = InMemoryMesh()
+        worker = _worker(mesh)
+        log: list[str] = []
+
+        @worker.on_startup
+        def explode():
+            raise RuntimeError("hook boom")
+
+        @worker.resource
+        async def never():
+            log.append("up")
+            yield
+
+        with pytest.raises(RuntimeError, match="hook boom"):
+            await worker.start()
+        assert log == []  # hooks run before resources enter
+        await mesh.stop()
+
+    async def test_after_shutdown_runs_on_rollback(self):
+        mesh = InMemoryMesh()
+        worker = _worker(mesh)
+        log: list[str] = []
+
+        @worker.after_shutdown
+        def observed():
+            log.append("after-shutdown")
+
+        @worker.resource
+        async def bad():
+            raise RuntimeError("boom")
+            yield  # pragma: no cover
+
+        with pytest.raises(RuntimeError):
+            await worker.start()
+        assert log == ["after-shutdown"]
+        await mesh.stop()
+
+
+class TestHookOrdering:
+    async def test_full_bracket_order(self):
+        """resource phase wraps serving phase: on_startup / resources /
+        (serving: after_startup ... on_shutdown) / after_shutdown /
+        resource finalizers."""
+        mesh = InMemoryMesh()
+        worker = _worker(mesh)
+        log: list[str] = []
+
+        @worker.on_startup
+        def a():
+            log.append("on_startup")
+
+        @worker.resource
+        async def r():
+            log.append("resource-up")
+            yield 1
+            log.append("resource-down")
+
+        @worker.after_startup
+        async def b():
+            log.append("after_startup")
+
+        @worker.on_shutdown
+        def c():
+            log.append("on_shutdown")
+
+        @worker.after_shutdown
+        async def d():
+            log.append("after_shutdown")
+
+        async with worker:
+            log.append("serving")
+        assert log == [
+            "on_startup", "resource-up", "after_startup", "serving",
+            "on_shutdown", "after_shutdown", "resource-down",
+        ]
+        await mesh.stop()
+
+    async def test_stop_is_idempotent(self):
+        mesh = InMemoryMesh()
+        worker = _worker(mesh)
+        count = {"n": 0}
+
+        @worker.after_shutdown
+        def once():
+            count["n"] += 1
+
+        await worker.start()
+        await worker.stop()
+        await worker.stop()
+        assert count["n"] == 1
+        await mesh.stop()
+
+    async def test_owned_transport_stopped_with_worker(self):
+        mesh = InMemoryMesh()
+        worker = _worker(mesh, owns_transport=True)
+        await worker.start()
+        await worker.stop()
+        assert not mesh._started  # owns_transport: worker stops the mesh
