@@ -1,0 +1,23 @@
+"""Shared demo bootstrap: run on 8 virtual CPU devices from a checkout.
+
+Import BEFORE jax: both long-context demos must work on a laptop, and infra
+images often export JAX_PLATFORMS pointing at real accelerators (ambient env
+is not user intent here — on real chips, drop this import and build the
+Mesh over jax.devices() directly).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

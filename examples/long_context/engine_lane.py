@@ -16,19 +16,8 @@ import asyncio
 import os
 import sys
 
-# runnable from a checkout without installing the package
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.path.dirname(__file__))
+import _bootstrap  # noqa: F401 - must run before jax device init
 
 from calfkit_tpu.inference.config import RuntimeConfig, preset
 from calfkit_tpu.inference.engine import InferenceEngine
@@ -50,8 +39,10 @@ async def main() -> None:
         ),
     )
     await engine.start()
-    print(f"engine mesh {dict(engine.mesh.shape)}; "
-          f"sp lane over {engine._sp_mesh().shape['sp']} devices")
+    # the sp lane spans ALL the engine's devices: dp x tp of the public mesh
+    shape = dict(engine.mesh.shape)
+    print(f"engine mesh {shape}; "
+          f"sp lane over {shape['dp'] * shape['tp']} devices")
 
     async def short(i: int) -> list[int]:
         return [t async for t in engine.generate([3 + i, 4, 5], max_new_tokens=8)]

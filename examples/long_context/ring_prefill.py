@@ -13,23 +13,10 @@ Run (8 virtual devices stand in for 8 chips):
 import os
 import sys
 
-# runnable from a checkout without installing the package
-sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
-
-# This demo ALWAYS runs on 8 virtual CPU devices: it must work on a laptop,
-# and infra images often export JAX_PLATFORMS pointing at real accelerators
-# (ambient env is not user intent here — on real chips you'd drop these
-# three lines and build the Mesh over jax.devices() directly).
-os.environ["JAX_PLATFORMS"] = "cpu"
-if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(__file__))
+import _bootstrap  # noqa: F401 - must run before jax device init
 
 import jax
-
-jax.config.update("jax_platforms", "cpu")
 
 import jax.numpy as jnp
 import numpy as np

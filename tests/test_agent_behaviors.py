@@ -1,0 +1,179 @@
+"""Agent-node construction and per-turn resolution corners.
+
+Reference analogs: tests/test_tools_selector.py, test_tool_selector.py,
+test_discover_kernel.py, test_agent_ctor_identity.py and the instructions
+checklist entry in SURVEY §7.
+"""
+
+import pytest
+
+from calfkit_tpu.client import Client
+from calfkit_tpu.engine import EchoModelClient, FunctionModelClient, TestModelClient
+from calfkit_tpu.exceptions import LifecycleConfigError
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models import ModelResponse, TextOutput
+from calfkit_tpu.models.capability import CapabilityRecord, ToolDef
+from calfkit_tpu.nodes import Agent, StatelessAgent, agent_tool
+from calfkit_tpu.nodes.tool import Tools, eager_tools
+from calfkit_tpu.peers import Handoff, Messaging
+from calfkit_tpu.worker import Worker
+
+
+def _record(node_id: str, *tool_names: str) -> CapabilityRecord:
+    return CapabilityRecord(
+        node_id=node_id,
+        dispatch_topic=f"tool.{node_id}.input",
+        tools=[ToolDef(name=n) for n in tool_names],
+    )
+
+
+class TestToolsSelector:
+    def test_named_resolution(self):
+        records = [_record("a", "lookup"), _record("b", "convert")]
+        bindings = Tools("convert").resolve(records)
+        assert [b.tool.name for b in bindings] == ["convert"]
+        assert bindings[0].dispatch_topic == "tool.b.input"
+
+    def test_discover_resolves_all_minus_excluded(self):
+        records = [_record("a", "lookup"), _record("b", "convert", "scale")]
+        names = {b.tool.name for b in Tools(discover=True, exclude=["scale"]).resolve(records)}
+        assert names == {"lookup", "convert"}
+
+    def test_missing_named_tool_is_loud(self):
+        with pytest.raises(Exception):
+            Tools("absent").resolve([_record("a", "lookup")])
+
+    def test_names_xor_discover_enforced(self):
+        with pytest.raises(Exception):
+            Tools("x", discover=True)
+        with pytest.raises(Exception):
+            Tools()  # neither names nor discover
+
+    def test_eager_tools_bind_to_input_topics(self):
+        @agent_tool
+        def greet(name: str) -> str:
+            """Say hello."""
+            return f"hi {name}"
+
+        bindings = eager_tools(greet)
+        assert bindings[0].tool.name == "greet"
+        assert bindings[0].dispatch_topic == "tool.greet.input"
+
+
+class TestConstruction:
+    def test_duplicate_peer_kinds_rejected(self):
+        with pytest.raises(LifecycleConfigError, match="one peer selector"):
+            Agent(
+                "a",
+                model=EchoModelClient(),
+                peers=[Messaging("x"), Messaging("y")],
+            )
+
+    def test_mixed_peer_kinds_accepted(self):
+        agent = Agent(
+            "a",
+            model=EchoModelClient(),
+            peers=[Messaging("x"), Handoff("y")],
+        )
+        assert len(agent.peers) == 2
+
+    def test_stateless_agent_is_an_agent(self):
+        agent = StatelessAgent("s", model=EchoModelClient())
+        assert isinstance(agent, Agent)
+        assert agent.kind == "agent"
+
+
+class TestInstructions:
+    async def _run(self, agent, prompt="hi"):
+        mesh = InMemoryMesh()
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent(agent.name).execute(prompt, timeout=30)
+            await client.close()
+        return result
+
+    async def test_static_instructions_reach_the_model(self):
+        seen = []
+
+        def scripted(messages, params):
+            seen.extend(
+                m.instructions for m in messages
+                if getattr(m, "instructions", None)
+            )
+            return ModelResponse(parts=[TextOutput(text="ok")])
+
+        agent = Agent(
+            "ins", model=FunctionModelClient(scripted),
+            instructions="Be terse.",
+        )
+        await self._run(agent)
+        assert seen == ["Be terse."]
+
+    async def test_callable_instructions_render_per_turn(self):
+        seen = []
+
+        def scripted(messages, params):
+            seen.extend(
+                m.instructions for m in messages
+                if getattr(m, "instructions", None)
+            )
+            return ModelResponse(parts=[TextOutput(text="ok")])
+
+        agent = Agent("dyn", model=FunctionModelClient(scripted))
+
+        @agent.instructions_fn
+        def render(ctx):
+            return f"You serve task {ctx.task_id[:4]}."
+
+        await self._run(agent)
+        assert len(seen) == 1 and seen[0].startswith("You serve task ")
+
+    async def test_temp_instructions_appended(self):
+        seen = []
+
+        def scripted(messages, params):
+            seen.extend(
+                m.instructions for m in messages
+                if getattr(m, "instructions", None)
+            )
+            return ModelResponse(parts=[TextOutput(text="ok")])
+
+        def stamp_temp(ctx):
+            # mid-run code (seams/tools) sets temp_instructions on the wire
+            # state; the next render must append it to the base
+            ctx.state.temp_instructions = "Today only: be verbose."
+
+        agent = Agent(
+            "tmp", model=FunctionModelClient(scripted), instructions="Base.",
+            before_node=[stamp_temp],
+        )
+        await self._run(agent)
+        assert seen == ["Base.\n\nToday only: be verbose."]
+
+
+class TestReservedNames:
+    async def test_reserved_tool_name_faults(self):
+        """A user tool named final_result collides with the structured-
+        output tool — the turn must fault loudly, not shadow it."""
+
+        @agent_tool(name="final_result")
+        def impostor(x: int) -> int:
+            return x
+
+        from pydantic import BaseModel
+
+        class Out(BaseModel):
+            ok: bool
+
+        agent = Agent(
+            "guard", model=TestModelClient(), tools=[impostor],
+            output_type=Out,
+        )
+        mesh = InMemoryMesh()
+        from calfkit_tpu.exceptions import NodeFaultError
+
+        async with Worker([agent, impostor], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            with pytest.raises(NodeFaultError):
+                await client.agent("guard").execute("go", timeout=30)
+            await client.close()
