@@ -2,6 +2,7 @@
 
 from calfkit_tpu.provisioning.provisioner import (
     ProvisioningConfig,
+    classify_topic_error,
     framework_topics_for_nodes,
     provision,
     topics_for_nodes,
@@ -9,6 +10,7 @@ from calfkit_tpu.provisioning.provisioner import (
 
 __all__ = [
     "ProvisioningConfig",
+    "classify_topic_error",
     "framework_topics_for_nodes",
     "provision",
     "topics_for_nodes",

@@ -40,13 +40,15 @@ class TestToolsSelector:
         assert names == {"lookup", "convert"}
 
     def test_missing_named_tool_is_loud(self):
-        with pytest.raises(Exception):
+        from calfkit_tpu.models.capability import CapabilityResolutionError
+
+        with pytest.raises(CapabilityResolutionError, match="absent"):
             Tools("absent").resolve([_record("a", "lookup")])
 
     def test_names_xor_discover_enforced(self):
-        with pytest.raises(Exception):
+        with pytest.raises(ValueError, match="not both"):
             Tools("x", discover=True)
-        with pytest.raises(Exception):
+        with pytest.raises(ValueError, match="requires names"):
             Tools()  # neither names nor discover
 
     def test_eager_tools_bind_to_input_topics(self):
@@ -83,6 +85,21 @@ class TestConstruction:
         assert agent.kind == "agent"
 
 
+def _instruction_probe():
+    """(seen, model): a scripted model that records every instructions
+    string the agent put on the request."""
+    seen: list = []
+
+    def scripted(messages, params):
+        seen.extend(
+            m.instructions for m in messages
+            if getattr(m, "instructions", None)
+        )
+        return ModelResponse(parts=[TextOutput(text="ok")])
+
+    return seen, FunctionModelClient(scripted)
+
+
 class TestInstructions:
     async def _run(self, agent, prompt="hi"):
         mesh = InMemoryMesh()
@@ -93,33 +110,14 @@ class TestInstructions:
         return result
 
     async def test_static_instructions_reach_the_model(self):
-        seen = []
-
-        def scripted(messages, params):
-            seen.extend(
-                m.instructions for m in messages
-                if getattr(m, "instructions", None)
-            )
-            return ModelResponse(parts=[TextOutput(text="ok")])
-
-        agent = Agent(
-            "ins", model=FunctionModelClient(scripted),
-            instructions="Be terse.",
-        )
+        seen, model = _instruction_probe()
+        agent = Agent("ins", model=model, instructions="Be terse.")
         await self._run(agent)
         assert seen == ["Be terse."]
 
     async def test_callable_instructions_render_per_turn(self):
-        seen = []
-
-        def scripted(messages, params):
-            seen.extend(
-                m.instructions for m in messages
-                if getattr(m, "instructions", None)
-            )
-            return ModelResponse(parts=[TextOutput(text="ok")])
-
-        agent = Agent("dyn", model=FunctionModelClient(scripted))
+        seen, model = _instruction_probe()
+        agent = Agent("dyn", model=model)
 
         @agent.instructions_fn
         def render(ctx):
@@ -129,14 +127,7 @@ class TestInstructions:
         assert len(seen) == 1 and seen[0].startswith("You serve task ")
 
     async def test_temp_instructions_appended(self):
-        seen = []
-
-        def scripted(messages, params):
-            seen.extend(
-                m.instructions for m in messages
-                if getattr(m, "instructions", None)
-            )
-            return ModelResponse(parts=[TextOutput(text="ok")])
+        seen, model = _instruction_probe()
 
         def stamp_temp(ctx):
             # mid-run code (seams/tools) sets temp_instructions on the wire
@@ -144,7 +135,7 @@ class TestInstructions:
             ctx.state.temp_instructions = "Today only: be verbose."
 
         agent = Agent(
-            "tmp", model=FunctionModelClient(scripted), instructions="Base.",
+            "tmp", model=model, instructions="Base.",
             before_node=[stamp_temp],
         )
         await self._run(agent)

@@ -1,0 +1,89 @@
+"""Provisioning: topic derivation + classification corners NOT covered by
+tests/test_connection_hardening.py (which owns the retry-ladder and
+batch-exists suites — keep provisioner behavior pinned in ONE place each).
+
+Reference analogs: tests/test_provisioning.py, test_startup_provisioning.py.
+"""
+
+from calfkit_tpu import protocol
+from calfkit_tpu.engine import EchoModelClient
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.nodes import Agent, agent_tool
+from calfkit_tpu.provisioning import (
+    ProvisioningConfig,
+    classify_topic_error,
+    framework_topics_for_nodes,
+    provision,
+    topics_for_nodes,
+)
+
+
+class TestClassificationOrdering:
+    def test_unauthorized_beats_retry_markers(self):
+        """'authorization failed after connection attempt' must classify
+        unauthorized, not retry — ACL markers are checked before
+        retriable markers (an unauthorized cluster must not look flaky)."""
+
+        class KafkaError(Exception):
+            pass
+
+        exc = KafkaError("topic authorization failed on connection")
+        assert classify_topic_error(exc) == "unauthorized"
+
+    def test_unauthorized_beats_existing_markers(self):
+        class KafkaError(Exception):
+            pass
+
+        exc = KafkaError("already exists check denied: aclauthorization")
+        assert classify_topic_error(exc) == "unauthorized"
+
+
+class TestTopicDerivation:
+    def _nodes(self):
+        @agent_tool
+        def lookup(q: str) -> str:
+            """Find things."""
+            return q
+
+        return [Agent("helper", model=EchoModelClient()), lookup]
+
+    def test_node_topics_cover_inputs_returns_publish(self):
+        topics = topics_for_nodes(self._nodes())
+        assert protocol.agent_input_topic("helper") in topics
+        assert protocol.agent_return_topic("helper") in topics
+        assert protocol.tool_input_topic("lookup") in topics
+        assert topics == sorted(set(topics))  # deterministic + deduped
+
+    def test_framework_topics_cover_controlplane_and_fanout(self):
+        nodes = self._nodes()
+        topics = framework_topics_for_nodes(nodes)
+        assert protocol.AGENTS_TOPIC in topics
+        assert protocol.CAPABILITIES_TOPIC in topics
+        assert protocol.fanout_state_topic(nodes[0].node_id) in topics
+        assert protocol.fanout_basestate_topic(nodes[0].node_id) in topics
+
+
+class TestProvisionSurface:
+    async def test_disabled_provisions_nothing(self):
+        calls = []
+
+        class Spy(InMemoryMesh):
+            async def ensure_topics(self, names, *, compacted=False):
+                calls.append(list(names))
+
+        result = await provision(
+            Spy(), [Agent("p", model=EchoModelClient())],
+            ProvisioningConfig(enabled=False),
+        )
+        assert result == {"plain": [], "compacted": []}
+        assert calls == []
+
+    async def test_include_framework_false_skips_compacted(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        result = await provision(
+            mesh, [Agent("p", model=EchoModelClient())],
+            ProvisioningConfig(include_framework=False),
+        )
+        assert result["plain"] and result["compacted"] == []
+        await mesh.stop()
