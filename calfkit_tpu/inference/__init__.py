@@ -13,7 +13,14 @@ from typing import Any
 
 from calfkit_tpu.inference.config import ModelConfig, PRESETS, RuntimeConfig
 
-__all__ = ["JaxLocalModelClient", "ModelConfig", "PRESETS", "RuntimeConfig"]
+__all__ = [
+    "JaxLocalModelClient",
+    "ModelConfig",
+    "PRESETS",
+    "RuntimeConfig",
+    "assert_engine_fits",
+    "initialize_multihost",
+]
 
 
 def __getattr__(name: str) -> Any:
@@ -22,4 +29,8 @@ def __getattr__(name: str) -> Any:
         from calfkit_tpu.inference.client import JaxLocalModelClient
 
         return JaxLocalModelClient
+    if name in ("initialize_multihost", "assert_engine_fits"):
+        from calfkit_tpu.inference import distributed
+
+        return getattr(distributed, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

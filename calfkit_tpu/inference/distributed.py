@@ -1,0 +1,169 @@
+"""Multi-host initialization for the inference backend.
+
+The two-tier distributed design (SURVEY §2.4 / §5 "distributed
+communication backend"):
+
+- **DCN tier — the agent mesh.** Envelopes, control-plane tables, and
+  fan-out state travel over the mesh transport (Kafka/meshd/in-memory).
+  This tier is host-count-agnostic: more Workers in a consumer group IS
+  the scale-out story, exactly like the reference's Kafka backend.
+- **ICI/DCN tier — inside the engine.** jax collectives under GSPMD.  On
+  one host this needs nothing.  On a TPU pod slice spanning hosts, every
+  host runs the SAME engine process and jax must be initialized for
+  multi-process so ``jax.devices()`` is the GLOBAL device list and the
+  engine's dp×tp (and sp) meshes span the pod — XLA then routes
+  collectives over ICI within a slice and DCN across slices.
+
+This module owns that second tier's bring-up.  It is deliberately thin:
+the heavy lifting IS ``jax.distributed.initialize``, and TPU pod runtimes
+(GKE, queued resources) set the cluster-discovery env vars themselves —
+on those, ``initialize_multihost()`` with no arguments does the right
+thing.  For manual bring-up (e.g. two CPU hosts in tests, or bare-metal),
+pass/export the three coordinates explicitly:
+
+    CALFKIT_COORDINATOR=10.0.0.1:8476 CALFKIT_NUM_PROCESSES=2 \
+    CALFKIT_PROCESS_ID=0 python serve.py
+
+Reference seam: the reference has no analog (its compute tier is a remote
+HTTPS service); this is the NCCL/MPI-equivalent bring-up the TPU build
+owns, mapped onto jax's runtime.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+
+logger = logging.getLogger(__name__)
+
+_ENV_COORDINATOR = "CALFKIT_COORDINATOR"
+_ENV_NUM_PROCESSES = "CALFKIT_NUM_PROCESSES"
+_ENV_PROCESS_ID = "CALFKIT_PROCESS_ID"
+
+
+@dataclass(frozen=True)
+class MultihostInfo:
+    """What the engine needs to know after bring-up."""
+
+    process_id: int
+    num_processes: int
+    local_devices: int
+    global_devices: int
+
+    @property
+    def is_multihost(self) -> bool:
+        return self.num_processes > 1
+
+
+def initialize_multihost(
+    coordinator: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> MultihostInfo:
+    """Initialize jax for multi-process serving; safe to call on one host.
+
+    Resolution order per coordinate: explicit argument →
+    ``CALFKIT_COORDINATOR``/``CALFKIT_NUM_PROCESSES``/``CALFKIT_PROCESS_ID``
+    env vars → jax's own cluster auto-detection (TPU pod runtimes).  With
+    no coordinates from any source, this is a no-op single-process setup —
+    the quickstart path never pays for distribution.
+
+    Call BEFORE constructing an :class:`InferenceEngine` (backend init
+    must not have happened yet, per jax's contract).  After it returns,
+    build engines with ``tp``/``dp`` sized to the GLOBAL device count;
+    each host admits only its own requests, but compilation and
+    collectives span the pod.
+    """
+    import jax
+
+    coordinator = coordinator or os.environ.get(_ENV_COORDINATOR)
+    if num_processes is None and (raw := os.environ.get(_ENV_NUM_PROCESSES)):
+        num_processes = int(raw)
+    if process_id is None and (raw := os.environ.get(_ENV_PROCESS_ID)):
+        process_id = int(raw)
+
+    given = {
+        "coordinator": coordinator is not None,
+        "num_processes": num_processes is not None,
+        "process_id": process_id is not None,
+    }
+    if any(given.values()) and not all(given.values()):
+        # fail HERE with a config error, not deep inside jax with None fields
+        missing = [k for k, ok in given.items() if not ok]
+        raise ValueError(
+            "multi-host coordinates must be set together "
+            f"(missing: {', '.join(missing)}); set all three of "
+            f"{_ENV_COORDINATOR}/{_ENV_NUM_PROCESSES}/{_ENV_PROCESS_ID} "
+            "or none"
+        )
+
+    if all(given.values()):
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        logger.info(
+            "jax.distributed initialized: process %s of %s via %s",
+            jax.process_index(), jax.process_count(), coordinator,
+        )
+    else:
+        # TPU pod runtimes are auto-detected by jax.distributed.initialize
+        # with no args — but bare single-host (CPU/dev) raises there, so
+        # only attempt when jax reports a cluster environment
+        try:
+            from jax._src.clusters import ClusterEnv
+
+            detected = ClusterEnv.auto_detect_unset_distributed_params(
+                None, None, None, None, None, None
+            )[0] is not None
+        except Exception:  # noqa: BLE001 - private API; see warning below
+            # LOUD degradation: if this private probe breaks on a jax
+            # upgrade, a real pod would silently serve host-local meshes —
+            # make that failure mode visible in logs
+            logger.warning(
+                "cluster auto-detection unavailable (jax internals moved?); "
+                "assuming single-process — on a pod, set %s/%s/%s explicitly",
+                _ENV_COORDINATOR, _ENV_NUM_PROCESSES, _ENV_PROCESS_ID,
+                exc_info=True,
+            )
+            detected = False
+        if detected:
+            jax.distributed.initialize()
+            logger.info(
+                "jax.distributed auto-initialized: process %s of %s",
+                jax.process_index(), jax.process_count(),
+            )
+
+    return MultihostInfo(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_devices=len(jax.local_devices()),
+        global_devices=len(jax.devices()),
+    )
+
+
+def assert_engine_fits(info: MultihostInfo, tp: int, dp: int) -> None:
+    """Loudly reject a mesh that over-asks — or, multi-host, under-uses —
+    the pod.
+
+    Single-host under-use is legitimate (an engine on 1 of 8 chips).
+    Multi-host under-use is not: a mesh that omits another process's
+    addressable devices hangs or errors at the first collective, so every
+    pod device must be in the mesh.
+    """
+    need = tp * dp
+    if need > info.global_devices:
+        raise ValueError(
+            f"engine mesh tp={tp} x dp={dp} needs {need} devices but the "
+            f"{'pod' if info.is_multihost else 'host'} has "
+            f"{info.global_devices}"
+        )
+    if info.is_multihost and need != info.global_devices:
+        raise ValueError(
+            f"multi-host engine mesh must span the whole pod: tp x dp = "
+            f"{need} but {info.num_processes} processes contribute "
+            f"{info.global_devices} devices (a partial mesh omits another "
+            "process's devices and deadlocks at the first collective)"
+        )

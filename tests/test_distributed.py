@@ -1,0 +1,137 @@
+"""Multi-host bring-up: initialize_multihost + mesh-fit guards.
+
+The real-cluster behavior is tested with TWO actual processes coordinating
+over localhost (jax multi-process on CPU): global device count spans both,
+and a jitted reduction over a global mesh agrees on each host.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from calfkit_tpu.inference.distributed import (
+    MultihostInfo,
+    assert_engine_fits,
+    initialize_multihost,
+)
+
+
+class TestSingleHost:
+    def test_noop_without_coordinates(self):
+        """On a bare host with no cluster env, bring-up is a no-op and
+        reports single-process truth."""
+        info = initialize_multihost()
+        assert info.num_processes == 1
+        assert info.process_id == 0
+        assert not info.is_multihost
+        assert info.global_devices == info.local_devices
+
+
+class TestMeshFit:
+    def _info(self, **kw):
+        defaults = dict(
+            process_id=0, num_processes=2, local_devices=4, global_devices=8
+        )
+        defaults.update(kw)
+        return MultihostInfo(**defaults)
+
+    def test_fits(self):
+        assert_engine_fits(self._info(), tp=4, dp=2)
+
+    def test_over_ask_rejected(self):
+        with pytest.raises(ValueError, match="needs 16 devices"):
+            assert_engine_fits(self._info(), tp=8, dp=2)
+
+    def test_multihost_partial_mesh_rejected(self):
+        """A multi-host mesh must span every pod device: omitting another
+        process's devices deadlocks at the first collective."""
+        with pytest.raises(ValueError, match="span the whole pod"):
+            assert_engine_fits(self._info(), tp=2, dp=1)
+
+    def test_single_host_partial_mesh_allowed(self):
+        info = self._info(num_processes=1, global_devices=8, local_devices=8)
+        assert_engine_fits(info, tp=2, dp=1)  # 2 of 8 chips: legitimate
+
+    def test_partial_coordinates_rejected_loudly(self):
+        from calfkit_tpu.inference.distributed import initialize_multihost
+
+        with pytest.raises(ValueError, match="set together"):
+            initialize_multihost(process_id=0)
+
+    def test_single_host_message_names_host(self):
+        info = self._info(num_processes=1, global_devices=4, local_devices=4)
+        with pytest.raises(ValueError, match="host has 4"):
+            assert_engine_fits(info, tp=8, dp=1)
+
+
+_CHILD = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from calfkit_tpu.inference.distributed import initialize_multihost
+
+    pid = int(sys.argv[1])
+    info = initialize_multihost({addr!r}, 2, pid)
+    assert info.num_processes == 2 and info.is_multihost, info
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()).reshape(2, -1), ("dp", "tp"))
+    x = jax.device_put(
+        jnp.arange(8, dtype=jnp.float32).reshape(2, 4),
+        NamedSharding(mesh, P("dp", None)),
+    )
+    total = float(jax.jit(lambda a: a.sum())(x))
+    print(f"RESULT {{pid}} {{info.global_devices}} {{total}}")
+""")
+
+
+class TestTwoProcesses:
+    def test_two_process_global_mesh(self, tmp_path):
+        """Two REAL processes coordinate over localhost: each sees the
+        global device list (2 hosts x 2 devices) and a jitted global-mesh
+        reduction returns the same answer on both."""
+        with socket.socket() as probe:
+            probe.bind(("127.0.0.1", 0))
+            port = probe.getsockname()[1]
+        script = tmp_path / "child.py"
+        script.write_text(
+            _CHILD.format(
+                repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                addr=f"127.0.0.1:{port}",
+            )
+        )
+        env = dict(
+            os.environ,
+            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            JAX_PLATFORMS="cpu",
+        )
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(pid)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True,
+            )
+            for pid in (0, 1)
+        ]
+        outs = []
+        for proc in procs:
+            try:
+                out, err = proc.communicate(timeout=120)
+            except subprocess.TimeoutExpired:
+                for p in procs:
+                    p.kill()
+                pytest.fail("two-process bring-up hung")
+            assert proc.returncode == 0, err[-800:]
+            outs.append(out)
+        results = sorted(
+            line.split()[1:] for out in outs for line in out.splitlines()
+            if line.startswith("RESULT")
+        )
+        assert results == [["0", "4", "28.0"], ["1", "4", "28.0"]]
