@@ -99,7 +99,7 @@ class ControlPlane:
             )
         return adverts
 
-    async def attach(self, worker: Any) -> _Attached:
+    async def attach(self, worker: Any, *, ensure: bool = True) -> _Attached:
         transport = worker.mesh
         config = self.config
 
@@ -117,14 +117,15 @@ class ControlPlane:
             stale_after=config.stale_after,
             catchup_timeout=config.catchup_timeout,
         )
-        await transport.ensure_topics(
-            [
-                protocol.AGENTS_TOPIC,
-                protocol.CAPABILITIES_TOPIC,
-                protocol.ENGINE_STATS_TOPIC,
-            ],
-            compacted=True,
-        )
+        if ensure:  # False when the worker's provisioner already ran
+            await transport.ensure_topics(
+                [
+                    protocol.AGENTS_TOPIC,
+                    protocol.CAPABILITIES_TOPIC,
+                    protocol.ENGINE_STATS_TOPIC,
+                ],
+                compacted=True,
+            )
         # views catch up BEFORE serving: a turn must not resolve against a
         # half-read directory.  Anything started before a failure is stopped
         # again — a failed attach must not orphan readers.

@@ -111,10 +111,14 @@ class KtablesFanoutBatchStore:
         self._base_reader = transport.table_reader(self._base_topic)
         self._base_writer = transport.table_writer(self._base_topic)
 
-    async def start(self) -> None:
-        await self._transport.ensure_topics(
-            [self._state_topic, self._base_topic], compacted=True
-        )
+    async def start(self, *, ensure: bool = True) -> None:
+        # ensure=False when the caller already provisioned the framework
+        # tables (Worker boots through the classifying provisioner; paying
+        # another admin round-trip per node would be pure overhead)
+        if ensure:
+            await self._transport.ensure_topics(
+                [self._state_topic, self._base_topic], compacted=True
+            )
         timeout = self._config.table.catchup_timeout_s
         await self._base_reader.start(timeout=timeout)
         await self._state_reader.start(timeout=timeout)

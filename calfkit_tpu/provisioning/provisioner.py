@@ -85,7 +85,11 @@ def topics_for_nodes(nodes: Iterable[BaseNodeDef]) -> list[str]:
 def framework_topics_for_nodes(nodes: Iterable[BaseNodeDef]) -> list[str]:
     """Framework-owned topics backing the nodes: control plane + durable
     fan-out tables (compacted)."""
-    topics: set[str] = {protocol.AGENTS_TOPIC, protocol.CAPABILITIES_TOPIC}
+    topics: set[str] = {
+        protocol.AGENTS_TOPIC,
+        protocol.CAPABILITIES_TOPIC,
+        protocol.ENGINE_STATS_TOPIC,
+    }
     for node in nodes:
         topics.add(protocol.fanout_state_topic(node.node_id))
         topics.add(protocol.fanout_basestate_topic(node.node_id))

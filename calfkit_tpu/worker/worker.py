@@ -44,6 +44,7 @@ class Worker(LifecycleHookMixin):
         owns_transport: bool = False,
         control_plane: Any = None,
         fanout: Any = None,  # FanoutConfig | None
+        provisioning: Any = None,  # ProvisioningConfig | None
     ):
         super().__init__()
         if not nodes:
@@ -67,6 +68,16 @@ class Worker(LifecycleHookMixin):
                 f"fanout must be a FanoutConfig, got {type(fanout).__name__}"
             )
         self.fanout_config = fanout
+        from calfkit_tpu.provisioning import ProvisioningConfig
+
+        if provisioning is not None and not isinstance(
+            provisioning, ProvisioningConfig
+        ):
+            raise LifecycleConfigError(
+                "provisioning must be a ProvisioningConfig, got "
+                f"{type(provisioning).__name__}"
+            )
+        self.provisioning_config = provisioning
         # control plane default ON: pass False (or a disabled config) to opt
         # out; a ControlPlaneConfig customizes; a ControlPlane is used as-is
         from calfkit_tpu.controlplane import ControlPlane, ControlPlaneConfig
@@ -110,11 +121,16 @@ class Worker(LifecycleHookMixin):
         await self._enter_resources(self.resources)
         await self.mesh.start()
 
-        # provision every topic the nodes touch
-        topics: list[str] = []
-        for node in self.nodes:
-            topics.extend(node.all_topics())
-        await self.mesh.ensure_topics(sorted(set(topics)))
+        # provision every topic the nodes touch, through the classifying
+        # provisioner (retry on transient broker trouble; an unauthorized
+        # cluster fails loudly instead of looking flaky)
+        from calfkit_tpu.provisioning import ProvisioningConfig, provision
+
+        await provision(self.mesh, self.nodes, self.provisioning_config)
+        # when the provisioner covered the framework tables, downstream
+        # starters skip their own ensure (no redundant admin round-trips)
+        prov = self.provisioning_config or ProvisioningConfig()
+        framework_provisioned = prov.enabled and prov.include_framework
 
         for node in self.nodes:
             node.bind(self.mesh)
@@ -125,7 +141,7 @@ class Worker(LifecycleHookMixin):
                 store = KtablesFanoutBatchStore(
                     self.mesh, node.node_id, self.fanout_config
                 )
-                await store.start()
+                await store.start(ensure=not framework_provisioned)
                 self._stores.append(store)
                 node.resources[FANOUT_STORE_KEY] = store
 
@@ -139,7 +155,9 @@ class Worker(LifecycleHookMixin):
         # control plane attaches BEFORE subscriptions: a delivery consumed
         # in the boot window must already find its views
         if self.control_plane is not None:
-            self._advertiser = await self.control_plane.attach(self)
+            self._advertiser = await self.control_plane.attach(
+                self, ensure=not framework_provisioned
+            )
 
         for node in self.nodes:
             subscribe_topics = list(node.input_topics()) + [node.return_topic()]

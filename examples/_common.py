@@ -1,0 +1,136 @@
+"""Shared example helpers.
+
+Every example is runnable straight from a checkout::
+
+    python examples/<name>/run.py
+
+Each file begins with a two-line ``sys.path`` bootstrap (the script's
+directory — not the repo root — is what Python puts on ``sys.path``), then
+imports these helpers.  Nothing here is framework machinery: real
+deployments ``pip install`` the package and point agents at a real model
+via ``JaxLocalModelClient``; examples use deterministic scripted models so
+they run anywhere, instantly, with zero weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from calfkit_tpu.engine import FunctionModelClient
+from calfkit_tpu.models.messages import (
+    ModelMessage,
+    ModelRequest,
+    ModelResponse,
+    TextOutput,
+    ToolCallOutput,
+    ToolReturnPart,
+    UserPart,
+)
+
+TurnFn = Callable[[list[ModelMessage], Any], ModelResponse]
+
+
+def scripted(*turns: TurnFn, name: str = "scripted-model") -> FunctionModelClient:
+    """A deterministic model that plays ``turns`` in order.
+
+    The turn index is the number of model responses already in the
+    (POV-projected) history — i.e. how many times THIS agent has spoken in
+    the conversation it can see.  The last turn repeats if the conversation
+    outlives the script.
+    """
+
+    def fn(messages: list[ModelMessage], params: Any) -> ModelResponse:
+        i = sum(isinstance(m, ModelResponse) for m in messages)
+        return turns[min(i, len(turns) - 1)](messages, params)
+
+    return FunctionModelClient(fn, name=name)
+
+
+def say(text: str) -> TurnFn:
+    """A turn that answers with plain text."""
+
+    def turn(messages: list[ModelMessage], params: Any) -> ModelResponse:
+        return ModelResponse(parts=[TextOutput(text=text)])
+
+    return turn
+
+
+def call(tool_name: str, **args: Any) -> TurnFn:
+    """A turn that calls one tool."""
+
+    def turn(messages: list[ModelMessage], params: Any) -> ModelResponse:
+        return ModelResponse(
+            parts=[_tool_call(tool_name, args, seq=0)]
+        )
+
+    return turn
+
+
+def call_many(*calls: tuple[str, dict[str, Any]]) -> TurnFn:
+    """A turn that issues several tool calls in ONE response (fan-out)."""
+
+    def turn(messages: list[ModelMessage], params: Any) -> ModelResponse:
+        return ModelResponse(
+            parts=[_tool_call(n, a, seq=i) for i, (n, a) in enumerate(calls)]
+        )
+
+    return turn
+
+
+def _tool_call(name: str, args: dict[str, Any], *, seq: int) -> ToolCallOutput:
+    import uuid
+
+    return ToolCallOutput(
+        tool_call_id=f"tc_{uuid.uuid4().hex[:8]}_{seq}",
+        tool_name=name,
+        args=args,
+    )
+
+
+def last_user_text(messages: list[ModelMessage]) -> str:
+    """The most recent user-visible prompt text in the projected history."""
+    from calfkit_tpu.models.payload import render_parts_as_text
+
+    for message in reversed(messages):
+        if isinstance(message, ModelRequest):
+            for part in reversed(message.parts):
+                if isinstance(part, UserPart):
+                    if isinstance(part.content, str):
+                        return part.content
+                    return render_parts_as_text(part.content)
+    return ""
+
+
+def all_user_text(messages: list[ModelMessage]) -> str:
+    """Every user-visible text in the projected history, joined.
+
+    After a handoff, the ORIGINAL user prompt is an earlier message and the
+    handing-off agent's briefing is the latest — scan everything."""
+    from calfkit_tpu.models.payload import render_parts_as_text
+
+    chunks: list[str] = []
+    for message in messages:
+        if isinstance(message, ModelRequest):
+            for part in message.parts:
+                if isinstance(part, UserPart):
+                    chunks.append(
+                        part.content
+                        if isinstance(part.content, str)
+                        else render_parts_as_text(part.content)
+                    )
+    return "\n".join(chunks)
+
+
+def tool_replies(messages: list[ModelMessage]) -> list[str]:
+    """Every tool-return text visible in the history, oldest first."""
+    out: list[str] = []
+    for message in messages:
+        if isinstance(message, ModelRequest):
+            for part in message.parts:
+                if isinstance(part, ToolReturnPart):
+                    out.append(
+                        part.content
+                        if isinstance(part.content, str)
+                        else str(part.content)
+                    )
+    return out

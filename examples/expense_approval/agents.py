@@ -1,0 +1,66 @@
+"""Expense approval: a request climbs a handoff chain until someone is
+authorized to clear it.
+
+Each approver has a spending limit.  Within the limit it approves; above it,
+it hands the WHOLE conversation to the next rung — the final approver
+answers the original caller directly, and every hop is visible in the run's
+step stream.
+"""
+
+import os
+import re
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu.nodes import Agent  # noqa: E402
+from calfkit_tpu.peers import Handoff  # noqa: E402
+from examples._common import all_user_text, call, say, scripted  # noqa: E402
+
+
+def _amount(messages) -> float:
+    """Largest dollar figure anywhere in the visible conversation (after a
+    handoff the original request is an EARLIER message, not the latest)."""
+    figures = re.findall(r"\$([\d,]+(?:\.\d+)?)", all_user_text(messages))
+    return max((float(f.replace(",", "")) for f in figures), default=0.0)
+
+
+def _approver_model(title: str, limit: float, next_rung: str | None):
+    def turn(messages, params):
+        amount = _amount(messages)
+        if amount <= limit or next_rung is None:
+            return say(
+                f"Approved by the {title} (${amount:,.0f} is within the "
+                f"${limit:,.0f} limit)."
+            )(messages, params)
+        return call("handoff_to_agent", agent_name=next_rung)(messages, params)
+
+    return scripted(turn, name=f"{title}-model")
+
+
+team_lead = Agent(
+    "team_lead",
+    model=_approver_model("team lead", 500, "director"),
+    instructions="Approve expenses up to $500; escalate anything larger.",
+    peers=[Handoff("director")],
+    description="First-line expense approval (limit $500).",
+)
+
+director = Agent(
+    "director",
+    model=_approver_model("director", 5_000, "vp"),
+    instructions="Approve expenses up to $5,000; escalate anything larger.",
+    peers=[Handoff("vp")],
+    description="Second-line expense approval (limit $5,000).",
+)
+
+vp = Agent(
+    "vp",
+    model=_approver_model("VP", 100_000, None),
+    instructions="You are the final authority on expenses.",
+    description="Final expense authority.",
+)
+
+CHAIN = [team_lead, director, vp]

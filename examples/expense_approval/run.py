@@ -1,0 +1,41 @@
+"""Run three expense requests up the approval chain.
+
+Run:  python examples/expense_approval/run.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu import Client, Worker  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+
+from agents import CHAIN  # noqa: E402
+
+
+async def main() -> None:
+    mesh = InMemoryMesh()
+    async with Worker(CHAIN, mesh=mesh, owns_transport=True):
+        client = Client.connect(mesh)
+        for amount in (120, 3_200, 48_000):
+            handle = await client.agent("team_lead").start(
+                f"Requesting approval for a ${amount:,} expense "
+                "(conference travel)."
+            )
+            hops = []
+            async for event in handle.stream():
+                step = getattr(event, "step", None)
+                if step is not None and step.kind == "handoff":
+                    hops.append(getattr(step, "to_agent", "?"))
+                elif step is None:
+                    chain = " -> ".join(["team_lead", *hops])
+                    print(f"${amount:>6,}: [{chain}] {event.output}")
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

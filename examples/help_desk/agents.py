@@ -1,0 +1,87 @@
+"""The help-desk team: a front desk that discovers expert teams at runtime.
+
+The front desk names NO experts in code — ``Messaging(discover=True)`` /
+``Handoff(discover=True)`` resolve against the live control plane each
+turn, so deploying a new expert (see ``extra_expert.py``) makes it
+reachable on the very next question, with no front-desk change.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu.engine import TestModelClient  # noqa: E402
+from calfkit_tpu.models.messages import ModelResponse  # noqa: E402
+from calfkit_tpu.nodes import Agent  # noqa: E402
+from calfkit_tpu.peers import Handoff, Messaging  # noqa: E402
+from examples._common import (  # noqa: E402
+    call,
+    last_user_text,
+    say,
+    scripted,
+    tool_replies,
+)
+from tools import invoice_status, reset_password  # noqa: E402
+
+it_expert = Agent(
+    "it_expert",
+    model=TestModelClient(
+        custom_output_text="IT here — the password was reset and temporary "
+        "credentials are on their way."
+    ),
+    instructions="You are the IT expert. Use your tools to fix accounts.",
+    tools=[reset_password],
+    description="Fixes accounts, passwords, and devices.",
+)
+
+billing_expert = Agent(
+    "billing_expert",
+    model=TestModelClient(
+        custom_output_text="Billing here — that invoice was paid on July 1."
+    ),
+    instructions="You are the billing expert. Use your tools to check invoices.",
+    tools=[invoice_status],
+    description="Answers invoice and payment questions.",
+)
+
+
+def _route(messages, params):
+    """Turn 1: pick an expert from the live directory by topic."""
+    text = last_user_text(messages).lower()
+    if "security" in text or "breach" in text:
+        # a security question is handed off entirely: the expert answers
+        # the caller directly and the front desk drops out
+        return call("handoff_to_agent", agent_name="security_expert")(
+            messages, params
+        )
+    target = "it_expert" if "password" in text else "billing_expert"
+    return call(
+        "message_agent",
+        agent_name=target,
+        message=last_user_text(messages),
+    )(messages, params)
+
+
+def _relay(messages, params):
+    """Turn 2: relay the expert's reply to the user."""
+    replies = tool_replies(messages)
+    detail = replies[-1] if replies else "(no expert reply)"
+    return say(f"Front desk: {detail}")(messages, params)
+
+
+front_desk = Agent(
+    "front_desk",
+    model=scripted(_route, _relay, name="front-desk-router"),
+    instructions=(
+        "You are the help-desk front desk. Route each question to the "
+        "right expert from the live directory; hand off entirely when the "
+        "expert should own the conversation."
+    ),
+    peers=[Messaging(discover=True), Handoff(discover=True)],
+    description="Routes help-desk questions to whichever experts are live.",
+)
+
+TEAM = [front_desk, it_expert, billing_expert, reset_password, invoice_status]

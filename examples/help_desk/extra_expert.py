@@ -1,0 +1,28 @@
+"""A new expert, deployed later — reachable with zero front-desk changes.
+
+The front desk uses ``discover=True``; the moment this worker's control-plane
+advert lands, ``security_expert`` appears in the front desk's live directory
+and handoffs to it start succeeding.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu.engine import TestModelClient  # noqa: E402
+from calfkit_tpu.nodes import Agent  # noqa: E402
+
+security_expert = Agent(
+    "security_expert",
+    model=TestModelClient(
+        custom_output_text="Security here — the incident is contained; "
+        "rotate your credentials and watch for the follow-up report."
+    ),
+    instructions="You are the security expert. Own every incident question.",
+    description="Handles security incidents and breach questions.",
+)
+
+NODES = [security_expert]

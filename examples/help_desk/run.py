@@ -1,0 +1,46 @@
+"""Run the help desk end-to-end on the in-memory mesh.
+
+Phase 1: the front desk messages a discovered expert and relays the answer.
+Phase 2: a NEW expert worker joins the mesh at runtime; the next question is
+handed off to it — the front desk's code never changed.
+
+Run:  python examples/help_desk/run.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu import Client, Worker  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+
+from agents import TEAM  # noqa: E402
+from extra_expert import NODES as EXTRA  # noqa: E402
+
+
+async def main() -> None:
+    mesh = InMemoryMesh()
+    async with Worker(TEAM, mesh=mesh, owns_transport=True):
+        client = Client.connect(mesh)
+        desk = client.agent("front_desk")
+
+        result = await client.agent("front_desk").execute(
+            "I forgot my password, can you help?"
+        )
+        print(f"[phase 1] {result.output}")
+
+        # ---- deploy a brand-new expert while the mesh is live
+        async with Worker(EXTRA, mesh=mesh):
+            result = await desk.execute(
+                "We may have a security breach on the build server!"
+            )
+            print(f"[phase 2] {result.output}")
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

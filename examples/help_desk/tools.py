@@ -1,0 +1,31 @@
+"""Expert tools for the internal help desk."""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu.nodes import agent_tool  # noqa: E402
+
+
+@agent_tool
+def reset_password(username: str) -> str:
+    """Reset a user's password and send temporary credentials.
+
+    Args:
+        username: The account to reset.
+    """
+    return f"Password for {username!r} reset; temporary credentials emailed."
+
+
+@agent_tool
+def invoice_status(invoice_id: str) -> dict:
+    """Look up the payment status of an invoice.
+
+    Args:
+        invoice_id: The invoice number.
+    """
+    return {"invoice_id": invoice_id or "INV-1234", "status": "paid",
+            "paid_on": "2026-07-01"}

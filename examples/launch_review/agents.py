@@ -1,0 +1,96 @@
+"""Launch review: parallel consultation, local synthesis.
+
+Unlike a handoff, the release manager keeps the conversation: it fans out to
+engineering, security, and legal in ONE model turn (three ``message_agent``
+calls dispatched as a durable parallel batch), then reads all three replies
+and synthesizes the go/no-go itself.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu.engine import TestModelClient  # noqa: E402
+from calfkit_tpu.nodes import Agent, agent_tool  # noqa: E402
+from calfkit_tpu.peers import Messaging  # noqa: E402
+from examples._common import (  # noqa: E402
+    call_many,
+    last_user_text,
+    say,
+    scripted,
+    tool_replies,
+)
+
+
+@agent_tool
+def scan_dependencies(release: str) -> dict:
+    """Scan a release's dependency tree for known CVEs.
+
+    Args:
+        release: The release tag to scan.
+    """
+    return {"release": release, "critical": 0, "high": 1,
+            "note": "one high CVE, patched in the pinned build"}
+
+
+engineering = Agent(
+    "engineering",
+    model=TestModelClient(
+        custom_output_text="Engineering: CI is green, rollback tested. GO."
+    ),
+    instructions="Assess release readiness from the engineering side.",
+    description="Assesses build/CI/rollback readiness.",
+)
+
+security = Agent(
+    "security",
+    model=TestModelClient(
+        custom_output_text="Security: scan shows one patched high CVE. GO."
+    ),
+    instructions="Scan the release and assess security risk.",
+    tools=[scan_dependencies],
+    description="Scans releases for vulnerabilities.",
+)
+
+legal = Agent(
+    "legal",
+    model=TestModelClient(
+        custom_output_text="Legal: licenses audited, export review clear. GO."
+    ),
+    instructions="Check licensing and compliance.",
+    description="Checks licensing and compliance.",
+)
+
+
+def _fan_out(messages, params):
+    ask = last_user_text(messages)
+    return call_many(
+        *(
+            ("message_agent", {"agent_name": team, "message": ask})
+            for team in ("engineering", "security", "legal")
+        )
+    )(messages, params)
+
+
+def _synthesize(messages, params):
+    replies = tool_replies(messages)
+    verdict = "GO" if all("GO" in r for r in replies) else "NO-GO"
+    lines = "\n".join(f"  - {r}" for r in replies)
+    return say(f"Launch review: {verdict}\n{lines}")(messages, params)
+
+
+release_manager = Agent(
+    "release_manager",
+    model=scripted(_fan_out, _synthesize, name="release-manager-model"),
+    instructions=(
+        "Consult engineering, security, and legal in parallel, then issue "
+        "the go/no-go yourself."
+    ),
+    peers=[Messaging("engineering", "security", "legal")],
+    description="Runs launch reviews: consults all teams, issues go/no-go.",
+)
+
+REVIEW = [release_manager, engineering, security, legal, scan_dependencies]

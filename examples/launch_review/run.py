@@ -1,0 +1,32 @@
+"""Run a launch review: three teams consulted in parallel, one verdict.
+
+Run:  python examples/launch_review/run.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu import Client, Worker  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+
+from agents import REVIEW  # noqa: E402
+
+
+async def main() -> None:
+    mesh = InMemoryMesh()
+    async with Worker(REVIEW, mesh=mesh, owns_transport=True):
+        client = Client.connect(mesh)
+        result = await client.agent("release_manager").execute(
+            "Review release v2.9.0 for Friday's launch."
+        )
+        print(result.output)
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

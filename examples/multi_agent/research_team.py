@@ -6,10 +6,17 @@ Run:  python -m calfkit_tpu.cli.main dev run \\
           examples/multi_agent/research_team.py:TEAM --agent coordinator
 """
 
-from calfkit_tpu import Agent
-from calfkit_tpu.engine import TestModelClient
-from calfkit_tpu.nodes import Tools, agent_tool
-from calfkit_tpu.peers import Handoff, Messaging
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu import Agent  # noqa: E402
+from calfkit_tpu.engine import TestModelClient  # noqa: E402
+from calfkit_tpu.nodes import Tools, agent_tool  # noqa: E402
+from calfkit_tpu.peers import Handoff, Messaging  # noqa: E402
 
 
 @agent_tool

@@ -1,0 +1,57 @@
+"""A three-persona debate over ONE shared transcript.
+
+Every response carries its author, and each agent receives the transcript
+re-rendered from its own point of view: its own turns verbatim, the other
+panelists' turns as attributed user-visible text (``<optimist> ...``).  No
+agent ever sees another's tool calls or internals — only their public
+surface.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu.models.messages import ModelResponse  # noqa: E402
+from calfkit_tpu.nodes import Agent  # noqa: E402
+from examples._common import say, scripted  # noqa: E402
+
+_LINES = {
+    "optimist": [
+        "Four-day weeks lift morale — energy compounds into output.",
+        "The pilot data backs me: output held steady while attrition fell.",
+    ],
+    "skeptic": [
+        "Compressing five days into four just moves the stress around.",
+        "One pilot isn't proof; coordination costs bite at scale.",
+    ],
+    "pragmatist": [
+        "Run a two-team pilot with clear metrics before any rollout.",
+        "Both of you are right: pilot more teams, measure coordination "
+        "overhead explicitly, decide in a quarter.",
+    ],
+}
+
+
+def _persona(name: str) -> Agent:
+    def turn(messages, params):
+        # how many times THIS persona has spoken in the visible transcript
+        spoken = sum(isinstance(m, ModelResponse) for m in messages)
+        lines = _LINES[name]
+        return say(lines[min(spoken, len(lines) - 1)])(messages, params)
+
+    return Agent(
+        name,
+        model=scripted(turn, name=f"{name}-model"),
+        instructions=f"You are the {name} on a debate panel. Stay in character.",
+        description=f"The {name} on the panel.",
+    )
+
+
+optimist = _persona("optimist")
+skeptic = _persona("skeptic")
+pragmatist = _persona("pragmatist")
+
+PANEL = [optimist, skeptic, pragmatist]

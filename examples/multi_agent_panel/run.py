@@ -1,0 +1,46 @@
+"""Drive two debate rounds: each panelist extends the SAME transcript.
+
+The caller owns the shared history — ``execute(..., message_history=...)``
+sends it with each turn, and the returned state carries it back extended.
+Author attribution on every response is what lets each agent's POV
+projection tell "my turn" from "their turn".
+
+Run:  python examples/multi_agent_panel/run.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu import Client, Worker  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+
+from panel import PANEL  # noqa: E402
+
+TOPIC = "Motion: this company should move to a four-day work week."
+
+
+async def main() -> None:
+    mesh = InMemoryMesh()
+    async with Worker(PANEL, mesh=mesh, owns_transport=True):
+        client = Client.connect(mesh)
+        transcript = []
+        print(f"{TOPIC}\n")
+        for round_no in (1, 2):
+            print(f"--- round {round_no}")
+            for name in ("optimist", "skeptic", "pragmatist"):
+                result = await client.agent(name).execute(
+                    TOPIC if not transcript else "Respond to the panel so far.",
+                    message_history=transcript,
+                )
+                transcript = result.state.message_history
+                print(f"{name:>10}: {result.output}")
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

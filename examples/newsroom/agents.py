@@ -1,0 +1,97 @@
+"""The newsroom: messaging and handoff in one run.
+
+An editor consults a researcher and a fact-checker over ``message_agent``
+(their conversations stay isolated from the editor's), then hands the story
+off to the writer — who answers the caller directly.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu.engine import TestModelClient  # noqa: E402
+from calfkit_tpu.nodes import Agent, agent_tool  # noqa: E402
+from calfkit_tpu.peers import Handoff, Messaging  # noqa: E402
+from examples._common import (  # noqa: E402
+    call,
+    call_many,
+    last_user_text,
+    scripted,
+    tool_replies,
+)
+
+
+@agent_tool
+def archive_search(topic: str) -> list[str]:
+    """Search the paper's archive for prior coverage.
+
+    Args:
+        topic: What to search for.
+    """
+    return [f"2025-11-02: early report on {topic}",
+            f"2026-03-17: follow-up on {topic}"]
+
+
+researcher = Agent(
+    "researcher",
+    model=TestModelClient(
+        custom_output_text="Research: two prior pieces exist; the key fact "
+        "is the launch date moved to September."
+    ),
+    instructions="Dig up background from the archive.",
+    tools=[archive_search],
+    description="Researches story background from the archive.",
+)
+
+fact_checker = Agent(
+    "fact_checker",
+    model=TestModelClient(
+        custom_output_text="Fact-check: the September date is confirmed by "
+        "two sources. Clear to publish."
+    ),
+    instructions="Verify claims before publication.",
+    description="Verifies claims before publication.",
+)
+
+writer = Agent(
+    "writer",
+    model=TestModelClient(
+        custom_output_text="HEADLINE: Launch slips to September — what it "
+        "means, in 400 carefully fact-checked words."
+    ),
+    instructions="Write the final story beautifully.",
+    description="Writes the final story.",
+)
+
+
+def _consult(messages, params):
+    """Turn 1: consult researcher AND fact-checker in one fan-out."""
+    story = last_user_text(messages)
+    return call_many(
+        ("message_agent", {"agent_name": "researcher", "message": story}),
+        ("message_agent", {"agent_name": "fact_checker",
+                           "message": f"Verify the claims in: {story}"}),
+    )(messages, params)
+
+
+def _handoff(messages, params):
+    """Turn 2: both replies are in — hand the story to the writer."""
+    assert len(tool_replies(messages)) >= 2
+    return call("handoff_to_agent", agent_name="writer")(messages, params)
+
+
+editor = Agent(
+    "editor",
+    model=scripted(_consult, _handoff, name="editor-model"),
+    instructions=(
+        "You are the editor. Consult the researcher and the fact-checker, "
+        "then hand the story off to the writer."
+    ),
+    peers=[Messaging("researcher", "fact_checker"), Handoff("writer")],
+    description="Runs the newsroom: consults the desk, assigns the writer.",
+)
+
+NEWSROOM = [editor, researcher, fact_checker, writer, archive_search]

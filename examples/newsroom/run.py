@@ -1,0 +1,38 @@
+"""Run the newsroom: messaging fan-out, then handoff, in one run.
+
+Run:  python examples/newsroom/run.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu import Client, Worker  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+
+from agents import NEWSROOM  # noqa: E402
+
+
+async def main() -> None:
+    mesh = InMemoryMesh()
+    async with Worker(NEWSROOM, mesh=mesh, owns_transport=True):
+        client = Client.connect(mesh)
+        handle = await client.agent("editor").start(
+            "Story tip: the rocket launch has slipped again."
+        )
+        async for event in handle.stream():
+            step = getattr(event, "step", None)
+            if step is not None:
+                label = getattr(step, "tool_name", "") or getattr(step, "text", "")
+                print(f"  [{step.kind}] {str(label)[:76]}")
+            else:
+                print(f"\nFINAL (from the writer, via handoff): {event.output}")
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -9,11 +9,17 @@ Run:  python examples/quickstart/weather_agent.py
 """
 
 import asyncio
+import os
+import sys
 
-from calfkit_tpu import Client, Worker
-from calfkit_tpu.engine import TestModelClient
-from calfkit_tpu.mesh import InMemoryMesh
-from calfkit_tpu.nodes import Agent, agent_tool
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu import Client, Worker  # noqa: E402
+from calfkit_tpu.engine import TestModelClient  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+from calfkit_tpu.nodes import Agent, agent_tool  # noqa: E402
 
 
 @agent_tool

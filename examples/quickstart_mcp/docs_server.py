@@ -1,0 +1,70 @@
+"""A tiny stdio MCP server: an offline "docs lookup" tool.
+
+Newline-delimited JSON-RPC implementing the MCP subset the toolbox node
+speaks (initialize / tools/list / tools/call).  In real deployments this
+would be any off-the-shelf MCP server (a web-fetch server, a database
+server, ...) — the agent's code is identical either way.
+"""
+
+import json
+import sys
+
+DOCS = {
+    "worker": "Worker hosts nodes on a shared mesh connection; two-phase "
+    "lifecycle (resource brackets, then serving brackets).",
+    "handoff": "handoff_to_agent transfers the whole conversation; the "
+    "target answers the caller directly.",
+    "fanout": "Parallel tool calls dispatch as a durable batch; a worker "
+    "crash mid-batch never loses completed slots.",
+}
+
+TOOLS = [
+    {
+        "name": "lookup",
+        "description": "Look up a topic in the framework docs.",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"topic": {"type": "string"}},
+            "required": ["topic"],
+        },
+    }
+]
+
+
+def reply(rpc_id, result) -> None:
+    sys.stdout.write(
+        json.dumps({"jsonrpc": "2.0", "id": rpc_id, "result": result}) + "\n"
+    )
+    sys.stdout.flush()
+
+
+def main() -> None:
+    for line in sys.stdin:
+        try:
+            message = json.loads(line)
+        except ValueError:
+            continue
+        method = message.get("method")
+        rpc_id = message.get("id")
+        if method == "initialize":
+            reply(rpc_id, {
+                "protocolVersion": message["params"]["protocolVersion"],
+                "capabilities": {"tools": {}},
+                "serverInfo": {"name": "docs-mcp", "version": "0"},
+            })
+        elif method == "tools/list":
+            reply(rpc_id, {"tools": TOOLS})
+        elif method == "tools/call":
+            args = message["params"].get("arguments", {})
+            topic = str(args.get("topic", "")).lower()
+            hit = next(
+                (text for key, text in DOCS.items() if key in topic),
+                f"No doc found for {topic!r}. Known: {sorted(DOCS)}",
+            )
+            reply(rpc_id, {"content": [{"type": "text", "text": hit}]})
+        elif rpc_id is not None:
+            reply(rpc_id, {})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""Give an agent a live MCP tool it never imports.
+
+The MCP server runs as its OWN node on the mesh (``MCPToolboxNode``); the
+agent references it by name (``Toolbox("docs")``).  The toolbox advertises
+its tools on the control plane, the agent's turn resolves them from the live
+capability view, and each call crosses the mesh like any other tool call —
+so the toolbox can live in a different process, or a different machine.
+
+Run:  python examples/quickstart_mcp/run.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu import Client, Worker  # noqa: E402
+from calfkit_tpu.mcp import MCPServerSpec, MCPToolboxNode, Toolbox  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+from calfkit_tpu.nodes import Agent  # noqa: E402
+from examples._common import call, say, scripted, tool_replies  # noqa: E402
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+docs_toolbox = MCPToolboxNode(
+    MCPServerSpec(
+        name="docs",
+        command=[sys.executable, os.path.join(_HERE, "docs_server.py")],
+    )
+)
+
+
+def _lookup(messages, params):
+    # MCP tools arrive namespaced: <toolbox-node-id>__<tool-name>
+    return call("toolbox.docs__lookup", topic="handoff")(messages, params)
+
+
+def _answer(messages, params):
+    return say(f"From the docs: {tool_replies(messages)[-1]}")(messages, params)
+
+
+researcher = Agent(
+    "docs_researcher",
+    model=scripted(_lookup, _answer, name="docs-researcher-model"),
+    instructions="Answer questions by looking things up in the docs toolbox.",
+    tools=Toolbox("docs"),
+    description="Answers questions from the framework docs via MCP.",
+)
+
+
+async def main() -> None:
+    mesh = InMemoryMesh()
+    async with Worker([researcher, docs_toolbox], mesh=mesh, owns_transport=True):
+        client = Client.connect(mesh)
+        result = await client.agent("docs_researcher").execute(
+            "What does a handoff do?"
+        )
+        print(result.output)
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,100 @@
+"""Raw request/reply over the mesh substrate — no nodes, no agents.
+
+The framework's transports are usable standalone: this is the classic
+RPC-over-pub/sub recipe (publish with a ``reply_to`` + correlation id,
+demux replies by correlation id on one reply topic).  It is what the
+Client's hub does under the hood, minus envelopes, state, and the fault
+rail — useful for wiring a plain service into the same mesh your agents
+run on.
+
+Run:  python examples/rpc_worker.py
+"""
+
+import asyncio
+import os
+import sys
+from uuid import uuid4
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+from calfkit_tpu.mesh.transport import MeshTransport, Record  # noqa: E402
+
+
+class RPCWorker:
+    """Request/reply over any MeshTransport."""
+
+    def __init__(self, mesh: MeshTransport, reply_topic: str):
+        self._mesh = mesh
+        self._reply_topic = reply_topic
+        self._pending: dict[str, asyncio.Future[bytes]] = {}
+        self._subscription = None
+
+    async def start(self) -> None:
+        self._subscription = await self._mesh.subscribe(
+            [self._reply_topic], self._on_reply, group_id=None
+        )
+
+    async def stop(self) -> None:
+        if self._subscription is not None:
+            await self._subscription.stop()
+
+    async def _on_reply(self, record: Record) -> None:
+        future = self._pending.pop(record.headers.get("correlation-id", ""), None)
+        if future is not None and not future.done():
+            future.set_result(record.value)
+
+    async def request(
+        self, topic: str, data: bytes, *, timeout: float = 10.0
+    ) -> bytes:
+        correlation_id = str(uuid4())
+        future: asyncio.Future[bytes] = asyncio.get_running_loop().create_future()
+        self._pending[correlation_id] = future
+        # keyed by correlation id: keyless records forfeit the per-key
+        # ordering contract (the transport warns about them)
+        await self._mesh.publish(
+            topic,
+            data,
+            key=correlation_id.encode(),
+            headers={
+                "reply-to": self._reply_topic,
+                "correlation-id": correlation_id,
+            },
+        )
+        try:
+            return await asyncio.wait_for(future, timeout)
+        finally:
+            self._pending.pop(correlation_id, None)
+
+
+async def serve_uppercase(mesh: MeshTransport):
+    """The service side: consume requests, publish replies to reply_to."""
+
+    async def handle(record: Record) -> None:
+        await mesh.publish(
+            record.headers["reply-to"],
+            record.value.upper(),
+            key=record.key,
+            headers={"correlation-id": record.headers["correlation-id"]},
+        )
+
+    return await mesh.subscribe(["svc.upper"], handle, group_id="upper-svc")
+
+
+async def main() -> None:
+    mesh = InMemoryMesh()
+    await mesh.start()
+    service = await serve_uppercase(mesh)
+
+    rpc = RPCWorker(mesh, reply_topic=f"rpc.replies.{uuid4().hex[:8]}")
+    await rpc.start()
+    reply = await rpc.request("svc.upper", b"hello mesh rpc")
+    print(reply.decode())
+
+    await rpc.stop()
+    await service.stop()
+    await mesh.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

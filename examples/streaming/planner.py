@@ -1,0 +1,58 @@
+"""A trip planner whose intermediate work streams live to the caller.
+
+Every hop of a run — preamble text, each tool call, each tool result, the
+final answer — is minted into the run's step stream and can be watched via
+``handle.stream()`` while the run executes.
+"""
+
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu.engine import TestModelClient  # noqa: E402
+from calfkit_tpu.nodes import Agent, agent_tool  # noqa: E402
+
+
+@agent_tool
+def find_flights(origin: str, destination: str) -> list[dict]:
+    """Find flights between two cities.
+
+    Args:
+        origin: Departure city.
+        destination: Arrival city.
+    """
+    return [
+        {"flight": "CK101", "depart": "08:05", "price_usd": 240},
+        {"flight": "CK205", "depart": "13:40", "price_usd": 185},
+    ]
+
+
+@agent_tool
+def find_hotels(city: str, nights: int = 2) -> list[dict]:
+    """Find hotels in a city.
+
+    Args:
+        city: Where to stay.
+        nights: How many nights.
+    """
+    return [
+        {"hotel": "The Foundry", "rate_usd": 150},
+        {"hotel": "Hotel Meridian", "rate_usd": 210},
+    ]
+
+
+planner = Agent(
+    "trip_planner",
+    model=TestModelClient(
+        custom_output_text="Itinerary: fly CK205 at 13:40 ($185), stay two "
+        "nights at The Foundry ($150/night). Total ~$485."
+    ),
+    instructions="Plan trips using your flight and hotel tools.",
+    tools=[find_flights, find_hotels],
+    description="Plans trips with live progress streaming.",
+)
+
+NODES = [planner, find_flights, find_hotels]

@@ -1,0 +1,42 @@
+"""Watch a run's intermediate work stream live via ``handle.stream()``.
+
+Run:  python examples/streaming/run.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
+
+from calfkit_tpu import Client, Worker  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+
+from planner import NODES  # noqa: E402
+
+
+async def main() -> None:
+    mesh = InMemoryMesh()
+    async with Worker(NODES, mesh=mesh, owns_transport=True):
+        client = Client.connect(mesh)
+        handle = await client.agent("trip_planner").start(
+            "Plan me a weekend in Lisbon, flying from Berlin."
+        )
+        async for event in handle.stream():
+            step = getattr(event, "step", None)
+            if step is None:  # the terminal event: the run's result
+                print(f"\nRESULT: {event.output}")
+                continue
+            if step.kind == "tool_call":
+                print(f"  -> calling {step.tool_name}({str(step.args)[:60]})")
+            elif step.kind == "tool_result":
+                print(f"  <- {step.tool_name}: {str(step.content)[:68]}")
+            elif getattr(step, "text", ""):
+                print(f"  [{step.kind}] {step.text[:72]}")
+        await client.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -8,14 +8,24 @@ Run:  python examples/structured_fanout/trip_planner.py
 """
 
 import asyncio
+import os
+import sys
 
-from pydantic import BaseModel
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+)
 
-from calfkit_tpu import Agent, Client, Worker
-from calfkit_tpu.engine import FunctionModelClient
-from calfkit_tpu.mesh import InMemoryMesh
-from calfkit_tpu.models.messages import ModelResponse, TextOutput, ToolCallOutput
-from calfkit_tpu.nodes import agent_tool
+from pydantic import BaseModel  # noqa: E402
+
+from calfkit_tpu import Agent, Client, Worker  # noqa: E402
+from calfkit_tpu.engine import FunctionModelClient  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+from calfkit_tpu.models.messages import (  # noqa: E402
+    ModelResponse,
+    TextOutput,
+    ToolCallOutput,
+)
+from calfkit_tpu.nodes import agent_tool  # noqa: E402
 
 
 class TripPlan(BaseModel):

@@ -1,0 +1,96 @@
+"""Topic provisioning — inspect and create a node set's topics up front.
+
+On brokers with auto-create disabled (hardened Kafka/Redpanda), producers
+and consumers stall on topics that don't exist.  The provisioner derives
+exactly which topics a node set touches and creates them idempotently, with
+error classification (created / existing / unauthorized / retry) so an ACL
+problem fails loudly instead of looking like a flaky broker.
+
+This example shows:
+
+* ``topics_for_nodes`` — which topics a topology references, WITHOUT
+  contacting any broker (the agent contributes its tool's input topic on
+  top of its own inboxes and publish topic);
+* ``framework_topics_for_nodes`` — the compacted framework tables behind
+  the same nodes (control plane + durable fan-out);
+* programmatic ``provision()`` and its idempotency (a second pass is a
+  no-op: racing workers are fine);
+* the common path — every ``Worker`` provisions its nodes' topics at boot
+  through the same classifying path; tune it with
+  ``Worker(..., provisioning=ProvisioningConfig(...))``.
+
+Run:  python examples/topic_provisioning.py
+
+The same one-off provisioning is available from the CLI::
+
+    ck topics provision examples/quickstart/weather_agent.py:weather_agent \
+        --mesh tcp://localhost:7337
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from calfkit_tpu.engine import TestModelClient  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+from calfkit_tpu.nodes import Agent, agent_tool, consumer  # noqa: E402
+from calfkit_tpu.provisioning import (  # noqa: E402
+    ProvisioningConfig,
+    framework_topics_for_nodes,
+    provision,
+    topics_for_nodes,
+)
+
+
+@agent_tool
+def get_weather(city: str) -> str:
+    """Get the weather for a city.
+
+    Args:
+        city: Which city.
+    """
+    return f"sunny in {city}"
+
+
+weather_agent = Agent(
+    "weather_agent",
+    model=TestModelClient(),
+    tools=[get_weather],
+    description="Answers weather questions.",
+)
+
+
+@consumer(topics=["agent.weather_agent.publish"])
+async def weather_sink(ctx) -> None:
+    pass
+
+
+NODES = [weather_agent, get_weather, weather_sink]
+
+
+async def main() -> None:
+    print("plain topics (derived offline, no broker contact):")
+    for topic in topics_for_nodes(NODES):
+        print(f"  {topic}")
+    print("compacted framework tables:")
+    for topic in framework_topics_for_nodes(NODES):
+        print(f"  {topic}")
+
+    mesh = InMemoryMesh()
+    await mesh.start()
+    config = ProvisioningConfig(max_attempts=5, retry_backoff_s=0.2)
+    report = await provision(mesh, NODES, config)
+    print(
+        f"provisioned: {len(report['plain'])} plain + "
+        f"{len(report['compacted'])} compacted"
+    )
+    # idempotent: a second pass (e.g. a racing worker) succeeds quietly
+    await provision(mesh, NODES, config)
+    print("second pass: ok (already-exists is success, not an error)")
+    await mesh.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,49 @@
+"""Every example must actually run — as a subprocess, exactly as documented.
+
+The reference ships examples as living documentation; here they are kept
+living by CI.  Each run uses the in-memory mesh and deterministic models, so
+the suite needs no broker, no weights, no network.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EXAMPLES = [
+    ("quickstart", "examples/quickstart/weather_agent.py", "RESULT"),
+    ("help_desk", "examples/help_desk/run.py", "[phase 2] Security here"),
+    ("newsroom", "examples/newsroom/run.py", "FINAL (from the writer"),
+    ("expense_approval", "examples/expense_approval/run.py",
+     "team_lead -> director -> vp"),
+    ("launch_review", "examples/launch_review/run.py", "Launch review: GO"),
+    ("multi_agent_panel", "examples/multi_agent_panel/run.py", "--- round 2"),
+    ("streaming", "examples/streaming/run.py", "RESULT: Itinerary"),
+    ("structured_fanout", "examples/structured_fanout/trip_planner.py",
+     "PLAN: Lisbon"),
+    ("quickstart_mcp", "examples/quickstart_mcp/run.py", "From the docs:"),
+    ("topic_provisioning", "examples/topic_provisioning.py",
+     "second pass: ok"),
+    ("rpc_worker", "examples/rpc_worker.py", "HELLO MESH RPC"),
+]
+
+
+@pytest.mark.parametrize(
+    "script,expect", [(s, e) for _, s, e in EXAMPLES],
+    ids=[name for name, _, _ in EXAMPLES],
+)
+def test_example_runs(script: str, expect: str):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, script)],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
+    assert expect in proc.stdout, (
+        f"{script} missing expected output {expect!r}:\n{proc.stdout}"
+    )

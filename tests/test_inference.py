@@ -1124,6 +1124,9 @@ class TestEngineStress:
             ):
                 break
             await asyncio.sleep(0.05)
+        # loud on timeout: a leak in ANY of the four pools must fail, not
+        # silently fall through the wait loop
+        assert not engine._active and not engine._pending and not engine._carry
         assert sorted(engine._free) == list(range(4))
         assert not engine._page_alloc.held_slots
         # engine still serves correctly after the churn
