@@ -18,12 +18,13 @@ def is_file_spec(module_part: str) -> bool:
 
 
 def load_object(spec: str) -> Any:
-    """Load ``module:attr`` or ``path/to/file.py:attr``."""
-    if ":" not in spec:
-        raise click.ClickException(
-            f"node spec {spec!r} must be 'module:attr' or 'file.py:attr'"
-        )
-    module_part, attr = spec.rsplit(":", 1)
+    """Load ``module:attr`` / ``path/to/file.py:attr``, or — with no
+    ``:attr`` — every node defined at the module's top level (node files
+    need no boilerplate; the reference's ``ck run`` spec grammar)."""
+    if ":" in spec:
+        module_part, attr = spec.rsplit(":", 1)
+    else:
+        module_part, attr = spec, None
     if is_file_spec(module_part):
         path = Path(module_part).resolve()
         if not path.exists():
@@ -34,7 +35,34 @@ def load_object(spec: str) -> Any:
         sys.modules[path.stem] = module
         spec_obj.loader.exec_module(module)
     else:
-        module = importlib.import_module(module_part)
+        try:
+            module = importlib.import_module(module_part)
+        except ModuleNotFoundError as exc:
+            raise click.ClickException(
+                f"cannot import {module_part!r} "
+                "(specs are 'module:attr', 'file.py:attr', or a bare "
+                "'file.py' to collect its nodes)"
+            ) from exc
+    if attr is None:
+        from calfkit_tpu.nodes.base import BaseNodeDef
+
+        found = [
+            value
+            for name, value in vars(module).items()
+            if not name.startswith("_") and isinstance(value, BaseNodeDef)
+        ]
+        # dedupe while preserving definition order (an attr alias like
+        # ``TEAM = [a, b]`` is a list, not a BaseNodeDef — untouched here)
+        unique: list[Any] = []
+        for node in found:
+            if all(node is not seen for seen in unique):
+                unique.append(node)
+        if not unique:
+            raise click.ClickException(
+                f"{module_part!r} defines no nodes at top level; "
+                "name one with 'module:attr'"
+            )
+        return unique
     try:
         return getattr(module, attr)
     except AttributeError as exc:

@@ -233,6 +233,8 @@ def _absolutize(spec: str) -> str:
     """File-based specs must survive the daemon's different cwd."""
     from calfkit_tpu.cli._common import is_file_spec
 
+    if ":" not in spec:  # bare file spec (collect-all grammar)
+        return str(Path(spec).resolve()) if is_file_spec(spec) else spec
     module_part, _, attr = spec.rpartition(":")
     if module_part and is_file_spec(module_part):
         return f"{Path(module_part).resolve()}:{attr}"

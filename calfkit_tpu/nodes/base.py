@@ -77,6 +77,24 @@ _HANDLED = "handled"
 _RESUME = "resume"
 
 
+def _as_action(value: Any) -> NodeResult:
+    """Coerce a seam-returned value into a publishable action.
+
+    Bodies return NodeResults; seams may return plain values (a canned
+    string, a dict) — wrap those in a ReturnCall so a short-circuit or an
+    after_node replacement can never silently fall through the publish
+    chokepoint."""
+    if isinstance(value, (Call, TailCall, ReturnCall, Next, list)):
+        return value
+    from calfkit_tpu.models.payload import DataPart, TextPart
+
+    if isinstance(value, str):
+        return ReturnCall(parts=[TextPart(text=value)])
+    if isinstance(value, dict):
+        return ReturnCall(parts=[DataPart(data=value)])
+    return ReturnCall(parts=[TextPart(text=str(value))])
+
+
 @dataclass
 class NodeRunContext:
     """What the body and seams see for one delivery."""
@@ -286,14 +304,20 @@ class BaseNodeDef(RegistryMixin):
             outcome = await self._aggregate(ctx)
             if outcome != _RESUME:
                 return
-        await run_chain(self.before_node, ctx)
-        action = await self._dispatch_routed(ctx)
+        short_circuit = await run_chain(self.before_node, ctx)
+        if short_circuit is not None:
+            # a before_node seam answered the delivery: the body never runs
+            # (caching / canned responses / maintenance mode); after_node
+            # still sees the result like any other
+            action = _as_action(short_circuit)
+        else:
+            action = await self._dispatch_routed(ctx)
         if isinstance(action, Observed):
             ctx.ledger.absorb(action.facts)
             action = action.action
         transformed = await run_chain(self.after_node, ctx, action)
         if transformed is not None:
-            action = transformed
+            action = _as_action(transformed)
         await self._publish_action(ctx, action)
 
     async def _dispatch_routed(self, ctx: NodeRunContext) -> NodeResult | Observed:

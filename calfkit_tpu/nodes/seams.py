@@ -3,8 +3,11 @@
 The four seams (reference: calfkit/nodes/_seams.py:23-136 and the seam table
 in nodes/base.py):
 
-- ``before_node(ctx)`` — observe/mutate state before the body.
-- ``after_node(ctx, action)`` — transform the body's action.
+- ``before_node(ctx)`` — guard/mutate before the body; a non-``None``
+  return SHORT-CIRCUITS the body and is published as the hop's action
+  (plain strings/dicts are coerced to a reply — see base._as_action).
+- ``after_node(ctx, action)`` — transform the body's action; a
+  non-``None`` return replaces it (same coercion).
 - ``on_node_error(ctx, report)`` — recover the node's own raise; returns a
   substitute action, or ``None`` to pass down the chain (fault escalates if
   no seam recovers).

@@ -184,3 +184,35 @@ class TestDaemons:
             for d in list_daemons():
                 stop_daemon(d.name)
             stop_broker(PORT)
+
+
+class TestBareFileSpecs:
+    """``ck run file.py`` with no :attr collects top-level nodes."""
+
+    def test_bare_file_collects_nodes(self, tmp_path):
+        from calfkit_tpu.cli._common import load_nodes
+
+        node_file = tmp_path / "my_nodes.py"
+        node_file.write_text(
+            "from calfkit_tpu.nodes import Agent, agent_tool\n"
+            "from calfkit_tpu.engine import TestModelClient\n"
+            "@agent_tool\n"
+            "def t(x: int) -> int:\n"
+            "    \"\"\"T.\n\n    Args:\n        x: x.\n    \"\"\"\n"
+            "    return x\n"
+            "a = Agent('bare_a', model=TestModelClient())\n"
+            "alias = a\n"  # alias must not duplicate the node
+        )
+        nodes = load_nodes((str(node_file),))
+        assert sorted(n.name for n in nodes) == ["bare_a", "t"]
+
+    def test_bare_file_without_nodes_fails_loudly(self, tmp_path):
+        import click
+        import pytest
+
+        from calfkit_tpu.cli._common import load_nodes
+
+        empty = tmp_path / "empty_mod.py"
+        empty.write_text("x = 1\n")
+        with pytest.raises(click.ClickException, match="no nodes"):
+            load_nodes((str(empty),))

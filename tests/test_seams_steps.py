@@ -231,3 +231,105 @@ class TestHopStepLedger:
         assert len(published) == 1
         topic, key, headers = published[0]
         assert topic == "caller.inbox"
+
+
+class TestSeamsEndToEnd:
+    """Seam chains through a real delivery (mesh -> kernel -> seams)."""
+
+    @staticmethod
+    def _team(agent):
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.worker import Worker
+
+        mesh = InMemoryMesh()
+        return mesh, Worker([agent], mesh=mesh, owns_transport=True), Client
+
+    async def test_before_node_short_circuits_the_body(self):
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.nodes import Agent
+
+        def must_not_run(messages, params):
+            raise AssertionError("body ran despite a short-circuiting seam")
+
+        agent = Agent(
+            "guarded",
+            model=FunctionModelClient(must_not_run),
+            before_node=[lambda ctx: "maintenance until 14:00"],
+        )
+        mesh, worker, Client = self._team(agent)
+        async with worker:
+            client = Client.connect(mesh)
+            result = await client.agent("guarded").execute("hi", timeout=10)
+            assert result.output == "maintenance until 14:00"
+            await client.close()
+
+    async def test_before_node_none_falls_through_to_body(self):
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.nodes import Agent
+
+        seen = []
+
+        def observe(ctx):
+            seen.append(ctx.task_id)
+            return None
+
+        agent = Agent(
+            "open",
+            model=TestModelClient(custom_output_text="body answer"),
+            before_node=[observe],
+        )
+        mesh, worker, Client = self._team(agent)
+        async with worker:
+            client = Client.connect(mesh)
+            result = await client.agent("open").execute("hi", timeout=10)
+            assert result.output == "body answer"
+            assert len(seen) == 1
+            await client.close()
+
+    async def test_after_node_replaces_result_with_coerced_dict(self):
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.nodes import Agent
+
+        def cap(ctx, action):
+            return {"replaced": True}
+
+        agent = Agent(
+            "capped",
+            model=TestModelClient(custom_output_text="raw"),
+            after_node=[cap],
+        )
+        mesh, worker, Client = self._team(agent)
+        async with worker:
+            client = Client.connect(mesh)
+            result = await client.agent("capped").execute("hi", timeout=10)
+            # a DataPart renders as its JSON string under output_type=str
+            assert "replaced" in result.output
+            await client.close()
+
+    async def test_seam_mutations_visible_to_later_stages(self):
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.nodes import Agent
+
+        stamps = []
+
+        def stamp(ctx):
+            ctx.deps["stamped"] = "yes"
+
+        def read(ctx, action):
+            stamps.append(ctx.deps.get("stamped"))
+            return None  # keep the body's action
+
+        agent = Agent(
+            "mutating",
+            model=TestModelClient(custom_output_text="ok"),
+            before_node=[stamp],
+            after_node=[read],
+        )
+        mesh, worker, Client = self._team(agent)
+        async with worker:
+            client = Client.connect(mesh)
+            result = await client.agent("mutating").execute("hi", timeout=10)
+            assert result.output == "ok"
+            assert stamps == ["yes"]
+            await client.close()
