@@ -38,6 +38,12 @@ def load_object(spec: str) -> Any:
         try:
             module = importlib.import_module(module_part)
         except ModuleNotFoundError as exc:
+            if exc.name and not module_part.startswith(exc.name):
+                # the spec resolved; one of ITS imports is missing — name
+                # the real missing dependency, not the spec grammar
+                raise click.ClickException(
+                    f"error importing {module_part!r}: {exc}"
+                ) from exc
             raise click.ClickException(
                 f"cannot import {module_part!r} "
                 "(specs are 'module:attr', 'file.py:attr', or a bare "
@@ -49,7 +55,11 @@ def load_object(spec: str) -> Any:
         found = [
             value
             for name, value in vars(module).items()
-            if not name.startswith("_") and isinstance(value, BaseNodeDef)
+            if not name.startswith("_")
+            and isinstance(value, BaseNodeDef)
+            # imported nodes belong to their DEFINING file's spec — a bare
+            # spec for this file must not re-collect them (duplicate nodes)
+            and value.defined_in_module in (module.__name__, None)
         ]
         # dedupe while preserving definition order (an attr alias like
         # ``TEAM = [a, b]`` is a list, not a BaseNodeDef — untouched here)

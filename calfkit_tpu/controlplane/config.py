@@ -2,10 +2,12 @@
 
 from __future__ import annotations
 
-from pydantic import BaseModel, Field
+from pydantic import BaseModel, ConfigDict, Field
 
 
 class ControlPlaneConfig(BaseModel):
+    model_config = ConfigDict(extra="forbid", frozen=True)
+
     enabled: bool = True
     heartbeat_interval: float = Field(default=5.0, gt=0)
     # a node is live while now - heartbeat_at < stale_multiplier × interval

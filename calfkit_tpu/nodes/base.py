@@ -77,6 +77,19 @@ _HANDLED = "handled"
 _RESUME = "resume"
 
 
+def _constructing_module() -> str | None:
+    """Module name of the first stack frame outside calfkit_tpu."""
+    import sys
+
+    frame = sys._getframe(1)
+    while frame is not None:
+        mod = frame.f_globals.get("__name__", "")
+        if not mod.startswith("calfkit_tpu"):
+            return mod or None
+        frame = frame.f_back
+    return None
+
+
 def _as_action(value: Any) -> NodeResult:
     """Coerce a seam-returned value into a publishable action.
 
@@ -92,7 +105,13 @@ def _as_action(value: Any) -> NodeResult:
         return ReturnCall(parts=[TextPart(text=value)])
     if isinstance(value, dict):
         return ReturnCall(parts=[DataPart(data=value)])
-    return ReturnCall(parts=[TextPart(text=str(value))])
+    # anything else is almost certainly an accidental return from a seam
+    # written for observe-only semantics (e.g. a trailing setdefault) —
+    # fail loudly instead of publishing its repr as the agent's answer
+    raise TypeError(
+        "a seam returned an unpublishable value "
+        f"({type(value).__name__}); return a NodeResult, str, dict, or None"
+    )
 
 
 @dataclass
@@ -154,6 +173,10 @@ class BaseNodeDef(RegistryMixin):
         protocol.require_topic_safe(name, what="node name")
         self.name = name
         self.instance_id = uuid.uuid4().hex[:12]
+        # the module that CONSTRUCTED this node (first non-framework frame):
+        # bare-file CLI specs collect only nodes defined in the named file,
+        # so an imported node is served once, by its defining module
+        self.defined_in_module = _constructing_module()
         for seam in before_node:
             validate_seam_arity(seam, 1, name="before_node")
         for seam in after_node:

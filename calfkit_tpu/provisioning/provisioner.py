@@ -21,7 +21,7 @@ import asyncio
 import logging
 from typing import Iterable
 
-from pydantic import BaseModel, Field
+from pydantic import BaseModel, ConfigDict, Field
 
 from calfkit_tpu import protocol
 from calfkit_tpu.exceptions import ProvisioningError
@@ -32,6 +32,8 @@ logger = logging.getLogger(__name__)
 
 
 class ProvisioningConfig(BaseModel):
+    model_config = ConfigDict(extra="forbid", frozen=True)
+
     enabled: bool = True
     include_framework: bool = True
     max_attempts: int = Field(3, ge=1)

@@ -9,11 +9,13 @@ error instead of a silent hang.
 
 from __future__ import annotations
 
-from pydantic import BaseModel, Field
+from pydantic import BaseModel, ConfigDict, Field
 
 
 class TableTuning(BaseModel):
     """Compacted-table reader bounds (the KTableReaderTuning analog)."""
+
+    model_config = ConfigDict(extra="forbid", frozen=True)
 
     catchup_timeout_s: float = Field(30.0, gt=0)
     barrier_timeout_s: float = Field(30.0, gt=0)
@@ -25,5 +27,7 @@ class FanoutConfig(BaseModel):
     Raise the timeouts on slow brokers; the write-order and fold/close
     semantics are not configurable (they are the correctness story).
     """
+
+    model_config = ConfigDict(extra="forbid", frozen=True)
 
     table: TableTuning = Field(default_factory=TableTuning)

@@ -1,0 +1,97 @@
+"""The chat REPL driven in-process: prompts in, rendered steps out, history
+threading across turns (reference analogs: tests/test_chat_cli.py,
+test_chat_session.py, test_picker.py)."""
+
+import builtins
+
+import pytest
+
+from calfkit_tpu.cli.chat import repl
+from calfkit_tpu.client import Client
+from calfkit_tpu.engine import FunctionModelClient, TestModelClient
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models import ModelResponse, TextOutput
+from calfkit_tpu.nodes import Agent, agent_tool
+from calfkit_tpu.worker import Worker
+
+
+@pytest.fixture
+def scripted_input(monkeypatch):
+    """Feed the REPL a list of prompts, then EOF."""
+
+    def feed(*prompts: str):
+        it = iter(prompts)
+
+        def fake_input(_prompt: str = "") -> str:
+            try:
+                return next(it)
+            except StopIteration:
+                raise EOFError
+
+        monkeypatch.setattr(builtins, "input", fake_input)
+
+    return feed
+
+
+class TestRepl:
+    async def test_turn_renders_answer_and_steps(self, scripted_input, capsys):
+        @agent_tool
+        def lookup(q: str) -> str:
+            """Lookup.
+
+            Args:
+                q: Query.
+            """
+            return "found it"
+
+        agent = Agent(
+            "chatty",
+            model=TestModelClient(custom_output_text="here you go"),
+            tools=[lookup],
+        )
+        mesh = InMemoryMesh()
+        async with Worker([agent, lookup], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            scripted_input("find me a thing")
+            await repl(client, "chatty")
+            await client.close()
+        out = capsys.readouterr().out
+        assert "chatty> here you go" in out
+        assert "lookup" in out          # the tool step rendered
+        assert "bye" in out             # EOF exits cleanly
+
+    async def test_history_threads_across_turns(self, scripted_input, capsys):
+        seen_counts = []
+
+        def model(messages, params):
+            seen_counts.append(len(messages))
+            return ModelResponse(parts=[TextOutput(text=f"turn {len(seen_counts)}")])
+
+        agent = Agent("memory", model=FunctionModelClient(model))
+        mesh = InMemoryMesh()
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            scripted_input("first", "second")
+            await repl(client, "memory")
+            await client.close()
+        # turn 2's model saw turn 1's exchange (history grew)
+        assert len(seen_counts) == 2
+        assert seen_counts[1] > seen_counts[0]
+        out = capsys.readouterr().out
+        assert "turn 1" in out and "turn 2" in out
+
+    async def test_blank_lines_do_not_invoke(self, scripted_input, capsys):
+        calls = []
+
+        def model(messages, params):
+            calls.append(1)
+            return ModelResponse(parts=[TextOutput(text="hi")])
+
+        agent = Agent("quiet", model=FunctionModelClient(model))
+        mesh = InMemoryMesh()
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            scripted_input("", "   ", "real question")
+            await repl(client, "quiet")
+            await client.close()
+        assert len(calls) == 1

@@ -216,3 +216,41 @@ class TestBareFileSpecs:
         empty.write_text("x = 1\n")
         with pytest.raises(click.ClickException, match="no nodes"):
             load_nodes((str(empty),))
+
+    def test_bare_file_skips_imported_nodes(self, tmp_path):
+        """A node imported from another file belongs to ITS file's spec."""
+        from calfkit_tpu.cli._common import load_nodes
+
+        (tmp_path / "shared_nodes.py").write_text(
+            "from calfkit_tpu.nodes import Agent\n"
+            "from calfkit_tpu.engine import TestModelClient\n"
+            "shared = Agent('shared_x', model=TestModelClient())\n"
+        )
+        (tmp_path / "team_file.py").write_text(
+            "from shared_nodes import shared\n"
+            "from calfkit_tpu.nodes import Agent\n"
+            "from calfkit_tpu.engine import TestModelClient\n"
+            "mine = Agent('mine_x', model=TestModelClient())\n"
+        )
+        both = load_nodes(
+            (str(tmp_path / "shared_nodes.py"), str(tmp_path / "team_file.py"))
+        )
+        assert sorted(n.name for n in both) == ["mine_x", "shared_x"]
+
+    def test_missing_dependency_named_not_spec_grammar(self, tmp_path):
+        import click
+        import pytest
+
+        from calfkit_tpu.cli._common import load_nodes
+
+        pkg = tmp_path / "depmod.py"
+        pkg.write_text("import nonexistent_dep_xyz\n")
+        import sys
+        sys.path.insert(0, str(tmp_path))
+        try:
+            with pytest.raises(click.ClickException,
+                               match="nonexistent_dep_xyz"):
+                load_nodes(("depmod:x",))
+        finally:
+            sys.path.remove(str(tmp_path))
+            sys.modules.pop("depmod", None)

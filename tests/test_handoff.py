@@ -1,0 +1,201 @@
+"""Handoff family: arbitration kernel, tool-def rendering, and end-to-end
+precedence (reference analogs: tests/test_handoff_arbitration.py,
+test_handoff_tool_def.py, test_handoff_precedence.py,
+test_handoff_dispatch.py)."""
+
+import pytest
+
+from calfkit_tpu.client import Client
+from calfkit_tpu.engine import FunctionModelClient, TestModelClient
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models import ModelResponse, TextOutput, ToolCallOutput
+from calfkit_tpu.models.agents import AgentCard
+from calfkit_tpu.nodes import Agent, agent_tool
+from calfkit_tpu.peers import Handoff, Messaging
+from calfkit_tpu.peers.handoff import (
+    HANDOFF_TOOL,
+    INVALID_TARGET,
+    SUPERSEDED_STUB,
+    arbitrate_handoff,
+)
+from calfkit_tpu.worker import Worker
+
+
+def _call(cid: str, name: str, args) -> ToolCallOutput:
+    return ToolCallOutput(tool_call_id=cid, tool_name=name, args=args)
+
+
+def _handoff(cid: str, target) -> ToolCallOutput:
+    return _call(cid, HANDOFF_TOOL, {"agent_name": target})
+
+
+class TestArbitration:
+    def test_first_valid_handoff_wins(self):
+        decision = arbitrate_handoff(
+            [_handoff("h1", "alpha"), _handoff("h2", "beta")],
+            allowed_names={"alpha", "beta"},
+        )
+        assert decision.target == "alpha"
+        assert decision.winner.tool_call_id == "h1"
+        assert decision.stubbed == {"h2": SUPERSEDED_STUB}
+        assert decision.rejected == {}
+
+    def test_invalid_target_rejected_with_pinned_text(self):
+        decision = arbitrate_handoff(
+            [_handoff("h1", "ghost")], allowed_names={"alpha"}
+        )
+        assert decision.winner is None
+        assert decision.rejected == {"h1": INVALID_TARGET.format(name="ghost")}
+
+    def test_invalid_then_valid_still_hands_off(self):
+        decision = arbitrate_handoff(
+            [_handoff("h1", "ghost"), _handoff("h2", "alpha")],
+            allowed_names={"alpha"},
+        )
+        assert decision.target == "alpha"
+        assert decision.rejected["h1"]
+        assert "h2" not in decision.stubbed
+
+    def test_unparseable_args_treated_as_invalid(self):
+        decision = arbitrate_handoff(
+            [_call("h1", HANDOFF_TOOL, "{not json")], allowed_names={"a"}
+        )
+        assert decision.winner is None
+        assert "h1" in decision.rejected
+
+    def test_winning_handoff_stubs_sibling_non_handoff_calls(self):
+        # whole-response arbitration: once a handoff wins, sibling TOOL
+        # calls in the same turn are superseded too (the conversation is
+        # leaving this agent)
+        decision = arbitrate_handoff(
+            [_call("t1", "search", {"q": "x"}), _handoff("h1", "alpha")],
+            allowed_names={"alpha"},
+        )
+        assert decision.target == "alpha"
+        assert decision.stubbed["t1"] == SUPERSEDED_STUB
+
+    def test_no_handoff_calls_is_a_no_op(self):
+        decision = arbitrate_handoff(
+            [_call("t1", "search", {})], allowed_names={"alpha"}
+        )
+        assert decision.winner is None
+        assert decision.stubbed == {} and decision.rejected == {}
+
+
+class TestToolDef:
+    CARDS = [
+        AgentCard(name="alpha", description="does a", input_topic="agent.alpha.private.input"),
+        AgentCard(name="beta", description="does b", input_topic="agent.beta.private.input"),
+        AgentCard(name="me", description="self", input_topic="agent.me.private.input"),
+    ]
+
+    def test_curated_names_enum_excludes_self(self):
+        tool = Handoff("alpha", "me").tool_def(self.CARDS, self_name="me")
+        schema = tool.parameters_schema["properties"]["agent_name"]
+        assert schema["enum"] == ["alpha"]  # self filtered even if curated
+
+    def test_discover_lists_all_live_peers(self):
+        tool = Handoff(discover=True).tool_def(self.CARDS, self_name="me")
+        assert tool.parameters_schema["properties"]["agent_name"]["enum"] == [
+            "alpha", "beta",
+        ]
+        # the directory is the model's routing surface
+        assert "does a" in tool.description and "does b" in tool.description
+
+    def test_empty_directory_degrades_to_plain_string(self):
+        tool = Handoff(discover=True).tool_def([], self_name="me")
+        assert "enum" not in tool.parameters_schema["properties"]["agent_name"]
+
+    def test_curated_xor_discover_enforced(self):
+        with pytest.raises(Exception):
+            Handoff("alpha", discover=True)
+        with pytest.raises(Exception):
+            Handoff()  # neither names nor discover
+
+
+class TestHandoffEndToEnd:
+    async def test_losing_handoffs_and_tools_superseded(self):
+        """One turn with [tool_call, handoff->b, handoff->c]: b answers the
+        caller; the tool never runs; the losing handoff never reaches c."""
+        tool_ran = []
+
+        @agent_tool
+        def side_effect(x: int) -> int:
+            """Side effect.
+
+            Args:
+                x: X.
+            """
+            tool_ran.append(x)
+            return x
+
+        def chooser(messages, params):
+            if not any(isinstance(m, ModelResponse) for m in messages):
+                return ModelResponse(parts=[
+                    _call("t1", "side_effect", {"x": 1}),
+                    _handoff("h1", "winner"),
+                    _handoff("h2", "loser"),
+                ])
+            return ModelResponse(parts=[TextOutput(text="fell through")])
+
+        chooser_agent = Agent(
+            "chooser",
+            model=FunctionModelClient(chooser),
+            tools=[side_effect],
+            peers=[Handoff("winner", "loser")],
+        )
+        winner = Agent(
+            "winner", model=TestModelClient(custom_output_text="winner answers"),
+            description="w",
+        )
+        loser_calls = []
+
+        def loser_model(messages, params):
+            loser_calls.append(1)
+            return ModelResponse(parts=[TextOutput(text="loser answers")])
+
+        loser = Agent("loser", model=FunctionModelClient(loser_model), description="l")
+
+        mesh = InMemoryMesh()
+        team = [chooser_agent, winner, loser, side_effect]
+        async with Worker(team, mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("chooser").execute("go", timeout=15)
+            assert result.output == "winner answers"
+            assert tool_ran == []      # superseded before dispatch
+            assert loser_calls == []   # the losing handoff never dispatched
+            await client.close()
+
+    async def test_rejected_handoff_returns_to_model_as_retry(self):
+        turns = []
+
+        def model(messages, params):
+            turns.append(len(messages))
+            if len(turns) == 1:
+                return ModelResponse(parts=[_handoff("h1", "ghost")])
+            # the retry text came back; answer normally
+            return ModelResponse(parts=[TextOutput(text="recovered")])
+
+        agent = Agent(
+            "retrier", model=FunctionModelClient(model),
+            peers=[Handoff(discover=True)],
+        )
+        mesh = InMemoryMesh()
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("retrier").execute("go", timeout=15)
+            assert result.output == "recovered"
+            assert len(turns) == 2
+            await client.close()
+
+    async def test_one_selector_per_peer_kind(self):
+        with pytest.raises(Exception, match="one peer selector per kind"):
+            Agent(
+                "dup", model=TestModelClient(),
+                peers=[Handoff("a"), Handoff("b")],
+            )
+        # distinct kinds are fine
+        Agent(
+            "ok", model=TestModelClient(),
+            peers=[Handoff("a"), Messaging("b")],
+        )

@@ -1,0 +1,199 @@
+"""Messaging family: ``message_agent`` isolation, carry-forward, validation
+(reference analogs: tests/test_message_agent.py,
+test_messaging_carry_forwards.py, test_peers_surface.py)."""
+
+import pytest
+
+from calfkit_tpu.client import Client
+from calfkit_tpu.engine import FunctionModelClient, TestModelClient
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models import ModelResponse, TextOutput, ToolCallOutput
+from calfkit_tpu.models.agents import AgentCard
+from calfkit_tpu.models.messages import ModelRequest, ToolReturnPart, UserPart
+from calfkit_tpu.nodes import Agent
+from calfkit_tpu.peers import Messaging
+from calfkit_tpu.peers.messaging import MESSAGE_AGENT_TOOL
+from calfkit_tpu.worker import Worker
+
+
+def _message(cid: str, target: str, text: str) -> ToolCallOutput:
+    return ToolCallOutput(
+        tool_call_id=cid,
+        tool_name=MESSAGE_AGENT_TOOL,
+        args={"agent_name": target, "message": text},
+    )
+
+
+class TestSelector:
+    CARDS = [
+        AgentCard(name="a", description="A", input_topic="agent.a.private.input"),
+        AgentCard(name="me", description="self", input_topic="agent.me.private.input"),
+    ]
+
+    def test_tool_def_has_message_and_target(self):
+        tool = Messaging("a").tool_def(self.CARDS, self_name="me")
+        props = tool.parameters_schema["properties"]
+        assert props["agent_name"]["enum"] == ["a"]
+        assert "message" in props
+        assert tool.parameters_schema["required"] == ["agent_name", "message"]
+
+    def test_curated_xor_discover(self):
+        with pytest.raises(Exception):
+            Messaging("a", discover=True)
+        with pytest.raises(Exception):
+            Messaging()
+
+
+class TestMessagingEndToEnd:
+    async def test_callee_sees_only_the_message_not_the_callers_history(self):
+        callee_views = []
+
+        def callee_model(messages, params):
+            callee_views.append(messages)
+            return ModelResponse(parts=[TextOutput(text="expert reply")])
+
+        expert = Agent(
+            "expert", model=FunctionModelClient(callee_model), description="e"
+        )
+
+        def caller_model(messages, params):
+            if not any(isinstance(m, ModelResponse) for m in messages):
+                return ModelResponse(
+                    parts=[_message("m1", "expert", "just the question")]
+                )
+            returns = [
+                p.content
+                for m in messages
+                if isinstance(m, ModelRequest)
+                for p in m.parts
+                if isinstance(p, ToolReturnPart)
+            ]
+            return ModelResponse(parts=[TextOutput(text=f"got: {returns[-1]}")])
+
+        caller = Agent(
+            "caller",
+            model=FunctionModelClient(caller_model),
+            peers=[Messaging("expert")],
+        )
+        mesh = InMemoryMesh()
+        async with Worker([caller, expert], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("caller").execute(
+                "SECRET caller context", timeout=15
+            )
+            assert "expert reply" in result.output
+            await client.close()
+
+        # the expert's view: exactly one user part, the message text —
+        # never the caller's conversation (isolated state)
+        assert len(callee_views) == 1
+        texts = [
+            p.content
+            for m in callee_views[0]
+            if isinstance(m, ModelRequest)
+            for p in m.parts
+            if isinstance(p, UserPart)
+        ]
+        joined = " ".join(str(t) for t in texts)
+        assert "just the question" in joined
+        assert "SECRET" not in joined
+
+    async def test_caller_state_survives_the_exchange(self):
+        """Carry-forward: after messaging, the caller's own history still
+        contains its original user turn (state parked durably, not lost)."""
+        expert = Agent(
+            "expert2", model=TestModelClient(custom_output_text="ok"),
+            description="e",
+        )
+
+        def caller_model(messages, params):
+            if not any(isinstance(m, ModelResponse) for m in messages):
+                return ModelResponse(parts=[_message("m1", "expert2", "q")])
+            user_texts = [
+                str(p.content)
+                for m in messages
+                if isinstance(m, ModelRequest)
+                for p in m.parts
+                if isinstance(p, UserPart)
+            ]
+            assert any("original prompt" in t for t in user_texts), user_texts
+            return ModelResponse(parts=[TextOutput(text="done")])
+
+        caller = Agent(
+            "caller2",
+            model=FunctionModelClient(caller_model),
+            peers=[Messaging("expert2")],
+        )
+        mesh = InMemoryMesh()
+        async with Worker([caller, expert], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("caller2").execute(
+                "original prompt", timeout=15
+            )
+            assert result.output == "done"
+            # the returned state carries the caller's conversation
+            assert any(
+                "original prompt" in str(getattr(p, "content", ""))
+                for m in result.state.message_history
+                if isinstance(m, ModelRequest)
+                for p in m.parts
+            )
+            await client.close()
+
+    async def test_message_to_dead_agent_returns_retry_to_model(self):
+        turns = []
+
+        def caller_model(messages, params):
+            turns.append(1)
+            if len(turns) == 1:
+                return ModelResponse(parts=[_message("m1", "nobody", "hello?")])
+            return ModelResponse(parts=[TextOutput(text="gave up gracefully")])
+
+        caller = Agent(
+            "caller3",
+            model=FunctionModelClient(caller_model),
+            peers=[Messaging(discover=True)],
+        )
+        mesh = InMemoryMesh()
+        async with Worker([caller], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("caller3").execute("go", timeout=15)
+            assert result.output == "gave up gracefully"
+            assert len(turns) == 2
+            await client.close()
+
+    async def test_parallel_messages_fold_into_one_reentry(self):
+        """Two message_agent calls in ONE turn: both replies present on the
+        next model turn (durable fan-out fold)."""
+        a = Agent("pa", model=TestModelClient(custom_output_text="alpha says"),
+                  description="a")
+        b = Agent("pb", model=TestModelClient(custom_output_text="beta says"),
+                  description="b")
+
+        def caller_model(messages, params):
+            if not any(isinstance(m, ModelResponse) for m in messages):
+                return ModelResponse(parts=[
+                    _message("m1", "pa", "q1"), _message("m2", "pb", "q2"),
+                ])
+            returns = [
+                str(p.content)
+                for m in messages
+                if isinstance(m, ModelRequest)
+                for p in m.parts
+                if isinstance(p, ToolReturnPart)
+            ]
+            assert len(returns) == 2, returns
+            return ModelResponse(
+                parts=[TextOutput(text=" | ".join(sorted(returns)))]
+            )
+
+        caller = Agent(
+            "fanner", model=FunctionModelClient(caller_model),
+            peers=[Messaging("pa", "pb")],
+        )
+        mesh = InMemoryMesh()
+        async with Worker([caller, a, b], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("fanner").execute("go", timeout=20)
+            assert "alpha says" in result.output and "beta says" in result.output
+            await client.close()

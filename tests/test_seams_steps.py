@@ -333,3 +333,24 @@ class TestSeamsEndToEnd:
             assert result.output == "ok"
             assert stamps == ["yes"]
             await client.close()
+
+    async def test_unpublishable_seam_return_faults_loudly(self):
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.exceptions import NodeFaultError
+        from calfkit_tpu.nodes import Agent
+
+        import pytest
+
+        # the classic accident: an observe-only seam ending in a truthy
+        # expression (setdefault returns the value)
+        agent = Agent(
+            "accident",
+            model=TestModelClient(custom_output_text="never"),
+            before_node=[lambda ctx: ctx.deps.setdefault("attempts", 3)],
+        )
+        mesh, worker, Client = self._team(agent)
+        async with worker:
+            client = Client.connect(mesh)
+            with pytest.raises(NodeFaultError, match="unpublishable"):
+                await client.agent("accident").execute("hi", timeout=10)
+            await client.close()

@@ -1,0 +1,67 @@
+"""Config value-objects are guard-rails: typo'd knobs and invalid values
+fail at construction, and configs are immutable once built (reference:
+calfkit/tuning.py strict validation + the reject-by-name kwarg style)."""
+
+import pytest
+from pydantic import ValidationError
+
+from calfkit_tpu.controlplane import ControlPlaneConfig
+from calfkit_tpu.provisioning import ProvisioningConfig
+from calfkit_tpu.tuning import FanoutConfig, TableTuning
+
+ALL_CONFIGS = [TableTuning, FanoutConfig, ControlPlaneConfig, ProvisioningConfig]
+
+
+class TestStrictness:
+    @pytest.mark.parametrize("cls", ALL_CONFIGS)
+    def test_unknown_knob_rejected_by_name(self, cls):
+        with pytest.raises(ValidationError, match="catchup_tiemout"):
+            cls(catchup_tiemout=5)  # the classic typo must not be ignored
+
+    @pytest.mark.parametrize("cls", ALL_CONFIGS)
+    def test_frozen_after_construction(self, cls):
+        config = cls()
+        field = next(iter(cls.model_fields))
+        with pytest.raises(ValidationError):
+            setattr(config, field, 99)
+
+
+class TestBounds:
+    def test_timeouts_must_be_positive(self):
+        with pytest.raises(ValidationError):
+            TableTuning(catchup_timeout_s=0)
+        with pytest.raises(ValidationError):
+            TableTuning(barrier_timeout_s=-1)
+        with pytest.raises(ValidationError):
+            ControlPlaneConfig(heartbeat_interval=0)
+
+    def test_stale_multiplier_at_least_one(self):
+        # below 1x, a node would be declared dead before its next heartbeat
+        with pytest.raises(ValidationError):
+            ControlPlaneConfig(stale_multiplier=0.5)
+        assert ControlPlaneConfig(stale_multiplier=1.0).stale_after == 5.0
+
+    def test_provisioning_attempts_at_least_one(self):
+        with pytest.raises(ValidationError):
+            ProvisioningConfig(max_attempts=0)
+        assert ProvisioningConfig(retry_backoff_s=0.0).retry_backoff_s == 0.0
+
+    def test_stale_after_derivation(self):
+        config = ControlPlaneConfig(heartbeat_interval=2.0, stale_multiplier=4.0)
+        assert config.stale_after == 8.0
+
+
+class TestWorkerKnobValidation:
+    def test_worker_rejects_wrong_config_types_by_name(self):
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.exceptions import LifecycleConfigError
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        agent = Agent("k", model=TestModelClient())
+        mesh = InMemoryMesh()
+        with pytest.raises(LifecycleConfigError, match="FanoutConfig"):
+            Worker([agent], mesh=mesh, fanout={"table": {}})
+        with pytest.raises(LifecycleConfigError, match="ProvisioningConfig"):
+            Worker([agent], mesh=mesh, provisioning={"enabled": False})
