@@ -38,7 +38,10 @@ def load_object(spec: str) -> Any:
         try:
             module = importlib.import_module(module_part)
         except ModuleNotFoundError as exc:
-            if exc.name and not module_part.startswith(exc.name):
+            if exc.name and not (
+                module_part == exc.name
+                or module_part.startswith(exc.name + ".")
+            ):
                 # the spec resolved; one of ITS imports is missing — name
                 # the real missing dependency, not the spec grammar
                 raise click.ClickException(
@@ -55,11 +58,7 @@ def load_object(spec: str) -> Any:
         found = [
             value
             for name, value in vars(module).items()
-            if not name.startswith("_")
-            and isinstance(value, BaseNodeDef)
-            # imported nodes belong to their DEFINING file's spec — a bare
-            # spec for this file must not re-collect them (duplicate nodes)
-            and value.defined_in_module in (module.__name__, None)
+            if not name.startswith("_") and isinstance(value, BaseNodeDef)
         ]
         # dedupe while preserving definition order (an attr alias like
         # ``TEAM = [a, b]`` is a list, not a BaseNodeDef — untouched here)
@@ -82,10 +81,26 @@ def load_object(spec: str) -> Any:
 
 
 def load_nodes(specs: tuple[str, ...]) -> list[Any]:
+    """Load every spec; bare-spec collections are deduped across specs.
+
+    Dedup key: (node name, defining module).  A node imported into one bare
+    file and ALSO loaded from its own file arrives as two instances of the
+    same logical node (file-spec exec re-creates the module) — one worker
+    must serve it once.  Two genuinely different nodes sharing a name in
+    different files keep colliding loudly in Worker's duplicate-name check.
+    """
     nodes: list[Any] = []
+    bare_seen: set[tuple[str, str | None]] = set()
     for spec in specs:
         obj = load_object(spec)
-        nodes.extend(obj if isinstance(obj, (list, tuple)) else [obj])
+        bare = ":" not in spec
+        for node in obj if isinstance(obj, (list, tuple)) else [obj]:
+            if bare:
+                key = (node.name, getattr(node, "defined_in_module", None))
+                if key in bare_seen:
+                    continue
+                bare_seen.add(key)
+            nodes.append(node)
     return nodes
 
 

@@ -266,6 +266,13 @@ class InferenceEngine:
         self._prefill_jits: dict[tuple[int, int], Any] = {}
 
     # ------------------------------------------------------------ jit build
+    def _resolved_attn_impl(self) -> str:
+        """"auto" stays on the XLA path until the Pallas kernels (decode +
+        flash prefill) are profiled on hardware; "pallas"/"pallas_interpret"
+        opt in explicitly across prefill, chunked prefill, and decode."""
+        impl = self.runtime.attention_impl
+        return "xla" if impl == "auto" else impl
+
     def _window_bucket(self, needed: int) -> int:
         """Smallest configured window ≥ needed (cap max_seq): the decode
         attention scan only reads this prefix of the cache, and each bucket
@@ -286,11 +293,7 @@ class InferenceEngine:
         if fn is not None:
             return fn
         cfg = self.config
-        # "auto" stays on the XLA path until the Pallas kernel is profiled on
-        # hardware; "pallas"/"pallas_interpret" opt in explicitly
-        attn_impl = self.runtime.attention_impl
-        if attn_impl == "auto":
-            attn_impl = "xla"
+        attn_impl = self._resolved_attn_impl()
 
         def decode(params, k, v, last, lens, active, slot_keys, temp, top_k, top_p):
             # ring-buffer decode: the main cache is READ-ONLY during the
@@ -350,9 +353,7 @@ class InferenceEngine:
         if fn is not None:
             return fn
         cfg = self.config
-        attn_impl = self.runtime.attention_impl
-        if attn_impl == "auto":
-            attn_impl = "xla"
+        attn_impl = self._resolved_attn_impl()
 
         def decode(params, k, v, tables, last, lens, active,
                    slot_keys, temp, top_k, top_p):
@@ -427,6 +428,7 @@ class InferenceEngine:
         if fn is not None:
             return fn
         cfg = self.config
+        attn_impl = self._resolved_attn_impl()
 
         def prefill(
             params, k, v, tokens, slots, true_lens,
@@ -442,7 +444,8 @@ class InferenceEngine:
             )
             pos = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (R, P))
             logits, (sk, sv) = M.forward(
-                params, cfg, tokens, pos, scratch, jnp.full((R,), P, jnp.int32)
+                params, cfg, tokens, pos, scratch,
+                jnp.full((R,), P, jnp.int32), attn_impl=attn_impl,
             )
             idx = jnp.clip(true_lens - 1, 0, P - 1)
             last_logits = jnp.take_along_axis(
@@ -469,6 +472,7 @@ class InferenceEngine:
         if fn is not None:
             return fn
         cfg = self.config
+        attn_impl = self._resolved_attn_impl()
 
         def chunk_step(params, sk, sv, tokens_chunk, offset):
             R = tokens_chunk.shape[0]
@@ -477,7 +481,8 @@ class InferenceEngine:
             )
             lens = jnp.full((R,), offset + chunk, jnp.int32)
             logits, (sk, sv) = M.forward(
-                params, cfg, tokens_chunk, pos, (sk, sv), lens
+                params, cfg, tokens_chunk, pos, (sk, sv), lens,
+                attn_impl=attn_impl,
             )
             return sk, sv, logits  # logits [R, chunk, V]
 

@@ -203,6 +203,43 @@ def attention_xla(
     return out.reshape(B, Sq, H, hd).astype(q.dtype)
 
 
+def prefill_attention(
+    q: jax.Array,  # [B, Sq, H, hd]
+    k_cache: jax.Array,  # [B, K, Skv, hd]
+    v_cache: jax.Array,
+    q_pos: jax.Array,  # [B, Sq]
+    seq_lens: jax.Array,  # [B]
+    *,
+    attn_impl: str = "xla",
+) -> jax.Array:
+    """Prefill attention dispatch: the Pallas flash kernel when opted in
+    and the shapes are block-eligible, else the XLA einsum path.
+
+    The flash kernel never materializes the [Sq, Skv] score matrix, so
+    long-chunk prefill stays VMEM-resident; eligibility mirrors the
+    engine's power-of-two chunk/bucket grammar (see
+    pallas_attention.prefill_attention_pallas).
+    """
+    if attn_impl.startswith("pallas"):
+        from calfkit_tpu.inference.pallas_attention import (
+            PREFILL_BLOCK_Q,
+            PREFILL_KV_CHUNK,
+            prefill_attention_pallas,
+        )
+
+        Sq, Skv = q.shape[1], k_cache.shape[2]
+        if (
+            Sq % min(PREFILL_BLOCK_Q, Sq) == 0
+            and Skv % min(PREFILL_KV_CHUNK, Skv) == 0
+        ):
+
+            return prefill_attention_pallas(
+                q, k_cache, v_cache, q_pos, seq_lens,
+                interpret=attn_impl == "pallas_interpret",
+            )
+    return attention_xla(q, k_cache, v_cache, q_pos, seq_lens)
+
+
 # --------------------------------------------------------------------------- #
 # the transformer
 # --------------------------------------------------------------------------- #
@@ -217,6 +254,7 @@ def forward(
     seq_lens: jax.Array,  # [B] kv length AFTER inserting this chunk
     attn_window: int | None = None,  # static: attend only cache[..., :W, :]
     unroll: bool = False,  # static: python layer loop (the decode hot path)
+    attn_impl: str = "xla",  # static: "xla" | "pallas" | "pallas_interpret"
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """Run the decoder over a token chunk, updating the cache functionally.
 
@@ -249,8 +287,9 @@ def forward(
         q, k, v = attn_qkv(x, lp, cos, sin, eps)
         k_page = _insert_chunk(k_page, k, insert_at)
         v_page = _insert_chunk(v_page, v, insert_at)
-        attn = attention_xla(
-            q, k_page[:, :, :W], v_page[:, :, :W], positions, seq_lens
+        attn = prefill_attention(
+            q, k_page[:, :, :W], v_page[:, :, :W], positions, seq_lens,
+            attn_impl=attn_impl,
         )
         return attn_out_mlp(x, attn, lp, eps), k_page, v_page
 

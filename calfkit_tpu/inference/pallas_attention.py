@@ -282,3 +282,134 @@ def merged_decode_attention_pallas(
     o2, m2, z2 = ring_attention_source(qg, ring_k, ring_v, t)
     out = logsumexp_merge((o1, m1[..., None], z1[..., None]), (o2, m2, z2))
     return out.reshape(B, 1, H, hd).astype(q.dtype)
+
+
+# --------------------------------------------------------------------------- #
+# prefill: flash attention over the (chunk-updated) cache
+# --------------------------------------------------------------------------- #
+
+# shared with model.prefill_attention's eligibility check — retune in ONE
+# place after hardware profiling
+PREFILL_BLOCK_Q = 128
+PREFILL_KV_CHUNK = 512
+
+
+def _prefill_attn_kernel(
+    qpos_ref, lens_ref, q_ref, k_ref, v_ref, o_ref, *, kv_chunk: int
+):
+    """One (batch, kv-head, q-block) program: flash accumulation over kv.
+
+    The whole [Skv, hd] K/V slice for this (b, k) sits in VMEM (≤ ~1 MB at
+    Skv=4096); the scores for each kv chunk are [BQ, kv_chunk] per query
+    group — never the full [Sq, Skv] matrix the XLA path materializes.
+    """
+    q_all = q_ref[0, 0].astype(jnp.float32)  # [G, BQ, hd]
+    k_all = k_ref[0, 0].astype(jnp.float32)  # [Skv, hd]
+    v_all = v_ref[0, 0].astype(jnp.float32)  # [Skv, hd]
+    q_pos = qpos_ref[0]  # [BQ] absolute positions of this q block
+    kv_len = lens_ref[0]  # scalar: valid kv for this row
+    G, BQ, hd = q_all.shape
+    Skv = k_all.shape[0]
+    scale = 1.0 / math.sqrt(hd)
+    n_chunks = Skv // kv_chunk
+
+    def chunk_body(ci, carry):
+        m, z, acc = carry  # [G,BQ,1], [G,BQ,1], [G,BQ,hd]
+        start = ci * kv_chunk
+        k_c = jax.lax.dynamic_slice_in_dim(k_all, start, kv_chunk, 0)
+        v_c = jax.lax.dynamic_slice_in_dim(v_all, start, kv_chunk, 0)
+        kv_pos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (BQ, kv_chunk), 1
+        )
+        mask = (kv_pos <= q_pos[:, None]) & (kv_pos < kv_len)  # [BQ, kv_chunk]
+
+        new_m, new_z, new_acc = [], [], []
+        for g in range(G):  # static unroll: G is 1-8
+            scores = jax.lax.dot_general(
+                q_all[g], k_c, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [BQ, kv_chunk]
+            scores = jnp.where(mask, scores, -1e30)
+            m_c = jnp.maximum(m[g], jnp.max(scores, axis=-1, keepdims=True))
+            m_c = jnp.maximum(m_c, -1e29)  # all-masked chunks stay finite
+            alpha = jnp.exp(m[g] - m_c)
+            p = jnp.exp(scores - m_c)  # [BQ, kv_chunk]
+            new_z.append(z[g] * alpha + jnp.sum(p, axis=-1, keepdims=True))
+            new_acc.append(
+                acc[g] * alpha
+                + jax.lax.dot_general(
+                    p, v_c, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            )
+            new_m.append(m_c)
+        return (
+            jnp.stack(new_m), jnp.stack(new_z), jnp.stack(new_acc)
+        )
+
+    init = (
+        jnp.full((G, BQ, 1), -1e30, jnp.float32),
+        jnp.zeros((G, BQ, 1), jnp.float32),
+        jnp.zeros((G, BQ, hd), jnp.float32),
+    )
+    m, z, acc = jax.lax.fori_loop(0, n_chunks, chunk_body, init)
+    o_ref[0, 0] = acc / jnp.maximum(z, 1e-30)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "block_q", "kv_chunk")
+)
+def prefill_attention_pallas(
+    q: jax.Array,  # [B, Sq, H, hd]
+    k_cache: jax.Array,  # [B, K, Skv, hd]
+    v_cache: jax.Array,  # [B, K, Skv, hd]
+    q_pos: jax.Array,  # [B, Sq] absolute positions
+    seq_lens: jax.Array,  # [B] valid kv per row
+    *,
+    block_q: int = PREFILL_BLOCK_Q,
+    kv_chunk: int = PREFILL_KV_CHUNK,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash-attention prefill — drop-in for :func:`model.attention_xla`.
+
+    Requires ``Sq % block_q == 0`` (or ``Sq < block_q``, which shrinks the
+    block) and ``Skv % kv_chunk == 0`` (ditto); the engine's power-of-two
+    prefill chunks and window buckets satisfy both.  Callers should fall
+    back to the XLA path otherwise (see ``model.prefill_attention``).
+    """
+    B, Sq, H, hd = q.shape
+    K, Skv = k_cache.shape[1], k_cache.shape[2]
+    G = H // K
+    block_q = min(block_q, Sq)
+    kv_chunk = min(kv_chunk, Skv)
+    if Sq % block_q or Skv % kv_chunk:
+        raise ValueError(
+            f"prefill_attention_pallas: Sq={Sq} %% block_q={block_q} and "
+            f"Skv={Skv} %% kv_chunk={kv_chunk} must be 0"
+        )
+    nq = Sq // block_q
+
+    # [B, Sq, H, hd] -> [B, K, G, Sq, hd]: kv-head-major query layout
+    qg = q.reshape(B, Sq, K, G, hd).transpose(0, 2, 3, 1, 4)
+
+    out = pl.pallas_call(
+        functools.partial(_prefill_attn_kernel, kv_chunk=kv_chunk),
+        grid=(B, K, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q), lambda b, k, qi: (b, qi)),  # q_pos
+            pl.BlockSpec((1,), lambda b, k, qi: (b,)),  # seq_lens
+            pl.BlockSpec(
+                (1, 1, G, block_q, hd), lambda b, k, qi: (b, k, 0, qi, 0)
+            ),
+            pl.BlockSpec((1, 1, Skv, hd), lambda b, k, qi: (b, k, 0, 0)),
+            pl.BlockSpec((1, 1, Skv, hd), lambda b, k, qi: (b, k, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G, block_q, hd), lambda b, k, qi: (b, k, 0, qi, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, K, G, Sq, hd), jnp.float32),
+        interpret=interpret,
+    )(q_pos, seq_lens, qg, k_cache, v_cache)
+
+    # [B, K, G, Sq, hd] -> [B, Sq, H, hd]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, hd).astype(q.dtype)

@@ -84,7 +84,7 @@ def _constructing_module() -> str | None:
     frame = sys._getframe(1)
     while frame is not None:
         mod = frame.f_globals.get("__name__", "")
-        if not mod.startswith("calfkit_tpu"):
+        if mod != "calfkit_tpu" and not mod.startswith("calfkit_tpu."):
             return mod or None
         frame = frame.f_back
     return None

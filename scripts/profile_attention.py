@@ -81,6 +81,55 @@ def profile_dense(preset_name: str, B: int, W: int, steps: int, impls) -> None:
         }))
 
 
+def profile_prefill(preset_name: str, R: int, S: int, impls) -> None:
+    """Time one prefill-wave forward ([R, S] into a fresh scratch cache)
+    per attention impl — the flash kernel's shape of interest."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from calfkit_tpu.inference import model as M
+    from calfkit_tpu.inference.config import preset
+
+    cfg = preset(preset_name)
+    dtype = jnp.bfloat16
+    params = jax.tree.map(
+        lambda s: jnp.zeros(s.shape, s.dtype),
+        jax.eval_shape(lambda k: M.init_params(cfg, k), jax.random.key(0)),
+    )
+    tokens = jnp.ones((R, S), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (R, S))
+    lens = jnp.full((R,), S, jnp.int32)
+
+    for impl in impls:
+        def prefill(params, tokens):
+            scratch = (
+                jnp.zeros((cfg.n_layers, R, cfg.n_kv_heads, S, cfg.head_dim), dtype),
+                jnp.zeros((cfg.n_layers, R, cfg.n_kv_heads, S, cfg.head_dim), dtype),
+            )
+            logits, _ = M.forward(
+                params, cfg, tokens, pos, scratch, lens, attn_impl=impl
+            )
+            return logits[:, -1]
+
+        fn = jax.jit(prefill)
+        out = fn(params, tokens)
+        np.asarray(jnp.float32(out)).sum()  # force a real fetch
+        times = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            out = fn(params, tokens)
+            np.asarray(jnp.float32(out)).sum()
+            times.append(time.perf_counter() - t0)
+        ms = min(times) * 1000.0
+        print(json.dumps({
+            "config": f"{preset_name} prefill R={R} S={S}",
+            "impl": impl,
+            "ms_per_wave": round(ms, 2),
+            "prefill_tok_s": round(R * S / (ms / 1000.0), 1),
+        }))
+
+
 def profile_paged(preset_name: str, B: int, wpages: int, steps: int,
                   page: int, impls, n_layers: int | None = None) -> None:
     import jax
@@ -172,6 +221,7 @@ def main() -> None:
         profile_dense("tinyllama-1.1b", B=64, W=1024, steps=32, impls=impls)
         profile_paged("tinyllama-1.1b", B=64, wpages=16, steps=32, page=64,
                       impls=impls)
+        profile_prefill("tinyllama-1.1b", R=8, S=512, impls=impls)
     if args.config in ("llama8b", "both"):
         # bench llama8b ATTENTION shapes (bs=32, 4 pages/row reserve) on a
         # 4-layer slice: bf16 zero-params at full depth would not fit 16 GB

@@ -254,3 +254,21 @@ class TestBareFileSpecs:
         finally:
             sys.path.remove(str(tmp_path))
             sys.modules.pop("depmod", None)
+
+    def test_factory_built_nodes_are_collected(self, tmp_path):
+        """Nodes constructed via a helper module still belong to the spec
+        that assigns them at top level."""
+        from calfkit_tpu.cli._common import load_nodes
+
+        (tmp_path / "node_factory.py").write_text(
+            "from calfkit_tpu.nodes import Agent\n"
+            "from calfkit_tpu.engine import TestModelClient\n"
+            "def make(name):\n"
+            "    return Agent(name, model=TestModelClient())\n"
+        )
+        (tmp_path / "factory_team.py").write_text(
+            "from node_factory import make\n"
+            "lead = make('factory_lead')\n"
+        )
+        nodes = load_nodes((str(tmp_path / "factory_team.py"),))
+        assert [n.name for n in nodes] == ["factory_lead"]

@@ -1133,3 +1133,93 @@ class TestEngineStress:
         out = [t async for t in engine.generate([9, 9, 9], max_new_tokens=5)]
         assert len(out) == 5
         await engine.stop()
+
+
+class TestPallasPrefillAttention:
+    """Flash-prefill kernel parity vs the XLA einsum path (interpret mode)."""
+
+    def _parity(self, B, Sq, H, K, hd, Skv, q_pos, lens, **kw):
+        import numpy as np
+
+        from calfkit_tpu.inference.model import attention_xla
+        from calfkit_tpu.inference.pallas_attention import (
+            prefill_attention_pallas,
+        )
+
+        ks = jax.random.split(jax.random.key(B * Sq + Skv), 3)
+        q = jax.random.normal(ks[0], (B, Sq, H, hd), jnp.float32)
+        kc = jax.random.normal(ks[1], (B, K, Skv, hd), jnp.float32)
+        vc = jax.random.normal(ks[2], (B, K, Skv, hd), jnp.float32)
+        ref = attention_xla(q, kc, vc, q_pos, lens)
+        out = prefill_attention_pallas(
+            q, kc, vc, q_pos, lens, interpret=True, **kw
+        )
+        np.testing.assert_allclose(
+            np.asarray(ref, np.float32), np.asarray(out, np.float32),
+            atol=2e-3, rtol=2e-3,
+        )
+
+    def test_gqa_causal_parity(self):
+        B, Sq, H, K, hd, Skv = 2, 32, 8, 2, 64, 32
+        q_pos = jnp.broadcast_to(jnp.arange(Sq), (B, Sq))
+        lens = jnp.array([Sq, Sq], jnp.int32)
+        self._parity(B, Sq, H, K, hd, Skv, q_pos, lens)
+
+    def test_mha_ragged_lens(self):
+        # rows whose valid kv is shorter than the cache extent
+        B, Sq, H, K, hd, Skv = 3, 16, 4, 4, 64, 64
+        q_pos = jnp.broadcast_to(jnp.arange(Sq), (B, Sq))
+        lens = jnp.array([16, 9, 3], jnp.int32)
+        self._parity(B, Sq, H, K, hd, Skv, q_pos, lens)
+
+    def test_chunk_at_offset_sees_prior_prefix(self):
+        # chunked prefill: queries at positions [32..48) over a 64-cache
+        B, Sq, H, K, hd, Skv = 2, 16, 8, 4, 64, 64
+        q_pos = jnp.broadcast_to(32 + jnp.arange(Sq), (B, Sq))
+        lens = jnp.array([48, 48], jnp.int32)
+        self._parity(B, Sq, H, K, hd, Skv, q_pos, lens)
+
+    def test_multiple_q_blocks_and_kv_chunks(self):
+        # forces the grid (nq=2) AND the inner kv loop (n_chunks=4)
+        B, Sq, H, K, hd, Skv = 1, 64, 8, 2, 64, 128
+        q_pos = jnp.broadcast_to(jnp.arange(Sq), (B, Sq))
+        lens = jnp.array([Sq], jnp.int32)
+        self._parity(B, Sq, H, K, hd, Skv, q_pos, lens,
+                     block_q=32, kv_chunk=32)
+
+    def test_ineligible_shapes_raise(self):
+        import pytest
+
+        from calfkit_tpu.inference.pallas_attention import (
+            prefill_attention_pallas,
+        )
+
+        q = jnp.zeros((1, 130, 4, 64), jnp.float32)  # 130 % 128 != 0
+        kc = jnp.zeros((1, 4, 256, 64), jnp.float32)
+        q_pos = jnp.zeros((1, 130), jnp.int32)
+        with pytest.raises(ValueError, match="block_q"):
+            prefill_attention_pallas(
+                q, kc, kc, q_pos, jnp.array([130], jnp.int32), interpret=True
+            )
+
+    def test_dispatch_falls_back_to_xla_when_ineligible(self):
+        import numpy as np
+
+        from calfkit_tpu.inference.model import (
+            attention_xla,
+            prefill_attention,
+        )
+
+        B, Sq, H, K, hd, Skv = 1, 130, 4, 4, 64, 256  # Sq not blockable
+        ks = jax.random.split(jax.random.key(7), 3)
+        q = jax.random.normal(ks[0], (B, Sq, H, hd), jnp.float32)
+        kc = jax.random.normal(ks[1], (B, K, Skv, hd), jnp.float32)
+        vc = jax.random.normal(ks[2], (B, K, Skv, hd), jnp.float32)
+        q_pos = jnp.broadcast_to(jnp.arange(Sq), (B, Sq))
+        lens = jnp.array([Sq], jnp.int32)
+        out = prefill_attention(q, kc, vc, q_pos, lens,
+                                attn_impl="pallas_interpret")
+        np.testing.assert_allclose(
+            np.asarray(attention_xla(q, kc, vc, q_pos, lens), np.float32),
+            np.asarray(out, np.float32), atol=1e-5, rtol=1e-5,
+        )
