@@ -81,25 +81,23 @@ def load_object(spec: str) -> Any:
 
 
 def load_nodes(specs: tuple[str, ...]) -> list[Any]:
-    """Load every spec; bare-spec collections are deduped across specs.
+    """Load every spec, deduping by ``node_id`` (first-seen order).
 
-    Dedup key: (node name, defining module).  A node imported into one bare
-    file and ALSO loaded from its own file arrives as two instances of the
-    same logical node (file-spec exec re-creates the module) — one worker
-    must serve it once.  Two genuinely different nodes sharing a name in
-    different files keep colliding loudly in Worker's duplicate-name check.
+    The reference's loader semantics (calfkit/cli/_loader.py:132
+    ``dedupe_by_node_id``): a node imported into one spec and also loaded
+    from its own file — even as a re-exec'd second instance — is served
+    once; two different nodes claiming one name resolve to the first seen.
     """
     nodes: list[Any] = []
-    bare_seen: set[tuple[str, str | None]] = set()
+    seen: set[str] = set()
     for spec in specs:
         obj = load_object(spec)
-        bare = ":" not in spec
         for node in obj if isinstance(obj, (list, tuple)) else [obj]:
-            if bare:
-                key = (node.name, getattr(node, "defined_in_module", None))
-                if key in bare_seen:
+            key = getattr(node, "node_id", None)
+            if key is not None:
+                if key in seen:
                     continue
-                bare_seen.add(key)
+                seen.add(key)
             nodes.append(node)
     return nodes
 

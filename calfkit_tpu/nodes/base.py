@@ -77,17 +77,24 @@ _HANDLED = "handled"
 _RESUME = "resume"
 
 
-def _constructing_module() -> str | None:
-    """Module name of the first stack frame outside calfkit_tpu."""
-    import sys
+def _as_recovery_parts(recovery: Any) -> list:
+    """Coerce an on_callee_error/on_tool_error recovery into content parts.
 
-    frame = sys._getframe(1)
-    while frame is not None:
-        mod = frame.f_globals.get("__name__", "")
-        if mod != "calfkit_tpu" and not mod.startswith("calfkit_tpu."):
-            return mod or None
-        frame = frame.f_back
-    return None
+    The documented sugar accepts a plain string ('answer from memory'), a
+    part, or a list of parts (reference: nodes/_tool_error.py — seam
+    returns become the slot's substitute value the model sees)."""
+    from calfkit_tpu.models.payload import DataPart, TextPart
+
+    def one(p: Any) -> Any:
+        if isinstance(p, str):
+            return TextPart(text=p)
+        if isinstance(p, dict):
+            return DataPart(data=p)
+        return p
+
+    if isinstance(recovery, list):
+        return [one(p) for p in recovery]
+    return [one(recovery)]
 
 
 def _as_action(value: Any) -> NodeResult:
@@ -173,10 +180,6 @@ class BaseNodeDef(RegistryMixin):
         protocol.require_topic_safe(name, what="node name")
         self.name = name
         self.instance_id = uuid.uuid4().hex[:12]
-        # the module that CONSTRUCTED this node (first non-framework frame):
-        # bare-file CLI specs collect only nodes defined in the named file,
-        # so an imported node is served once, by its defining module
-        self.defined_in_module = _constructing_module()
         for seam in before_node:
             validate_seam_arity(seam, 1, name="before_node")
         for seam in after_node:
@@ -425,21 +428,21 @@ class BaseNodeDef(RegistryMixin):
         report = reply.report
         recovery = await run_chain_guarded(self.on_callee_error, ctx, report)
         if recovery is not None:
-            parts = (
-                recovery
-                if isinstance(recovery, list)
-                else [recovery]  # a single part is accepted
-            )
             outcome = FanoutOutcome(
-                slot_id=slot_id, parts=parts, marker=reply.marker
+                slot_id=slot_id,
+                parts=_as_recovery_parts(recovery),
+                marker=reply.marker,
             )
-            self._note_fold(ctx, outcome)
+            self._note_fold(ctx, outcome, recovered_fault=True)
             return outcome
         outcome = FanoutOutcome(slot_id=slot_id, fault=report, marker=reply.marker)
         self._note_fold(ctx, outcome)
         return outcome
 
-    def _note_fold(self, ctx: NodeRunContext, outcome: FanoutOutcome) -> None:
+    def _note_fold(
+        self, ctx: NodeRunContext, outcome: FanoutOutcome, *,
+        recovered_fault: bool = False,
+    ) -> None:
         """Pair law: the result step for a marked call mints at the fold."""
         marker = outcome.marker
         if isinstance(marker, ToolCallMarker):
@@ -452,6 +455,7 @@ class BaseNodeDef(RegistryMixin):
                     marker.tool_call_id,
                     marker.tool_name,
                     render_parts_as_text(outcome.parts or []),
+                    ok=not recovered_fault,
                 )
 
     def materialize_outcome(self, ctx: NodeRunContext, outcome: FanoutOutcome) -> None:

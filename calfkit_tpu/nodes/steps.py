@@ -142,12 +142,18 @@ class HopStepLedger:
             ToolCallStep(tool_call_id=tool_call_id, tool_name=tool_name, args=args)
         )
 
-    def folded(self, tool_call_id: str, tool_name: str, content: Any) -> None:
+    def folded(
+        self, tool_call_id: str, tool_name: str, content: Any, *,
+        ok: bool = True,
+    ) -> None:
+        """``ok=False`` with content: the callee faulted but a recovery seam
+        substituted a value — honest telemetry shows the failure AND what
+        the model will see instead."""
         self._steps.append(
             ToolResultStep(
                 tool_call_id=tool_call_id,
                 tool_name=tool_name,
-                ok=True,
+                ok=ok,
                 content=safe_str(content, 2048),
             )
         )

@@ -272,3 +272,26 @@ class TestBareFileSpecs:
         )
         nodes = load_nodes((str(tmp_path / "factory_team.py"),))
         assert [n.name for n in nodes] == ["factory_lead"]
+
+    def test_same_name_from_two_factories_first_seen_wins(self, tmp_path):
+        """Reference loader semantics (dedupe_by_node_id): first seen wins,
+        in spec order."""
+        from calfkit_tpu.cli._common import load_nodes
+
+        (tmp_path / "mk.py").write_text(
+            "from calfkit_tpu.nodes import Agent\n"
+            "from calfkit_tpu.engine import TestModelClient\n"
+            "def make(text):\n"
+            "    return Agent('shared_lead',\n"
+            "                 model=TestModelClient(custom_output_text=text))\n"
+        )
+        (tmp_path / "team_a2.py").write_text(
+            "from mk import make\nlead = make('alpha')\n"
+        )
+        (tmp_path / "team_b2.py").write_text(
+            "from mk import make\nlead = make('beta')\n"
+        )
+        nodes = load_nodes(
+            (str(tmp_path / "team_a2.py"), str(tmp_path / "team_b2.py"))
+        )
+        assert len(nodes) == 1  # one node_id -> one serving instance

@@ -354,3 +354,102 @@ class TestSeamsEndToEnd:
             with pytest.raises(NodeFaultError, match="unpublishable"):
                 await client.agent("accident").execute("hi", timeout=10)
             await client.close()
+
+
+class TestStepPairLawEndToEnd:
+    """The pair law observed at the CLIENT: every tool_call step that
+    streams out is closed by exactly one tool_result step — including the
+    failing call (closed ok=False) — before the terminal event."""
+
+    async def test_pairs_close_for_success_and_failure(self):
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.exceptions import NodeFaultError
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.models import ModelResponse, TextOutput, ToolCallOutput
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        @agent_tool
+        def fine(x: int) -> int:
+            """F.
+
+            Args:
+                x: X.
+            """
+            return x * 2
+
+        @agent_tool
+        def broken(x: int) -> int:
+            """B.
+
+            Args:
+                x: X.
+            """
+            raise RuntimeError("tool died")
+
+        def model(messages, params):
+            from calfkit_tpu.models.messages import ModelRequest, ToolReturnPart
+
+            replied = any(
+                isinstance(p, ToolReturnPart)
+                for m in messages
+                if isinstance(m, ModelRequest)
+                for p in m.parts
+            )
+            if not replied:
+                return ModelResponse(parts=[
+                    ToolCallOutput(tool_call_id="ok1", tool_name="fine",
+                                   args={"x": 2}),
+                    ToolCallOutput(tool_call_id="bad1", tool_name="broken",
+                                   args={"x": 1}),
+                ])
+            return ModelResponse(parts=[TextOutput(text="survived")])
+
+        def absorb(tool_call, ctx, report):
+            return "substituted"  # recover the broken sibling
+
+        agent = Agent(
+            "paired", model=FunctionModelClient(model),
+            tools=[fine, broken], on_tool_error=absorb,
+        )
+        mesh = InMemoryMesh()
+        opened: dict[str, str] = {}
+        closed: dict[str, bool] = {}
+        async with Worker([agent, fine, broken], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            handle = await client.agent("paired").start("go", timeout=20)
+            async for event in handle.stream():
+                step = getattr(event, "step", None)
+                if step is None:
+                    assert event.output == "survived"
+                elif step.kind == "tool_call":
+                    opened[step.tool_call_id] = step.tool_name
+                elif step.kind == "tool_result":
+                    assert step.tool_call_id in opened, "result before call"
+                    closed[step.tool_call_id] = step.ok
+            await client.close()
+        assert set(opened) == set(closed) == {"ok1", "bad1"}
+        assert closed["ok1"] is True
+        assert closed["bad1"] is False  # failure closes the pair, ok=False
+
+    async def test_firehose_sees_steps_across_runs(self):
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        agent = Agent("hose", model=TestModelClient(custom_output_text="y"))
+        mesh = InMemoryMesh()
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            stream = client.events()
+            r1 = await client.agent("hose").execute("one", timeout=10)
+            r2 = await client.agent("hose").execute("two", timeout=10)
+            stream.close()
+            cids = set()
+            async for event in stream:
+                cids.add(event.correlation_id)
+            assert {r1.correlation_id, r2.correlation_id} <= cids
+            await client.close()
