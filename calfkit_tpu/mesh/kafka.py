@@ -111,6 +111,7 @@ class KafkaMesh(MeshTransport):
         self._tasks: list[asyncio.Task[None]] = []
         self._consumers: list = []
         self._dispatchers: list[KeyOrderedDispatcher] = []
+        self._readers: list["_KafkaTableReader"] = []
         self._started = False
 
     @property
@@ -137,6 +138,14 @@ class KafkaMesh(MeshTransport):
 
     async def stop(self) -> None:
         self._started = False
+        # table readers own consumers + pump tasks the lists below don't
+        # cover; stopping the mesh must not leak them
+        for reader in list(self._readers):
+            try:
+                await reader.stop()
+            except Exception:  # noqa: BLE001
+                logger.exception("table reader stop failed")
+        self._readers = []
         for task in self._tasks:
             task.cancel()
         for task in self._tasks:
@@ -297,7 +306,9 @@ class KafkaMesh(MeshTransport):
 
     # --------------------------------------------------------------- tables
     def table_reader(self, topic: str) -> TableReader:
-        return _KafkaTableReader(self, topic)
+        reader = _KafkaTableReader(self, topic)
+        self._readers.append(reader)
+        return reader
 
     def table_writer(self, topic: str) -> TableWriter:
         return _KafkaTableWriter(self, topic)
@@ -364,8 +375,12 @@ class _KafkaTableReader(TableReader):
                 await self._task
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
+            self._task = None
         if self._consumer:
             await self._consumer.stop()
+            self._consumer = None
+        if self in self._mesh._readers:
+            self._mesh._readers.remove(self)
 
     async def barrier(self, *, timeout: float = 30.0) -> None:
         """Freshness barrier across ALL partitions: capture end offsets at

@@ -115,6 +115,7 @@ class TcpMesh(MeshTransport):
         self._pumps: list[asyncio.Task[None]] = []
         self._dispatchers: list[KeyOrderedDispatcher] = []
         self._sub_conns: list[_Conn] = []  # per-subscription connections
+        self._readers: list["_TcpTableReader"] = []
         self._started = False
 
     @property
@@ -133,6 +134,12 @@ class TcpMesh(MeshTransport):
 
     async def stop(self) -> None:
         self._started = False
+        # table readers own their conn + pump; stopping the mesh must not
+        # leak them (same discipline as KafkaMesh)
+        for reader in list(self._readers):
+            with contextlib.suppress(Exception):
+                await reader.stop()
+        self._readers = []
         for pump in self._pumps:
             pump.cancel()
         for pump in self._pumps:
@@ -313,7 +320,9 @@ class TcpMesh(MeshTransport):
 
     # --------------------------------------------------------------- tables
     def table_reader(self, topic: str) -> TableReader:
-        return _TcpTableReader(self, topic)
+        reader = _TcpTableReader(self, topic)
+        self._readers.append(reader)
+        return reader
 
     def table_writer(self, topic: str) -> TableWriter:
         return _TcpTableWriter(self, topic)
@@ -381,6 +390,8 @@ class _TcpTableReader(TableReader):
         if self._conn is not None:
             await self._conn.close()
             self._conn = None
+        if self in self._mesh._readers:
+            self._mesh._readers.remove(self)
 
     async def barrier(self, *, timeout: float = 30.0) -> None:
         assert self._mesh._control is not None

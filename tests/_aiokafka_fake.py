@@ -1,0 +1,340 @@
+"""An in-process fake of the aiokafka subset KafkaMesh uses.
+
+Purpose: this image has no aiokafka and no broker, so ``kafka.py`` would be
+specified-but-never-executed.  Installing this module as ``aiokafka`` (see
+``install()``) lets the transport contract suite drive the REAL KafkaMesh
+code — its producer guard, consumer wiring, table reader catch-up/barrier
+math, tombstones, and group rebalance handling — against a faithful
+in-process broker model.
+
+Modeled semantics (the ones the contract asserts):
+
+- topics with N partitions; keyed records land on ``crc32(key) % N``
+  (keyless round-robin), per-partition append logs with offsets;
+- group consumers share partitions (range assignment, rebalance on member
+  join/leave, resume from committed offsets — commit==consumed position,
+  i.e. auto-commit ack-first);
+- groupless consumers get every partition; ``auto_offset_reset`` decides
+  earliest/latest start;
+- ``end_offsets`` / ``assignment`` as the table reader's barrier needs;
+- admin ``create_topics`` raising ``TopicAlreadyExistsError``;
+- tombstones are records with ``value=None`` (compaction itself is not
+  modeled: readers consume the full log, which is semantically identical
+  for correctness).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import sys
+import time
+import types
+import zlib
+from dataclasses import dataclass, field
+from typing import Any
+
+NUM_PARTITIONS = 16
+
+
+@dataclass(frozen=True)
+class TopicPartition:
+    topic: str
+    partition: int
+
+
+@dataclass(frozen=True)
+class ConsumerRecord:
+    topic: str
+    partition: int
+    offset: int
+    key: bytes | None
+    value: bytes | None
+    headers: list[tuple[str, bytes]]
+    timestamp: int  # ms, as aiokafka
+
+
+class TopicAlreadyExistsError(Exception):
+    pass
+
+
+@dataclass
+class _Group:
+    members: list["AIOKafkaConsumer"] = field(default_factory=list)
+    committed: dict[TopicPartition, int] = field(default_factory=dict)
+    generation: int = 0
+
+
+class _Broker:
+    """One broker world per bootstrap string."""
+
+    def __init__(self) -> None:
+        self.topics: dict[str, list[list[ConsumerRecord]]] = {}
+        self.groups: dict[str, _Group] = {}
+        self.advanced = asyncio.Event()
+        self._rr = itertools.count()
+
+    def ensure_topic(self, name: str) -> list[list[ConsumerRecord]]:
+        if name not in self.topics:
+            self.topics[name] = [[] for _ in range(NUM_PARTITIONS)]
+        return self.topics[name]
+
+    def append(self, topic: str, key: bytes | None, value: bytes | None,
+               headers: list[tuple[str, bytes]]) -> None:
+        logs = self.ensure_topic(topic)
+        if key:
+            partition = zlib.crc32(key) % len(logs)
+        else:
+            partition = next(self._rr) % len(logs)
+        log = logs[partition]
+        log.append(ConsumerRecord(
+            topic=topic, partition=partition, offset=len(log), key=key,
+            value=value, headers=list(headers),
+            timestamp=int(time.time() * 1000),
+        ))
+        self.advanced.set()
+
+    def end_offset(self, tp: TopicPartition) -> int:
+        logs = self.topics.get(tp.topic)
+        return len(logs[tp.partition]) if logs else 0
+
+    # ------------------------------------------------------------- groups
+    def join(self, group_id: str, consumer: "AIOKafkaConsumer") -> None:
+        group = self.groups.setdefault(group_id, _Group())
+        group.members.append(consumer)
+        self._rebalance(group)
+
+    def leave(self, group_id: str, consumer: "AIOKafkaConsumer") -> None:
+        group = self.groups.get(group_id)
+        if group and consumer in group.members:
+            group.members.remove(consumer)
+            self._rebalance(group)
+
+    def _rebalance(self, group: _Group) -> None:
+        """Range assignment over the union of the members' topics.
+
+        Position-cache rule (mirrors real aiokafka): a member's locally
+        cached position is valid only while it holds the partition
+        CONTINUOUSLY.  On revoke the position is committed; on (re)gain the
+        member re-derives from the group's committed offset — otherwise a
+        partition bouncing A→B→A would replay records B already processed.
+        """
+        group.generation += 1
+        members = group.members
+        if not members:
+            return
+        previous = {id(m): set(m._assignment) for m in members}
+        topics = sorted({t for m in members for t in m._topics})
+        for m in members:
+            m._assignment = set()
+        for topic in topics:
+            self.ensure_topic(topic)
+            interested = [m for m in members if topic in m._topics]
+            for p in range(NUM_PARTITIONS):
+                owner = interested[p % len(interested)]
+                owner._assignment.add(TopicPartition(topic, p))
+        for m in members:
+            old = previous[id(m)]
+            for tp in old - m._assignment:  # revoked: commit, drop cache
+                if tp in m._positions:
+                    group.committed[tp] = max(
+                        group.committed.get(tp, 0), m._positions.pop(tp)
+                    )
+            for tp in m._assignment - old:  # gained: stale cache invalid
+                m._positions.pop(tp, None)
+        self.advanced.set()
+
+
+_BROKERS: dict[str, _Broker] = {}
+
+
+def _broker(bootstrap: Any) -> _Broker:
+    key = str(bootstrap)
+    if key not in _BROKERS:
+        _BROKERS[key] = _Broker()
+    return _BROKERS[key]
+
+
+def reset() -> None:
+    """Fresh broker worlds (per-test isolation when desired)."""
+    _BROKERS.clear()
+
+
+class AIOKafkaProducer:
+    def __init__(self, *, bootstrap_servers: Any, **_ignored: Any):
+        self._broker = _broker(bootstrap_servers)
+        self._started = False
+
+    async def start(self) -> None:
+        self._started = True
+
+    async def stop(self) -> None:
+        self._started = False
+
+    async def send_and_wait(
+        self, topic: str, value: bytes | None = None, *,
+        key: bytes | None = None,
+        headers: list[tuple[str, bytes]] | None = None,
+    ) -> None:
+        if not self._started:
+            raise RuntimeError("producer not started")
+        self._broker.append(topic, key, value, headers or [])
+
+
+class AIOKafkaConsumer:
+    def __init__(
+        self, *topics: str, bootstrap_servers: Any,
+        group_id: str | None = None, auto_offset_reset: str = "latest",
+        enable_auto_commit: bool = True, **_ignored: Any,
+    ):
+        self._broker = _broker(bootstrap_servers)
+        self._topics = list(topics)
+        self._group_id = group_id
+        self._from_latest = auto_offset_reset == "latest"
+        self._auto_commit = enable_auto_commit
+        self._assignment: set[TopicPartition] = set()
+        self._positions: dict[TopicPartition, int] = {}
+        self._started = False
+
+    async def start(self) -> None:
+        for topic in self._topics:
+            self._broker.ensure_topic(topic)
+        if self._group_id is None:
+            self._assignment = {
+                TopicPartition(t, p)
+                for t in self._topics
+                for p in range(NUM_PARTITIONS)
+            }
+        else:
+            self._broker.join(self._group_id, self)
+        self._started = True
+
+    async def stop(self) -> None:
+        if not self._started:
+            return
+        self._started = False
+        if self._group_id is not None:
+            # graceful leave: commit consumed positions, then rebalance —
+            # the survivor resumes exactly where this member stopped
+            group = self._broker.groups.get(self._group_id)
+            if group is not None:
+                for tp, pos in self._positions.items():
+                    group.committed[tp] = max(group.committed.get(tp, 0), pos)
+            self._broker.leave(self._group_id, self)
+        self._broker.advanced.set()
+
+    def assignment(self) -> set[TopicPartition]:
+        return set(self._assignment)
+
+    async def end_offsets(
+        self, partitions: list[TopicPartition]
+    ) -> dict[TopicPartition, int]:
+        return {tp: self._broker.end_offset(tp) for tp in partitions}
+
+    def _position(self, tp: TopicPartition) -> int:
+        if tp in self._positions:
+            return self._positions[tp]
+        if self._group_id is not None:
+            group = self._broker.groups[self._group_id]
+            start = group.committed.get(
+                tp, self._broker.end_offset(tp) if self._from_latest else 0
+            )
+        else:
+            start = self._broker.end_offset(tp) if self._from_latest else 0
+        self._positions[tp] = start
+        return start
+
+    def __aiter__(self) -> "AIOKafkaConsumer":
+        return self
+
+    async def __anext__(self) -> ConsumerRecord:
+        while True:
+            if not self._started:
+                raise StopAsyncIteration
+            for tp in sorted(self._assignment, key=lambda t: (t.topic, t.partition)):
+                position = self._position(tp)
+                logs = self._broker.topics.get(tp.topic)
+                if logs is None:
+                    continue
+                log = logs[tp.partition]
+                if position < len(log):
+                    record = log[position]
+                    self._positions[tp] = position + 1
+                    if self._auto_commit and self._group_id is not None:
+                        # ack-first: commit cadence independent of handling
+                        group = self._broker.groups.get(self._group_id)
+                        if group is not None:
+                            group.committed[tp] = position + 1
+                    return record
+            self._broker.advanced.clear()
+            # re-check before parking (lost-wakeup guard), then wait with a
+            # short cap so assignment changes are noticed promptly
+            try:
+                await asyncio.wait_for(self._broker.advanced.wait(), 0.05)
+            except asyncio.TimeoutError:
+                pass
+
+
+class _AdminNewTopic:
+    def __init__(self, *, name: str, num_partitions: int,
+                 replication_factor: int, topic_configs: dict | None = None):
+        self.name = name
+        self.num_partitions = num_partitions
+        self.topic_configs = dict(topic_configs or {})
+
+
+class AIOKafkaAdminClient:
+    def __init__(self, *, bootstrap_servers: Any, **_ignored: Any):
+        self._broker = _broker(bootstrap_servers)
+
+    async def start(self) -> None:
+        pass
+
+    async def close(self) -> None:
+        pass
+
+    async def create_topics(
+        self, topics: list[_AdminNewTopic], validate_only: bool = False
+    ) -> None:
+        existing = [t.name for t in topics if t.name in self._broker.topics]
+        if existing:
+            raise TopicAlreadyExistsError(
+                f"TopicAlreadyExistsError: {existing}"
+            )
+        if not validate_only:
+            for t in topics:
+                self._broker.ensure_topic(t.name)
+
+
+def install() -> None:
+    """Register this fake as ``aiokafka`` (+ ``aiokafka.admin``) in
+    sys.modules.  Refuses to shadow a real install."""
+    if "aiokafka" in sys.modules and not getattr(
+        sys.modules["aiokafka"], "__calfkit_fake__", False
+    ):
+        raise RuntimeError("real aiokafka present; not shadowing it")
+    root = types.ModuleType("aiokafka")
+    root.__calfkit_fake__ = True
+    root.AIOKafkaProducer = AIOKafkaProducer
+    root.AIOKafkaConsumer = AIOKafkaConsumer
+    root.TopicPartition = TopicPartition
+    root.ConsumerRecord = ConsumerRecord
+    admin = types.ModuleType("aiokafka.admin")
+    admin.__calfkit_fake__ = True
+    admin.AIOKafkaAdminClient = AIOKafkaAdminClient
+    admin.NewTopic = _AdminNewTopic
+    errors = types.ModuleType("aiokafka.errors")
+    errors.__calfkit_fake__ = True
+    errors.TopicAlreadyExistsError = TopicAlreadyExistsError
+    root.admin = admin
+    root.errors = errors
+    sys.modules["aiokafka"] = root
+    sys.modules["aiokafka.admin"] = admin
+    sys.modules["aiokafka.errors"] = errors
+
+
+def uninstall() -> None:
+    for name in ("aiokafka", "aiokafka.admin", "aiokafka.errors"):
+        mod = sys.modules.get(name)
+        if mod is not None and getattr(mod, "__calfkit_fake__", False):
+            sys.modules.pop(name, None)

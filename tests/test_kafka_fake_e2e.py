@@ -1,0 +1,112 @@
+"""Full framework stack over the REAL KafkaMesh code against the in-process
+aiokafka fake: worker boot (provisioning, control plane tables, fan-out
+stores), an agent+tool round trip with a parallel fan-out, step streaming,
+and clean shutdown.  This is the closest this image can get to the
+reference's ``-m kafka`` lane without a broker."""
+
+import pytest  # noqa: F401 - fixtures come from conftest
+
+# the shared kafka_fake_broker fixture (tests/conftest.py) installs the
+# in-process aiokafka fake for each test and yields a fresh bootstrap id
+
+
+class TestKafkaFakeEndToEnd:
+    async def test_agent_fanout_roundtrip_over_kafka_mesh(self, kafka_fake_broker):
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.mesh.kafka import KafkaMesh
+        from calfkit_tpu.models import ModelResponse, TextOutput, ToolCallOutput
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        @agent_tool
+        def double(x: int) -> int:
+            """D.
+
+            Args:
+                x: X.
+            """
+            return x * 2
+
+        @agent_tool
+        def triple(x: int) -> int:
+            """T.
+
+            Args:
+                x: X.
+            """
+            return x * 3
+
+        def model(messages, params):
+            from calfkit_tpu.models.messages import ModelRequest, ToolReturnPart
+
+            returns = sorted(
+                str(p.content)
+                for m in messages
+                if isinstance(m, ModelRequest)
+                for p in m.parts
+                if isinstance(p, ToolReturnPart)
+            )
+            if not returns:
+                # TWO calls in one turn: a durable fan-out batch through the
+                # kafka-backed ktables store
+                return ModelResponse(parts=[
+                    ToolCallOutput(tool_call_id="c1", tool_name="double",
+                                   args={"x": 10}),
+                    ToolCallOutput(tool_call_id="c2", tool_name="triple",
+                                   args={"x": 10}),
+                ])
+            return ModelResponse(parts=[TextOutput(text=" ".join(returns))])
+
+        agent = Agent("kagent", model=FunctionModelClient(model),
+                      tools=[double, triple], description="kafka-lane agent")
+
+        mesh = KafkaMesh(kafka_fake_broker)
+        async with Worker([agent, double, triple], mesh=mesh,
+                          owns_transport=True):
+            client = Client.connect(KafkaMesh(kafka_fake_broker))
+            handle = await client.agent("kagent").start("go", timeout=30)
+            step_kinds = []
+            output = None
+            async for event in handle.stream():
+                step = getattr(event, "step", None)
+                if step is not None:
+                    step_kinds.append(step.kind)
+                else:
+                    output = event.output
+            assert output == "20 30"
+            assert step_kinds.count("tool_call") == 2
+            assert step_kinds.count("tool_result") == 2
+            # the live directory read through kafka-backed views
+            cards = await client.mesh_directory.get_agents()
+            assert [c.name for c in cards] == ["kagent"]
+            await client.close()
+
+    async def test_worker_restart_resumes_on_same_group(self, kafka_fake_broker):
+        """Second worker incarnation on the same broker world serves new
+        runs — consumer groups + committed offsets survive the restart."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.mesh.kafka import KafkaMesh
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        def make_agent():
+            return Agent(
+                "phoenix", model=TestModelClient(custom_output_text="alive"),
+                description="restartable",
+            )
+
+        async with Worker([make_agent()], mesh=KafkaMesh(kafka_fake_broker),
+                          owns_transport=True):
+            client = Client.connect(KafkaMesh(kafka_fake_broker))
+            r = await client.agent("phoenix").execute("one", timeout=30)
+            assert r.output == "alive"
+            await client.close()
+
+        async with Worker([make_agent()], mesh=KafkaMesh(kafka_fake_broker),
+                          owns_transport=True):
+            client = Client.connect(KafkaMesh(kafka_fake_broker))
+            r = await client.agent("phoenix").execute("two", timeout=30)
+            assert r.output == "alive"
+            await client.close()

@@ -22,7 +22,7 @@ import uuid
 
 import pytest
 
-TRANSPORTS = ["memory", "tcp", "kafka"]
+TRANSPORTS = ["memory", "tcp", "kafka", "kafka-fake"]
 
 
 def _kafka_available() -> bool:
@@ -62,6 +62,13 @@ def transport(request, meshd_broker):
             pytest.skip("meshd not built (make -C native)")
     if kind == "kafka" and not _kafka_available():
         pytest.skip("aiokafka/broker unavailable (set CALF_TEST_KAFKA_BOOTSTRAP)")
+    fake_bootstrap = None
+    if kind == "kafka-fake":
+        # no aiokafka/broker in this image: run the REAL KafkaMesh against
+        # the in-process aiokafka fake (tests/_aiokafka_fake.py) so
+        # kafka.py's logic is executed, not just specified.  One fresh
+        # broker world per test; connections share it via the bootstrap id.
+        fake_bootstrap = request.getfixturevalue("kafka_fake_broker")
 
     async def make():
         if kind == "memory":
@@ -76,10 +83,14 @@ def transport(request, meshd_broker):
             from calfkit_tpu.mesh.tcp import TcpMesh
 
             mesh = TcpMesh("127.0.0.1:19876")
-        else:
+        elif kind == "kafka":
             from calfkit_tpu.mesh.kafka import KafkaMesh
 
             mesh = KafkaMesh(os.environ["CALF_TEST_KAFKA_BOOTSTRAP"])
+        else:
+            from calfkit_tpu.mesh.kafka import KafkaMesh
+
+            mesh = KafkaMesh(fake_bootstrap)
         await mesh.start()
         made.append(mesh)
         return mesh
