@@ -41,6 +41,8 @@ _LAZY: dict[str, str] = {
     "JaxLocalModelClient": "calfkit_tpu.inference",
     "EchoModelClient": "calfkit_tpu.engine",
     "FunctionModelClient": "calfkit_tpu.engine",
+    "OpenAIModelClient": "calfkit_tpu.providers",
+    "AnthropicModelClient": "calfkit_tpu.providers",
 }
 
 if TYPE_CHECKING:  # pragma: no cover

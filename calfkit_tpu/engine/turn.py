@@ -23,7 +23,8 @@ from calfkit_tpu.engine.model_client import (
 )
 from calfkit_tpu.engine.schema import output_tool_def
 from calfkit_tpu.models.capability import ToolDef
-from calfkit_tpu.models.error_report import ErrorReport, FaultTypes
+from calfkit_tpu.exceptions import NodeFaultError
+from calfkit_tpu.models.error_report import ErrorReport, FaultTypes, safe_str
 from calfkit_tpu.models.messages import (
     ModelMessage,
     ModelRequest,
@@ -35,6 +36,20 @@ from calfkit_tpu.models.messages import (
 from calfkit_tpu.models.node_result import extract_lenient
 
 FINAL_RESULT_TOOL = "final_result"
+
+# vendor/in-tree phrasings of "the prompt does not fit the model":
+# JaxLocalModelClient ("exceeds max_seq_len"/"exceeds long_max_prompt"),
+# OpenAI ("maximum context length"), Anthropic ("prompt is too long"),
+# generic "context window"
+_CONTEXT_OVERFLOW_MARKERS = (
+    "context window", "context length", "context_length",
+    "prompt is too long", "exceeds max_seq_len", "exceeds long_max_prompt",
+)
+
+
+def _is_context_overflow(message: str) -> bool:
+    lowered = message.lower()
+    return any(marker in lowered for marker in _CONTEXT_OVERFLOW_MARKERS)
 
 
 class TurnError(Exception):
@@ -90,7 +105,29 @@ async def run_turn(
     last_error: Exception | None = None
 
     for _attempt in range(max_output_retries + 1):
-        response = await model.request(working, settings, params)
+        try:
+            response = await model.request(working, settings, params)
+        except NodeFaultError:
+            raise
+        except Exception as exc:
+            # a backend failure is a MODEL fault, not a generic node error:
+            # the typed report lets callers/seams match on mesh.model_error
+            # (context-window overflows keep their own narrower type).
+            # safe_str: a hostile __str__ must not defeat the typed mint.
+            message = safe_str(exc)
+            error_type = (
+                FaultTypes.CONTEXT_WINDOW_EXCEEDED
+                if _is_context_overflow(message)
+                else FaultTypes.MODEL_ERROR
+            )
+            raise NodeFaultError(
+                ErrorReport.build_safe(
+                    error_type,
+                    f"model request failed ({model.model_name}): "
+                    f"{type(exc).__name__}: {message}",
+                    exc=exc,
+                )
+            ) from exc
         if author and response.author is None:
             response = response.model_copy(update={"author": author})
         usage = usage + response.usage

@@ -1,0 +1,19 @@
+"""Remote-API model clients — the reference's provider layer, rebuilt.
+
+Reference: calfkit/providers/pydantic_ai/*.py (thin sugar over the vendored
+``Model`` ABC; SURVEY.md §1 layer 4).  Here the TPU-local
+``JaxLocalModelClient`` is the DEFAULT path; these HTTP clients exist so a
+reference user migrating an OpenAI/Anthropic deployment finds the same
+providers, speaking the same :class:`calfkit_tpu.engine.ModelClient` seam.
+
+Both are httpx-based (no vendor SDKs), honor ``ModelSettings``, map tool
+calls both ways, and raise :class:`ModelAPIError` with the HTTP status and
+body on failure — which the agent turn runner converts into a typed
+``mesh.model_error`` fault.
+"""
+
+from calfkit_tpu.providers.anthropic import AnthropicModelClient
+from calfkit_tpu.providers.http import ModelAPIError
+from calfkit_tpu.providers.openai import OpenAIModelClient
+
+__all__ = ["AnthropicModelClient", "ModelAPIError", "OpenAIModelClient"]

@@ -1,0 +1,213 @@
+"""Anthropic messages-API model client (reference:
+calfkit/providers/pydantic_ai/anthropic.py — thin subclass there; a direct
+httpx client here, same ModelClient seam)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from calfkit_tpu.engine.model_client import (
+    ModelClient,
+    ModelRequestParameters,
+    ModelSettings,
+)
+from calfkit_tpu.models.messages import (
+    ModelMessage,
+    ModelRequest,
+    ModelResponse,
+    RetryPart,
+    SystemPart,
+    TextOutput,
+    ToolCallOutput,
+    ToolReturnPart,
+    Usage,
+    UserPart,
+)
+from calfkit_tpu.providers.http import (
+    ModelAPIError,
+    content_str,
+    post_json,
+)
+
+_DEFAULT_BASE_URL = "https://api.anthropic.com"
+_API_VERSION = "2023-06-01"
+_DEFAULT_MAX_TOKENS = 4096
+
+
+def render_anthropic_messages(
+    messages: list[ModelMessage],
+) -> tuple[str, list[dict]]:
+    """Our wire vocabulary → (system, messages-with-content-blocks).
+
+    Consecutive same-role messages are merged — the API requires
+    alternation and tool_result blocks must ride user messages."""
+    system_chunks: list[str] = []
+    rendered: list[dict] = []
+
+    def emit(role: str, blocks: list[dict]) -> None:
+        if not blocks:
+            return
+        if rendered and rendered[-1]["role"] == role:
+            rendered[-1]["content"].extend(blocks)
+        else:
+            rendered.append({"role": role, "content": blocks})
+
+    for message in messages:
+        if isinstance(message, ModelResponse):
+            blocks: list[dict] = []
+            text = message.text()
+            if text:
+                blocks.append({"type": "text", "text": text})
+            for call in message.tool_calls():
+                blocks.append({
+                    "type": "tool_use",
+                    "id": call.tool_call_id,
+                    "name": call.tool_name,
+                    "input": call.args_dict(),
+                })
+            emit("assistant", blocks)
+            continue
+        assert isinstance(message, ModelRequest)
+        if message.instructions:
+            system_chunks.append(message.instructions)
+        blocks = []
+        for part in message.parts:
+            if isinstance(part, SystemPart):
+                system_chunks.append(part.content)
+            elif isinstance(part, UserPart):
+                blocks.append({"type": "text", "text": content_str(part.content)})
+            elif isinstance(part, ToolReturnPart):
+                blocks.append({
+                    "type": "tool_result",
+                    "tool_use_id": part.tool_call_id,
+                    "content": [{"type": "text", "text": content_str(part.content)}],
+                })
+            elif isinstance(part, RetryPart):
+                if part.tool_call_id:
+                    blocks.append({
+                        "type": "tool_result",
+                        "tool_use_id": part.tool_call_id,
+                        "is_error": True,
+                        "content": [{"type": "text", "text": part.content}],
+                    })
+                else:
+                    blocks.append({"type": "text", "text": part.content})
+        emit("user", blocks)
+    return "\n\n".join(system_chunks), rendered
+
+
+def parse_anthropic_response(data: dict, model: str) -> ModelResponse:
+    content = data.get("content")
+    if not isinstance(content, list):
+        raise ModelAPIError(f"anthropic response missing content: {data!r}"[:500])
+    parts: list[Any] = []
+    for block in content:
+        kind = block.get("type")
+        if kind == "text" and block.get("text"):
+            parts.append(TextOutput(text=block["text"]))
+        elif kind == "tool_use":
+            parts.append(ToolCallOutput(
+                tool_call_id=block.get("id", ""),
+                tool_name=block.get("name", ""),
+                args=block.get("input") or {},
+            ))
+    usage = data.get("usage") or {}
+    return ModelResponse(
+        parts=parts,
+        usage=Usage(
+            input_tokens=usage.get("input_tokens", 0),
+            output_tokens=usage.get("output_tokens", 0),
+        ),
+        model_name=data.get("model", model),
+    )
+
+
+class AnthropicModelClient(ModelClient):
+    def __init__(
+        self,
+        model: str,
+        *,
+        api_key: str | None = None,
+        base_url: str = _DEFAULT_BASE_URL,
+        http_client: Any | None = None,
+        default_max_tokens: int = _DEFAULT_MAX_TOKENS,
+    ):
+        self._model = model
+        self._api_key = api_key or os.environ.get("ANTHROPIC_API_KEY", "")
+        self._base_url = base_url.rstrip("/")
+        self._client = http_client
+        self._owns_client = http_client is None
+        self._default_max_tokens = default_max_tokens
+
+    @property
+    def model_name(self) -> str:
+        return self._model
+
+    def _http(self) -> Any:
+        if self._client is None:
+            import httpx
+
+            self._client = httpx.AsyncClient(timeout=120.0)
+            self._owns_client = True
+        return self._client
+
+    async def aclose(self) -> None:
+        # close only the DEFAULT client this instance created; a
+        # caller-injected http_client= stays the caller's to close
+        # (it may be shared across model clients)
+        if self._client is not None and self._owns_client:
+            await self._client.aclose()
+            self._client = None
+
+    async def request(
+        self,
+        messages: list[ModelMessage],
+        settings: ModelSettings | None = None,
+        params: ModelRequestParameters | None = None,
+    ) -> ModelResponse:
+        settings = settings or ModelSettings()
+        params = params or ModelRequestParameters()
+        system, rendered = render_anthropic_messages(messages)
+        payload: dict[str, Any] = {
+            "model": self._model,
+            "messages": rendered,
+            # max_tokens is REQUIRED by the API
+            "max_tokens": settings.max_tokens or self._default_max_tokens,
+        }
+        if system:
+            payload["system"] = system
+        tools = [
+            {
+                "name": t.name,
+                "description": t.description,
+                "input_schema": t.parameters_schema,
+            }
+            for t in params.all_tools()
+        ]
+        if tools:
+            payload["tools"] = tools
+            if not params.allow_text_output:
+                payload["tool_choice"] = {"type": "any"}
+        if settings.temperature is not None:
+            payload["temperature"] = settings.temperature
+        if settings.top_p is not None:
+            payload["top_p"] = settings.top_p
+        if settings.top_k is not None:
+            payload["top_k"] = settings.top_k
+        if settings.stop_sequences:
+            payload["stop_sequences"] = settings.stop_sequences
+        payload.update(settings.extra)
+
+        data = await post_json(
+            self._http(),
+            f"{self._base_url}/v1/messages",
+            headers={
+                "x-api-key": self._api_key,
+                "anthropic-version": _API_VERSION,
+            },
+            payload=payload,
+            provider="anthropic",
+        )
+        return parse_anthropic_response(data, self._model)

@@ -1,0 +1,64 @@
+"""Shared HTTP plumbing for the remote providers."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import json
+
+from calfkit_tpu.exceptions import CalfkitError
+
+
+def content_str(content: Any) -> str:
+    """Coerce arbitrary tool-return / user content to transportable text."""
+    from calfkit_tpu.models.payload import render_parts_as_text
+
+    if isinstance(content, str):
+        return content
+    if isinstance(content, list):
+        try:
+            return render_parts_as_text(content)
+        except Exception:  # noqa: BLE001
+            return str(content)
+    try:
+        return json.dumps(content)
+    except (TypeError, ValueError):
+        return str(content)
+
+
+class ModelAPIError(CalfkitError):
+    """A remote model API failure (non-2xx or malformed payload)."""
+
+    def __init__(self, message: str, *, status: int | None = None,
+                 body: str | None = None):
+        self.status = status
+        self.body = (body or "")[:2000]
+        super().__init__(
+            f"{message}" + (f" (HTTP {status})" if status else "")
+            + (f": {self.body[:400]}" if self.body else "")
+        )
+
+
+async def post_json(
+    client: Any, url: str, *, headers: dict[str, str], payload: dict,
+    provider: str,
+) -> dict:
+    """POST and decode, normalizing every failure into ModelAPIError."""
+    import httpx
+
+    try:
+        response = await client.post(url, headers=headers, json=payload)
+    except httpx.HTTPError as exc:
+        raise ModelAPIError(f"{provider} request failed: {exc}") from exc
+    if response.status_code // 100 != 2:
+        raise ModelAPIError(
+            f"{provider} API error", status=response.status_code,
+            body=response.text,
+        )
+    try:
+        return response.json()
+    except ValueError as exc:
+        raise ModelAPIError(
+            f"{provider} returned non-JSON", status=response.status_code,
+            body=response.text,
+        ) from exc

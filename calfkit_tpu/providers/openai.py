@@ -1,0 +1,203 @@
+"""OpenAI chat-completions model client (reference:
+calfkit/providers/pydantic_ai/openai.py — there a thin subclass of the
+vendored model; here a direct httpx client speaking the same ModelClient
+seam)."""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+from calfkit_tpu.engine.model_client import (
+    ModelClient,
+    ModelRequestParameters,
+    ModelSettings,
+)
+from calfkit_tpu.models.messages import (
+    ModelMessage,
+    ModelRequest,
+    ModelResponse,
+    RetryPart,
+    SystemPart,
+    TextOutput,
+    ToolCallOutput,
+    ToolReturnPart,
+    Usage,
+    UserPart,
+)
+from calfkit_tpu.providers.http import (
+    ModelAPIError,
+    content_str,
+    post_json,
+)
+
+_DEFAULT_BASE_URL = "https://api.openai.com/v1"
+
+
+def render_openai_messages(messages: list[ModelMessage]) -> list[dict]:
+    """Our wire vocabulary → chat-completions messages."""
+    out: list[dict] = []
+    for message in messages:
+        if isinstance(message, ModelResponse):
+            entry: dict[str, Any] = {"role": "assistant"}
+            text = message.text()
+            entry["content"] = text or None
+            calls = [
+                {
+                    "id": c.tool_call_id,
+                    "type": "function",
+                    "function": {
+                        "name": c.tool_name,
+                        "arguments": (
+                            c.args
+                            if isinstance(c.args, str)
+                            else json.dumps(c.args)
+                        ),
+                    },
+                }
+                for c in message.tool_calls()
+            ]
+            if calls:
+                entry["tool_calls"] = calls
+            out.append(entry)
+            continue
+        assert isinstance(message, ModelRequest)
+        if message.instructions:
+            out.append({"role": "system", "content": message.instructions})
+        for part in message.parts:
+            if isinstance(part, SystemPart):
+                out.append({"role": "system", "content": part.content})
+            elif isinstance(part, UserPart):
+                out.append({"role": "user", "content": content_str(part.content)})
+            elif isinstance(part, ToolReturnPart):
+                out.append({
+                    "role": "tool",
+                    "tool_call_id": part.tool_call_id,
+                    "content": content_str(part.content),
+                })
+            elif isinstance(part, RetryPart):
+                if part.tool_call_id:
+                    out.append({
+                        "role": "tool",
+                        "tool_call_id": part.tool_call_id,
+                        "content": part.content,
+                    })
+                else:
+                    out.append({"role": "user", "content": part.content})
+    return out
+
+
+def parse_openai_response(data: dict, model: str) -> ModelResponse:
+    try:
+        message = data["choices"][0]["message"]
+    except (KeyError, IndexError, TypeError) as exc:
+        raise ModelAPIError(
+            f"openai response missing choices: {data!r}"[:500]
+        ) from exc
+    parts: list[Any] = []
+    if message.get("content"):
+        parts.append(TextOutput(text=message["content"]))
+    for call in message.get("tool_calls") or []:
+        function = call.get("function", {})
+        parts.append(ToolCallOutput(
+            tool_call_id=call.get("id", ""),
+            tool_name=function.get("name", ""),
+            args=function.get("arguments", "{}"),
+        ))
+    usage = data.get("usage") or {}
+    return ModelResponse(
+        parts=parts,
+        usage=Usage(
+            input_tokens=usage.get("prompt_tokens", 0),
+            output_tokens=usage.get("completion_tokens", 0),
+        ),
+        model_name=data.get("model", model),
+    )
+
+
+class OpenAIModelClient(ModelClient):
+    """Chat-completions over httpx.  ``http_client=`` injects a configured
+    ``httpx.AsyncClient`` (timeouts, proxies, MockTransport in tests)."""
+
+    def __init__(
+        self,
+        model: str,
+        *,
+        api_key: str | None = None,
+        base_url: str = _DEFAULT_BASE_URL,
+        http_client: Any | None = None,
+    ):
+        self._model = model
+        self._api_key = api_key or os.environ.get("OPENAI_API_KEY", "")
+        self._base_url = base_url.rstrip("/")
+        self._client = http_client
+        self._owns_client = http_client is None
+
+    @property
+    def model_name(self) -> str:
+        return self._model
+
+    def _http(self) -> Any:
+        if self._client is None:
+            import httpx
+
+            self._client = httpx.AsyncClient(timeout=120.0)
+            self._owns_client = True
+        return self._client
+
+    async def aclose(self) -> None:
+        # close only the DEFAULT client this instance created; a
+        # caller-injected http_client= stays the caller's to close
+        # (it may be shared across model clients)
+        if self._client is not None and self._owns_client:
+            await self._client.aclose()
+            self._client = None
+
+    async def request(
+        self,
+        messages: list[ModelMessage],
+        settings: ModelSettings | None = None,
+        params: ModelRequestParameters | None = None,
+    ) -> ModelResponse:
+        settings = settings or ModelSettings()
+        params = params or ModelRequestParameters()
+        payload: dict[str, Any] = {
+            "model": self._model,
+            "messages": render_openai_messages(messages),
+        }
+        tools = [
+            {
+                "type": "function",
+                "function": {
+                    "name": t.name,
+                    "description": t.description,
+                    "parameters": t.parameters_schema,
+                },
+            }
+            for t in params.all_tools()
+        ]
+        if tools:
+            payload["tools"] = tools
+            if not params.allow_text_output:
+                payload["tool_choice"] = "required"
+        if settings.max_tokens is not None:
+            payload["max_tokens"] = settings.max_tokens
+        if settings.temperature is not None:
+            payload["temperature"] = settings.temperature
+        if settings.top_p is not None:
+            payload["top_p"] = settings.top_p
+        if settings.seed is not None:
+            payload["seed"] = settings.seed
+        if settings.stop_sequences:
+            payload["stop"] = settings.stop_sequences
+        payload.update(settings.extra)
+
+        data = await post_json(
+            self._http(),
+            f"{self._base_url}/chat/completions",
+            headers={"Authorization": f"Bearer {self._api_key}"},
+            payload=payload,
+            provider="openai",
+        )
+        return parse_openai_response(data, self._model)

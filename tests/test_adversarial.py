@@ -177,7 +177,7 @@ class TestElisionOverTcp:
                     "y" * 3000, timeout=20
                 )
             err = exc_info.value
-            assert err.report.error_type == FaultTypes.NODE_ERROR
+            assert err.report.error_type == FaultTypes.MODEL_ERROR
             assert err.envelope is not None
             assert err.envelope.state_elided is True
             assert err.envelope.context.state.message_history == []

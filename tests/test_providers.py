@@ -1,0 +1,333 @@
+"""Remote-provider clients against httpx.MockTransport — request mapping,
+response parsing, error typing, and a full agent round trip over a mocked
+API (reference analog: the provider sugar tests + the live lane, minus the
+network)."""
+
+import json
+
+import httpx
+import pytest
+
+from calfkit_tpu.engine.model_client import ModelRequestParameters, ModelSettings
+from calfkit_tpu.models.capability import ToolDef
+from calfkit_tpu.models.messages import (
+    ModelRequest,
+    ModelResponse,
+    TextOutput,
+    ToolCallOutput,
+    ToolReturnPart,
+    UserPart,
+)
+from calfkit_tpu.providers import (
+    AnthropicModelClient,
+    ModelAPIError,
+    OpenAIModelClient,
+)
+
+TOOL = ToolDef(
+    name="lookup",
+    description="Look things up.",
+    parameters_schema={
+        "type": "object",
+        "properties": {"q": {"type": "string"}},
+        "required": ["q"],
+    },
+)
+
+
+def _openai(handler) -> OpenAIModelClient:
+    return OpenAIModelClient(
+        "gpt-test", api_key="k",
+        http_client=httpx.AsyncClient(transport=httpx.MockTransport(handler)),
+    )
+
+
+def _anthropic(handler) -> AnthropicModelClient:
+    return AnthropicModelClient(
+        "claude-test", api_key="k",
+        http_client=httpx.AsyncClient(transport=httpx.MockTransport(handler)),
+    )
+
+
+HISTORY = [
+    ModelRequest(parts=[UserPart(content="find the answer")],
+                 instructions="be brief"),
+    ModelResponse(parts=[ToolCallOutput(
+        tool_call_id="c1", tool_name="lookup", args={"q": "answer"})]),
+    ModelRequest(parts=[ToolReturnPart(
+        tool_call_id="c1", tool_name="lookup", content="42")]),
+]
+
+
+class TestOpenAI:
+    async def test_request_mapping_and_parse(self):
+        seen = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen["url"] = str(request.url)
+            seen["auth"] = request.headers["authorization"]
+            seen["payload"] = json.loads(request.content)
+            return httpx.Response(200, json={
+                "model": "gpt-test-001",
+                "choices": [{"message": {"content": "the answer is 42"}}],
+                "usage": {"prompt_tokens": 30, "completion_tokens": 6},
+            })
+
+        client = _openai(handler)
+        response = await client.request(
+            HISTORY,
+            ModelSettings(temperature=0.2, max_tokens=99, seed=7,
+                          stop_sequences=["END"]),
+            ModelRequestParameters(tool_defs=[TOOL]),
+        )
+        assert response.text() == "the answer is 42"
+        assert response.usage.input_tokens == 30
+        assert seen["auth"] == "Bearer k"
+        payload = seen["payload"]
+        assert payload["model"] == "gpt-test"
+        assert payload["temperature"] == 0.2
+        assert payload["max_tokens"] == 99
+        assert payload["seed"] == 7
+        assert payload["stop"] == ["END"]
+        assert payload["tools"][0]["function"]["name"] == "lookup"
+        roles = [m["role"] for m in payload["messages"]]
+        assert roles == ["system", "user", "assistant", "tool"]
+        assert payload["messages"][3]["tool_call_id"] == "c1"
+        # the assistant turn carried its tool call with JSON-string args
+        call = payload["messages"][2]["tool_calls"][0]
+        assert json.loads(call["function"]["arguments"]) == {"q": "answer"}
+        await client.aclose()
+
+    async def test_tool_call_response_parsed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, json={
+                "choices": [{"message": {
+                    "content": None,
+                    "tool_calls": [{
+                        "id": "x9", "type": "function",
+                        "function": {"name": "lookup",
+                                     "arguments": "{\"q\": \"hi\"}"},
+                    }],
+                }}],
+            })
+
+        client = _openai(handler)
+        response = await client.request([HISTORY[0]])
+        calls = response.tool_calls()
+        assert len(calls) == 1
+        assert calls[0].tool_call_id == "x9"
+        assert calls[0].args_dict() == {"q": "hi"}
+        await client.aclose()
+
+    async def test_structured_output_forces_tool_choice(self):
+        seen = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen["payload"] = json.loads(request.content)
+            return httpx.Response(200, json={
+                "choices": [{"message": {"content": "x"}}]})
+
+        client = _openai(handler)
+        await client.request(
+            [HISTORY[0]],
+            params=ModelRequestParameters(
+                output_tool=TOOL, allow_text_output=False
+            ),
+        )
+        assert seen["payload"]["tool_choice"] == "required"
+        await client.aclose()
+
+    async def test_http_error_is_typed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(429, text="rate limited")
+
+        client = _openai(handler)
+        with pytest.raises(ModelAPIError) as exc_info:
+            await client.request([HISTORY[0]])
+        assert exc_info.value.status == 429
+        assert "rate limited" in exc_info.value.body
+        await client.aclose()
+
+
+class TestAnthropic:
+    async def test_request_mapping_and_parse(self):
+        seen = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen["url"] = str(request.url)
+            seen["key"] = request.headers["x-api-key"]
+            seen["version"] = request.headers["anthropic-version"]
+            seen["payload"] = json.loads(request.content)
+            return httpx.Response(200, json={
+                "model": "claude-test-002",
+                "content": [{"type": "text", "text": "it is 42"}],
+                "usage": {"input_tokens": 21, "output_tokens": 4},
+            })
+
+        client = _anthropic(handler)
+        response = await client.request(
+            HISTORY,
+            ModelSettings(temperature=0.5, top_k=40),
+            ModelRequestParameters(tool_defs=[TOOL]),
+        )
+        assert response.text() == "it is 42"
+        assert response.usage.output_tokens == 4
+        assert seen["key"] == "k"
+        payload = seen["payload"]
+        assert payload["system"] == "be brief"
+        assert payload["max_tokens"] > 0  # required by the API, defaulted
+        assert payload["top_k"] == 40
+        assert payload["tools"][0]["input_schema"]["required"] == ["q"]
+        roles = [m["role"] for m in payload["messages"]]
+        assert roles == ["user", "assistant", "user"]  # tool_result merged
+        tool_result = payload["messages"][2]["content"][0]
+        assert tool_result["type"] == "tool_result"
+        assert tool_result["tool_use_id"] == "c1"
+        await client.aclose()
+
+    async def test_tool_use_parsed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, json={
+                "content": [
+                    {"type": "text", "text": "let me check"},
+                    {"type": "tool_use", "id": "t7", "name": "lookup",
+                     "input": {"q": "x"}},
+                ],
+                "usage": {"input_tokens": 1, "output_tokens": 2},
+            })
+
+        client = _anthropic(handler)
+        response = await client.request([HISTORY[0]])
+        assert response.text() == "let me check"
+        assert response.tool_calls()[0].tool_call_id == "t7"
+        await client.aclose()
+
+    async def test_error_typed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(529, text="overloaded")
+
+        client = _anthropic(handler)
+        with pytest.raises(ModelAPIError) as exc_info:
+            await client.request([HISTORY[0]])
+        assert exc_info.value.status == 529
+        await client.aclose()
+
+
+class TestProviderThroughAgent:
+    async def test_agent_round_trip_over_mocked_openai(self):
+        """The provider in its real seat: an Agent on the mesh whose model
+        is the OpenAI client; turn 1 calls a tool, turn 2 answers."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        @agent_tool
+        def lookup(q: str) -> str:
+            """L.
+
+            Args:
+                q: Q.
+            """
+            return f"result-for-{q}"
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            payload = json.loads(request.content)
+            has_tool_result = any(m["role"] == "tool" for m in payload["messages"])
+            if not has_tool_result:
+                return httpx.Response(200, json={"choices": [{"message": {
+                    "content": None,
+                    "tool_calls": [{
+                        "id": "call1", "type": "function",
+                        "function": {"name": "lookup",
+                                     "arguments": "{\"q\": \"metrics\"}"},
+                    }],
+                }}]})
+            returned = next(
+                m["content"] for m in payload["messages"] if m["role"] == "tool"
+            )
+            return httpx.Response(200, json={"choices": [{"message": {
+                "content": f"According to the tool: {returned}",
+            }}]})
+
+        model = _openai(handler)
+        agent = Agent("remote_backed", model=model, tools=[lookup])
+        mesh = InMemoryMesh()
+        async with Worker([agent, lookup], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("remote_backed").execute("go", timeout=15)
+            assert result.output == "According to the tool: result-for-metrics"
+            await client.close()
+        await model.aclose()
+
+    async def test_api_failure_surfaces_as_model_fault(self):
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.exceptions import NodeFaultError
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.models import FaultTypes
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(500, text="boom")
+
+        model = _openai(handler)
+        agent = Agent("doomed", model=model)
+        mesh = InMemoryMesh()
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            with pytest.raises(NodeFaultError) as exc_info:
+                await client.agent("doomed").execute("go", timeout=15)
+            assert exc_info.value.report.error_type == FaultTypes.MODEL_ERROR
+            assert "HTTP 500" in exc_info.value.report.message
+            await client.close()
+        await model.aclose()
+
+
+class TestModelFaultTyping:
+    async def test_context_overflow_gets_narrower_type(self):
+        """Vendor overflow phrasings classify as
+        mesh.model.context_window_exceeded, not generic model_error."""
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.engine.turn import run_turn
+        from calfkit_tpu.exceptions import NodeFaultError
+        from calfkit_tpu.models import FaultTypes
+        from calfkit_tpu.models.messages import ModelRequest, UserPart
+
+        for phrase in (
+            "This model's maximum context length is 8192 tokens",
+            "prompt is too long: 210000 tokens",
+            "prompt of 9000 tokens exceeds max_seq_len 8192",
+        ):
+            def boom(messages, params, _p=phrase):
+                raise RuntimeError(_p)
+
+            with pytest.raises(NodeFaultError) as exc_info:
+                await run_turn(
+                    FunctionModelClient(boom),
+                    [ModelRequest(parts=[UserPart(content="hi")])],
+                )
+            assert exc_info.value.report.error_type == (
+                FaultTypes.CONTEXT_WINDOW_EXCEEDED
+            ), phrase
+
+    async def test_hostile_model_exception_still_mints_typed_fault(self):
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.engine.turn import run_turn
+        from calfkit_tpu.exceptions import NodeFaultError
+        from calfkit_tpu.models import FaultTypes
+        from calfkit_tpu.models.messages import ModelRequest, UserPart
+
+        class Hostile(Exception):
+            def __str__(self):
+                raise RuntimeError("gotcha")
+
+        def boom(messages, params):
+            raise Hostile()
+
+        with pytest.raises(NodeFaultError) as exc_info:
+            await run_turn(
+                FunctionModelClient(boom),
+                [ModelRequest(parts=[UserPart(content="hi")])],
+            )
+        assert exc_info.value.report.error_type == FaultTypes.MODEL_ERROR
