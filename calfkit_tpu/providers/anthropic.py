@@ -12,6 +12,8 @@ from calfkit_tpu.engine.model_client import (
     ModelClient,
     ModelRequestParameters,
     ModelSettings,
+    ResponseDone,
+    TextDelta,
 )
 from calfkit_tpu.models.messages import (
     ModelMessage,
@@ -29,6 +31,7 @@ from calfkit_tpu.providers.http import (
     ModelAPIError,
     content_str,
     post_json,
+    sse_lines,
 )
 
 _DEFAULT_BASE_URL = "https://api.anthropic.com"
@@ -161,14 +164,12 @@ class AnthropicModelClient(ModelClient):
             await self._client.aclose()
             self._client = None
 
-    async def request(
+    def _build_payload(
         self,
         messages: list[ModelMessage],
-        settings: ModelSettings | None = None,
-        params: ModelRequestParameters | None = None,
-    ) -> ModelResponse:
-        settings = settings or ModelSettings()
-        params = params or ModelRequestParameters()
+        settings: ModelSettings,
+        params: ModelRequestParameters,
+    ) -> dict[str, Any]:
         system, rendered = render_anthropic_messages(messages)
         payload: dict[str, Any] = {
             "model": self._model,
@@ -199,15 +200,105 @@ class AnthropicModelClient(ModelClient):
         if settings.stop_sequences:
             payload["stop_sequences"] = settings.stop_sequences
         payload.update(settings.extra)
+        return payload
 
+    def _headers(self) -> dict[str, str]:
+        return {
+            "x-api-key": self._api_key,
+            "anthropic-version": _API_VERSION,
+        }
+
+    async def request(
+        self,
+        messages: list[ModelMessage],
+        settings: ModelSettings | None = None,
+        params: ModelRequestParameters | None = None,
+    ) -> ModelResponse:
+        settings = settings or ModelSettings()
+        params = params or ModelRequestParameters()
         data = await post_json(
             self._http(),
             f"{self._base_url}/v1/messages",
-            headers={
-                "x-api-key": self._api_key,
-                "anthropic-version": _API_VERSION,
-            },
-            payload=payload,
+            headers=self._headers(),
+            payload=self._build_payload(messages, settings, params),
             provider="anthropic",
         )
         return parse_anthropic_response(data, self._model)
+
+    async def request_stream(
+        self,
+        messages: list[ModelMessage],
+        settings: ModelSettings | None = None,
+        params: ModelRequestParameters | None = None,
+    ):
+        """SSE streaming: text_delta blocks yield TextDelta; tool_use
+        blocks accumulate their input_json_delta; one ResponseDone."""
+        settings = settings or ModelSettings()
+        params = params or ModelRequestParameters()
+        payload = self._build_payload(messages, settings, params)
+        payload["stream"] = True
+
+        text_chunks: list[str] = []
+        tools_by_index: dict[int, dict] = {}
+        usage = Usage()
+        model_name = self._model
+        async for data in sse_lines(
+            self._http(), f"{self._base_url}/v1/messages",
+            headers=self._headers(), payload=payload, provider="anthropic",
+        ):
+            try:
+                event = json.loads(data)
+            except ValueError:
+                continue
+            kind = event.get("type")
+            if kind == "error":
+                # mid-stream failure (e.g. overloaded_error): a truncated
+                # answer must not pass as success
+                raise ModelAPIError(
+                    f"anthropic mid-stream error: {event.get('error')}"[:500]
+                )
+            if kind == "message_start":
+                message = event.get("message") or {}
+                model_name = message.get("model", model_name)
+                start_usage = message.get("usage") or {}
+                usage = Usage(
+                    input_tokens=start_usage.get("input_tokens", 0),
+                    output_tokens=usage.output_tokens,
+                )
+            elif kind == "content_block_start":
+                block = event.get("content_block") or {}
+                if block.get("type") == "tool_use":
+                    tools_by_index[event.get("index", 0)] = {
+                        "id": block.get("id", ""),
+                        "name": block.get("name", ""),
+                        "json": "",
+                    }
+            elif kind == "content_block_delta":
+                delta = event.get("delta") or {}
+                if delta.get("type") == "text_delta" and delta.get("text"):
+                    text_chunks.append(delta["text"])
+                    yield TextDelta(delta["text"])
+                elif delta.get("type") == "input_json_delta":
+                    slot = tools_by_index.get(event.get("index", 0))
+                    if slot is not None:
+                        slot["json"] += delta.get("partial_json", "")
+            elif kind == "message_delta":
+                delta_usage = event.get("usage") or {}
+                if delta_usage.get("output_tokens"):
+                    usage = Usage(
+                        input_tokens=usage.input_tokens,
+                        output_tokens=delta_usage["output_tokens"],
+                    )
+
+        parts: list[Any] = []
+        if text_chunks:
+            parts.append(TextOutput(text="".join(text_chunks)))
+        for index in sorted(tools_by_index):
+            slot = tools_by_index[index]
+            parts.append(ToolCallOutput(
+                tool_call_id=slot["id"], tool_name=slot["name"],
+                args=slot["json"] or "{}",
+            ))
+        yield ResponseDone(ModelResponse(
+            parts=parts, usage=usage, model_name=model_name,
+        ))

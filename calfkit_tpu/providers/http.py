@@ -62,3 +62,29 @@ async def post_json(
             f"{provider} returned non-JSON", status=response.status_code,
             body=response.text,
         ) from exc
+
+
+async def sse_lines(client: Any, url: str, *, headers: dict[str, str],
+                    payload: dict, provider: str):
+    """POST with ``stream=True`` and yield SSE ``data:`` payload strings.
+
+    Normalizes transport failures and non-2xx into ModelAPIError before the
+    first yield, so callers can trust the stream once it starts."""
+    import httpx
+
+    try:
+        async with client.stream(
+            "POST", url, headers=headers, json=payload
+        ) as response:
+            if response.status_code // 100 != 2:
+                body = (await response.aread()).decode("utf-8", "replace")
+                raise ModelAPIError(
+                    f"{provider} API error", status=response.status_code,
+                    body=body,
+                )
+            async for line in response.aiter_lines():
+                line = line.strip()
+                if line.startswith("data:"):
+                    yield line[5:].strip()
+    except httpx.HTTPError as exc:
+        raise ModelAPIError(f"{provider} stream failed: {exc}") from exc

@@ -13,6 +13,8 @@ from calfkit_tpu.engine.model_client import (
     ModelClient,
     ModelRequestParameters,
     ModelSettings,
+    ResponseDone,
+    TextDelta,
 )
 from calfkit_tpu.models.messages import (
     ModelMessage,
@@ -30,6 +32,7 @@ from calfkit_tpu.providers.http import (
     ModelAPIError,
     content_str,
     post_json,
+    sse_lines,
 )
 
 _DEFAULT_BASE_URL = "https://api.openai.com/v1"
@@ -116,6 +119,19 @@ def parse_openai_response(data: dict, model: str) -> ModelResponse:
     )
 
 
+def _merge_tool_call_delta(acc: dict[int, dict], delta: dict) -> None:
+    """Accumulate a streaming tool_calls delta by index."""
+    index = delta.get("index", 0)
+    slot = acc.setdefault(index, {"id": "", "name": "", "arguments": ""})
+    if delta.get("id"):
+        slot["id"] = delta["id"]
+    function = delta.get("function") or {}
+    if function.get("name"):
+        slot["name"] += function["name"]
+    if function.get("arguments"):
+        slot["arguments"] += function["arguments"]
+
+
 class OpenAIModelClient(ModelClient):
     """Chat-completions over httpx.  ``http_client=`` injects a configured
     ``httpx.AsyncClient`` (timeouts, proxies, MockTransport in tests)."""
@@ -154,14 +170,12 @@ class OpenAIModelClient(ModelClient):
             await self._client.aclose()
             self._client = None
 
-    async def request(
+    def _build_payload(
         self,
         messages: list[ModelMessage],
-        settings: ModelSettings | None = None,
-        params: ModelRequestParameters | None = None,
-    ) -> ModelResponse:
-        settings = settings or ModelSettings()
-        params = params or ModelRequestParameters()
+        settings: ModelSettings,
+        params: ModelRequestParameters,
+    ) -> dict[str, Any]:
         payload: dict[str, Any] = {
             "model": self._model,
             "messages": render_openai_messages(messages),
@@ -192,12 +206,84 @@ class OpenAIModelClient(ModelClient):
         if settings.stop_sequences:
             payload["stop"] = settings.stop_sequences
         payload.update(settings.extra)
+        return payload
 
+    async def request(
+        self,
+        messages: list[ModelMessage],
+        settings: ModelSettings | None = None,
+        params: ModelRequestParameters | None = None,
+    ) -> ModelResponse:
+        settings = settings or ModelSettings()
+        params = params or ModelRequestParameters()
         data = await post_json(
             self._http(),
             f"{self._base_url}/chat/completions",
             headers={"Authorization": f"Bearer {self._api_key}"},
-            payload=payload,
+            payload=self._build_payload(messages, settings, params),
             provider="openai",
         )
         return parse_openai_response(data, self._model)
+
+    async def request_stream(
+        self,
+        messages: list[ModelMessage],
+        settings: ModelSettings | None = None,
+        params: ModelRequestParameters | None = None,
+    ):
+        """SSE streaming: yields TextDelta per content delta, accumulates
+        tool-call deltas by index, then one ResponseDone."""
+        settings = settings or ModelSettings()
+        params = params or ModelRequestParameters()
+        payload = self._build_payload(messages, settings, params)
+        payload["stream"] = True
+        payload["stream_options"] = {"include_usage": True}
+
+        text_chunks: list[str] = []
+        calls: dict[int, dict] = {}
+        usage = Usage()
+        model_name = self._model
+        async for data in sse_lines(
+            self._http(), f"{self._base_url}/chat/completions",
+            headers={"Authorization": f"Bearer {self._api_key}"},
+            payload=payload, provider="openai",
+        ):
+            if data == "[DONE]":
+                break
+            try:
+                event = json.loads(data)
+            except ValueError:
+                continue
+            if event.get("error"):
+                # mid-stream failure: a truncated answer must not pass as
+                # success (the non-streaming path raises for this state)
+                raise ModelAPIError(
+                    f"openai mid-stream error: {event['error']}"[:500]
+                )
+            model_name = event.get("model", model_name)
+            if event.get("usage"):
+                usage = Usage(
+                    input_tokens=event["usage"].get("prompt_tokens", 0),
+                    output_tokens=event["usage"].get("completion_tokens", 0),
+                )
+            for choice in event.get("choices") or []:
+                delta = choice.get("delta") or {}
+                if delta.get("content"):
+                    text_chunks.append(delta["content"])
+                    yield TextDelta(delta["content"])
+                for call_delta in delta.get("tool_calls") or []:
+                    _merge_tool_call_delta(calls, call_delta)
+
+        parts: list[Any] = []
+        if text_chunks:
+            parts.append(TextOutput(text="".join(text_chunks)))
+        for index in sorted(calls):
+            slot = calls[index]
+            parts.append(ToolCallOutput(
+                tool_call_id=slot["id"], tool_name=slot["name"],
+                args=slot["arguments"] or "{}",
+            ))
+        yield ResponseDone(ModelResponse(
+            parts=parts, usage=usage, model_name=model_name,
+        ))
+

@@ -331,3 +331,158 @@ class TestModelFaultTyping:
                 [ModelRequest(parts=[UserPart(content="hi")])],
             )
         assert exc_info.value.report.error_type == FaultTypes.MODEL_ERROR
+
+
+class TestStreaming:
+    async def test_openai_sse_stream(self):
+        sse = (
+            'data: {"model":"gpt-s","choices":[{"delta":{"content":"Hel"}}]}\n\n'
+            'data: {"choices":[{"delta":{"content":"lo"}}]}\n\n'
+            'data: {"choices":[{"delta":{"tool_calls":[{"index":0,"id":"c5",'
+            '"function":{"name":"lookup","arguments":"{\\"q\\""}}]}}]}\n\n'
+            'data: {"choices":[{"delta":{"tool_calls":[{"index":0,'
+            '"function":{"arguments":": \\"x\\"}"}}]}}]}\n\n'
+            'data: {"usage":{"prompt_tokens":9,"completion_tokens":4},'
+            '"choices":[]}\n\n'
+            "data: [DONE]\n\n"
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            assert json.loads(request.content)["stream"] is True
+            return httpx.Response(
+                200, text=sse, headers={"content-type": "text/event-stream"}
+            )
+
+        from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
+
+        client = _openai(handler)
+        events = [e async for e in client.request_stream([HISTORY[0]])]
+        deltas = [e.text for e in events if isinstance(e, TextDelta)]
+        assert deltas == ["Hel", "lo"]
+        done = events[-1]
+        assert isinstance(done, ResponseDone)
+        assert done.response.text() == "Hello"
+        calls = done.response.tool_calls()
+        assert calls[0].tool_call_id == "c5"
+        assert calls[0].args_dict() == {"q": "x"}
+        assert done.response.usage.input_tokens == 9
+        await client.aclose()
+
+    async def test_anthropic_sse_stream(self):
+        sse = (
+            'data: {"type":"message_start","message":{"model":"claude-s",'
+            '"usage":{"input_tokens":12}}}\n\n'
+            'data: {"type":"content_block_delta","index":0,'
+            '"delta":{"type":"text_delta","text":"Hi "}}\n\n'
+            'data: {"type":"content_block_delta","index":0,'
+            '"delta":{"type":"text_delta","text":"there"}}\n\n'
+            'data: {"type":"content_block_start","index":1,'
+            '"content_block":{"type":"tool_use","id":"t3","name":"lookup"}}\n\n'
+            'data: {"type":"content_block_delta","index":1,'
+            '"delta":{"type":"input_json_delta","partial_json":"{\\"q\\": "}}\n\n'
+            'data: {"type":"content_block_delta","index":1,'
+            '"delta":{"type":"input_json_delta","partial_json":"\\"y\\"}"}}\n\n'
+            'data: {"type":"message_delta","usage":{"output_tokens":7}}\n\n'
+            'data: {"type":"message_stop"}\n\n'
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(
+                200, text=sse, headers={"content-type": "text/event-stream"}
+            )
+
+        from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
+
+        client = _anthropic(handler)
+        events = [e async for e in client.request_stream([HISTORY[0]])]
+        assert [e.text for e in events if isinstance(e, TextDelta)] == [
+            "Hi ", "there",
+        ]
+        done = events[-1]
+        assert isinstance(done, ResponseDone)
+        assert done.response.text() == "Hi there"
+        assert done.response.tool_calls()[0].args_dict() == {"q": "y"}
+        assert done.response.usage.input_tokens == 12
+        assert done.response.usage.output_tokens == 7
+        await client.aclose()
+
+    async def test_stream_error_before_first_token_is_typed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(503, text="down")
+
+        client = _openai(handler)
+        with pytest.raises(ModelAPIError) as exc_info:
+            async for _ in client.request_stream([HISTORY[0]]):
+                pass
+        assert exc_info.value.status == 503
+        await client.aclose()
+
+    async def test_agent_streams_tokens_from_remote_provider(self):
+        """stream_tokens=True + a streaming remote model: TokenSteps arrive
+        on the run's step stream before the terminal result."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        sse = (
+            'data: {"choices":[{"delta":{"content":"str"}}]}\n\n'
+            'data: {"choices":[{"delta":{"content":"eamed"}}]}\n\n'
+            "data: [DONE]\n\n"
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(
+                200, text=sse, headers={"content-type": "text/event-stream"}
+            )
+
+        model = _openai(handler)
+        agent = Agent("streamy", model=model, stream_tokens=True)
+        mesh = InMemoryMesh()
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            handle = await client.agent("streamy").start("go", timeout=15)
+            token_text, output = [], None
+            async for event in handle.stream():
+                step = getattr(event, "step", None)
+                if step is not None and step.kind == "token":
+                    token_text.append(step.text)
+                elif step is None:
+                    output = event.output
+            assert output == "streamed"
+            assert "".join(token_text) == "streamed"
+            await client.close()
+        await model.aclose()
+
+
+class TestStreamMidFailure:
+    async def test_openai_midstream_error_raises(self):
+        sse = (
+            'data: {"choices":[{"delta":{"content":"par"}}]}\n\n'
+            'data: {"error":{"message":"server exploded"}}\n\n'
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        client = _openai(handler)
+        with pytest.raises(ModelAPIError, match="mid-stream"):
+            async for _ in client.request_stream([HISTORY[0]]):
+                pass
+        await client.aclose()
+
+    async def test_anthropic_midstream_error_raises(self):
+        sse = (
+            'data: {"type":"content_block_delta","index":0,'
+            '"delta":{"type":"text_delta","text":"par"}}\n\n'
+            'data: {"type":"error","error":{"type":"overloaded_error"}}\n\n'
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        client = _anthropic(handler)
+        with pytest.raises(ModelAPIError, match="overloaded"):
+            async for _ in client.request_stream([HISTORY[0]]):
+                pass
+        await client.aclose()
