@@ -129,6 +129,8 @@ async def run() -> dict:
     stats.decode_time_s = 0.0
     stats.decode_dispatches = 0
     stats.occupancy_sum = 0.0
+    stats.occupancy_hist = [0, 0, 0, 0]
+    stats.short_dispatches = 0
 
     async def one(i: int) -> int:
         n = 0
@@ -164,6 +166,9 @@ async def run() -> dict:
         "detail": {
             "decode_only_tok_s_per_chip": round(decode_tps, 1),
             "mean_batch_occupancy": round(stats.mean_occupancy, 3),
+            # dispatch counts per occupancy quartile [0-25%, .., 75-100%]
+            "occupancy_hist": list(stats.occupancy_hist),
+            "short_dispatches": stats.short_dispatches,
             "p50_mesh_to_first_token_ms": ttft_p50_ms,
             **({"ttft_error": ttft_error} if ttft_error else {}),
             "requests": cfg["requests"],

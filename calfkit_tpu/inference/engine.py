@@ -113,6 +113,12 @@ class EngineStats:
     decode_dispatches: int = 0
     decode_time_s: float = 0.0
     occupancy_sum: float = 0.0
+    # occupancy distribution: dispatch counts per quartile of max_batch_size
+    # (diagnoses WHERE a low mean comes from: ramp-up, tail, or admission
+    # starvation — the round-2 bench's 0.365 mean needs this split)
+    occupancy_hist: list = field(default_factory=lambda: [0, 0, 0, 0])
+    # dispatch lengths actually used (adaptive shortening visibility)
+    short_dispatches: int = 0
     long_requests: int = 0  # served via the sequence-parallel lane
     long_dispatches: int = 0  # sp-lane decode dispatches (whole-mesh units)
 
@@ -1337,7 +1343,11 @@ class InferenceEngine:
         n_active = len(self._active)
         self.stats.decode_dispatches += 1
         self.stats.decode_time_s += elapsed
-        self.stats.occupancy_sum += n_active / self.runtime.max_batch_size
+        occupancy = n_active / self.runtime.max_batch_size
+        self.stats.occupancy_sum += occupancy
+        self.stats.occupancy_hist[min(3, int(occupancy * 4))] += 1
+        if steps < self.runtime.decode_steps_per_dispatch:
+            self.stats.short_dispatches += 1
         for slot, request in list(self._active.items()):
             for step_tokens in block:
                 self._emit(request, int(step_tokens[slot]))
