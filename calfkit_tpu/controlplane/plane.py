@@ -144,7 +144,7 @@ class ControlPlane:
             worker.resources.setdefault(AGENTS_VIEW_KEY, agents_view)
 
             publisher = ControlPlanePublisher(transport, adverts, config)
-            await publisher.start()  # fail-loud first adverts
+            await publisher.start(ensure=ensure)  # fail-loud first adverts
         except BaseException:
             for view in started:
                 try:

@@ -80,9 +80,10 @@ class ControlPlanePublisher:
             record=advert.current_payload(),
         )
 
-    async def start(self) -> None:
-        topics = sorted(self._writers)
-        await self._transport.ensure_topics(topics, compacted=True)
+    async def start(self, *, ensure: bool = True) -> None:
+        if ensure:  # False when the worker's provisioner owns topic admin
+            topics = sorted(self._writers)
+            await self._transport.ensure_topics(topics, compacted=True)
         # first adverts: fail-loud
         for advert in self._adverts:
             await self._writers[advert.topic].put(

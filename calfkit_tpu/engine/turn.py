@@ -46,9 +46,45 @@ _CONTEXT_OVERFLOW_MARKERS = (
     "prompt is too long", "exceeds max_seq_len", "exceeds long_max_prompt",
 )
 
+# providers' STRUCTURED error codes for "prompt does not fit"
+_CONTEXT_OVERFLOW_CODES = frozenset({
+    "context_length_exceeded",        # OpenAI error.code
+    "context_window_exceeded",
+})
 
-def _is_context_overflow(message: str) -> bool:
-    lowered = message.lower()
+# GENERIC request-rejected spellings (Anthropic, OpenAI-compatible proxies/
+# SGLang/vLLM) that say nothing about WHY — these fall through to the
+# message heuristic.  Any other specific code is authoritative non-overflow.
+_GENERIC_ERROR_CODES = frozenset({
+    "invalid_request_error", "badrequesterror", "bad_request",
+    "invalid_request", "bad_request_error",
+})
+
+
+def _is_context_overflow(exc: BaseException, message: str) -> bool:
+    """Classify by the provider's structured error fields first; substring
+    matching is only a fallback (the raw text can include an echoed HTTP
+    body, and user text saying 'context window' must not flip the fault
+    type).  A SPECIFIC structured code that is not an overflow code is
+    authoritative non-overflow; generic request-rejected codes (Anthropic's
+    overflow spelling carries no dedicated code; compat backends use bare
+    BadRequestError) fall through to the provider's own message field."""
+    code = getattr(exc, "error_code", None)
+    if isinstance(code, str):
+        lc = code.lower()
+        # exact overflow codes, plus proxy class-name spellings like
+        # ContextWindowExceededError
+        if lc in _CONTEXT_OVERFLOW_CODES or (
+            "context" in lc and ("exceed" in lc or "length" in lc)
+        ):
+            return True
+        if lc not in _GENERIC_ERROR_CODES:
+            return False
+    api_message = getattr(exc, "error_message", None)
+    if isinstance(api_message, str):
+        lowered = api_message.lower()
+    else:
+        lowered = message.lower()
     return any(marker in lowered for marker in _CONTEXT_OVERFLOW_MARKERS)
 
 
@@ -117,7 +153,7 @@ async def run_turn(
             message = safe_str(exc)
             error_type = (
                 FaultTypes.CONTEXT_WINDOW_EXCEEDED
-                if _is_context_overflow(message)
+                if _is_context_overflow(exc, message)
                 else FaultTypes.MODEL_ERROR
             )
             raise NodeFaultError(

@@ -242,6 +242,7 @@ class AnthropicModelClient(ModelClient):
         tools_by_index: dict[int, dict] = {}
         usage = Usage()
         model_name = self._model
+        terminated = False
         async for data in sse_lines(
             self._http(), f"{self._base_url}/v1/messages",
             headers=self._headers(), payload=payload, provider="anthropic",
@@ -289,6 +290,16 @@ class AnthropicModelClient(ModelClient):
                         input_tokens=usage.input_tokens,
                         output_tokens=delta_usage["output_tokens"],
                     )
+            elif kind == "message_stop":
+                terminated = True
+
+        if not terminated:
+            # a clean close without message_stop means the answer may be
+            # truncated — that must not pass as success
+            raise ModelAPIError(
+                "anthropic stream closed without message_stop "
+                "(response may be truncated)"
+            )
 
         parts: list[Any] = []
         if text_chunks:

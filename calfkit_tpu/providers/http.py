@@ -27,16 +27,47 @@ def content_str(content: Any) -> str:
 
 
 class ModelAPIError(CalfkitError):
-    """A remote model API failure (non-2xx or malformed payload)."""
+    """A remote model API failure (non-2xx or malformed payload).
+
+    ``error_code`` / ``error_message`` carry the provider's STRUCTURED error
+    fields (OpenAI ``error.code``/``error.type``, Anthropic
+    ``error.type``/``error.message``) when the body parsed — classification
+    downstream (engine/turn.py) prefers these over substring-matching the
+    raw body, which can echo user text."""
 
     def __init__(self, message: str, *, status: int | None = None,
                  body: str | None = None):
         self.status = status
+        # parse the UNTRUNCATED body (truncation would cut the JSON and
+        # silently demote classification to the substring fallback), then
+        # truncate for storage
+        self.error_code, self.error_message = _parse_error_fields(body or "")
         self.body = (body or "")[:2000]
         super().__init__(
             f"{message}" + (f" (HTTP {status})" if status else "")
             + (f": {self.body[:400]}" if self.body else "")
         )
+
+
+def _parse_error_fields(body: str) -> tuple[str | None, str | None]:
+    """Extract (code-or-type, provider message) from a JSON error body."""
+    if not body:
+        return None, None
+    try:
+        data = json.loads(body)
+    except ValueError:
+        return None, None
+    err = data.get("error") if isinstance(data, dict) else None
+    if not isinstance(err, dict):
+        return None, None
+    # first STRING among code/type — some backends put an int HTTP status in
+    # 'code', which must not shadow a usable string 'type'
+    code = next(
+        (v for v in (err.get("code"), err.get("type")) if isinstance(v, str)),
+        None,
+    )
+    msg = err.get("message")
+    return code, msg if isinstance(msg, str) else None
 
 
 async def post_json(

@@ -120,8 +120,24 @@ def parse_openai_response(data: dict, model: str) -> ModelResponse:
 
 
 def _merge_tool_call_delta(acc: dict[int, dict], delta: dict) -> None:
-    """Accumulate a streaming tool_calls delta by index."""
-    index = delta.get("index", 0)
+    """Accumulate a streaming tool_calls delta by index.
+
+    Compatible backends sometimes omit ``index``; defaulting it to 0 would
+    merge distinct parallel calls into one slot (concatenated names/args).
+    Fallback order: match by call id, else continue the latest open slot,
+    else open a fresh one.
+    """
+    index = delta.get("index")
+    if index is None:
+        call_id = delta.get("id") or ""
+        if call_id:
+            index = next(
+                (k for k, s in acc.items() if s["id"] == call_id), None
+            )
+        else:
+            index = max(acc, default=None)
+        if index is None:
+            index = max(acc, default=-1) + 1
     slot = acc.setdefault(index, {"id": "", "name": "", "arguments": ""})
     if delta.get("id"):
         slot["id"] = delta["id"]
@@ -143,12 +159,32 @@ class OpenAIModelClient(ModelClient):
         api_key: str | None = None,
         base_url: str = _DEFAULT_BASE_URL,
         http_client: Any | None = None,
+        max_tokens_param: str = "auto",
     ):
+        if max_tokens_param not in ("auto", "max_tokens", "max_completion_tokens"):
+            raise ValueError(
+                "max_tokens_param must be 'auto', 'max_tokens' or "
+                f"'max_completion_tokens', got {max_tokens_param!r}"
+            )
         self._model = model
         self._api_key = api_key or os.environ.get("OPENAI_API_KEY", "")
         self._base_url = base_url.rstrip("/")
         self._client = http_client
         self._owns_client = http_client is None
+        self._max_tokens_param = max_tokens_param
+
+    # reasoning-model families reject the legacy ``max_tokens`` spelling in
+    # favor of ``max_completion_tokens``; OpenAI-compatible third-party
+    # backends mostly only know the legacy one, so 'auto' decides by model
+    # name and the constructor knob / settings.extra override it
+    _REASONING_PREFIXES = ("o1", "o3", "o4", "gpt-5")
+
+    def _max_tokens_key(self) -> str:
+        if self._max_tokens_param != "auto":
+            return self._max_tokens_param
+        if self._model.lower().startswith(self._REASONING_PREFIXES):
+            return "max_completion_tokens"
+        return "max_tokens"
 
     @property
     def model_name(self) -> str:
@@ -196,7 +232,7 @@ class OpenAIModelClient(ModelClient):
             if not params.allow_text_output:
                 payload["tool_choice"] = "required"
         if settings.max_tokens is not None:
-            payload["max_tokens"] = settings.max_tokens
+            payload[self._max_tokens_key()] = settings.max_tokens
         if settings.temperature is not None:
             payload["temperature"] = settings.temperature
         if settings.top_p is not None:
@@ -206,6 +242,12 @@ class OpenAIModelClient(ModelClient):
         if settings.stop_sequences:
             payload["stop"] = settings.stop_sequences
         payload.update(settings.extra)
+        # an explicit key in settings.extra wins outright — never send both
+        # spellings (the API rejects the pair)
+        if "max_completion_tokens" in settings.extra:
+            payload.pop("max_tokens", None)
+        elif "max_tokens" in settings.extra:
+            payload.pop("max_completion_tokens", None)
         return payload
 
     async def request(
@@ -243,12 +285,14 @@ class OpenAIModelClient(ModelClient):
         calls: dict[int, dict] = {}
         usage = Usage()
         model_name = self._model
+        terminated = False
         async for data in sse_lines(
             self._http(), f"{self._base_url}/chat/completions",
             headers={"Authorization": f"Bearer {self._api_key}"},
             payload=payload, provider="openai",
         ):
             if data == "[DONE]":
+                terminated = True
                 break
             try:
                 event = json.loads(data)
@@ -273,6 +317,14 @@ class OpenAIModelClient(ModelClient):
                     yield TextDelta(delta["content"])
                 for call_delta in delta.get("tool_calls") or []:
                     _merge_tool_call_delta(calls, call_delta)
+
+        if not terminated:
+            # a clean TCP close without the [DONE] sentinel means the answer
+            # may be truncated — that must not pass as success
+            raise ModelAPIError(
+                "openai stream closed without the [DONE] sentinel "
+                "(response may be truncated)"
+            )
 
         parts: list[Any] = []
         if text_chunks:

@@ -127,10 +127,14 @@ class Worker(LifecycleHookMixin):
         from calfkit_tpu.provisioning import ProvisioningConfig, provision
 
         await provision(self.mesh, self.nodes, self.provisioning_config)
-        # when the provisioner covered the framework tables, downstream
-        # starters skip their own ensure (no redundant admin round-trips)
+        # downstream starters (fan-out store, control plane) skip their own
+        # ensure_topics when the provisioner covered the framework tables —
+        # AND when provisioning is disabled outright: enabled=False is the
+        # operator saying "topics pre-exist; issue no admin round-trips at
+        # all" (e.g. an ACL-restricted cluster), and a raw ensure here would
+        # bypass the provisioner's unauthorized/retry classification
         prov = self.provisioning_config or ProvisioningConfig()
-        framework_provisioned = prov.enabled and prov.include_framework
+        ensure_framework = prov.enabled and not prov.include_framework
 
         for node in self.nodes:
             node.bind(self.mesh)
@@ -141,7 +145,7 @@ class Worker(LifecycleHookMixin):
                 store = KtablesFanoutBatchStore(
                     self.mesh, node.node_id, self.fanout_config
                 )
-                await store.start(ensure=not framework_provisioned)
+                await store.start(ensure=ensure_framework)
                 self._stores.append(store)
                 node.resources[FANOUT_STORE_KEY] = store
 
@@ -156,7 +160,7 @@ class Worker(LifecycleHookMixin):
         # in the boot window must already find its views
         if self.control_plane is not None:
             self._advertiser = await self.control_plane.attach(
-                self, ensure=not framework_provisioned
+                self, ensure=ensure_framework
             )
 
         for node in self.nodes:

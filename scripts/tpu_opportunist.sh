@@ -3,6 +3,9 @@
 # This watcher probes it on a cadence and, whenever it is alive, burns down
 # a queue of hardware jobs exactly once each, logging to tpu_results/.
 # Safe to re-run: finished jobs leave a .done stamp and are skipped.
+#
+# Round-3 change (VERDICT #1): each finished job COMMITS its artifacts
+# immediately — a mid-round capture must survive a wedged round-end.
 
 set -u
 cd "$(dirname "$0")/.."
@@ -17,6 +20,21 @@ print('alive:', d)
 " >/dev/null 2>&1
 }
 
+commit_results() {  # $1 = job name; commit ONLY the hardware artifacts
+  local name="$1" err=""
+  for attempt in 1 2 3; do
+    if err=$(git add -A tpu_results BENCH_TPU_CACHE.json 2>&1 \
+       && git commit -q -m "tpu: ${name} results captured" \
+            -- tpu_results BENCH_TPU_CACHE.json 2>&1); then
+      echo "[opportunist] $(date -u +%H:%M:%S) $name committed" >> tpu_results/watcher.log
+      return 0
+    fi
+    sleep 7  # index.lock contention with the builder's own commits
+  done
+  echo "[opportunist] $(date -u +%H:%M:%S) $name commit FAILED: ${err}" >> tpu_results/watcher.log
+  return 1
+}
+
 run_job() {  # $1 = name, $2... = command
   local name="$1"; shift
   [ -f "tpu_results/$name.done" ] && return 0
@@ -24,8 +42,13 @@ run_job() {  # $1 = name, $2... = command
   if timeout "${JOB_TIMEOUT:-3600}" "$@" > "tpu_results/$name.out" 2> "tpu_results/$name.err"; then
     touch "tpu_results/$name.done"
     echo "[opportunist] $(date -u +%H:%M:%S) $name OK" >> tpu_results/watcher.log
+    commit_results "$name" || true
   else
     echo "[opportunist] $(date -u +%H:%M:%S) $name FAILED rc=$?" >> tpu_results/watcher.log
+    # raw .err streams are gitignored (can be huge); commit a bounded tail
+    # so the failure diagnostics survive a wedged round-end too
+    tail -c 100000 "tpu_results/$name.err" > "tpu_results/$name.err.tail" 2>/dev/null
+    commit_results "$name-failed" || true
     return 1
   fi
 }
@@ -51,6 +74,6 @@ while ! all_done; do
     echo "[opportunist] $(date -u +%H:%M:%S) chip wedged" >> tpu_results/watcher.log
   fi
   all_done && break
-  sleep "${PROBE_INTERVAL:-600}"
+  sleep "${PROBE_INTERVAL:-300}"
 done
 echo "[opportunist] $(date -u +%H:%M:%S) all jobs done" >> tpu_results/watcher.log

@@ -486,3 +486,177 @@ class TestStreamMidFailure:
             async for _ in client.request_stream([HISTORY[0]]):
                 pass
         await client.aclose()
+
+
+class TestAdviceRound2Fixes:
+    """Pins for the round-2 advisor findings (ADVICE.md r2)."""
+
+    async def test_reasoning_models_get_max_completion_tokens(self):
+        """o-series / gpt-5 reject the legacy max_tokens spelling."""
+        seen = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen.update(json.loads(request.content))
+            return httpx.Response(200, json={
+                "choices": [{"message": {"content": "ok"}}],
+            })
+
+        client = OpenAIModelClient(
+            "o3-mini", api_key="k",
+            http_client=httpx.AsyncClient(
+                transport=httpx.MockTransport(handler)),
+        )
+        await client.request(
+            [HISTORY[0]], settings=ModelSettings(max_tokens=77))
+        assert seen["max_completion_tokens"] == 77
+        assert "max_tokens" not in seen
+        await client.aclose()
+
+    async def test_legacy_models_keep_max_tokens(self):
+        seen = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen.update(json.loads(request.content))
+            return httpx.Response(200, json={
+                "choices": [{"message": {"content": "ok"}}],
+            })
+
+        client = _openai(handler)  # model name "gpt-test"
+        await client.request(
+            [HISTORY[0]], settings=ModelSettings(max_tokens=55))
+        assert seen["max_tokens"] == 55
+        assert "max_completion_tokens" not in seen
+        await client.aclose()
+
+    async def test_extra_override_never_sends_both_spellings(self):
+        seen = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen.update(json.loads(request.content))
+            return httpx.Response(200, json={
+                "choices": [{"message": {"content": "ok"}}],
+            })
+
+        client = _openai(handler)
+        await client.request(
+            [HISTORY[0]],
+            settings=ModelSettings(
+                max_tokens=55, extra={"max_completion_tokens": 99}),
+        )
+        assert seen["max_completion_tokens"] == 99
+        assert "max_tokens" not in seen
+        await client.aclose()
+
+    async def test_openai_stream_without_done_sentinel_raises(self):
+        """A clean TCP close without [DONE] may hide truncation."""
+        sse = 'data: {"choices":[{"delta":{"content":"par"}}]}\n\n'
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        client = _openai(handler)
+        with pytest.raises(ModelAPIError, match=r"without the \[DONE\]"):
+            async for _ in client.request_stream([HISTORY[0]]):
+                pass
+        await client.aclose()
+
+    async def test_anthropic_stream_without_message_stop_raises(self):
+        sse = (
+            'data: {"type":"content_block_delta","index":0,'
+            '"delta":{"type":"text_delta","text":"par"}}\n\n'
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        client = _anthropic(handler)
+        with pytest.raises(ModelAPIError, match="without message_stop"):
+            async for _ in client.request_stream([HISTORY[0]]):
+                pass
+        await client.aclose()
+
+    async def test_indexless_parallel_tool_deltas_stay_distinct(self):
+        """Backends that omit 'index' must not merge two parallel calls
+        into one slot; correlation falls back to the call id."""
+        sse = (
+            'data: {"choices":[{"delta":{"tool_calls":[{"id":"a1",'
+            '"function":{"name":"lookup","arguments":"{\\"q\\""}}]}}]}\n\n'
+            'data: {"choices":[{"delta":{"tool_calls":[{"id":"b2",'
+            '"function":{"name":"lookup","arguments":"{\\"q\\""}}]}}]}\n\n'
+            'data: {"choices":[{"delta":{"tool_calls":[{"id":"a1",'
+            '"function":{"arguments":": \\"x\\"}"}}]}}]}\n\n'
+            'data: {"choices":[{"delta":{"tool_calls":[{"id":"b2",'
+            '"function":{"arguments":": \\"y\\"}"}}]}}]}\n\n'
+            "data: [DONE]\n\n"
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        from calfkit_tpu.engine.model_client import ResponseDone
+
+        client = _openai(handler)
+        events = [e async for e in client.request_stream([HISTORY[0]])]
+        done = events[-1]
+        assert isinstance(done, ResponseDone)
+        calls = done.response.tool_calls()
+        assert len(calls) == 2
+        by_id = {c.tool_call_id: c.args_dict() for c in calls}
+        assert by_id == {"a1": {"q": "x"}, "b2": {"q": "y"}}
+        await client.aclose()
+
+    async def test_structured_overflow_code_wins_over_body_echo(self):
+        """Classification prefers the provider's structured error fields:
+        a 400 whose body ECHOES user text saying 'context window' but whose
+        error.code is unrelated stays MODEL_ERROR; a structured
+        context_length_exceeded code flips to CONTEXT_WINDOW_EXCEEDED."""
+        from calfkit_tpu.engine.turn import run_turn
+        from calfkit_tpu.exceptions import NodeFaultError
+        from calfkit_tpu.models import FaultTypes
+        from calfkit_tpu.models.messages import ModelRequest, UserPart
+
+        async def run_with(body: dict) -> str:
+            def handler(request: httpx.Request) -> httpx.Response:
+                return httpx.Response(400, json=body)
+
+            client = _openai(handler)
+            try:
+                with pytest.raises(NodeFaultError) as exc_info:
+                    await run_turn(
+                        client,
+                        [ModelRequest(parts=[UserPart(content="hi")])],
+                    )
+                return exc_info.value.report.error_type
+            finally:
+                await client.aclose()
+
+        echoed = await run_with({"error": {
+            "code": "invalid_value",
+            "message": "invalid 'metadata' near: 'my context window essay'",
+        }})
+        assert echoed == FaultTypes.MODEL_ERROR
+
+        real = await run_with({"error": {
+            "code": "context_length_exceeded",
+            "message": "This model's maximum context length is 128 tokens.",
+        }})
+        assert real == FaultTypes.CONTEXT_WINDOW_EXCEEDED
+
+    async def test_proxy_camelcase_overflow_code_classifies(self):
+        """LiteLLM-style ContextWindowExceededError class-name codes and
+        >2000-char error bodies must both still classify as overflow
+        (structured fields are parsed from the UNTRUNCATED body)."""
+        from calfkit_tpu.engine.turn import _is_context_overflow
+
+        camel = ModelAPIError("x", status=400, body=json.dumps({
+            "error": {"type": "ContextWindowExceededError",
+                      "message": "too big"},
+        }))
+        assert _is_context_overflow(camel, str(camel))
+
+        big = ModelAPIError("x", status=400, body=json.dumps({
+            "error": {"code": "context_length_exceeded",
+                      "message": "m" * 3000},
+        }))
+        assert big.error_code == "context_length_exceeded"
+        assert _is_context_overflow(big, str(big))

@@ -87,3 +87,26 @@ class TestProvisionSurface:
         )
         assert result["plain"] and result["compacted"] == []
         await mesh.stop()
+
+    async def test_disabled_suppresses_all_admin_round_trips(self):
+        """enabled=False means NO ensure_topics from anywhere in worker
+        boot — not just the provisioner: the fan-out store and control
+        plane must not sneak their own ensure past the operator's choice
+        (ADVICE r2: pre-created topics on an ACL-restricted cluster)."""
+        from calfkit_tpu.worker import Worker
+
+        calls = []
+
+        class Spy(InMemoryMesh):
+            async def ensure_topics(self, names, *, compacted=False):
+                calls.append(list(names))
+                await super().ensure_topics(names, compacted=compacted)
+
+        mesh = Spy()
+        agent = Agent("quiet", model=EchoModelClient())
+        async with Worker(
+            [agent], mesh=mesh, owns_transport=True,
+            provisioning=ProvisioningConfig(enabled=False),
+        ):
+            pass
+        assert calls == []
