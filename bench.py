@@ -37,10 +37,11 @@ def _bench_config():
     if choice == "auto":
         choice = "smoke" if platform == "cpu" else "tinyllama"
     if choice == "smoke":
-        # offline smoke mode: tiny model, tiny workload
+        # offline smoke mode: tiny model, small workload (requests = 4x bs
+        # so even the fallback number reflects steady-state batching)
         return dict(
             preset="debug", bs=8, max_seq=256, prefill_chunk=32,
-            steps=8, requests=8, new_tokens=32, prompt_len=16,
+            steps=8, requests=32, new_tokens=32, prompt_len=16,
         )
     if choice == "llama8b":
         # BASELINE north star shape: Llama-3-8B, int8 weights (~8 GB),
@@ -48,16 +49,21 @@ def _bench_config():
         # int8-shaped params built host-side (no checkpoint in image)
         return dict(
             preset="llama-3-8b", bs=32, max_seq=1024, prefill_chunk=128,
-            steps=32, requests=40, new_tokens=128, prompt_len=64,
+            steps=32, requests=128, new_tokens=128, prompt_len=64,
             quantization="int8", kv_layout="paged", random_quantized=True,
             # 32 slots x 4 pages reserve (64+128+1 tokens) + headroom
             num_kv_pages=32 * 4 + 65,
         )
     return dict(
-        # requests > bs: the measured region exercises real continuous
-        # batching (admission churn + slot reuse), not a static batch
+        # requests = 4x bs so the measured region is steady-state-dominated
+        # real continuous batching (admission churn + slot reuse).  The
+        # round-2 number used requests=72 at bs=64: the 8-request tail plus
+        # ramp put a third of the dispatches in the bottom occupancy
+        # quartile (mean occupancy 0.365 on TPU, 0.68 in the CPU replay) —
+        # a measurement-window artifact, not engine starvation.  At 4x bs
+        # the same engine measures occupancy 1.0 and ~3x the wall tok/s.
         preset="tinyllama-1.1b", bs=64, max_seq=1024, prefill_chunk=128,
-        steps=32, requests=72, new_tokens=128, prompt_len=64,
+        steps=32, requests=256, new_tokens=128, prompt_len=64,
         quantization="int8",  # weight-only: halves the decode HBM stream
     )
 
@@ -144,15 +150,22 @@ async def run() -> dict:
     started = time.perf_counter()
     counts = await asyncio.gather(*[one(i) for i in range(cfg["requests"])])
     wall = time.perf_counter() - started
+    # snapshot throughput-phase stats NOW: the TTFT phase below pushes 12
+    # deliberately single-stream requests through the same engine, and its
+    # occ=1/bs dispatches must not pollute the batching metrics (this was
+    # a third of the round-2 "0.365 mean occupancy" mystery)
+    decode_tps = stats.tokens_per_second / n_dev
+    mean_occupancy = stats.mean_occupancy
+    occupancy_hist = list(stats.occupancy_hist)
+    short_dispatches = stats.short_dispatches
 
     # ---- TTFT phase: p50 mesh-msg -> first streamed token through the FULL
     # agent path (client -> mesh -> agent -> engine -> token step -> client)
-    ttft_p50_ms, ttft_error = await _ttft_phase(engine)
+    ttft_p50_ms, ttft_error, ttft_transport = await _ttft_phase(engine)
     await engine.stop()
 
     total = sum(counts)
     wall_tps = total / wall / n_dev
-    decode_tps = stats.tokens_per_second / n_dev
     return {
         "metric": (
             f"decode_tok_s_per_chip[{model.name} bs={cfg['bs']}"
@@ -165,11 +178,12 @@ async def run() -> dict:
         "vs_baseline": round(wall_tps / 2000.0, 3),
         "detail": {
             "decode_only_tok_s_per_chip": round(decode_tps, 1),
-            "mean_batch_occupancy": round(stats.mean_occupancy, 3),
+            "mean_batch_occupancy": round(mean_occupancy, 3),
             # dispatch counts per occupancy quartile [0-25%, .., 75-100%]
-            "occupancy_hist": list(stats.occupancy_hist),
-            "short_dispatches": stats.short_dispatches,
+            "occupancy_hist": occupancy_hist,
+            "short_dispatches": short_dispatches,
             "p50_mesh_to_first_token_ms": ttft_p50_ms,
+            "ttft_transport": ttft_transport,
             **({"ttft_error": ttft_error} if ttft_error else {}),
             "requests": cfg["requests"],
             "new_tokens_per_request": cfg["new_tokens"],
@@ -202,12 +216,69 @@ class _BenchTokenizer:
         return " ".join(f"t{i}" for i in ids)
 
 
-async def _ttft_phase(engine) -> tuple[float | None, str | None]:
-    """Median client-publish -> first-token latency over the live mesh."""
+async def _ttft_phase(engine) -> tuple[float | None, str | None, str]:
+    """Median client-publish -> first-token latency over the live mesh.
+
+    BASELINE phrases the north star as "Kafka-msg -> first-token": the
+    measured path should include real wire hops, so the phase first tries
+    the native meshd broker (worker and client on SEPARATE TCP
+    connections); ANY failure there falls back to InMemoryMesh — a broken
+    broker spawn must not cost the TTFT number, hardware captures can be
+    hours apart.  The returned transport label says which carried it."""
+    meshd_note = None
+    try:
+        from calfkit_tpu.mesh.tcp import find_meshd
+
+        if find_meshd() is not None:
+            p50, err = await _ttft_over_meshd(engine)
+            if p50 is not None or err is None:
+                return p50, err, "meshd-tcp"
+            meshd_note = f"meshd lane failed ({err}); fell back to inmemory"
+    except Exception as e:  # noqa: BLE001 - fall back below
+        meshd_note = (
+            f"meshd lane failed ({type(e).__name__}: {e}); "
+            "fell back to inmemory"
+        )
+    from calfkit_tpu.mesh import InMemoryMesh
+
+    p50, err = await _ttft_runs(engine, InMemoryMesh(), None)
+    if err is None and meshd_note is not None:
+        err = meshd_note
+    elif err is not None and meshd_note is not None:
+        err = f"{meshd_note} | {err}"
+    return p50, err, "inmemory"
+
+
+async def _ttft_over_meshd(engine) -> tuple[float | None, str | None]:
+    """Spawn a meshd broker on a free port and measure over real TCP."""
+    import contextlib as _ctx
+    import socket
+
+    from calfkit_tpu.mesh.tcp import TcpMesh, spawn_meshd
+
+    with socket.socket() as probe_sock:
+        probe_sock.bind(("127.0.0.1", 0))
+        port = probe_sock.getsockname()[1]
+    proc = spawn_meshd(port)
+    try:
+        mesh = TcpMesh(f"127.0.0.1:{port}")
+        client_mesh = TcpMesh(f"127.0.0.1:{port}")
+        await client_mesh.start()
+        try:
+            return await _ttft_runs(engine, mesh, client_mesh)
+        finally:
+            await client_mesh.stop()
+    finally:
+        proc.terminate()
+        with _ctx.suppress(Exception):
+            proc.wait(timeout=5)
+
+
+async def _ttft_runs(engine, mesh, client_mesh) -> tuple[float | None, str | None]:
+    """Drive 12 single-turn runs (2 warmup) and return (p50_ms, error)."""
     try:
         from calfkit_tpu.client import Client
         from calfkit_tpu.inference.client import JaxLocalModelClient
-        from calfkit_tpu.mesh import InMemoryMesh
         from calfkit_tpu.nodes import Agent
         from calfkit_tpu.worker import Worker
 
@@ -215,11 +286,10 @@ async def _ttft_phase(engine) -> tuple[float | None, str | None]:
             engine=engine, max_new_tokens=8, tokenizer=_BenchTokenizer()
         )
         await model.start()
-        mesh = InMemoryMesh()
         agent = Agent("bench_agent", model=model, stream_tokens=True)
         samples: list[float] = []
         async with Worker([agent], mesh=mesh, owns_transport=True):
-            client = Client.connect(mesh)
+            client = Client.connect(client_mesh or mesh)
             # 2 unmeasured warmup runs absorb the agent-path jit variants
             # (prompt-length buckets the throughput phase never touched)
             for i in range(12):
@@ -328,16 +398,56 @@ _TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "BENCH_TPU_CACHE.json")
 
 
+def _git(*args: str) -> str | None:
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)), *args],
+            capture_output=True, text=True, timeout=20,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return proc.stdout.strip() if proc.returncode == 0 else None
+
+
 def _save_tpu_cache(result: dict) -> None:
     if result.get("detail", {}).get("platform") != "tpu":
         return
     try:
         stamped = dict(result)
         stamped["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        # the SHA lets a later wedged-chip capture tell whether the cached
+        # number still describes the CURRENT inference code
+        sha = _git("rev-parse", "HEAD")
+        if sha:
+            stamped["git_sha"] = sha
         with open(_TPU_CACHE, "w") as f:
             json.dump(stamped, f)
     except OSError:  # cache is best-effort
         pass
+
+
+def _cache_is_stale_code(cached: dict) -> bool:
+    """True when HEAD has touched the inference path since the cached
+    capture — the number is then labeled stale-code (a perf regression in
+    new code must not hide behind an old cached headline)."""
+    sha = cached.get("git_sha")
+    if not isinstance(sha, str) or not sha:
+        return False  # legacy cache: can't tell; keep prior behavior
+    if _git("rev-parse", "HEAD") is None:
+        return False  # git itself unavailable: can't tell either way
+    # a sha git doesn't know (rebase dropped it, shallow clone) means the
+    # capture can't be tied to current code — that is stale, not clean
+    if _git("cat-file", "-e", f"{sha}^{{commit}}") is None:
+        return True
+    changed = _git(
+        "diff", "--name-only", sha, "HEAD", "--",
+        "calfkit_tpu/inference", "bench.py",
+    )
+    if changed is None:
+        return True  # sha exists but diff failed: cannot certify freshness
+    return bool(changed.strip())
 
 
 def _load_tpu_cache() -> dict | None:
@@ -407,12 +517,19 @@ def main() -> None:
     if not explicit_cpu:
         cached = _load_tpu_cache()
         if cached is not None:
-            cached["metric"] = cached["metric"].replace(
-                "]", f" cached@{cached.get('captured_at', '?')}]", 1
-            )
+            stale = _cache_is_stale_code(cached)
+            label = f" cached@{cached.get('captured_at', '?')}"
+            if stale:
+                label += " stale-code"
+            cached["metric"] = cached["metric"].replace("]", label + "]", 1)
             cached["error"] = (
                 f"accelerator unavailable at capture; value is the last "
-                f"successful on-TPU run | {error}"
+                f"successful on-TPU run"
+                + (
+                    " (STALE: calfkit_tpu/inference or bench.py changed "
+                    "since capture)" if stale else ""
+                )
+                + f" | {error}"
             ).strip()
             print(json.dumps(cached))
             return

@@ -38,6 +38,13 @@ commit_results() {  # $1 = job name; commit ONLY the hardware artifacts
 run_job() {  # $1 = name, $2... = command
   local name="$1"; shift
   [ -f "tpu_results/$name.done" ] && return 0
+  # bounded retries: transient wedges deserve another shot, but a
+  # deterministic failure must not spam a commit per probe cycle forever
+  local fails=0
+  [ -f "tpu_results/$name.failcount" ] && fails=$(cat "tpu_results/$name.failcount")
+  if [ "$fails" -ge "${MAX_JOB_FAILS:-3}" ]; then
+    return 1
+  fi
   echo "[opportunist] $(date -u +%H:%M:%S) running $name" >> tpu_results/watcher.log
   if timeout "${JOB_TIMEOUT:-3600}" "$@" > "tpu_results/$name.out" 2> "tpu_results/$name.err"; then
     touch "tpu_results/$name.done"
@@ -45,6 +52,7 @@ run_job() {  # $1 = name, $2... = command
     commit_results "$name" || true
   else
     echo "[opportunist] $(date -u +%H:%M:%S) $name FAILED rc=$?" >> tpu_results/watcher.log
+    echo $((fails + 1)) > "tpu_results/$name.failcount"
     # raw .err streams are gitignored (can be huge); commit a bounded tail
     # so the failure diagnostics survive a wedged round-end too
     tail -c 100000 "tpu_results/$name.err" > "tpu_results/$name.err.tail" 2>/dev/null
@@ -54,8 +62,12 @@ run_job() {  # $1 = name, $2... = command
 }
 
 all_done() {
+  local f
   for j in bench_tinyllama profile_attn bench_llama8b tpu_lane; do
-    [ -f "tpu_results/$j.done" ] || return 1
+    [ -f "tpu_results/$j.done" ] && continue
+    f=0; [ -f "tpu_results/$j.failcount" ] && f=$(cat "tpu_results/$j.failcount")
+    [ "$f" -ge "${MAX_JOB_FAILS:-3}" ] && continue
+    return 1
   done
   return 0
 }
