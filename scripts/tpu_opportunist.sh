@@ -52,7 +52,14 @@ run_job() {  # $1 = name, $2... = command
     commit_results "$name" || true
   else
     echo "[opportunist] $(date -u +%H:%M:%S) $name FAILED rc=$?" >> tpu_results/watcher.log
-    echo $((fails + 1)) > "tpu_results/$name.failcount"
+    # attribute the failure: if the chip is dead right now, the job almost
+    # certainly died of the wedge, not of its own bug — such failures must
+    # not burn the bounded retry budget (wedges dominate this image)
+    if probe; then
+      echo $((fails + 1)) > "tpu_results/$name.failcount"
+    else
+      echo "[opportunist] $(date -u +%H:%M:%S) $name failure attributed to chip wedge; retry budget not charged" >> tpu_results/watcher.log
+    fi
     # raw .err streams are gitignored (can be huge); commit a bounded tail
     # so the failure diagnostics survive a wedged round-end too
     tail -c 100000 "tpu_results/$name.err" > "tpu_results/$name.err.tail" 2>/dev/null
@@ -88,4 +95,10 @@ while ! all_done; do
   all_done && break
   sleep "${PROBE_INTERVAL:-300}"
 done
-echo "[opportunist] $(date -u +%H:%M:%S) all jobs done" >> tpu_results/watcher.log
+# distinguish captured vs gave-up in the terminal record
+summary=""
+for j in bench_tinyllama profile_attn bench_llama8b tpu_lane; do
+  if [ -f "tpu_results/$j.done" ]; then summary="$summary $j=done"
+  else summary="$summary $j=gave-up"; fi
+done
+echo "[opportunist] $(date -u +%H:%M:%S) queue finished:$summary" >> tpu_results/watcher.log
