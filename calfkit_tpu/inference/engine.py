@@ -23,8 +23,11 @@ functional forward and owns the jit specializations.
 from __future__ import annotations
 
 import asyncio
+import heapq
+import itertools
 import logging
 import math
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -49,6 +52,17 @@ from calfkit_tpu.inference.sharding import (
 logger = logging.getLogger(__name__)
 
 _DONE = object()
+
+
+def _deliver_batch(deliveries: "list[tuple[asyncio.Queue, list]]") -> None:
+    """Event-loop side of the batched cross-thread token fan-out.
+
+    Each request's whole dispatch-worth of tokens lands as ONE queue item
+    (a list, possibly ending in _DONE): one consumer wakeup per dispatch
+    instead of one per token — at 32-step dispatches that is 32x less
+    event-loop churn on the serving hot path."""
+    for queue, items in deliveries:
+        queue.put_nowait(items)
 
 
 def _finalize_wave_math(
@@ -255,6 +269,18 @@ class InferenceEngine:
 
         self._free: list[int] = list(range(B))
         self._active: dict[int, GenRequest] = {}
+        # bound-retirement horizon tracking: a min-heap of
+        # (absolute decode-clock step at which the request hits a bound,
+        # tiebreak, request) so _retirement_near is O(log n) amortized
+        # instead of an O(active) scan on the decode thread every dispatch.
+        # Pushes happen on the event loop (activation), peeks/pops on the
+        # decode thread — the lock covers both; stop-token/cancel
+        # retirements just leave stale entries that pop lazily.
+        self._retire_heap: list[tuple[int, int, GenRequest]] = []
+        self._retire_lock = threading.Lock()
+        self._retire_seq = itertools.count()
+        self._decode_clock = 0
+        self._cancel_dirty = False  # at least one .cancelled flag is set
         self._inflight: dict | None = None  # chunked-prefill wave in flight
         self._carry: list[GenRequest] = []  # wave-trimmed, ahead of the queue
         self._pending: deque[GenRequest] = deque()
@@ -409,18 +435,37 @@ class InferenceEngine:
         steps = self.runtime.decode_steps_per_dispatch
         return min(steps, max(4, steps // 4))
 
+    def _retirement_bound(self, request: GenRequest) -> int:
+        """Decode steps until the request hits a hard stop bound."""
+        remaining = request.max_new_tokens - request.generated
+        seq_room = self.runtime.max_seq_len - 1 - (
+            len(request.prompt) + request.generated
+        )
+        return min(remaining, seq_room)
+
+    def _track_retirement(self, request: GenRequest) -> None:
+        """Register an activated request's bound-retirement horizon."""
+        with self._retire_lock:
+            heapq.heappush(
+                self._retire_heap,
+                (
+                    self._decode_clock + self._retirement_bound(request),
+                    next(self._retire_seq),
+                    request,
+                ),
+            )
+
     def _retirement_near(self, horizon: int) -> bool:
         """Will any active request hit a stop bound within ``horizon`` steps?
         (Shortening ticks while nothing can retire just multiplies dispatch
-        overhead — slots only free on retirement.)"""
-        for request in self._active.values():
-            remaining = request.max_new_tokens - request.generated
-            seq_room = self.runtime.max_seq_len - 1 - (
-                len(request.prompt) + request.generated
-            )
-            if min(remaining, seq_room) <= horizon:
-                return True
-        return False
+        overhead — slots only free on retirement.)  O(log n) amortized: the
+        heap top is the earliest bound; entries for requests that already
+        retired early (stop token / cancel set slot = -1) pop lazily."""
+        with self._retire_lock:
+            heap = self._retire_heap
+            while heap and heap[0][2].slot == -1:
+                heapq.heappop(heap)
+            return bool(heap) and heap[0][0] <= self._decode_clock + horizon
 
     def _prefill_jit(self, bucket: int, rows: int, sampled: bool = False) -> Any:
         """Batched prefill: R admissions run as one [R, bucket] forward on a
@@ -672,10 +717,18 @@ class InferenceEngine:
                 if item is _DONE:
                     done = True
                     return
+                if type(item) is list:  # one dispatch's token block
+                    for token in item:
+                        if token is _DONE:
+                            done = True
+                            return
+                        yield token
+                    continue
                 yield item
         finally:
             if not done:
                 request.cancelled = True
+                self._cancel_dirty = True
                 self._wake.set()
 
     # ------------------------------------------------------------ scheduler
@@ -715,7 +768,14 @@ class InferenceEngine:
         outright (slots + page reservations released, remaining chunks
         skipped); partially-cancelled waves finish their flight and shed
         the cancelled members at activation.
+
+        The dirty flag keeps this O(1) on the ordinary pass: the full
+        scan over active/carry/pending/long only runs after some consumer
+        actually set a ``cancelled`` flag since the last reap.
         """
+        if not self._cancel_dirty:
+            return
+        self._cancel_dirty = False
         if self._inflight is not None and all(
             r.cancelled for r in self._inflight["wave"]
         ):
@@ -883,6 +943,7 @@ class InferenceEngine:
                 request.out.put_nowait(_DONE)
                 continue
             self._active[request.slot] = request
+            self._track_retirement(request)
 
     async def _admit(self) -> bool:
         admitted = False
@@ -1336,6 +1397,8 @@ class InferenceEngine:
         self._k, self._v, self._last, self._lens, toks = (
             self._decode_jit(window, steps, sampled)(*args)
         )
+        with self._retire_lock:
+            self._decode_clock += steps
         for slot in self._active:
             self._host_lens[slot] += steps
         block = np.asarray(toks)  # [steps, B] — THE host sync per dispatch
@@ -1348,11 +1411,41 @@ class InferenceEngine:
         self.stats.occupancy_hist[min(3, int(occupancy * 4))] += 1
         if steps < self.runtime.decode_steps_per_dispatch:
             self.stats.short_dispatches += 1
+        # fan tokens out with ONE event-loop marshal per dispatch: a
+        # call_soon_threadsafe per token costs ~65 us of loop machinery
+        # each (scripts/sched_overhead.py found it dominating host cost at
+        # bs=128), so bookkeeping runs here on the decode thread and the
+        # queue puts cross threads as a single batch
+        deliveries: list[tuple[asyncio.Queue, list]] = []
         for slot, request in list(self._active.items()):
+            items: list = []
             for step_tokens in block:
-                self._emit(request, int(step_tokens[slot]))
                 if request.slot == -1:
                     break
+                token = int(step_tokens[slot])
+                request.generated += 1
+                hit_stop = token in request.stop_tokens
+                if not hit_stop:
+                    items.append(token)
+                    self.stats.decode_tokens += 1
+                exhausted = (
+                    request.generated >= request.max_new_tokens
+                    or len(request.prompt) + request.generated
+                    >= self.runtime.max_seq_len - 1
+                )
+                if hit_stop or exhausted:
+                    # bookkeeping BEFORE the _DONE signal: once the consumer
+                    # observes completion, the slot is already reclaimed
+                    self._active.pop(request.slot, None)
+                    if self._paged:
+                        self._page_alloc.free(request.slot)
+                    self._free.append(request.slot)
+                    request.slot = -1
+                    items.append(_DONE)
+            if items:
+                deliveries.append((request.out, items))
+        if deliveries:
+            self._loop.call_soon_threadsafe(_deliver_batch, deliveries)
 
     def _emit(self, request: GenRequest, token: int) -> None:
         """Record one generated token; retire the request on stop.

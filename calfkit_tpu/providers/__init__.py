@@ -13,7 +13,17 @@ body on failure — which the agent turn runner converts into a typed
 """
 
 from calfkit_tpu.providers.anthropic import AnthropicModelClient
+from calfkit_tpu.providers.fallback import (
+    FallbackExhaustedError,
+    FallbackModelClient,
+)
 from calfkit_tpu.providers.http import ModelAPIError
 from calfkit_tpu.providers.openai import OpenAIModelClient
 
-__all__ = ["AnthropicModelClient", "ModelAPIError", "OpenAIModelClient"]
+__all__ = [
+    "AnthropicModelClient",
+    "FallbackExhaustedError",
+    "FallbackModelClient",
+    "ModelAPIError",
+    "OpenAIModelClient",
+]

@@ -1,0 +1,148 @@
+"""FallbackModelClient: provider composition semantics (reference analog:
+the vendored FallbackModel's request/stream fallback + exception-group
+behavior, calfkit/_vendor/pydantic_ai/models/fallback.py)."""
+
+import json
+
+import httpx
+import pytest
+
+from calfkit_tpu.engine import EchoModelClient, FunctionModelClient
+from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
+from calfkit_tpu.models.messages import ModelRequest, ModelResponse, TextOutput, UserPart
+from calfkit_tpu.providers import (
+    FallbackExhaustedError,
+    FallbackModelClient,
+    ModelAPIError,
+    OpenAIModelClient,
+)
+
+MSGS = [ModelRequest(parts=[UserPart(content="hi")])]
+
+
+def _failing(name="primary", exc=None):
+    def boom(messages, params):
+        raise exc or ModelAPIError("backend down", status=503)
+
+    return FunctionModelClient(boom, name=name)
+
+
+class TestRequestFallback:
+    async def test_primary_failure_rolls_to_secondary(self):
+        fb = FallbackModelClient(_failing(), EchoModelClient(name="backup"))
+        response = await fb.request(MSGS)
+        assert response.text() == "echo: hi"
+        assert fb.model_name == "fallback:primary,backup"
+
+    async def test_non_matching_exception_propagates_immediately(self):
+        fb = FallbackModelClient(
+            _failing(exc=ValueError("schema bug")),
+            EchoModelClient(name="backup"),
+        )
+        with pytest.raises(ValueError, match="schema bug"):
+            await fb.request(MSGS)
+
+    async def test_all_failed_raises_exhausted_with_every_cause(self):
+        fb = FallbackModelClient(
+            _failing("a", ModelAPIError("a down", status=500)),
+            _failing("b", ConnectionError("b unreachable")),
+        )
+        with pytest.raises(FallbackExhaustedError) as exc_info:
+            await fb.request(MSGS)
+        err = exc_info.value
+        assert len(err.exceptions) == 2
+        assert "a down" in str(err) and "b unreachable" in str(err)
+
+    async def test_custom_predicate(self):
+        fb = FallbackModelClient(
+            _failing(exc=RuntimeError("quota")),
+            EchoModelClient(name="backup"),
+            fallback_on=lambda e: "quota" in str(e),
+        )
+        response = await fb.request(MSGS)
+        assert response.text() == "echo: hi"
+
+    async def test_remote_to_remote_over_mock_transport(self):
+        """The parity shape: a 503 OpenAI primary falls back to a healthy
+        OpenAI-compatible secondary."""
+        def down(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(503, text="overloaded")
+
+        def up(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, json={
+                "choices": [{"message": {"content": "from backup"}}],
+            })
+
+        primary = OpenAIModelClient(
+            "gpt-a", api_key="k",
+            http_client=httpx.AsyncClient(transport=httpx.MockTransport(down)),
+        )
+        backup = OpenAIModelClient(
+            "gpt-b", api_key="k",
+            http_client=httpx.AsyncClient(transport=httpx.MockTransport(up)),
+        )
+        fb = FallbackModelClient(primary, backup)
+        response = await fb.request(MSGS)
+        assert response.text() == "from backup"
+        await fb.aclose()
+
+
+class TestStreamFallback:
+    async def test_prestream_failure_falls_back(self):
+        fb = FallbackModelClient(_failing(), EchoModelClient(name="backup"))
+        events = [e async for e in fb.request_stream(MSGS)]
+        assert isinstance(events[-1], ResponseDone)
+        assert events[-1].response.text() == "echo: hi"
+
+    async def test_midstream_failure_propagates_not_retries(self):
+        class MidFail(EchoModelClient):
+            async def request_stream(self, messages, settings=None, params=None):
+                yield TextDelta("par")
+                raise ModelAPIError("cut mid-stream")
+
+        fb = FallbackModelClient(MidFail(), EchoModelClient(name="backup"))
+        got = []
+        with pytest.raises(ModelAPIError, match="mid-stream"):
+            async for event in fb.request_stream(MSGS):
+                got.append(event)
+        # the partial token reached the consumer exactly once (no dupes)
+        assert [e.text for e in got if isinstance(e, TextDelta)] == ["par"]
+
+    async def test_all_streams_failed_raises_exhausted(self):
+        fb = FallbackModelClient(_failing("a"), _failing("b"))
+        with pytest.raises(FallbackExhaustedError):
+            async for _ in fb.request_stream(MSGS):
+                pass
+
+
+class TestAgentIntegration:
+    async def test_agent_serves_through_fallback_and_mints_typed_fault(self):
+        """End-to-end over the mesh: an agent on a fallback model answers
+        via the backup; with all models down the client sees the typed
+        mesh.model_error fault (the round-2 fault vocabulary)."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.exceptions import NodeFaultError
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.models import FaultTypes
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        fb = FallbackModelClient(_failing(), EchoModelClient(name="backup"))
+        agent = Agent("resilient", model=fb)
+        mesh = InMemoryMesh()
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("resilient").execute("ping", timeout=15)
+            assert result.output == "echo: ping"
+            await client.close()
+
+        dead = FallbackModelClient(_failing("a"), _failing("b"))
+        agent2 = Agent("doomed", model=dead)
+        mesh2 = InMemoryMesh()
+        async with Worker([agent2], mesh=mesh2, owns_transport=True):
+            client = Client.connect(mesh2)
+            with pytest.raises(NodeFaultError) as exc_info:
+                await client.agent("doomed").execute("ping", timeout=15)
+            assert exc_info.value.report.error_type == FaultTypes.MODEL_ERROR
+            assert "fallback models failed" in exc_info.value.report.message
+            await client.close()
