@@ -42,7 +42,9 @@ _LAZY: dict[str, str] = {
     "EchoModelClient": "calfkit_tpu.engine",
     "FunctionModelClient": "calfkit_tpu.engine",
     "OpenAIModelClient": "calfkit_tpu.providers",
+    "OpenAIResponsesModelClient": "calfkit_tpu.providers",
     "AnthropicModelClient": "calfkit_tpu.providers",
+    "FallbackModelClient": "calfkit_tpu.providers",
 }
 
 if TYPE_CHECKING:  # pragma: no cover

@@ -451,6 +451,11 @@ def spawn_meshd(
 ) -> subprocess.Popen:
     """Spawn the native broker and wait for readiness.
 
+    ``port=0`` lets the broker bind an OS-assigned port (no
+    probe-then-spawn TOCTOU race); the actual port is parsed from the
+    broker's ``PORT <n>`` stdout line and exposed as ``proc.meshd_port``
+    (set for every spawn).
+
     ``start_new_session=True`` detaches it from the caller's terminal
     (managed dev brokers must survive a ctrl-c aimed at the CLI).
     """
@@ -461,10 +466,41 @@ def spawn_meshd(
         )
     proc = subprocess.Popen(
         [binary, str(port)],
-        stdout=subprocess.DEVNULL,
+        stdout=subprocess.PIPE if port == 0 else subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
         start_new_session=start_new_session,
     )
+    if port == 0:
+        import contextlib
+        import select
+
+        def _kill_unreporting(message: str, error: type) -> None:
+            # reap + close on the failure path too: no zombie, no fd leak
+            proc.terminate()
+            with contextlib.suppress(Exception):
+                proc.wait(timeout=5)
+            proc.stdout.close()
+            raise error(message + " — stale binary? run `make -C native`")
+
+        # bounded wait: a stale binary that never prints PORT must not
+        # block the caller forever
+        ready, _, _ = select.select([proc.stdout], [], [], 10)
+        if not ready:
+            _kill_unreporting(
+                "meshd did not report its bound port within 10s", TimeoutError
+            )
+        line = proc.stdout.readline().decode(errors="replace").strip()
+        try:
+            port = int(line.removeprefix("PORT "))
+        except ValueError:
+            port = -1
+        if not line.startswith("PORT ") or port <= 0:
+            _kill_unreporting(
+                f"meshd did not report its bound port (got {line!r})",
+                RuntimeError,
+            )
+        proc.stdout.close()
+    proc.meshd_port = port  # type: ignore[attr-defined]
     deadline = time.time() + 10
     import socket
 

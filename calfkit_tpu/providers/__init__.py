@@ -19,6 +19,7 @@ from calfkit_tpu.providers.fallback import (
 )
 from calfkit_tpu.providers.http import ModelAPIError
 from calfkit_tpu.providers.openai import OpenAIModelClient
+from calfkit_tpu.providers.openai_responses import OpenAIResponsesModelClient
 
 __all__ = [
     "AnthropicModelClient",
@@ -26,4 +27,5 @@ __all__ = [
     "FallbackModelClient",
     "ModelAPIError",
     "OpenAIModelClient",
+    "OpenAIResponsesModelClient",
 ]

@@ -119,13 +119,18 @@ def parse_openai_response(data: dict, model: str) -> ModelResponse:
     )
 
 
-def _merge_tool_call_delta(acc: dict[int, dict], delta: dict) -> None:
+def _merge_tool_call_delta(
+    acc: dict[int, dict], delta: dict, last: int | None = None
+) -> int:
     """Accumulate a streaming tool_calls delta by index.
 
     Compatible backends sometimes omit ``index``; defaulting it to 0 would
     merge distinct parallel calls into one slot (concatenated names/args).
-    Fallback order: match by call id, else continue the latest open slot,
-    else open a fresh one.
+    Fallback order: match by call id, else continue the MOST-RECENTLY-
+    TOUCHED slot (``last`` — streaming order; matching the highest index
+    instead misattributes continuations when a backend interleaves id-less
+    chunks across parallel calls), else open a fresh one.  Returns the
+    touched index for the caller to thread back in as ``last``.
     """
     index = delta.get("index")
     if index is None:
@@ -134,6 +139,8 @@ def _merge_tool_call_delta(acc: dict[int, dict], delta: dict) -> None:
             index = next(
                 (k for k, s in acc.items() if s["id"] == call_id), None
             )
+        elif last in acc:
+            index = last
         else:
             index = max(acc, default=None)
         if index is None:
@@ -146,6 +153,7 @@ def _merge_tool_call_delta(acc: dict[int, dict], delta: dict) -> None:
         slot["name"] += function["name"]
     if function.get("arguments"):
         slot["arguments"] += function["arguments"]
+    return index
 
 
 class OpenAIModelClient(ModelClient):
@@ -283,9 +291,11 @@ class OpenAIModelClient(ModelClient):
 
         text_chunks: list[str] = []
         calls: dict[int, dict] = {}
+        last_call: int | None = None
         usage = Usage()
         model_name = self._model
         terminated = False
+        finish_seen = False
         async for data in sse_lines(
             self._http(), f"{self._base_url}/chat/completions",
             headers={"Authorization": f"Bearer {self._api_key}"},
@@ -311,18 +321,25 @@ class OpenAIModelClient(ModelClient):
                     output_tokens=event["usage"].get("completion_tokens", 0),
                 )
             for choice in event.get("choices") or []:
+                if choice.get("finish_reason"):
+                    finish_seen = True
                 delta = choice.get("delta") or {}
                 if delta.get("content"):
                     text_chunks.append(delta["content"])
                     yield TextDelta(delta["content"])
                 for call_delta in delta.get("tool_calls") or []:
-                    _merge_tool_call_delta(calls, call_delta)
+                    last_call = _merge_tool_call_delta(
+                        calls, call_delta, last_call
+                    )
 
-        if not terminated:
-            # a clean TCP close without the [DONE] sentinel means the answer
-            # may be truncated — that must not pass as success
+        if not terminated and not finish_seen:
+            # a clean TCP close with neither the [DONE] sentinel nor any
+            # finish_reason-bearing chunk means the answer may be truncated
+            # — that must not pass as success.  Some OpenAI-compatible
+            # proxies end successful streams without [DONE]; a seen
+            # finish_reason is the alternate completion signal.
             raise ModelAPIError(
-                "openai stream closed without the [DONE] sentinel "
+                "openai stream closed without [DONE] or a finish_reason "
                 "(response may be truncated)"
             )
 

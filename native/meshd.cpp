@@ -361,7 +361,16 @@ int main(int argc, char** argv) {
     perror("bind");
     return 1;
   }
+  if (port == 0) {
+    // OS-assigned port: report it on stdout for the spawning parent
+    // (closes the probe-then-spawn TOCTOU race on busy hosts)
+    socklen_t len = sizeof(addr);
+    if (getsockname(server, (sockaddr*)&addr, &len) == 0)
+      port = ntohs(addr.sin_port);
+  }
   listen(server, 64);
+  printf("PORT %d\n", port);
+  fflush(stdout);
   fprintf(stderr, "meshd listening on 127.0.0.1:%d\n", port);
   for (;;) {
     int fd = accept(server, nullptr, nullptr);

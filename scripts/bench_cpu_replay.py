@@ -1,0 +1,84 @@
+"""Round-stamped CPU-replay bench artifact (VERDICT r3 item 3).
+
+Runs the REAL bench (same engine code path, same measurement window) on
+CPU in two shapes — the smoke config and the tinyllama-architecture
+``tinyllama_cpu`` config — and writes ``BENCH_CPU_r{N}.json`` at the repo
+root.  This is the evidence that engine / measurement-window changes
+actually moved, committed every round even when the chip is wedged; claims
+like "occupancy 1.0 at 4x-bs windows" live here instead of in commit
+messages.
+
+Usage: python scripts/bench_cpu_replay.py --round 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from bench import _git, _last_json_line  # noqa: E402 - shared helpers
+
+
+def _run_config(config: str, timeout_s: int) -> dict:
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        CALFKIT_BENCH_CONFIG=config,
+        CALFKIT_BENCH_INNER="1",  # skip the accelerator probe outright
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            env=env, capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return {"config": config, "error": f"timeout after {timeout_s}s"}
+    result = _last_json_line(proc.stdout)
+    if result is not None:
+        result["config"] = config
+        return result
+    return {
+        "config": config,
+        "error": f"no JSON line (rc={proc.returncode}): "
+                 f"{(proc.stdout + proc.stderr)[-400:]}",
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--round", type=int, required=True)
+    parser.add_argument("--smoke-timeout", type=int, default=900)
+    parser.add_argument("--tinyllama-timeout", type=int, default=2400)
+    ns = parser.parse_args()
+
+    artifact = {
+        "kind": "cpu-replay",
+        "round": ns.round,
+        "captured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_sha": _git("rev-parse", "HEAD"),
+        "runs": [
+            _run_config("smoke", ns.smoke_timeout),
+            _run_config("tinyllama_cpu", ns.tinyllama_timeout),
+        ],
+    }
+    out = os.path.join(REPO, f"BENCH_CPU_r{ns.round:02d}.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({
+        "artifact": os.path.basename(out),
+        "ok": all("error" not in r for r in artifact["runs"]),
+        "values": {
+            r["config"]: r.get("value") for r in artifact["runs"]
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

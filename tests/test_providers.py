@@ -555,7 +555,7 @@ class TestAdviceRound2Fixes:
             return httpx.Response(200, text=sse)
 
         client = _openai(handler)
-        with pytest.raises(ModelAPIError, match=r"without the \[DONE\]"):
+        with pytest.raises(ModelAPIError, match=r"without \[DONE\]"):
             async for _ in client.request_stream([HISTORY[0]]):
                 pass
         await client.aclose()
@@ -660,3 +660,336 @@ class TestAdviceRound2Fixes:
         }))
         assert big.error_code == "context_length_exceeded"
         assert _is_context_overflow(big, str(big))
+
+
+class TestAdviceRound3Fixes:
+    """Pins for the round-3 advisor findings (ADVICE.md r3)."""
+
+    async def test_finish_reason_is_alternate_stream_termination(self):
+        """Some OpenAI-compatible proxies end successful streams without
+        [DONE]; a finish_reason-bearing chunk marks completion, so the
+        stream must not be rejected as truncated."""
+        sse = (
+            'data: {"choices":[{"delta":{"content":"full"}}]}\n\n'
+            'data: {"choices":[{"delta":{},"finish_reason":"stop"}]}\n\n'
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        from calfkit_tpu.engine.model_client import ResponseDone
+
+        client = _openai(handler)
+        events = [e async for e in client.request_stream([HISTORY[0]])]
+        done = events[-1]
+        assert isinstance(done, ResponseDone)
+        assert done.response.text() == "full"
+        await client.aclose()
+
+    async def test_truncated_stream_still_raises_without_finish_reason(self):
+        """The truncation guard survives the finish_reason alternate: no
+        [DONE] AND no finish_reason is still an error."""
+        sse = 'data: {"choices":[{"delta":{"content":"par"}}]}\n\n'
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        client = _openai(handler)
+        with pytest.raises(ModelAPIError, match="truncated"):
+            async for _ in client.request_stream([HISTORY[0]]):
+                pass
+        await client.aclose()
+
+    async def test_indexless_idless_continuation_goes_to_last_touched(self):
+        """An indexless, id-less continuation delta attaches to the slot
+        touched most recently in streaming order — NOT the highest index
+        (which misattributes when a backend opens slot 1 before slot 0)."""
+        sse = (
+            # slot 1 opens FIRST, then slot 0; the id-less continuation
+            # must extend slot 0 (last touched), not slot 1 (max index)
+            'data: {"choices":[{"delta":{"tool_calls":[{"index":1,"id":"b2",'
+            '"function":{"name":"lookup","arguments":"{\\"q\\": \\"y\\"}"}}]}}]}\n\n'
+            'data: {"choices":[{"delta":{"tool_calls":[{"index":0,"id":"a1",'
+            '"function":{"name":"lookup","arguments":"{\\"q\\""}}]}}]}\n\n'
+            'data: {"choices":[{"delta":{"tool_calls":[{'
+            '"function":{"arguments":": \\"x\\"}"}}]}}]}\n\n'
+            "data: [DONE]\n\n"
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        from calfkit_tpu.engine.model_client import ResponseDone
+
+        client = _openai(handler)
+        events = [e async for e in client.request_stream([HISTORY[0]])]
+        done = events[-1]
+        assert isinstance(done, ResponseDone)
+        by_id = {c.tool_call_id: c.args_dict() for c in done.response.tool_calls()}
+        assert by_id == {"a1": {"q": "x"}, "b2": {"q": "y"}}
+        await client.aclose()
+
+
+class TestOpenAIResponses:
+    """OpenAIResponsesModelClient parity suite (reference:
+    calfkit/providers/pydantic_ai/openai.py:71)."""
+
+    def _client(self, handler):
+        from calfkit_tpu.providers import OpenAIResponsesModelClient
+
+        return OpenAIResponsesModelClient(
+            "gpt-resp", api_key="k",
+            http_client=httpx.AsyncClient(
+                transport=httpx.MockTransport(handler)
+            ),
+        )
+
+    async def test_request_mapping_and_parse(self):
+        seen = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen["url"] = str(request.url)
+            seen["auth"] = request.headers["authorization"]
+            seen["payload"] = json.loads(request.content)
+            return httpx.Response(200, json={
+                "model": "gpt-resp-001",
+                "status": "completed",
+                "output": [{
+                    "type": "message", "role": "assistant",
+                    "content": [{"type": "output_text",
+                                 "text": "the answer is 42"}],
+                }],
+                "usage": {"input_tokens": 30, "output_tokens": 6},
+            })
+
+        client = self._client(handler)
+        response = await client.request(
+            HISTORY,
+            ModelSettings(temperature=0.2, max_tokens=99),
+            ModelRequestParameters(tool_defs=[TOOL]),
+        )
+        assert response.text() == "the answer is 42"
+        assert response.usage.input_tokens == 30
+        assert seen["url"].endswith("/responses")
+        assert seen["auth"] == "Bearer k"
+        payload = seen["payload"]
+        assert payload["model"] == "gpt-resp"
+        assert payload["instructions"] == "be brief"
+        assert payload["max_output_tokens"] == 99
+        assert "max_tokens" not in payload
+        # tools are FLAT in the Responses API (no nested "function" key)
+        assert payload["tools"][0]["name"] == "lookup"
+        assert payload["tools"][0]["parameters"]["required"] == ["q"]
+        # history: user msg, assistant function_call, function_call_output
+        kinds = [
+            item.get("type") or item["role"] for item in payload["input"]
+        ]
+        assert kinds == ["user", "function_call", "function_call_output"]
+        call_item = payload["input"][1]
+        assert call_item["call_id"] == "c1"
+        assert json.loads(call_item["arguments"]) == {"q": "answer"}
+        assert payload["input"][2]["output"] == "42"
+        await client.aclose()
+
+    async def test_function_call_output_parsed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, json={
+                "status": "completed",
+                "output": [
+                    {"type": "reasoning", "summary": []},
+                    {"type": "function_call", "call_id": "x9",
+                     "name": "lookup", "arguments": "{\"q\": \"hi\"}"},
+                ],
+            })
+
+        client = self._client(handler)
+        response = await client.request([HISTORY[0]])
+        calls = response.tool_calls()
+        assert len(calls) == 1
+        assert calls[0].tool_call_id == "x9"
+        assert calls[0].args_dict() == {"q": "hi"}
+        await client.aclose()
+
+    async def test_structured_output_forces_tool_choice(self):
+        seen = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen["payload"] = json.loads(request.content)
+            return httpx.Response(200, json={
+                "status": "completed",
+                "output": [{"type": "message", "role": "assistant",
+                            "content": [{"type": "output_text", "text": "x"}]}],
+            })
+
+        client = self._client(handler)
+        await client.request(
+            [HISTORY[0]],
+            params=ModelRequestParameters(
+                output_tool=TOOL, allow_text_output=False
+            ),
+        )
+        assert seen["payload"]["tool_choice"] == "required"
+        await client.aclose()
+
+    async def test_failed_status_raises_typed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, json={
+                "status": "failed",
+                "error": {"code": "server_error", "message": "boom"},
+                "output": [],
+            })
+
+        client = self._client(handler)
+        with pytest.raises(ModelAPIError, match="failed"):
+            await client.request([HISTORY[0]])
+        await client.aclose()
+
+    async def test_http_error_is_typed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(429, text="rate limited")
+
+        client = self._client(handler)
+        with pytest.raises(ModelAPIError) as exc_info:
+            await client.request([HISTORY[0]])
+        assert exc_info.value.status == 429
+        await client.aclose()
+
+    async def test_sse_stream(self):
+        sse = (
+            'data: {"type":"response.created","response":{}}\n\n'
+            'data: {"type":"response.output_text.delta","delta":"Hel"}\n\n'
+            'data: {"type":"response.output_text.delta","delta":"lo"}\n\n'
+            'data: {"type":"response.completed","response":{'
+            '"model":"gpt-resp-001","output":['
+            '{"type":"message","role":"assistant","content":'
+            '[{"type":"output_text","text":"Hello"}]},'
+            '{"type":"function_call","call_id":"c5","name":"lookup",'
+            '"arguments":"{\\"q\\": \\"x\\"}"}],'
+            '"usage":{"input_tokens":9,"output_tokens":3}}}\n\n'
+            "data: [DONE]\n\n"
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            assert json.loads(request.content)["stream"] is True
+            return httpx.Response(
+                200, text=sse, headers={"content-type": "text/event-stream"}
+            )
+
+        from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
+
+        client = self._client(handler)
+        events = [e async for e in client.request_stream([HISTORY[0]])]
+        deltas = [e.text for e in events if isinstance(e, TextDelta)]
+        assert deltas == ["Hel", "lo"]
+        done = events[-1]
+        assert isinstance(done, ResponseDone)
+        assert done.response.text() == "Hello"
+        calls = done.response.tool_calls()
+        assert calls[0].tool_call_id == "c5"
+        assert calls[0].args_dict() == {"q": "x"}
+        assert done.response.usage.input_tokens == 9
+        await client.aclose()
+
+    async def test_stream_failed_event_raises(self):
+        sse = (
+            'data: {"type":"response.output_text.delta","delta":"par"}\n\n'
+            'data: {"type":"response.failed","response":{"error":'
+            '{"code":"server_error","message":"upstream died"}}}\n\n'
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        client = self._client(handler)
+        with pytest.raises(ModelAPIError, match="mid-stream"):
+            async for _ in client.request_stream([HISTORY[0]]):
+                pass
+        await client.aclose()
+
+    async def test_stream_without_completed_raises(self):
+        sse = 'data: {"type":"response.output_text.delta","delta":"par"}\n\n'
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        client = self._client(handler)
+        with pytest.raises(ModelAPIError, match="truncated"):
+            async for _ in client.request_stream([HISTORY[0]]):
+                pass
+        await client.aclose()
+
+    async def test_agent_round_trip_over_mocked_responses_api(self):
+        """The Responses client drives a full agent turn: tool call out,
+        function_call_output back, final text."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        calls = {"n": 0}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            calls["n"] += 1
+            payload = json.loads(request.content)
+            if calls["n"] == 1:
+                return httpx.Response(200, json={
+                    "status": "completed",
+                    "output": [{"type": "function_call", "call_id": "t1",
+                                "name": "lookup",
+                                "arguments": "{\"q\": \"answer\"}"}],
+                })
+            # second turn must carry the tool result back
+            outputs = [i for i in payload["input"]
+                       if i.get("type") == "function_call_output"]
+            assert outputs and outputs[0]["call_id"] == "t1"
+            return httpx.Response(200, json={
+                "status": "completed",
+                "output": [{"type": "message", "role": "assistant",
+                            "content": [{"type": "output_text",
+                                         "text": "it is 42"}]}],
+            })
+
+        @agent_tool
+        def lookup(q: str) -> str:
+            """Look things up.
+
+            Args:
+                q: the query.
+            """
+            return "42"
+
+        model = self._client(handler)
+        agent = Agent("resp_agent", model=model, tools=[lookup])
+        mesh = InMemoryMesh()
+        async with Worker([agent, lookup], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("resp_agent").execute("go", timeout=15)
+            assert result.output == "it is 42"
+            await client.close()
+        await model.aclose()
+
+    async def test_stream_incomplete_event_raises_typed(self):
+        """A max_output_tokens-capped stream ends with response.incomplete:
+        the typed error (with details) must surface, not the generic
+        truncation guard."""
+        sse = (
+            'data: {"type":"response.output_text.delta","delta":"par"}\n\n'
+            'data: {"type":"response.incomplete","response":{'
+            '"incomplete_details":{"reason":"max_output_tokens"},'
+            '"output":[]}}\n\n'
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        client = self._client(handler)
+        with pytest.raises(ModelAPIError, match="max_output_tokens"):
+            async for _ in client.request_stream([HISTORY[0]]):
+                pass
+        await client.aclose()
+
+    def test_top_level_lazy_export(self):
+        import calfkit_tpu
+
+        assert calfkit_tpu.OpenAIResponsesModelClient is not None
+        assert calfkit_tpu.FallbackModelClient is not None
