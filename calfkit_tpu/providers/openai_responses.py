@@ -143,6 +143,24 @@ def parse_responses_output(data: dict, model: str) -> ModelResponse:
     )
 
 
+def _is_hard_failure(data: dict) -> bool:
+    """True when a terminal Responses payload must raise.
+
+    ``status="incomplete"`` with reason ``max_output_tokens`` is NOT a
+    failure: the partial output is returned, matching the chat-completions
+    client's behavior on ``finish_reason="length"`` (divergent handling
+    would make the same cap fatal behind one provider and benign behind
+    the other — and burn FallbackModelClient attempts on a condition every
+    fallback hits too)."""
+    status = data.get("status")
+    if status == "failed":
+        return True
+    if status == "incomplete":
+        reason = (data.get("incomplete_details") or {}).get("reason")
+        return reason != "max_output_tokens"
+    return False
+
+
 class OpenAIResponsesModelClient(ModelClient):
     """The Responses API over httpx.  ``http_client=`` injects a configured
     ``httpx.AsyncClient`` (timeouts, proxies, MockTransport in tests)."""
@@ -231,7 +249,7 @@ class OpenAIResponsesModelClient(ModelClient):
             payload=self._build_payload(messages, settings, params),
             provider="openai-responses",
         )
-        if data.get("status") in ("failed", "incomplete"):
+        if _is_hard_failure(data):
             err = data.get("error") or data.get("incomplete_details") or {}
             raise ModelAPIError(
                 f"openai responses run {data.get('status')}: {err}"[:500],
@@ -270,15 +288,18 @@ class OpenAIResponsesModelClient(ModelClient):
             elif kind == "response.completed":
                 final = event.get("response") or {}
             elif kind == "response.incomplete":
-                # terminal-but-capped (max_output_tokens / content filter):
-                # mirror the non-streaming path's typed error instead of
-                # falling through to the generic truncation guard
+                # terminal-but-capped: a max_output_tokens cap keeps the
+                # partial output (chat-completions parity, see
+                # _is_hard_failure); other reasons (content filter) raise
+                # the typed error instead of the generic truncation guard
                 resp = event.get("response") or {}
-                raise ModelAPIError(
-                    "openai responses run incomplete: "
-                    f"{resp.get('incomplete_details')}"[:500],
-                    body=json.dumps(resp)[:2000],
-                )
+                if _is_hard_failure({**resp, "status": "incomplete"}):
+                    raise ModelAPIError(
+                        "openai responses run incomplete: "
+                        f"{resp.get('incomplete_details')}"[:500],
+                        body=json.dumps(resp)[:2000],
+                    )
+                final = resp
             elif kind in ("response.failed", "error"):
                 detail = (
                     (event.get("response") or {}).get("error")

@@ -22,7 +22,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def profile_dense(preset_name: str, B: int, W: int, steps: int, impls) -> None:
+def profile_dense(preset_name: str, B: int, W: int, steps: int, impls,
+                  rows=None) -> None:
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -73,15 +74,20 @@ def profile_dense(preset_name: str, B: int, W: int, steps: int, impls) -> None:
             times.append(time.perf_counter() - t0)
             k, v = k2, v2
         ms = min(times) * 1000.0
-        print(json.dumps({
+        row = {
+            "path": "decode",
             "config": f"{preset_name} dense B={B} W={W} steps={steps}",
             "impl": impl,
             "ms_per_dispatch": round(ms, 2),
             "tok_s": round(B * steps / (ms / 1000.0), 1),
-        }))
+        }
+        print(json.dumps(row))
+        if rows is not None:
+            rows.append(row)
 
 
-def profile_prefill(preset_name: str, R: int, S: int, impls) -> None:
+def profile_prefill(preset_name: str, R: int, S: int, impls,
+                    rows=None) -> None:
     """Time one prefill-wave forward ([R, S] into a fresh scratch cache)
     per attention impl — the flash kernel's shape of interest."""
     import jax
@@ -122,16 +128,21 @@ def profile_prefill(preset_name: str, R: int, S: int, impls) -> None:
             np.asarray(jnp.float32(out)).sum()
             times.append(time.perf_counter() - t0)
         ms = min(times) * 1000.0
-        print(json.dumps({
+        row = {
+            "path": "prefill",
             "config": f"{preset_name} prefill R={R} S={S}",
             "impl": impl,
-            "ms_per_wave": round(ms, 2),
+            "ms_per_dispatch": round(ms, 2),
             "prefill_tok_s": round(R * S / (ms / 1000.0), 1),
-        }))
+        }
+        print(json.dumps(row))
+        if rows is not None:
+            rows.append(row)
 
 
 def profile_paged(preset_name: str, B: int, wpages: int, steps: int,
-                  page: int, impls, n_layers: int | None = None) -> None:
+                  page: int, impls, n_layers: int | None = None,
+                  rows=None) -> None:
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -187,12 +198,42 @@ def profile_paged(preset_name: str, B: int, wpages: int, steps: int,
             times.append(time.perf_counter() - t0)
             pool_k, pool_v = pk, pv
         ms = min(times) * 1000.0
-        print(json.dumps({
+        row = {
+            "path": "paged_decode",
             "config": f"{preset_name} paged B={B} wpages={wpages} page={page} steps={steps}",
             "impl": impl,
             "ms_per_dispatch": round(ms, 2),
             "tok_s": round(B * steps / (ms / 1000.0), 1),
-        }))
+        }
+        print(json.dumps(row))
+        if rows is not None:
+            rows.append(row)
+
+
+def compute_winners(rows: list[dict], margin: float = 0.97) -> dict:
+    """Per-path winner for the auto-resolution artifact.
+
+    Conservative rule: "pallas" wins a path only when it beat XLA by
+    >= (1 - margin) on EVERY config measured for that path — a single
+    losing shape keeps the safe XLA default (the engine serves all shapes
+    with one setting per path, so the winner must generalize)."""
+    by_path: dict[str, dict[str, dict[str, float]]] = {}
+    for row in rows:
+        by_path.setdefault(row["path"], {}).setdefault(
+            row["config"], {}
+        )[row["impl"]] = row["ms_per_dispatch"]
+    winners: dict[str, str] = {}
+    for path, configs in by_path.items():
+        comparable = [
+            c for c in configs.values() if "xla" in c and "pallas" in c
+        ]
+        if comparable and all(
+            c["pallas"] < margin * c["xla"] for c in comparable
+        ):
+            winners[path] = "pallas"
+        elif comparable:
+            winners[path] = "xla"
+    return winners
 
 
 def main() -> None:
@@ -200,6 +241,15 @@ def main() -> None:
     ap.add_argument("--config", default="both",
                     choices=("tinyllama", "llama8b", "both"))
     ap.add_argument("--impls", default="xla,pallas")
+    ap.add_argument("--out", default=None, help=(
+        "write the per-path winner artifact here (the engine's "
+        "attention_impl='auto' reads it via $CALFKIT_ATTN_PROFILE or "
+        "~/.cache/calfkit_tpu_attn_profile.json)"
+    ))
+    ap.add_argument("--install", action="store_true", help=(
+        "also copy the artifact to ~/.cache/calfkit_tpu_attn_profile.json "
+        "so auto picks it up on this machine"
+    ))
     args = ap.parse_args()
     impls = args.impls.split(",")
 
@@ -214,20 +264,47 @@ def main() -> None:
     except Exception:  # noqa: BLE001 - cache is best-effort
         pass
 
-    print(f"# platform={jax.devices()[0].platform} devices={len(jax.devices())}",
+    platform = jax.devices()[0].platform
+    print(f"# platform={platform} devices={len(jax.devices())}",
           file=sys.stderr)
+    rows: list[dict] = []
     if args.config in ("tinyllama", "both"):
         # bench tinyllama shape: bs=64, window bucket 1024, 32-step dispatch
-        profile_dense("tinyllama-1.1b", B=64, W=1024, steps=32, impls=impls)
+        profile_dense("tinyllama-1.1b", B=64, W=1024, steps=32, impls=impls,
+                      rows=rows)
         profile_paged("tinyllama-1.1b", B=64, wpages=16, steps=32, page=64,
-                      impls=impls)
-        profile_prefill("tinyllama-1.1b", R=8, S=512, impls=impls)
+                      impls=impls, rows=rows)
+        profile_prefill("tinyllama-1.1b", R=8, S=512, impls=impls, rows=rows)
     if args.config in ("llama8b", "both"):
         # bench llama8b ATTENTION shapes (bs=32, 4 pages/row reserve) on a
         # 4-layer slice: bf16 zero-params at full depth would not fit 16 GB
         # next to the pool, and the impl comparison is per-layer anyway
         profile_paged("llama-3-8b", B=32, wpages=4, steps=32, page=64,
-                      impls=impls, n_layers=4)
+                      impls=impls, n_layers=4, rows=rows)
+
+    if args.out or args.install:
+        verdict = {
+            "platform": platform,
+            "captured_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "winners": compute_winners(rows),
+            "rows": rows,
+        }
+        payload = json.dumps(verdict, indent=1)
+        targets = []
+        if args.out:
+            targets.append(args.out)
+        if args.install:
+            targets.append(
+                os.path.expanduser("~/.cache/calfkit_tpu_attn_profile.json")
+            )
+        for target in targets:
+            os.makedirs(os.path.dirname(os.path.abspath(target)), exist_ok=True)
+            with open(target, "w") as f:
+                f.write(payload)
+        print(json.dumps({"winners": verdict["winners"],
+                          "written": targets}))
 
 
 if __name__ == "__main__":

@@ -82,9 +82,14 @@ all_done() {
 while ! all_done; do
   if probe; then
     echo "[opportunist] $(date -u +%H:%M:%S) chip alive" >> tpu_results/watcher.log
-    run_job bench_tinyllama python bench.py || true
+    # profile FIRST: it writes + installs the attention-impl verdict
+    # (tpu_results/ATTN_PROFILE.json + ~/.cache), so the benches below run
+    # with attention_impl="auto" resolved on evidence — the Pallas flip is
+    # automatic on the first live window (VERDICT r4 decision procedure)
+    run_job profile_attn python scripts/profile_attention.py --config both \
+      --out tpu_results/ATTN_PROFILE.json --install || true
     probe || continue
-    run_job profile_attn python scripts/profile_attention.py --config both || true
+    run_job bench_tinyllama python bench.py || true
     probe || continue
     JOB_TIMEOUT=4800 run_job bench_llama8b env CALFKIT_BENCH_CONFIG=llama8b python bench.py || true
     probe || continue

@@ -415,3 +415,75 @@ class TestMeshUrls:
         finally:
             proc.terminate()
             proc.wait(timeout=5)
+
+
+class TestConfig5Scale:
+    """BASELINE config 5 exercised END-TO-END (VERDICT r3 item 7): 128
+    concurrent streams through the FULL product path — client → mesh →
+    agent → engine — on the virtual mesh, with one long-context request
+    interleaved through the sp ring-prefill lane.  The engine-level
+    128-stream test (test_inference.py) proves the scheduler; this proves
+    the whole serving stack at that width."""
+
+    async def test_128_streams_full_agent_path_with_long_interleaved(self):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from calfkit_tpu.inference import JaxLocalModelClient
+        from calfkit_tpu.inference.config import RuntimeConfig, preset
+
+        B = 16  # slot pool; 128 streams oversubscribe it 8x
+        model = JaxLocalModelClient(
+            config=preset("debug"),
+            runtime=RuntimeConfig(
+                max_batch_size=B, max_seq_len=128, prefill_chunk=16,
+                decode_steps_per_dispatch=4, kv_layout="paged", page_size=16,
+                num_kv_pages=4 * B + 1, long_context=True, long_new_cap=8,
+            ),
+            max_new_tokens=12,
+        )
+        agent = Agent("scale_agent", model=model)
+        mesh = InMemoryMesh()
+        # worker concurrency must exceed the slot pool or the dispatcher
+        # (default 8 lanes) caps concurrent runs below the batch and the
+        # engine can never fill — config 5's width is end-to-end, not just
+        # an engine property
+        async with Worker(
+            [agent], mesh=mesh, owns_transport=True, max_workers=64
+        ):
+            client = Client.connect(mesh)
+
+            async def short(i: int) -> str:
+                result = await client.agent("scale_agent").execute(
+                    f"req {i} " + "x" * (i % 23), timeout=600
+                )
+                return result.output
+
+            async def long_one() -> str:
+                # ByteTokenizer: ~1 token/byte — 200+ chars exceeds
+                # max_seq_len=128 and routes through the sp long lane
+                result = await client.agent("scale_agent").execute(
+                    "long " + "y" * 220, timeout=600
+                )
+                return result.output
+
+            results = await asyncio.gather(
+                long_one(), *[short(i) for i in range(128)]
+            )
+            assert len(results) == 129
+            assert all(isinstance(r, str) for r in results)
+            await client.close()
+
+        engine = model._engine
+        # the long request went through the sequence-parallel lane
+        assert engine.stats.long_requests == 1
+        # steady state dominated: 128 streams over 16 slots keep the batch
+        # full once ramped (config-5's continuous-batching claim)
+        assert engine.stats.mean_occupancy > 0.5, engine.stats.mean_occupancy
+        hist = engine.stats.occupancy_hist
+        assert hist[3] >= sum(hist) / 2, hist
+        # no leaks anywhere after the storm
+        assert not engine._active and not engine._pending and not engine._carry
+        assert engine._page_alloc.free_pages == 4 * B
+        assert sorted(engine._free) == list(range(B))
+        await model.stop()

@@ -1303,3 +1303,98 @@ class TestPallasPrefillAttention:
             np.asarray(attention_xla(q, kc, vc, q_pos, lens), np.float32),
             np.asarray(out, np.float32), atol=1e-5, rtol=1e-5,
         )
+
+
+class TestAttnAutoResolution:
+    """attention_impl="auto" resolves per-path from the profile artifact
+    (VERDICT r3 item 8: the Pallas flip is evidence-based and automatic on
+    the first hardware profile)."""
+
+    def _rt(self) -> RuntimeConfig:
+        return RuntimeConfig(max_batch_size=2, max_seq_len=128,
+                             prefill_chunk=16)
+
+    def test_auto_resolves_per_path_from_artifact(self, tmp_path, monkeypatch):
+        import json
+
+        platform = jax.devices()[0].platform
+        artifact = tmp_path / "attn.json"
+        artifact.write_text(json.dumps({
+            "platform": platform,
+            "winners": {"decode": "pallas_interpret", "paged_decode": "xla"},
+        }))
+        monkeypatch.setenv("CALFKIT_ATTN_PROFILE", str(artifact))
+        engine = InferenceEngine(CFG, self._rt())
+        assert engine._resolved_attn_impl("decode") == "pallas_interpret"
+        assert engine._resolved_attn_impl("paged_decode") == "xla"
+        # no verdict for this path -> the safe default
+        assert engine._resolved_attn_impl("prefill") == "xla"
+
+    def test_platform_mismatch_keeps_xla(self, tmp_path, monkeypatch):
+        import json
+
+        artifact = tmp_path / "attn_tpu.json"
+        artifact.write_text(json.dumps({
+            "platform": "tpu", "winners": {"decode": "pallas"},
+        }))
+        monkeypatch.setenv("CALFKIT_ATTN_PROFILE", str(artifact))
+        engine = InferenceEngine(CFG, self._rt())
+        # a TPU verdict must not steer this CPU run
+        assert engine._resolved_attn_impl("decode") == "xla"
+
+    def test_explicit_impl_bypasses_artifact(self, tmp_path, monkeypatch):
+        import json
+        from dataclasses import replace
+
+        artifact = tmp_path / "attn2.json"
+        artifact.write_text(json.dumps({
+            "platform": jax.devices()[0].platform,
+            "winners": {"decode": "xla"},
+        }))
+        monkeypatch.setenv("CALFKIT_ATTN_PROFILE", str(artifact))
+        engine = InferenceEngine(
+            CFG, replace(self._rt(), attention_impl="pallas_interpret")
+        )
+        assert engine._resolved_attn_impl("decode") == "pallas_interpret"
+
+    def test_missing_artifact_defaults_xla(self, monkeypatch):
+        monkeypatch.setenv("CALFKIT_ATTN_PROFILE", "/nonexistent/attn.json")
+        engine = InferenceEngine(CFG, self._rt())
+        assert engine._resolved_attn_impl("decode") == "xla"
+
+    def test_compute_winners_requires_sweep(self):
+        """Pallas must beat XLA on EVERY config of a path (with margin) to
+        win it; one losing shape keeps the safe default."""
+        import importlib.util
+        import os
+
+        spec = importlib.util.spec_from_file_location(
+            "profile_attention",
+            os.path.join(os.path.dirname(__file__), "..", "scripts",
+                         "profile_attention.py"),
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+
+        rows = [
+            {"path": "decode", "config": "a", "impl": "xla",
+             "ms_per_dispatch": 10.0},
+            {"path": "decode", "config": "a", "impl": "pallas",
+             "ms_per_dispatch": 8.0},
+            {"path": "paged_decode", "config": "b", "impl": "xla",
+             "ms_per_dispatch": 10.0},
+            {"path": "paged_decode", "config": "b", "impl": "pallas",
+             "ms_per_dispatch": 9.0},
+            {"path": "paged_decode", "config": "c", "impl": "xla",
+             "ms_per_dispatch": 10.0},
+            {"path": "paged_decode", "config": "c", "impl": "pallas",
+             "ms_per_dispatch": 11.0},  # loses one shape
+            {"path": "prefill", "config": "d", "impl": "xla",
+             "ms_per_dispatch": 10.0},
+            {"path": "prefill", "config": "d", "impl": "pallas",
+             "ms_per_dispatch": 9.9},  # within noise margin: not a win
+        ]
+        winners = mod.compute_winners(rows)
+        assert winners == {
+            "decode": "pallas", "paged_decode": "xla", "prefill": "xla",
+        }

@@ -968,14 +968,38 @@ class TestOpenAIResponses:
             await client.close()
         await model.aclose()
 
-    async def test_stream_incomplete_event_raises_typed(self):
+    async def test_stream_capped_at_max_tokens_keeps_partial(self):
         """A max_output_tokens-capped stream ends with response.incomplete:
-        the typed error (with details) must surface, not the generic
-        truncation guard."""
+        the partial output is returned — chat-completions parity with
+        finish_reason='length' (divergent handling would make the same cap
+        fatal behind one provider and benign behind the other)."""
         sse = (
             'data: {"type":"response.output_text.delta","delta":"par"}\n\n'
             'data: {"type":"response.incomplete","response":{'
             '"incomplete_details":{"reason":"max_output_tokens"},'
+            '"output":[{"type":"message","role":"assistant","content":'
+            '[{"type":"output_text","text":"par"}]}]}}\n\n'
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        from calfkit_tpu.engine.model_client import ResponseDone
+
+        client = self._client(handler)
+        events = [e async for e in client.request_stream([HISTORY[0]])]
+        done = events[-1]
+        assert isinstance(done, ResponseDone)
+        assert done.response.text() == "par"
+        await client.aclose()
+
+    async def test_stream_incomplete_content_filter_raises_typed(self):
+        """Non-cap incomplete reasons (content filter) raise the typed
+        error, not the generic truncation guard."""
+        sse = (
+            'data: {"type":"response.output_text.delta","delta":"par"}\n\n'
+            'data: {"type":"response.incomplete","response":{'
+            '"incomplete_details":{"reason":"content_filter"},'
             '"output":[]}}\n\n'
         )
 
@@ -983,9 +1007,24 @@ class TestOpenAIResponses:
             return httpx.Response(200, text=sse)
 
         client = self._client(handler)
-        with pytest.raises(ModelAPIError, match="max_output_tokens"):
+        with pytest.raises(ModelAPIError, match="content_filter"):
             async for _ in client.request_stream([HISTORY[0]]):
                 pass
+        await client.aclose()
+
+    async def test_request_capped_at_max_tokens_keeps_partial(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, json={
+                "status": "incomplete",
+                "incomplete_details": {"reason": "max_output_tokens"},
+                "output": [{"type": "message", "role": "assistant",
+                            "content": [{"type": "output_text",
+                                         "text": "truncated ans"}]}],
+            })
+
+        client = self._client(handler)
+        response = await client.request([HISTORY[0]])
+        assert response.text() == "truncated ans"
         await client.aclose()
 
     def test_top_level_lazy_export(self):
