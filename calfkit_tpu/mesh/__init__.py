@@ -11,16 +11,20 @@ communication backend"):
 
 ``InMemoryMesh`` is a full single-process implementation — it is both the
 offline test substrate and the ``ck dev`` zero-setup mesh.  ``KafkaMesh``
-(gated on aiokafka) is the production adapter.
+(gated on aiokafka) and ``KafkaWireMesh`` (the dependency-free native
+wire-protocol client; pairs with the in-repo ``native/bin/kafkad`` broker
+or any real Kafka/Redpanda) are the production adapters.
 """
 
 from calfkit_tpu.mesh.transport import MeshTransport, Record, Subscription
 from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
+from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh
 from calfkit_tpu.mesh.memory import InMemoryMesh
 from calfkit_tpu.mesh.tables import TableReader, TableWriter
 
 __all__ = [
     "InMemoryMesh",
+    "KafkaWireMesh",
     "KeyOrderedDispatcher",
     "MeshTransport",
     "Record",

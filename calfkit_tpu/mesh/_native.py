@@ -1,0 +1,84 @@
+"""Shared spawn machinery for the in-repo native brokers (meshd, kafkad).
+
+Both binaries follow the same contract: ``<binary> <port>`` where port 0
+binds an OS-assigned port, and the bound port is reported on stdout as
+``PORT <n>`` before serving begins.
+"""
+
+from __future__ import annotations
+
+import select
+import subprocess
+import time
+from pathlib import Path
+
+
+def find_native_binary(name: str, env_var: str) -> str | None:
+    """Locate an in-repo native binary; ``$<env_var>`` overrides."""
+    import os
+
+    env = os.environ.get(env_var)
+    if env and Path(env).exists():
+        return env
+    candidate = Path(__file__).resolve().parents[2] / "native" / "bin" / name
+    return str(candidate) if candidate.exists() else None
+
+
+def spawn_port_reporting(
+    binary: str, port: int, *, name: str, start_new_session: bool = False,
+    timeout: float = 10.0,
+) -> tuple[subprocess.Popen, int]:
+    """Spawn a PORT-reporting broker and return (proc, bound_port).
+
+    Handles the failure paths uniformly: immediate exit (bind failure on a
+    taken fixed port) raises with the exit code instead of hanging in
+    select; a binary that never prints ``PORT`` (stale build) is killed,
+    reaped, and reported."""
+    proc = subprocess.Popen(
+        [binary, str(port)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        start_new_session=start_new_session,
+    )
+
+    def _kill(message: str, error: type) -> None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except Exception:  # noqa: BLE001
+            pass
+        proc.stdout.close()
+        raise error(message)
+
+    deadline = time.time() + timeout
+    while True:
+        if proc.poll() is not None:
+            proc.stdout.close()
+            raise RuntimeError(
+                f"{name} exited immediately (code {proc.returncode}) — is "
+                f"port {port} already in use?"
+            )
+        ready, _, _ = select.select(
+            [proc.stdout], [], [], min(0.25, max(0.0, deadline - time.time()))
+        )
+        if ready:
+            break
+        if time.time() >= deadline:
+            _kill(
+                f"{name} did not report its bound port within {timeout:.0f}s "
+                "— stale binary? run `make -C native`",
+                TimeoutError,
+            )
+    line = proc.stdout.readline().decode(errors="replace").strip()
+    try:
+        reported = int(line.removeprefix("PORT "))
+    except ValueError:
+        reported = -1
+    if not line.startswith("PORT ") or reported <= 0:
+        _kill(
+            f"{name} did not report its bound port (got {line!r}) — "
+            "stale binary? run `make -C native`",
+            RuntimeError,
+        )
+    proc.stdout.close()
+    return proc, reported

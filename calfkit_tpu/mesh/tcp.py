@@ -439,11 +439,9 @@ class _TcpTableWriter(TableWriter):
 
 
 def find_meshd() -> str | None:
-    env = os.environ.get("CALFKIT_MESHD")
-    if env and Path(env).exists():
-        return env
-    candidate = Path(__file__).resolve().parents[2] / "native" / "bin" / "meshd"
-    return str(candidate) if candidate.exists() else None
+    from calfkit_tpu.mesh._native import find_native_binary
+
+    return find_native_binary("meshd", "CALFKIT_MESHD")
 
 
 def spawn_meshd(
@@ -459,47 +457,16 @@ def spawn_meshd(
     ``start_new_session=True`` detaches it from the caller's terminal
     (managed dev brokers must survive a ctrl-c aimed at the CLI).
     """
+    from calfkit_tpu.mesh._native import spawn_port_reporting
+
     binary = find_meshd()
     if binary is None:
         raise FileNotFoundError(
             "meshd binary not found: run `make -C native` or set CALFKIT_MESHD"
         )
-    proc = subprocess.Popen(
-        [binary, str(port)],
-        stdout=subprocess.PIPE if port == 0 else subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-        start_new_session=start_new_session,
+    proc, port = spawn_port_reporting(
+        binary, port, name="meshd", start_new_session=start_new_session
     )
-    if port == 0:
-        import contextlib
-        import select
-
-        def _kill_unreporting(message: str, error: type) -> None:
-            # reap + close on the failure path too: no zombie, no fd leak
-            proc.terminate()
-            with contextlib.suppress(Exception):
-                proc.wait(timeout=5)
-            proc.stdout.close()
-            raise error(message + " — stale binary? run `make -C native`")
-
-        # bounded wait: a stale binary that never prints PORT must not
-        # block the caller forever
-        ready, _, _ = select.select([proc.stdout], [], [], 10)
-        if not ready:
-            _kill_unreporting(
-                "meshd did not report its bound port within 10s", TimeoutError
-            )
-        line = proc.stdout.readline().decode(errors="replace").strip()
-        try:
-            port = int(line.removeprefix("PORT "))
-        except ValueError:
-            port = -1
-        if not line.startswith("PORT ") or port <= 0:
-            _kill_unreporting(
-                f"meshd did not report its bound port (got {line!r})",
-                RuntimeError,
-            )
-        proc.stdout.close()
     proc.meshd_port = port  # type: ignore[attr-defined]
     deadline = time.time() + 10
     import socket

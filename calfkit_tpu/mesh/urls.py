@@ -14,7 +14,13 @@ MESH_URL_ENV = "CALFKIT_MESH_URL"
 
 
 def mesh_from_url(url: str) -> MeshTransport:
-    """``memory://`` | ``tcp://host:port`` | ``kafka://host:port[,...]``."""
+    """``memory://`` | ``tcp://host:port`` | ``kafka://host:port[,...]`` |
+    ``kafka+wire://host:port``.
+
+    ``kafka://`` prefers the aiokafka adapter and falls back to the native
+    wire-protocol client (:class:`KafkaWireMesh`) when aiokafka is not
+    installed — same broker, same protocol, zero extra dependencies.
+    ``kafka+wire://`` forces the native client."""
     if url.startswith("memory://"):
         from calfkit_tpu.mesh.memory import InMemoryMesh
 
@@ -23,13 +29,31 @@ def mesh_from_url(url: str) -> MeshTransport:
         from calfkit_tpu.mesh.tcp import TcpMesh
 
         return TcpMesh(url.removeprefix("tcp://"))
-    if url.startswith("kafka://"):
-        from calfkit_tpu.mesh.kafka import KafkaMesh
+    if url.startswith("kafka+wire://"):
+        from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh
 
-        return KafkaMesh(url.removeprefix("kafka://"))
+        return KafkaWireMesh(url.removeprefix("kafka+wire://"))
+    if url.startswith("kafka://"):
+        from calfkit_tpu.exceptions import MeshUnavailableError
+
+        bootstrap = url.removeprefix("kafka://")
+        try:
+            from calfkit_tpu.mesh.kafka import KafkaMesh
+
+            return KafkaMesh(bootstrap)
+        except MeshUnavailableError:
+            import logging
+
+            from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh
+
+            logging.getLogger(__name__).info(
+                "aiokafka not installed; using the native kafka wire client"
+            )
+            return KafkaWireMesh(bootstrap)
     raise ValueError(
         f"unsupported mesh url {url!r} "
-        "(use memory://, tcp://host:port or kafka://host:port)"
+        "(use memory://, tcp://host:port, kafka://host:port or "
+        "kafka+wire://host:port)"
     )
 
 

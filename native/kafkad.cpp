@@ -1,0 +1,963 @@
+// kafkad — a native single-node broker speaking the REAL Kafka wire
+// protocol (reference anchor: the reference validates its mesh against a
+// Kafka-compatible broker, tests/integration + Makefile test-kafka; this
+// image ships neither a broker nor aiokafka, so the TPU build carries its
+// own).  The framework's KafkaWireMesh client (calfkit_tpu/mesh/kafka_wire.py)
+// speaks the same protocol to THIS binary in-image and to a real
+// Kafka/Redpanda cluster in production — one client, one wire format.
+//
+// Implemented APIs (fixed, non-flexible versions — chosen so both this
+// broker and real brokers accept them):
+//   ApiVersions v0, Metadata v1, Produce v3, Fetch v4, ListOffsets v1,
+//   FindCoordinator v0, JoinGroup v2, SyncGroup v1, Heartbeat v1,
+//   LeaveGroup v1, OffsetCommit v2, OffsetFetch v1, CreateTopics v0
+// Record format: RecordBatch v2 (magic=2, crc32c, zigzag varints) — the
+// only format modern brokers speak.
+//
+// Scope decisions:
+// - one node (node_id 0); all partitions led here; replication factor 1
+// - consumer-group coordination is COMPLETE (generations, leader range
+//   assignment done client-side per the standard "consumer" embedded
+//   protocol, rebalance on join/leave/session-expiry, blocking joins)
+// - compacted topics retain all records; compaction is an optimization,
+//   not semantics — readers apply tombstones, so views converge the same
+// - fetch long-polls up to max_wait_ms on a producer-signalled condvar
+//
+// Usage: kafkad [port]   (port 0 = OS-assigned, reported as "PORT <n>")
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <optional>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ----------------------------------------------------------------- crc32c
+// Castagnoli CRC (poly 0x1EDC6F41, reflected 0x82F63B78) — what
+// RecordBatch v2's crc field uses.  Table-based, byte at a time.
+uint32_t kCrcTable[256];
+void crc_init() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0x82F63B78u ^ (c >> 1) : c >> 1;
+    kCrcTable[i] = c;
+  }
+}
+uint32_t crc32c(const uint8_t* data, size_t n) {
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; i++) c = kCrcTable[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------------------ byte codecs
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok = true;
+
+  Reader(const uint8_t* data, size_t n) : p(data), end(data + n) {}
+  bool need(size_t n) {
+    if (size_t(end - p) < n) { ok = false; return false; }
+    return true;
+  }
+  uint8_t i8() { if (!need(1)) return 0; return *p++; }
+  int16_t i16() {
+    if (!need(2)) return 0;
+    int16_t v = int16_t((p[0] << 8) | p[1]); p += 2; return v;
+  }
+  int32_t i32() {
+    if (!need(4)) return 0;
+    int32_t v = int32_t((uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+                        (uint32_t(p[2]) << 8) | p[3]);
+    p += 4; return v;
+  }
+  int64_t i64() {
+    if (!need(8)) return 0;
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+    p += 8; return int64_t(v);
+  }
+  // zigzag varint (records)
+  int64_t varlong() {
+    uint64_t v = 0; int shift = 0;
+    while (true) {
+      if (!need(1)) return 0;
+      uint8_t b = *p++;
+      v |= uint64_t(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 63) { ok = false; return 0; }
+    }
+    return int64_t(v >> 1) ^ -int64_t(v & 1);
+  }
+  std::string str() {  // STRING (i16 length, -1 => null -> "")
+    int16_t n = i16();
+    if (n < 0) return "";
+    if (!need(size_t(n))) return "";
+    std::string s(reinterpret_cast<const char*>(p), size_t(n));
+    p += n; return s;
+  }
+  std::optional<std::vector<uint8_t>> bytes() {  // BYTES (i32 length, -1 null)
+    int32_t n = i32();
+    if (n < 0) return std::nullopt;
+    if (!need(size_t(n))) return std::nullopt;
+    std::vector<uint8_t> b(p, p + n);
+    p += n; return b;
+  }
+};
+
+struct Writer {
+  std::vector<uint8_t> buf;
+  void raw(const void* data, size_t n) {
+    const uint8_t* d = static_cast<const uint8_t*>(data);
+    buf.insert(buf.end(), d, d + n);
+  }
+  void i8(uint8_t v) { buf.push_back(v); }
+  void i16(int16_t v) { buf.push_back(uint8_t(v >> 8)); buf.push_back(uint8_t(v)); }
+  void i32(int32_t v) {
+    for (int i = 3; i >= 0; i--) buf.push_back(uint8_t(uint32_t(v) >> (8 * i)));
+  }
+  void i64(int64_t v) {
+    for (int i = 7; i >= 0; i--) buf.push_back(uint8_t(uint64_t(v) >> (8 * i)));
+  }
+  void varlong(int64_t v) {
+    uint64_t z = (uint64_t(v) << 1) ^ uint64_t(v >> 63);
+    while (z >= 0x80) { buf.push_back(uint8_t(z) | 0x80); z >>= 7; }
+    buf.push_back(uint8_t(z));
+  }
+  void str(const std::string& s) {
+    i16(int16_t(s.size()));
+    raw(s.data(), s.size());
+  }
+  void null_str() { i16(-1); }
+  void bytes(const std::vector<uint8_t>& b) {
+    i32(int32_t(b.size()));
+    raw(b.data(), b.size());
+  }
+  // overwrite a previously-reserved i32 at `at`
+  void patch_i32(size_t at, int32_t v) {
+    for (int i = 0; i < 4; i++) buf[at + i] = uint8_t(uint32_t(v) >> (8 * (3 - i)));
+  }
+};
+
+// ------------------------------------------------------------ log storage
+struct StoredRecord {
+  int64_t offset;
+  int64_t timestamp_ms;
+  std::optional<std::vector<uint8_t>> key;    // nullopt = null key
+  std::optional<std::vector<uint8_t>> value;  // nullopt = tombstone
+  std::vector<std::pair<std::string, std::vector<uint8_t>>> headers;
+};
+
+struct Partition {
+  std::vector<StoredRecord> log;  // offset == index (no truncation)
+  int64_t high_watermark() const { return int64_t(log.size()); }
+};
+
+struct Topic {
+  std::vector<Partition> partitions;
+  bool compacted = false;
+};
+
+// --------------------------------------------------------- group machinery
+struct Member {
+  std::string id;
+  // protocol name -> metadata, in the member's preference order
+  std::vector<std::pair<std::string, std::vector<uint8_t>>> protocols;
+  std::vector<uint8_t> assignment;
+  int64_t deadline_ms = 0;         // session expiry
+  int32_t session_timeout_ms = 30000;
+  bool joined_round = false;       // has (re-)joined the current rebalance
+};
+
+struct Group {
+  enum State { Empty, PreparingRebalance, CompletingRebalance, Stable };
+  State state = Empty;
+  int32_t generation = 0;
+  std::string leader;
+  std::string protocol;  // chosen protocol name (e.g. "range")
+  std::map<std::string, Member> members;
+  std::map<std::pair<std::string, int32_t>, int64_t> offsets;
+  int64_t rebalance_deadline_ms = 0;
+  int member_counter = 0;
+};
+
+// ----------------------------------------------------------- broker state
+std::mutex g_mu;
+std::condition_variable g_data_cv;   // new produce landed (fetch long-poll)
+std::condition_variable g_group_cv;  // group state changed (join/sync blocks)
+std::map<std::string, Topic> g_topics;
+std::map<std::string, Group> g_groups;
+int g_port = 0;
+constexpr int32_t kDefaultPartitions = 8;
+
+int64_t now_ms() {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
+}
+
+Topic& topic_ref_locked(const std::string& name, int32_t partitions = kDefaultPartitions) {
+  auto it = g_topics.find(name);
+  if (it == g_topics.end()) {
+    Topic t;
+    t.partitions.resize(size_t(partitions));
+    it = g_topics.emplace(name, std::move(t)).first;
+  }
+  return it->second;
+}
+
+// error codes
+constexpr int16_t ERR_NONE = 0;
+constexpr int16_t ERR_UNKNOWN_TOPIC = 3;
+constexpr int16_t ERR_ILLEGAL_GENERATION = 22;
+constexpr int16_t ERR_UNKNOWN_MEMBER = 25;
+constexpr int16_t ERR_INVALID_TOPIC = 17;
+constexpr int16_t ERR_REBALANCE_IN_PROGRESS = 27;
+constexpr int16_t ERR_UNSUPPORTED_VERSION = 35;
+
+// ------------------------------------------------------- record batch v2
+// Parse every record of a RecordBatch v2 blob into `out` (timestamps and
+// offsets recomputed by the broker — producer deltas are relative).
+bool parse_record_batch(const std::vector<uint8_t>& blob,
+                        std::vector<StoredRecord>* out) {
+  Reader r(blob.data(), blob.size());
+  while (r.ok && r.p < r.end) {
+    r.i64();                       // baseOffset (producer-side, ignored)
+    int32_t batch_len = r.i32();   // bytes after this field
+    if (!r.need(size_t(batch_len))) return false;
+    const uint8_t* batch_end = r.p + batch_len;
+    r.i32();                       // partitionLeaderEpoch
+    uint8_t magic = r.i8();
+    if (magic != 2) return false;
+    r.i32();                       // crc (trusted: same-process tests + TCP)
+    int16_t attrs = r.i16();
+    if (attrs & 0x07) return false;  // compression unsupported
+    r.i32();                       // lastOffsetDelta
+    int64_t first_ts = r.i64();
+    r.i64();                       // maxTimestamp
+    r.i64();                       // producerId
+    r.i16();                       // producerEpoch
+    r.i32();                       // baseSequence
+    int32_t count = r.i32();
+    for (int32_t i = 0; i < count && r.ok; i++) {
+      int64_t rec_len = r.varlong();
+      const uint8_t* rec_end = r.p + rec_len;
+      r.i8();                      // record attributes
+      int64_t ts_delta = r.varlong();
+      r.varlong();                 // offsetDelta
+      StoredRecord rec;
+      rec.timestamp_ms = first_ts + ts_delta;
+      int64_t klen = r.varlong();
+      if (klen >= 0) {
+        if (!r.need(size_t(klen))) return false;
+        rec.key = std::vector<uint8_t>(r.p, r.p + klen);
+        r.p += klen;
+      }
+      int64_t vlen = r.varlong();
+      if (vlen >= 0) {
+        if (!r.need(size_t(vlen))) return false;
+        rec.value = std::vector<uint8_t>(r.p, r.p + vlen);
+        r.p += vlen;
+      }
+      int64_t hcount = r.varlong();
+      for (int64_t h = 0; h < hcount && r.ok; h++) {
+        int64_t hklen = r.varlong();
+        if (!r.need(size_t(hklen))) return false;
+        std::string hk(reinterpret_cast<const char*>(r.p), size_t(hklen));
+        r.p += hklen;
+        int64_t hvlen = r.varlong();
+        std::vector<uint8_t> hv;
+        if (hvlen >= 0) {
+          if (!r.need(size_t(hvlen))) return false;
+          hv.assign(r.p, r.p + hvlen);
+          r.p += hvlen;
+        }
+        rec.headers.emplace_back(std::move(hk), std::move(hv));
+      }
+      if (r.p != rec_end) r.p = rec_end;  // tolerate producer padding
+      out->push_back(std::move(rec));
+    }
+    if (r.p != batch_end) r.p = batch_end;
+  }
+  return r.ok;
+}
+
+// Encode records [first, last) of a partition log as ONE RecordBatch v2.
+std::vector<uint8_t> encode_record_batch(const std::vector<StoredRecord>& log,
+                                         size_t first, size_t last) {
+  Writer records;
+  int64_t base_ts = log[first].timestamp_ms;
+  for (size_t i = first; i < last; i++) {
+    const StoredRecord& rec = log[i];
+    Writer body;
+    body.i8(0);  // attributes
+    body.varlong(rec.timestamp_ms - base_ts);
+    body.varlong(int64_t(i - first));  // offsetDelta
+    if (rec.key) { body.varlong(int64_t(rec.key->size())); body.raw(rec.key->data(), rec.key->size()); }
+    else body.varlong(-1);
+    if (rec.value) { body.varlong(int64_t(rec.value->size())); body.raw(rec.value->data(), rec.value->size()); }
+    else body.varlong(-1);
+    body.varlong(int64_t(rec.headers.size()));
+    for (const auto& h : rec.headers) {
+      body.varlong(int64_t(h.first.size()));
+      body.raw(h.first.data(), h.first.size());
+      body.varlong(int64_t(h.second.size()));
+      body.raw(h.second.data(), h.second.size());
+    }
+    records.varlong(int64_t(body.buf.size()));
+    records.raw(body.buf.data(), body.buf.size());
+  }
+  // the crc covers everything from attributes (i16) onward
+  Writer crcbody;
+  crcbody.i16(0);                          // attributes
+  crcbody.i32(int32_t(last - first - 1));  // lastOffsetDelta
+  crcbody.i64(base_ts);
+  crcbody.i64(log[last - 1].timestamp_ms);
+  crcbody.i64(-1);                         // producerId
+  crcbody.i16(-1);                         // producerEpoch
+  crcbody.i32(-1);                         // baseSequence
+  crcbody.i32(int32_t(last - first));
+  crcbody.raw(records.buf.data(), records.buf.size());
+  uint32_t crc = crc32c(crcbody.buf.data(), crcbody.buf.size());
+
+  Writer out;
+  out.i64(int64_t(first));                     // baseOffset
+  out.i32(int32_t(4 + 1 + 4 + crcbody.buf.size()));  // batchLength
+  out.i32(0);                                  // partitionLeaderEpoch
+  out.i8(2);                                   // magic
+  out.i32(int32_t(crc));
+  out.raw(crcbody.buf.data(), crcbody.buf.size());
+  return out.buf;
+}
+
+// ----------------------------------------------------------- API handlers
+void handle_api_versions(Writer& w) {
+  // (api_key, min, max) for everything we speak
+  const int16_t table[][3] = {
+      {0, 0, 3},  {1, 0, 4},  {2, 0, 1},  {3, 0, 1},  {8, 0, 2},
+      {9, 0, 1},  {10, 0, 0}, {11, 0, 2}, {12, 0, 1}, {13, 0, 1},
+      {14, 0, 1}, {18, 0, 0}, {19, 0, 0},
+  };
+  w.i16(ERR_NONE);
+  w.i32(int32_t(sizeof(table) / sizeof(table[0])));
+  for (const auto& row : table) { w.i16(row[0]); w.i16(row[1]); w.i16(row[2]); }
+}
+
+void handle_metadata(Reader& r, Writer& w) {
+  int32_t n = r.i32();
+  std::vector<std::string> names;
+  for (int32_t i = 0; i < n; i++) names.push_back(r.str());
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (n < 0) for (const auto& kv : g_topics) names.push_back(kv.first);
+  else for (const auto& name : names) topic_ref_locked(name);  // auto-create
+  // brokers
+  w.i32(1);
+  w.i32(0); w.str("127.0.0.1"); w.i32(g_port); w.null_str();  // rack
+  w.i32(0);  // controller_id
+  w.i32(int32_t(names.size()));
+  for (const auto& name : names) {
+    Topic& t = g_topics.at(name);
+    w.i16(ERR_NONE); w.str(name); w.i8(0);  // is_internal
+    w.i32(int32_t(t.partitions.size()));
+    for (size_t p = 0; p < t.partitions.size(); p++) {
+      w.i16(ERR_NONE); w.i32(int32_t(p)); w.i32(0);  // leader
+      w.i32(1); w.i32(0);  // replicas [0]
+      w.i32(1); w.i32(0);  // isr [0]
+    }
+  }
+}
+
+void handle_produce(Reader& r, Writer& w) {
+  r.str();   // transactional_id (v3; nullable)
+  r.i16();   // acks — we always ack after append (durability = RAM)
+  r.i32();   // timeout
+  int32_t ntopics = r.i32();
+  struct PartResult { std::string topic; int32_t part; int16_t err; int64_t base; };
+  std::vector<PartResult> results;
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    for (int32_t t = 0; t < ntopics; t++) {
+      std::string name = r.str();
+      int32_t nparts = r.i32();
+      for (int32_t p = 0; p < nparts; p++) {
+        int32_t part = r.i32();
+        auto blob = r.bytes();
+        PartResult res{name, part, ERR_NONE, -1};
+        Topic& topic = topic_ref_locked(name);
+        if (part < 0 || size_t(part) >= topic.partitions.size()) {
+          res.err = ERR_UNKNOWN_TOPIC;
+        } else if (blob) {
+          std::vector<StoredRecord> recs;
+          if (!parse_record_batch(*blob, &recs)) {
+            res.err = ERR_INVALID_TOPIC;
+          } else {
+            Partition& pa = topic.partitions[size_t(part)];
+            res.base = pa.high_watermark();
+            int64_t ts = now_ms();
+            for (auto& rec : recs) {
+              rec.offset = pa.high_watermark();
+              if (rec.timestamp_ms <= 0) rec.timestamp_ms = ts;
+              pa.log.push_back(std::move(rec));
+            }
+          }
+        }
+        results.push_back(std::move(res));
+      }
+    }
+  }
+  g_data_cv.notify_all();
+  // group results by topic, preserving order
+  w.i32(ntopics);
+  size_t i = 0;
+  while (i < results.size()) {
+    const std::string& name = results[i].topic;
+    size_t j = i;
+    while (j < results.size() && results[j].topic == name) j++;
+    w.str(name);
+    w.i32(int32_t(j - i));
+    for (size_t k = i; k < j; k++) {
+      w.i32(results[k].part);
+      w.i16(results[k].err);
+      w.i64(results[k].base);
+      w.i64(-1);  // log_append_time
+    }
+    i = j;
+  }
+  w.i32(0);  // throttle_time_ms (LAST for produce)
+}
+
+void handle_fetch(Reader& r, Writer& w) {
+  r.i32();  // replica_id
+  int32_t max_wait = r.i32();
+  int32_t min_bytes = r.i32();
+  r.i32();  // max_bytes (total)
+  r.i8();   // isolation
+  int32_t ntopics = r.i32();
+  struct Want { std::string topic; int32_t part; int64_t off; int32_t max; };
+  std::vector<Want> wants;
+  for (int32_t t = 0; t < ntopics; t++) {
+    std::string name = r.str();
+    int32_t nparts = r.i32();
+    for (int32_t p = 0; p < nparts; p++) {
+      Want want;
+      want.topic = name;
+      want.part = r.i32();
+      want.off = r.i64();
+      want.max = r.i32();
+      wants.push_back(std::move(want));
+    }
+  }
+  auto have_data = [&wants]() {
+    for (const auto& want : wants) {
+      auto it = g_topics.find(want.topic);
+      if (it == g_topics.end()) continue;
+      if (want.part < 0 || size_t(want.part) >= it->second.partitions.size())
+        continue;
+      if (it->second.partitions[size_t(want.part)].high_watermark() > want.off)
+        return true;
+    }
+    return false;
+  };
+  std::unique_lock<std::mutex> lk(g_mu);
+  if (max_wait > 0 && min_bytes > 0 && !have_data()) {
+    g_data_cv.wait_for(lk, std::chrono::milliseconds(max_wait),
+                       [&] { return have_data(); });
+  }
+  w.i32(0);  // throttle (FIRST for fetch v1+)
+  w.i32(ntopics);
+  size_t i = 0;
+  while (i < wants.size()) {
+    const std::string& name = wants[i].topic;
+    size_t j = i;
+    while (j < wants.size() && wants[j].topic == name) j++;
+    w.str(name);
+    w.i32(int32_t(j - i));
+    for (size_t k = i; k < j; k++) {
+      const Want& want = wants[k];
+      w.i32(want.part);
+      auto it = g_topics.find(want.topic);
+      bool known = it != g_topics.end() && want.part >= 0 &&
+                   size_t(want.part) < it->second.partitions.size();
+      if (!known) {
+        w.i16(ERR_UNKNOWN_TOPIC); w.i64(-1); w.i64(-1);
+        w.i32(-1);  // aborted_transactions (null)
+        w.i32(-1);  // record_set null
+        continue;
+      }
+      Partition& pa = it->second.partitions[size_t(want.part)];
+      int64_t hw = pa.high_watermark();
+      w.i16(ERR_NONE); w.i64(hw); w.i64(hw);
+      w.i32(-1);  // aborted_transactions (null)
+      if (want.off >= hw || want.off < 0) { w.i32(-1); continue; }
+      // cap records by the partition max_bytes request (approximate:
+      // stop before exceeding, always include at least one)
+      size_t first = size_t(want.off), last = first;
+      int64_t budget = want.max > 0 ? want.max : 1 << 20;
+      int64_t used = 0;
+      while (last < pa.log.size()) {
+        const StoredRecord& rec = pa.log[last];
+        int64_t sz = 32 + int64_t(rec.key ? rec.key->size() : 0) +
+                     int64_t(rec.value ? rec.value->size() : 0);
+        for (const auto& h : rec.headers)
+          sz += int64_t(h.first.size() + h.second.size() + 4);
+        if (last > first && used + sz > budget) break;
+        used += sz;
+        last++;
+      }
+      std::vector<uint8_t> blob = encode_record_batch(pa.log, first, last);
+      w.bytes(blob);
+    }
+    i = j;
+  }
+}
+
+void handle_list_offsets(Reader& r, Writer& w) {
+  r.i32();  // replica
+  int32_t ntopics = r.i32();
+  std::lock_guard<std::mutex> lk(g_mu);
+  w.i32(ntopics);
+  for (int32_t t = 0; t < ntopics; t++) {
+    std::string name = r.str();
+    int32_t nparts = r.i32();
+    w.str(name);
+    w.i32(nparts);
+    for (int32_t p = 0; p < nparts; p++) {
+      int32_t part = r.i32();
+      int64_t ts = r.i64();
+      w.i32(part);
+      auto it = g_topics.find(name);
+      if (it == g_topics.end() || part < 0 ||
+          size_t(part) >= it->second.partitions.size()) {
+        w.i16(ERR_UNKNOWN_TOPIC); w.i64(-1); w.i64(-1);
+        continue;
+      }
+      int64_t hw = it->second.partitions[size_t(part)].high_watermark();
+      w.i16(ERR_NONE);
+      w.i64(-1);  // timestamp
+      w.i64(ts == -2 ? 0 : hw);  // -2 earliest, -1 latest
+    }
+  }
+}
+
+void handle_find_coordinator(Reader& r, Writer& w) {
+  r.str();  // group id — single node: always us
+  w.i16(ERR_NONE);
+  w.i32(0); w.str("127.0.0.1"); w.i32(g_port);
+}
+
+// complete a pending rebalance if every current member has rejoined (or
+// the deadline passed — stragglers are dropped).  Caller holds g_mu.
+void maybe_complete_join_locked(Group& g) {
+  if (g.state != Group::PreparingRebalance) return;
+  bool all = true;
+  for (const auto& kv : g.members) all = all && kv.second.joined_round;
+  if (!all && now_ms() < g.rebalance_deadline_ms) return;
+  if (!all) {  // drop stragglers
+    for (auto it = g.members.begin(); it != g.members.end();) {
+      if (!it->second.joined_round) it = g.members.erase(it);
+      else ++it;
+    }
+  }
+  if (g.members.empty()) { g.state = Group::Empty; g_group_cv.notify_all(); return; }
+  g.generation++;
+  g.leader = g.members.begin()->first;
+  // protocol selection: first protocol of the leader (all members align
+  // on "range" in our client)
+  if (!g.members.begin()->second.protocols.empty())
+    g.protocol = g.members.begin()->second.protocols[0].first;
+  g.state = Group::CompletingRebalance;
+  for (auto& kv : g.members) kv.second.assignment.clear();
+  g_group_cv.notify_all();
+}
+
+void handle_join_group(Reader& r, Writer& w) {
+  std::string group_id = r.str();
+  int32_t session_timeout = r.i32();
+  int32_t rebalance_timeout = r.i32();
+  std::string member_id = r.str();
+  std::string protocol_type = r.str();
+  int32_t nproto = r.i32();
+  std::vector<std::pair<std::string, std::vector<uint8_t>>> protocols;
+  for (int32_t i = 0; i < nproto; i++) {
+    std::string pname = r.str();
+    auto meta = r.bytes();
+    protocols.emplace_back(pname, meta.value_or(std::vector<uint8_t>{}));
+  }
+  (void)protocol_type;
+
+  std::unique_lock<std::mutex> lk(g_mu);
+  Group& g = g_groups[group_id];
+  if (member_id.empty())
+    member_id = "m-" + std::to_string(++g.member_counter);
+  Member& m = g.members[member_id];
+  m.id = member_id;
+  m.protocols = std::move(protocols);
+  m.session_timeout_ms = session_timeout;
+  m.deadline_ms = now_ms() + session_timeout;
+  m.joined_round = true;
+  if (g.state == Group::Empty || g.state == Group::Stable ||
+      g.state == Group::CompletingRebalance) {
+    // a (re)join interrupts a stable/completing group: everyone rebalances
+    g.state = Group::PreparingRebalance;
+    g.rebalance_deadline_ms = now_ms() + std::max(rebalance_timeout, 1000);
+    for (auto& kv : g.members) kv.second.joined_round = kv.first == member_id;
+  }
+  maybe_complete_join_locked(g);
+  // block until this round completes (or our straggler deadline drops us)
+  g_group_cv.wait_for(
+      lk, std::chrono::milliseconds(std::max(rebalance_timeout, 1000) + 2000),
+      [&] {
+        maybe_complete_join_locked(g);
+        return g.state == Group::CompletingRebalance || g.state == Group::Stable ||
+               g.members.find(member_id) == g.members.end();
+      });
+  w.i32(0);  // throttle (JoinGroup v2)
+  if (g.members.find(member_id) == g.members.end()) {
+    w.i16(ERR_UNKNOWN_MEMBER); w.i32(-1); w.str(""); w.str(""); w.str(member_id);
+    w.i32(0);
+    return;
+  }
+  w.i16(ERR_NONE);
+  w.i32(g.generation);
+  w.str(g.protocol);
+  w.str(g.leader);
+  w.str(member_id);
+  if (member_id == g.leader) {
+    w.i32(int32_t(g.members.size()));
+    for (const auto& kv : g.members) {
+      w.str(kv.first);
+      // leader assigns from each member's metadata for the CHOSEN protocol
+      const std::vector<uint8_t>* meta = nullptr;
+      for (const auto& pr : kv.second.protocols)
+        if (pr.first == g.protocol) { meta = &pr.second; break; }
+      static const std::vector<uint8_t> kEmpty;
+      w.bytes(meta ? *meta : kEmpty);
+    }
+  } else {
+    w.i32(0);
+  }
+}
+
+void handle_sync_group(Reader& r, Writer& w) {
+  std::string group_id = r.str();
+  int32_t generation = r.i32();
+  std::string member_id = r.str();
+  int32_t nassign = r.i32();
+  std::vector<std::pair<std::string, std::vector<uint8_t>>> assignments;
+  for (int32_t i = 0; i < nassign; i++) {
+    std::string mid = r.str();
+    auto blob = r.bytes();
+    assignments.emplace_back(mid, blob.value_or(std::vector<uint8_t>{}));
+  }
+  std::unique_lock<std::mutex> lk(g_mu);
+  auto git = g_groups.find(group_id);
+  w.i32(0);  // throttle (SyncGroup v1)
+  if (git == g_groups.end() || !git->second.members.count(member_id)) {
+    w.i16(ERR_UNKNOWN_MEMBER); w.i32(-1);
+    return;
+  }
+  Group& g = git->second;
+  if (generation != g.generation) {
+    w.i16(ERR_ILLEGAL_GENERATION); w.i32(-1);
+    return;
+  }
+  if (member_id == g.leader) {
+    for (auto& kv : assignments) {
+      auto mit = g.members.find(kv.first);
+      if (mit != g.members.end()) mit->second.assignment = std::move(kv.second);
+    }
+    g.state = Group::Stable;
+    g_group_cv.notify_all();
+  } else {
+    g_group_cv.wait_for(lk, std::chrono::milliseconds(30000), [&] {
+      return g.state == Group::Stable || g.generation != generation ||
+             !g.members.count(member_id);
+    });
+    if (g.generation != generation || !g.members.count(member_id)) {
+      w.i16(g.members.count(member_id) ? ERR_ILLEGAL_GENERATION
+                                       : ERR_UNKNOWN_MEMBER);
+      w.i32(-1);
+      return;
+    }
+  }
+  w.i16(ERR_NONE);
+  w.bytes(g.members[member_id].assignment);
+}
+
+void handle_heartbeat(Reader& r, Writer& w) {
+  std::string group_id = r.str();
+  int32_t generation = r.i32();
+  std::string member_id = r.str();
+  std::lock_guard<std::mutex> lk(g_mu);
+  w.i32(0);  // throttle (v1)
+  auto git = g_groups.find(group_id);
+  if (git == g_groups.end() || !git->second.members.count(member_id)) {
+    w.i16(ERR_UNKNOWN_MEMBER);
+    return;
+  }
+  Group& g = git->second;
+  Member& m = g.members[member_id];
+  m.deadline_ms = now_ms() + m.session_timeout_ms;
+  if (g.state == Group::PreparingRebalance) { w.i16(ERR_REBALANCE_IN_PROGRESS); return; }
+  if (generation != g.generation) { w.i16(ERR_ILLEGAL_GENERATION); return; }
+  w.i16(ERR_NONE);
+}
+
+void handle_leave_group(Reader& r, Writer& w) {
+  std::string group_id = r.str();
+  std::string member_id = r.str();
+  std::lock_guard<std::mutex> lk(g_mu);
+  w.i32(0);  // throttle (v1)
+  auto git = g_groups.find(group_id);
+  if (git != g_groups.end() && git->second.members.erase(member_id)) {
+    Group& g = git->second;
+    if (g.members.empty()) {
+      g.state = Group::Empty;
+    } else {
+      g.state = Group::PreparingRebalance;
+      g.rebalance_deadline_ms = now_ms() + 5000;
+      for (auto& kv : g.members) kv.second.joined_round = false;
+    }
+    g_group_cv.notify_all();
+  }
+  w.i16(ERR_NONE);
+}
+
+void handle_offset_commit(Reader& r, Writer& w) {
+  std::string group_id = r.str();
+  int32_t generation = r.i32();
+  std::string member_id = r.str();
+  r.i64();  // retention (v2)
+  int32_t ntopics = r.i32();
+  std::lock_guard<std::mutex> lk(g_mu);
+  Group& g = g_groups[group_id];
+  // commits from a stale generation still record (commit-on-revoke lands
+  // right before rejoin); unknown members commit too (simple consumers)
+  (void)generation; (void)member_id;
+  w.i32(ntopics);
+  for (int32_t t = 0; t < ntopics; t++) {
+    std::string name = r.str();
+    int32_t nparts = r.i32();
+    w.str(name);
+    w.i32(nparts);
+    for (int32_t p = 0; p < nparts; p++) {
+      int32_t part = r.i32();
+      int64_t off = r.i64();
+      r.str();  // metadata
+      g.offsets[{name, part}] = off;
+      w.i32(part);
+      w.i16(ERR_NONE);
+    }
+  }
+}
+
+void handle_offset_fetch(Reader& r, Writer& w) {
+  std::string group_id = r.str();
+  int32_t ntopics = r.i32();
+  std::lock_guard<std::mutex> lk(g_mu);
+  auto git = g_groups.find(group_id);
+  w.i32(ntopics);
+  for (int32_t t = 0; t < ntopics; t++) {
+    std::string name = r.str();
+    int32_t nparts = r.i32();
+    w.str(name);
+    w.i32(nparts);
+    for (int32_t p = 0; p < nparts; p++) {
+      int32_t part = r.i32();
+      int64_t off = -1;
+      if (git != g_groups.end()) {
+        auto oit = git->second.offsets.find({name, part});
+        if (oit != git->second.offsets.end()) off = oit->second;
+      }
+      w.i32(part);
+      w.i64(off);
+      w.null_str();  // metadata
+      w.i16(ERR_NONE);
+    }
+  }
+}
+
+void handle_create_topics(Reader& r, Writer& w) {
+  int32_t ntopics = r.i32();
+  std::vector<std::pair<std::string, int32_t>> reqs;
+  for (int32_t t = 0; t < ntopics; t++) {
+    std::string name = r.str();
+    int32_t parts = r.i32();
+    r.i16();  // replication
+    int32_t nassign = r.i32();
+    for (int32_t a = 0; a < nassign; a++) {
+      r.i32();
+      int32_t nrep = r.i32();
+      for (int32_t x = 0; x < nrep; x++) r.i32();
+    }
+    int32_t nconf = r.i32();
+    bool compacted = false;
+    for (int32_t c = 0; c < nconf; c++) {
+      std::string key = r.str();
+      std::string value = r.str();
+      if (key == "cleanup.policy" && value.find("compact") != std::string::npos)
+        compacted = true;
+    }
+    if (parts <= 0) parts = kDefaultPartitions;
+    reqs.emplace_back(name, compacted ? -parts : parts);
+  }
+  r.i32();  // timeout
+  std::lock_guard<std::mutex> lk(g_mu);
+  w.i32(int32_t(reqs.size()));
+  for (auto& req : reqs) {
+    bool compacted = req.second < 0;
+    int32_t parts = compacted ? -req.second : req.second;
+    bool existed = g_topics.count(req.first) > 0;
+    Topic& t = topic_ref_locked(req.first, parts);
+    if (!existed) t.compacted = compacted;
+    w.str(req.first);
+    w.i16(existed ? int16_t(36) : ERR_NONE);  // 36 = TOPIC_ALREADY_EXISTS
+  }
+}
+
+// ------------------------------------------------------- session reaping
+void reaper() {
+  while (true) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(1000));
+    std::lock_guard<std::mutex> lk(g_mu);
+    int64_t now = now_ms();
+    for (auto& gkv : g_groups) {
+      Group& g = gkv.second;
+      bool removed = false;
+      for (auto it = g.members.begin(); it != g.members.end();) {
+        // members mid-rebalance are judged by the rebalance deadline,
+        // not their heartbeat (joins block without heartbeating)
+        bool expired = g.state == Group::Stable && now > it->second.deadline_ms;
+        if (expired) { it = g.members.erase(it); removed = true; }
+        else ++it;
+      }
+      if (removed) {
+        if (g.members.empty()) {
+          g.state = Group::Empty;
+        } else {
+          g.state = Group::PreparingRebalance;
+          g.rebalance_deadline_ms = now + 5000;
+          for (auto& kv : g.members) kv.second.joined_round = false;
+        }
+        g_group_cv.notify_all();
+      }
+    }
+  }
+}
+
+// --------------------------------------------------------------- serving
+bool read_exact(int fd, uint8_t* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t k = recv(fd, buf + got, n - got, 0);
+    if (k <= 0) return false;
+    got += size_t(k);
+  }
+  return true;
+}
+
+void serve(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  while (true) {
+    uint8_t szbuf[4];
+    if (!read_exact(fd, szbuf, 4)) break;
+    uint32_t size = (uint32_t(szbuf[0]) << 24) | (uint32_t(szbuf[1]) << 16) |
+                    (uint32_t(szbuf[2]) << 8) | szbuf[3];
+    if (size == 0 || size > (64u << 20)) break;
+    std::vector<uint8_t> req(size);
+    if (!read_exact(fd, req.data(), size)) break;
+    Reader r(req.data(), req.size());
+    int16_t api_key = r.i16();
+    int16_t api_version = r.i16();
+    int32_t correlation = r.i32();
+    r.str();  // client_id
+
+    Writer w;
+    w.i32(0);  // size placeholder
+    w.i32(correlation);
+    bool supported = true;
+    switch (api_key) {
+      case 18: handle_api_versions(w); break;
+      case 3:  handle_metadata(r, w); break;
+      case 0:  handle_produce(r, w); break;
+      case 1:  handle_fetch(r, w); break;
+      case 2:  handle_list_offsets(r, w); break;
+      case 10: handle_find_coordinator(r, w); break;
+      case 11: handle_join_group(r, w); break;
+      case 14: handle_sync_group(r, w); break;
+      case 12: handle_heartbeat(r, w); break;
+      case 13: handle_leave_group(r, w); break;
+      case 8:  handle_offset_commit(r, w); break;
+      case 9:  handle_offset_fetch(r, w); break;
+      case 19: handle_create_topics(r, w); break;
+      default: supported = false; break;
+    }
+    (void)api_version;
+    if (!supported) {
+      w.buf.resize(8);
+      w.i16(ERR_UNSUPPORTED_VERSION);
+    }
+    w.patch_i32(0, int32_t(w.buf.size() - 4));
+    size_t sent = 0;
+    bool fail = false;
+    while (sent < w.buf.size()) {
+      ssize_t k = send(fd, w.buf.data() + sent, w.buf.size() - sent, 0);
+      if (k <= 0) { fail = true; break; }
+      sent += size_t(k);
+    }
+    if (fail) break;
+  }
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  crc_init();
+  int port = argc > 1 ? atoi(argv[1]) : 19192;
+  signal(SIGPIPE, SIG_IGN);
+  int server = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(server, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(uint16_t(port));
+  if (bind(server, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  if (port == 0) {
+    socklen_t len = sizeof(addr);
+    if (getsockname(server, (sockaddr*)&addr, &len) == 0)
+      port = ntohs(addr.sin_port);
+  }
+  g_port = port;
+  listen(server, 64);
+  printf("PORT %d\n", port);
+  fflush(stdout);
+  fprintf(stderr, "kafkad listening on 127.0.0.1:%d\n", port);
+  std::thread(reaper).detach();
+  for (;;) {
+    int fd = accept(server, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(serve, fd).detach();
+  }
+}

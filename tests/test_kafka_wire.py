@@ -1,0 +1,219 @@
+"""Native Kafka wire client + kafkad broker (VERDICT r3 item 4: the
+"real broker" lane, in-image).  The transport-contract suite runs
+KafkaWireMesh through the shared semantics tests; this file covers the
+wire layer itself — codec vectors, RecordBatch round trips, the range
+assignor — and the group-coordination behaviors a contract test can't
+see (rebalance splits, takeover, commit-resume), plus a full agent
+round trip over the wire mesh.
+
+Reference anchor: tests/integration/ + Makefile test-kafka (the
+reference's Redpanda lane); here the broker is the in-repo
+``native/bin/kafkad`` speaking the same wire format.
+"""
+
+import asyncio
+
+import pytest
+
+from calfkit_tpu.mesh.kafka_wire import (
+    KafkaWireClient,
+    KafkaWireMesh,
+    crc32c,
+    decode_record_batches,
+    encode_record_batch,
+    find_kafkad,
+    murmur2,
+    partition_for,
+    range_assign,
+    spawn_kafkad,
+)
+
+pytestmark = pytest.mark.skipif(
+    find_kafkad() is None, reason="kafkad not built (make -C native)"
+)
+
+
+@pytest.fixture(scope="module")
+def broker_port():
+    proc = spawn_kafkad(0)
+    yield proc.kafkad_port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+class TestWireCodec:
+    def test_crc32c_vector(self):
+        # the canonical CRC-32C check value
+        assert crc32c(b"123456789") == 0xE3069283
+
+    def test_murmur2_vectors(self):
+        # librdkafka rdmurmur2.c unittest vectors (Java-compatible)
+        assert murmur2(b"kafka") == 0xD067CF64
+        assert murmur2(b"") == 0x106E08D9
+        assert murmur2(b"1234") == 0x9FC97B14
+        assert murmur2(b"giberish123456789") == 0x8F552B0C
+
+    def test_keyed_partitioning_is_deterministic(self):
+        counter = [0]
+        a = partition_for(b"run-42", 16, counter)
+        b = partition_for(b"run-42", 16, counter)
+        assert a == b
+        # keyless round-robins
+        seen = {partition_for(None, 4, counter) for _ in range(8)}
+        assert seen == {0, 1, 2, 3}
+
+    def test_record_batch_round_trip(self):
+        records = [
+            (b"k1", b"v1", [("trace", b"t1"), ("hop", b"2")]),
+            (None, b"keyless", []),
+            (b"tomb", None, []),  # null value = tombstone
+        ]
+        blob = encode_record_batch(records, 1_700_000_000_000)
+        out = decode_record_batches(blob)
+        assert [(k, v, h) for _o, _t, k, v, h in out] == records
+        assert [o for o, *_ in out] == [0, 1, 2]
+
+    def test_range_assign_splits_evenly(self):
+        members = {"m-1": ["a"], "m-2": ["a"]}
+        partitions = {"a": [0, 1, 2, 3, 4]}
+        out = range_assign(members, partitions)
+        assert out["m-1"]["a"] == [0, 1, 2]
+        assert out["m-2"]["a"] == [3, 4]
+        # a member not subscribed to a topic gets none of it
+        members = {"m-1": ["a"], "m-2": ["b"]}
+        partitions = {"a": [0, 1], "b": [0]}
+        out = range_assign(members, partitions)
+        assert out["m-1"] == {"a": [0, 1]}
+        assert out["m-2"] == {"b": [0]}
+
+
+class TestWireBroker:
+    async def test_produce_fetch_headers_tombstones(self, broker_port):
+        client = KafkaWireClient("127.0.0.1", broker_port)
+        await client.metadata(["t1"])
+        base = await client.produce(
+            "t1", 0,
+            encode_record_batch(
+                [(b"k", b"v", [("h", b"x")]), (b"k", None, [])], 1234,
+            ),
+        )
+        assert base == 0
+        fetched = await client.fetch([("t1", 0, 0)], max_wait_ms=100)
+        [(_t, _p, err, blob)] = fetched
+        assert err == 0
+        recs = decode_record_batches(blob)
+        assert recs[0][2:] == (b"k", b"v", [("h", b"x")])
+        assert recs[1][3] is None  # tombstone survives the wire
+        await client.close()
+
+    async def test_fetch_from_middle_offset(self, broker_port):
+        client = KafkaWireClient("127.0.0.1", broker_port)
+        await client.metadata(["t2"])
+        for i in range(5):
+            await client.produce(
+                "t2", 1,
+                encode_record_batch([(None, b"m%d" % i, [])], 1000 + i),
+            )
+        fetched = await client.fetch([("t2", 1, 3)], max_wait_ms=100)
+        [(_t, _p, _e, blob)] = fetched
+        assert [v for _o, _t2, _k, v, _h in decode_record_batches(blob)] == [
+            b"m3", b"m4",
+        ]
+        await client.close()
+
+    async def test_commit_resume_across_group_restarts(self, broker_port):
+        """Offsets committed by one consumer generation are where the next
+        one resumes — the crash/restart contract."""
+        mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        await mesh.start()
+        topic = "resume-topic"
+        await mesh.ensure_topics([topic])
+        first, second = [], []
+
+        async def h1(rec):
+            first.append(rec.value)
+
+        async def h2(rec):
+            second.append(rec.value)
+
+        sub = await mesh.subscribe([topic], h1, group_id="resume-g")
+        for i in range(4):
+            await mesh.publish(topic, b"a%d" % i, key=b"same-key")
+        for _ in range(100):
+            if len(first) == 4:
+                break
+            await asyncio.sleep(0.05)
+        assert len(first) == 4
+        await sub.stop()  # final commit on stop
+        # records published while nobody is subscribed
+        for i in range(3):
+            await mesh.publish(topic, b"b%d" % i, key=b"same-key")
+        sub2 = await mesh.subscribe([topic], h2, group_id="resume-g")
+        for _ in range(200):
+            if len(second) == 3:
+                break
+            await asyncio.sleep(0.05)
+        # ONLY the gap records: committed offsets were honored
+        assert second == [b"b0", b"b1", b"b2"]
+        await sub2.stop()
+        await mesh.stop()
+
+    async def test_rebalance_splits_and_takeover(self, broker_port):
+        mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        await mesh.start()
+        topic = "split-topic"
+        await mesh.ensure_topics([topic])
+        got_a, got_b = [], []
+
+        async def ha(rec):
+            got_a.append(rec.value)
+
+        async def hb(rec):
+            got_b.append(rec.value)
+
+        sub_a = await mesh.subscribe([topic], ha, group_id="split-g")
+        sub_b = await mesh.subscribe([topic], hb, group_id="split-g")
+        await asyncio.sleep(1.0)  # both generations settle
+        # keys spread over all 8 partitions: both members must see work
+        for i in range(24):
+            await mesh.publish(topic, b"w%d" % i, key=b"key-%d" % i)
+        for _ in range(200):
+            if len(got_a) + len(got_b) == 24:
+                break
+            await asyncio.sleep(0.05)
+        assert len(got_a) + len(got_b) == 24
+        assert got_a and got_b, "range assignment must split the partitions"
+        # one member leaves; the survivor owns everything
+        await sub_b.stop()
+        await asyncio.sleep(1.0)
+        mark = len(got_a)
+        for i in range(6):
+            await mesh.publish(topic, b"z%d" % i, key=b"key-%d" % i)
+        for _ in range(200):
+            if len(got_a) - mark == 6:
+                break
+            await asyncio.sleep(0.05)
+        assert len(got_a) - mark == 6
+        await sub_a.stop()
+        await mesh.stop()
+
+    async def test_agent_round_trip_over_wire_mesh(self, broker_port):
+        """The whole product path — client → kafkad (real Kafka wire
+        protocol) → worker → agent → reply — with zero aiokafka."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        client_mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        await client_mesh.start()
+        agent = Agent(
+            "wire_agent", model=TestModelClient(custom_output_text="over-kafka")
+        )
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(client_mesh)
+            result = await client.agent("wire_agent").execute("go", timeout=60)
+            assert result.output == "over-kafka"
+            await client.close()
+        await client_mesh.stop()
