@@ -231,33 +231,63 @@ async def _ttft_phase(engine) -> tuple[float | None, str | None, str]:
     """Median client-publish -> first-token latency over the live mesh.
 
     BASELINE phrases the north star as "Kafka-msg -> first-token": the
-    measured path should include real wire hops, so the phase first tries
-    the native meshd broker (worker and client on SEPARATE TCP
-    connections); ANY failure there falls back to InMemoryMesh — a broken
-    broker spawn must not cost the TTFT number, hardware captures can be
-    hours apart.  The returned transport label says which carried it."""
-    meshd_note = None
+    preferred lane is therefore the in-repo ``kafkad`` broker over the
+    REAL Kafka wire protocol (worker and client as separate wire
+    clients); next the native meshd TCP broker; ANY failure falls through
+    to InMemoryMesh — a broken broker spawn must not cost the TTFT
+    number, hardware captures can be hours apart.  The returned transport
+    label says which lane carried the measurement."""
+    notes = []
+    try:
+        from calfkit_tpu.mesh.kafka_wire import find_kafkad
+
+        if find_kafkad() is not None:
+            p50, err = await _ttft_over_kafkad(engine)
+            if p50 is not None or err is None:
+                return p50, err, "kafkad-wire"
+            notes.append(f"kafkad lane failed ({err})")
+    except Exception as e:  # noqa: BLE001 - fall through
+        notes.append(f"kafkad lane failed ({type(e).__name__}: {e})")
     try:
         from calfkit_tpu.mesh.tcp import find_meshd
 
         if find_meshd() is not None:
             p50, err = await _ttft_over_meshd(engine)
             if p50 is not None or err is None:
+                err = "; ".join(notes + ([err] if err else [])) or None
                 return p50, err, "meshd-tcp"
-            meshd_note = f"meshd lane failed ({err}); fell back to inmemory"
-    except Exception as e:  # noqa: BLE001 - fall back below
-        meshd_note = (
-            f"meshd lane failed ({type(e).__name__}: {e}); "
-            "fell back to inmemory"
-        )
+            notes.append(f"meshd lane failed ({err})")
+    except Exception as e:  # noqa: BLE001 - fall through
+        notes.append(f"meshd lane failed ({type(e).__name__}: {e})")
     from calfkit_tpu.mesh import InMemoryMesh
 
     p50, err = await _ttft_runs(engine, InMemoryMesh(), None)
-    if err is None and meshd_note is not None:
-        err = meshd_note
-    elif err is not None and meshd_note is not None:
-        err = f"{meshd_note} | {err}"
+    notes and notes.append("fell back to inmemory")
+    err = "; ".join(notes + ([err] if err else [])) or None
     return p50, err, "inmemory"
+
+
+async def _ttft_over_kafkad(engine) -> tuple[float | None, str | None]:
+    """Measure over the real Kafka wire protocol: spawn kafkad, run the
+    worker and client as separate KafkaWireMesh connections."""
+    import contextlib as _ctx
+
+    from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh, spawn_kafkad
+
+    proc = spawn_kafkad(0)
+    port = proc.kafkad_port
+    try:
+        mesh = KafkaWireMesh(f"127.0.0.1:{port}")
+        client_mesh = KafkaWireMesh(f"127.0.0.1:{port}")
+        await client_mesh.start()
+        try:
+            return await _ttft_runs(engine, mesh, client_mesh)
+        finally:
+            await client_mesh.stop()
+    finally:
+        proc.terminate()
+        with _ctx.suppress(Exception):
+            proc.wait(timeout=5)
 
 
 async def _ttft_over_meshd(engine) -> tuple[float | None, str | None]:

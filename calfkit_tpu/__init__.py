@@ -44,6 +44,7 @@ _LAZY: dict[str, str] = {
     "OpenAIModelClient": "calfkit_tpu.providers",
     "OpenAIResponsesModelClient": "calfkit_tpu.providers",
     "AnthropicModelClient": "calfkit_tpu.providers",
+    "GeminiModelClient": "calfkit_tpu.providers",
     "FallbackModelClient": "calfkit_tpu.providers",
 }
 

@@ -345,6 +345,7 @@ class KafkaWireError(Exception):
         super().__init__(f"{api} error_code={code}")
 
 
+ERR_OFFSET_OUT_OF_RANGE = 1
 ERR_REBALANCE_IN_PROGRESS = 27
 ERR_ILLEGAL_GENERATION = 22
 ERR_UNKNOWN_MEMBER = 25
@@ -718,7 +719,11 @@ class KafkaWireClient:
             r.string()
             for _ in range(r.i32()):
                 r.i32()
-                r.i16()
+                err = r.i16()
+                if err:
+                    # a silently-failed commit (rebalance in flight against
+                    # a real broker) would rewind the group on restart
+                    raise KafkaWireError("offset_commit", err)
 
     async def offset_fetch(
         self, group: str, wants: "list[tuple[str, int]]"
@@ -983,7 +988,23 @@ class _WireConsumer:
         ]
         results = await self._client.fetch(wants, max_wait_ms=300)
         for topic, part, err, blob in results:
-            if err or not blob:
+            if err == ERR_OFFSET_OUT_OF_RANGE:
+                # retention moved log-start past our position (real
+                # brokers): re-resolve instead of silently stalling the
+                # partition forever
+                fresh = await self._client.list_offsets(
+                    [(topic, part)], earliest=not self._from_latest
+                )
+                self._positions[(topic, part)] = fresh.get((topic, part), 0)
+                continue
+            if err:
+                logger.warning(
+                    "kafka-wire fetch error %d on %s[%d]; retrying",
+                    err, topic, part,
+                )
+                await asyncio.sleep(0.2)
+                continue
+            if not blob:
                 continue
             for off, ts_ms, key, value, headers in decode_record_batches(blob):
                 position = self._positions.get((topic, part), 0)
@@ -1040,7 +1061,6 @@ class KafkaWireMesh(MeshTransport):
         self._max_bytes = max_message_bytes
         self._default_partitions = default_partitions
         self._producer: KafkaWireClient | None = None
-        self._producer_lock = asyncio.Lock()
         self._partition_counts: dict[str, int] = {}
         self._rr_counter = [0]
         self._consumers: list[_WireConsumer] = []
@@ -1120,16 +1140,27 @@ class KafkaWireMesh(MeshTransport):
             )
         if self._producer is None:
             raise RuntimeError("mesh not started")
-        async with self._producer_lock:
-            n = await self._partitions_of(topic)
-            part = partition_for(key, n, self._rr_counter)
-            batch = encode_record_batch(
-                [(key, value,
-                  [(hk, hv.encode("utf-8"))
-                   for hk, hv in (headers or {}).items()])],
-                int(time.time() * 1000),
+        # no mesh-wide lock: partition choice is synchronous, the metadata
+        # lookup caches after the first call per topic, and _Conn already
+        # serializes the wire — holding a lock across the produce RTT
+        # would cap the whole transport at one in-flight message
+        n = await self._partitions_of(topic)
+        part = partition_for(key, n, self._rr_counter)
+        records = [(
+            key, value,
+            [(hk, hv.encode("utf-8")) for hk, hv in (headers or {}).items()],
+        )]
+        now_ms = int(time.time() * 1000)
+        if value is not None and len(value) > 65536:
+            # the pure-Python crc32c over a multi-MiB payload would stall
+            # the event loop (heartbeats, fetch long-polls); encode big
+            # batches on a worker thread
+            batch = await asyncio.to_thread(
+                encode_record_batch, records, now_ms
             )
-            await self._producer.produce(topic, part, batch)
+        else:
+            batch = encode_record_batch(records, now_ms)
+        await self._producer.produce(topic, part, batch)
 
     # -------------------------------------------------------------- consume
     async def subscribe(
@@ -1165,7 +1196,17 @@ class KafkaWireMesh(MeshTransport):
         )
         consumer.start()
         self._consumers.append(consumer)
-        await asyncio.wait_for(consumer.started.wait(), timeout=30)
+        try:
+            await asyncio.wait_for(consumer.started.wait(), timeout=30)
+        except BaseException:
+            # a failed subscribe must not leak a live consumer task (still
+            # rejoining, still a group member) + a running dispatcher
+            self._consumers.remove(consumer)
+            await consumer.stop()
+            if dispatcher is not None:
+                await dispatcher.stop()
+                self._dispatchers.remove(dispatcher)
+            raise
 
         async def stop_fn() -> None:
             await consumer.stop()
@@ -1239,6 +1280,14 @@ class _WireTableReader(TableReader):
                 await asyncio.sleep(0.5)
                 continue
             for _topic, part, err, blob in results:
+                if err == ERR_OFFSET_OUT_OF_RANGE:
+                    fresh = await self._client.list_offsets(
+                        [(self._topic, part)], earliest=True
+                    )
+                    self._fetch_positions[part] = fresh.get(
+                        (self._topic, part), 0
+                    )
+                    continue
                 if err or not blob:
                     continue
                 for off, _ts, key, value, _headers in decode_record_batches(blob):

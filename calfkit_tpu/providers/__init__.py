@@ -17,6 +17,7 @@ from calfkit_tpu.providers.fallback import (
     FallbackExhaustedError,
     FallbackModelClient,
 )
+from calfkit_tpu.providers.gemini import GeminiModelClient
 from calfkit_tpu.providers.http import ModelAPIError
 from calfkit_tpu.providers.openai import OpenAIModelClient
 from calfkit_tpu.providers.openai_responses import OpenAIResponsesModelClient
@@ -25,6 +26,7 @@ __all__ = [
     "AnthropicModelClient",
     "FallbackExhaustedError",
     "FallbackModelClient",
+    "GeminiModelClient",
     "ModelAPIError",
     "OpenAIModelClient",
     "OpenAIResponsesModelClient",

