@@ -26,6 +26,18 @@ from pathlib import Path
 
 DEFAULT_DEV_PORT = 19092
 
+# the managed dev brokers: meshd (native line protocol) and kafkad (the
+# real Kafka wire protocol — closest to the reference's bundled Tansu
+# dev broker, which is itself Kafka-compatible)
+BROKER_KINDS = {
+    "meshd": {"default_port": 19092, "scheme": "tcp"},
+    "kafkad": {"default_port": 19392, "scheme": "kafka+wire"},
+}
+
+
+def default_port(kind: str = "meshd") -> int:
+    return BROKER_KINDS[kind]["default_port"]
+
 
 def dev_dir() -> Path:
     root = os.environ.get("CALFKIT_DEV_DIR") or os.path.expanduser(
@@ -69,6 +81,28 @@ def _port_open(port: int, timeout: float = 0.5) -> bool:
         return False
 
 
+def _probe_kind(port: int, kind: str, timeout: float = 0.5) -> bool:
+    """Protocol-aware liveness: an open port is only 'our broker' if it
+    answers the kind's own protocol (a meshd squatting the port must not
+    be claimed as a kafkad and vice versa)."""
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=timeout) as s:
+            s.settimeout(timeout)
+            if kind == "meshd":
+                s.sendall(b"PING\n")
+                return s.recv(16).startswith(b"PONG")
+            # kafkad: ApiVersions v0 (api_key 18) with correlation id 7
+            req = (b"\x00\x12" b"\x00\x00" b"\x00\x00\x00\x07" b"\xff\xff")
+            s.sendall(len(req).to_bytes(4, "big") + req)
+            header = s.recv(8)
+            return (
+                len(header) == 8
+                and int.from_bytes(header[4:8], "big") == 7
+            )
+    except OSError:
+        return False
+
+
 # --------------------------------------------------------------------------- #
 # broker: connect-or-spawn with a spawn-race file lock
 # --------------------------------------------------------------------------- #
@@ -79,62 +113,105 @@ class BrokerInfo:
     port: int
     pid: int | None  # None = pre-existing broker we merely connected to
     spawned: bool
+    kind: str = "meshd"
 
     @property
     def url(self) -> str:
-        return f"tcp://127.0.0.1:{self.port}"
+        scheme = BROKER_KINDS[self.kind]["scheme"]
+        return f"{scheme}://127.0.0.1:{self.port}"
 
 
-def ensure_broker(port: int = DEFAULT_DEV_PORT) -> BrokerInfo:
+def _broker_meta(kind: str) -> Path:
+    # meshd keeps the legacy filename so existing dev state stays valid
+    name = "broker.json" if kind == "meshd" else f"broker-{kind}.json"
+    return dev_dir() / name
+
+
+def ensure_broker(
+    port: int | None = None, kind: str = "meshd"
+) -> BrokerInfo:
     """Connect to a live dev broker, or spawn one — exactly one, even when
     multiple ``ck dev`` invocations race (the reference's file-lock
     discipline, cli/_dev_broker.py:1-22)."""
+    if kind not in BROKER_KINDS:
+        raise ValueError(f"unknown broker kind {kind!r}")
+    if port is None:
+        port = default_port(kind)
+    if _probe_kind(port, kind):
+        return BrokerInfo(
+            port=port, pid=_read_broker_pid(port, kind), spawned=False,
+            kind=kind,
+        )
     if _port_open(port):
-        return BrokerInfo(port=port, pid=_read_broker_pid(port), spawned=False)
-    lock_path = dev_dir() / "broker.lock"
+        # something else is listening: claiming it would point daemons'
+        # wire clients at the wrong protocol
+        raise RuntimeError(
+            f"port {port} is occupied by something that does not speak "
+            f"the {kind} protocol — pick another --port"
+        )
+    lock_path = dev_dir() / f"broker-{kind}.lock"
     with open(lock_path, "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)  # losers wait here while one spawns
         try:
-            if _port_open(port):  # the winner got it up while we waited
+            if _probe_kind(port, kind):  # the winner got it up while we waited
                 return BrokerInfo(
-                    port=port, pid=_read_broker_pid(port), spawned=False
+                    port=port, pid=_read_broker_pid(port, kind),
+                    spawned=False, kind=kind,
                 )
-            from calfkit_tpu.mesh.tcp import spawn_meshd
+            if kind == "kafkad":
+                from calfkit_tpu.mesh.kafka_wire import spawn_kafkad as spawn
+            else:
+                from calfkit_tpu.mesh.tcp import spawn_meshd as spawn
 
             # own session: a ctrl-c aimed at the CLI must not take the
             # broker (daemons pointed at it) down with it
-            proc = spawn_meshd(port, start_new_session=True)
-            (dev_dir() / "broker.json").write_text(
-                json.dumps({"port": port, "pid": proc.pid})
+            proc = spawn(port, start_new_session=True)
+            _broker_meta(kind).write_text(
+                json.dumps({"port": port, "pid": proc.pid, "kind": kind})
             )
-            return BrokerInfo(port=port, pid=proc.pid, spawned=True)
+            return BrokerInfo(port=port, pid=proc.pid, spawned=True, kind=kind)
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
 
 
-def _read_broker_pid(port: int) -> int | None:
+def _read_broker_pid(port: int, kind: str = "meshd") -> int | None:
     with contextlib.suppress(Exception):
-        meta = json.loads((dev_dir() / "broker.json").read_text())
+        meta = json.loads(_broker_meta(kind).read_text())
         if meta.get("port") == port and _pid_alive(meta.get("pid", -1)):
             return int(meta["pid"])
     return None
 
 
-def broker_status(port: int = DEFAULT_DEV_PORT) -> dict:
+def recorded_port(kind: str) -> int | None:
+    """The port this registry last spawned a ``kind`` broker on."""
+    with contextlib.suppress(Exception):
+        return int(json.loads(_broker_meta(kind).read_text())["port"])
+    return None
+
+
+def broker_status(port: int | None = None, kind: str = "meshd") -> dict:
+    if port is None:
+        port = recorded_port(kind) or default_port(kind)
+    scheme = BROKER_KINDS[kind]["scheme"]
     return {
         "port": port,
-        "up": _port_open(port),
-        "pid": _read_broker_pid(port),
+        "kind": kind,
+        "url": f"{scheme}://127.0.0.1:{port}",
+        "up": _probe_kind(port, kind),
+        "pid": _read_broker_pid(port, kind),
     }
 
 
-def stop_broker(port: int = DEFAULT_DEV_PORT) -> bool:
+def stop_broker(port: int | None = None, kind: str = "meshd") -> bool:
     """Stop the MANAGED broker (one we spawned and recorded); a broker this
-    registry doesn't own — or a recycled pid — is left alone."""
-    pid = _read_broker_pid(port)
+    registry doesn't own — or a recycled pid — is left alone.  ``port=None``
+    targets whatever port the registry recorded for this kind."""
+    if port is None:
+        port = recorded_port(kind) or default_port(kind)
+    pid = _read_broker_pid(port, kind)
     if pid is None:
         return False
-    if _pid_is_ours(pid, "meshd"):
+    if _pid_is_ours(pid, kind):
         with contextlib.suppress(ProcessLookupError):
             os.kill(pid, signal.SIGTERM)
         for _ in range(50):
@@ -142,7 +219,7 @@ def stop_broker(port: int = DEFAULT_DEV_PORT) -> bool:
                 break
             time.sleep(0.1)
     with contextlib.suppress(FileNotFoundError):
-        (dev_dir() / "broker.json").unlink()
+        _broker_meta(kind).unlink()
     return True
 
 

@@ -53,23 +53,31 @@ def dev_run(specs: tuple[str, ...], agent_name: str | None) -> None:
 
 
 @dev_group.command("mesh")
-@click.option("--port", default=19092, show_default=True)
+@click.option("--port", default=None, type=int,
+              help="broker port (default: 19092 meshd, 19392 kafkad)")
+@click.option("--kafka", "use_kafka", is_flag=True,
+              help="manage the kafkad broker (real Kafka wire protocol) "
+                   "instead of meshd")
 @click.option("--detach", is_flag=True, help="leave the broker running and return")
-def dev_mesh(port: int, detach: bool) -> None:
-    """Ensure the native dev broker (meshd) is up — connect-or-spawn.
+def dev_mesh(port: int | None, use_kafka: bool, detach: bool) -> None:
+    """Ensure the native dev broker is up — connect-or-spawn.
 
-    Safe to run from several terminals at once: a file lock guarantees
-    exactly one spawn wins and the rest connect.
+    Default broker is meshd (native line protocol); ``--kafka`` manages
+    kafkad, the in-repo broker speaking the real Kafka wire protocol
+    (the reference's dev broker is Kafka-compatible too).  Safe to run
+    from several terminals at once: a file lock guarantees exactly one
+    spawn wins and the rest connect.
     """
     from calfkit_tpu.cli._dev_state import ensure_broker
 
+    kind = "kafkad" if use_kafka else "meshd"
     try:
-        info = ensure_broker(port)
+        info = ensure_broker(port, kind)
     except (FileNotFoundError, RuntimeError, TimeoutError) as exc:
         raise click.ClickException(str(exc)) from exc
     verb = "spawned" if info.spawned else "already up"
     click.echo(
-        f"meshd {verb} on {info.url} — export CALFKIT_MESH_URL={info.url}"
+        f"{kind} {verb} on {info.url} — export CALFKIT_MESH_URL={info.url}"
     )
     if detach or not info.spawned:
         return
@@ -81,21 +89,25 @@ def dev_mesh(port: int, detach: bool) -> None:
     except KeyboardInterrupt:
         from calfkit_tpu.cli._dev_state import stop_broker
 
-        stop_broker(port)
-        click.echo("meshd stopped")
+        stop_broker(info.port, kind)
+        click.echo(f"{kind} stopped")
 
 
 @dev_group.command("serve")
 @click.argument("specs", nargs=-1, required=True)
 @click.option("--name", "daemon_name", default=None,
               help="daemon name (default: first spec's attr)")
-@click.option("--port", default=19092, show_default=True)
-def dev_serve(specs: tuple[str, ...], daemon_name: str | None, port: int) -> None:
+@click.option("--port", default=None, type=int,
+              help="broker port (default: 19092 meshd, 19392 kafkad)")
+@click.option("--kafka", "use_kafka", is_flag=True,
+              help="serve on the kafkad broker (real Kafka wire protocol)")
+def dev_serve(specs: tuple[str, ...], daemon_name: str | None,
+              port: int | None, use_kafka: bool) -> None:
     """Detach a worker daemon serving SPECS on the managed dev broker."""
     from calfkit_tpu.cli._dev_state import ensure_broker, spawn_daemon
 
     try:
-        broker = ensure_broker(port)
+        broker = ensure_broker(port, "kafkad" if use_kafka else "meshd")
     except (FileNotFoundError, RuntimeError, TimeoutError) as exc:
         raise click.ClickException(str(exc)) from exc
     name = daemon_name or specs[0].rsplit(":", 1)[-1]
@@ -111,53 +123,63 @@ def dev_serve(specs: tuple[str, ...], daemon_name: str | None, port: int) -> Non
 
 @dev_group.command("chat")
 @click.option("--agent", "agent_name", default=None)
-@click.option("--port", default=19092, show_default=True)
-def dev_chat(agent_name: str | None, port: int) -> None:
+@click.option("--port", default=None, type=int,
+              help="broker port (default: 19092 meshd, 19392 kafkad)")
+@click.option("--kafka", "use_kafka", is_flag=True,
+              help="chat over the kafkad broker (real Kafka wire protocol)")
+def dev_chat(agent_name: str | None, port: int | None, use_kafka: bool) -> None:
     """Chat with the detached dev-mesh agents."""
     from calfkit_tpu.cli._dev_state import broker_status
     from calfkit_tpu.cli.chat import _chat
-    from calfkit_tpu.mesh.tcp import TcpMesh
+    from calfkit_tpu.mesh.urls import mesh_from_url
 
-    if not broker_status(port)["up"]:
+    kind = "kafkad" if use_kafka else "meshd"
+    status = broker_status(port, kind)
+    if not status["up"]:
         raise click.ClickException(
-            f"dev broker is down on port {port} — start it with "
+            f"dev broker is down on port {status['port']} — start it with "
             "`ck dev mesh` (or `ck dev serve file.py:agent`)"
         )
     try:
-        asyncio.run(_chat(TcpMesh(f"127.0.0.1:{port}"), agent_name))
+        asyncio.run(_chat(mesh_from_url(status["url"]), agent_name))
     except OSError as exc:
         raise click.ClickException(f"mesh connection failed: {exc}") from exc
 
 
 @dev_group.command("status")
-@click.option("--port", default=19092, show_default=True)
+@click.option("--port", default=None, type=int,
+              help="broker port (default: 19092 meshd, 19392 kafkad)")
 @click.option("--stats", is_flag=True,
               help="also query live agents + engine metrics off the mesh")
-def dev_status(port: int, stats: bool) -> None:
+def dev_status(port: int | None, stats: bool) -> None:
     """Broker + daemon liveness (add --stats for mesh-level detail)."""
     from calfkit_tpu.cli._dev_state import broker_status, list_daemons
 
-    broker = broker_status(port)
-    state = "up" if broker["up"] else "down"
-    owner = f" (managed pid {broker['pid']})" if broker["pid"] else ""
-    click.echo(f"broker tcp://127.0.0.1:{port}: {state}{owner}")
+    statuses = [
+        broker_status(port, kind) for kind in ("meshd", "kafkad")
+    ]
+    for broker in statuses:
+        state = "up" if broker["up"] else "down"
+        owner = f" (managed pid {broker['pid']})" if broker["pid"] else ""
+        click.echo(f"broker {broker['url']}: {state}{owner}")
     daemons = list_daemons()
     if not daemons:
         click.echo("daemons: none")
     for d in daemons:
         mark = "alive" if d.alive else "DEAD"
         click.echo(f"  {d.name}: {mark} pid {d.pid} specs={','.join(d.specs)}")
-    if stats and broker["up"]:
+    live = next((b for b in statuses if b["up"]), None)
+    if stats and live is not None:
         try:
-            asyncio.run(_mesh_stats(port))
+            asyncio.run(_mesh_stats(live["url"]))
         except Exception as exc:  # noqa: BLE001 - CLI boundary
             raise click.ClickException(f"mesh stats unavailable: {exc}") from exc
 
 
-async def _mesh_stats(port: int) -> None:
+async def _mesh_stats(url: str) -> None:
     from calfkit_tpu.client import Client
 
-    client = Client.connect(f"tcp://127.0.0.1:{port}")
+    client = Client.connect(url)
     try:
         cards = await client.mesh_directory.get_agents()
         click.echo(f"live agents: {[c.name for c in cards] or 'none'}")
@@ -199,15 +221,18 @@ def dev_stop(names: tuple[str, ...]) -> None:
 
 
 @dev_group.command("down")
-@click.option("--port", default=19092, show_default=True)
-def dev_down(port: int) -> None:
-    """Stop every daemon AND the managed broker."""
+def dev_down() -> None:
+    """Stop every daemon AND the managed brokers (meshd + kafkad).
+
+    Each broker is stopped on the port this registry recorded for it —
+    a broker someone else runs is left alone."""
     from calfkit_tpu.cli._dev_state import list_daemons, stop_broker, stop_daemon
 
     for d in list_daemons():
         stop_daemon(d.name)
         click.echo(f"daemon {d.name}: stopped")
-    if stop_broker(port):
-        click.echo("broker: stopped")
-    else:
-        click.echo("broker: not managed here (left alone)")
+    for kind in ("meshd", "kafkad"):
+        if stop_broker(None, kind):
+            click.echo(f"{kind}: stopped")
+        else:
+            click.echo(f"{kind}: not managed here (left alone)")

@@ -1,0 +1,91 @@
+"""KafkaWireMesh against a REAL external Kafka-compatible broker.
+
+The in-image lane runs the same client against the in-repo kafkad
+(tests/test_kafka_wire.py, tests/test_transport_contract.py).  This file
+points the identical wire client at a real cluster when one is provided:
+
+    CALF_TEST_KAFKA_BOOTSTRAP=localhost:9092 \
+        python -m pytest -m kafka tests/integration/test_kafka_wire_live.py
+
+Unlike the aiokafka lane (test_kafka_mesh.py), this needs NO extra
+Python dependency — the client is the in-repo wire implementation.
+"""
+
+import asyncio
+import os
+import uuid
+
+import pytest
+
+pytestmark = pytest.mark.kafka
+
+BOOTSTRAP = os.environ.get("CALF_TEST_KAFKA_BOOTSTRAP")
+
+if not BOOTSTRAP:  # pragma: no cover - depends on environment
+    pytest.skip(
+        "set CALF_TEST_KAFKA_BOOTSTRAP to run against a real broker",
+        allow_module_level=True,
+    )
+
+
+# NOTE: no async fixtures — the repo has no pytest-asyncio plugin (the
+# conftest hook drives async TEST FUNCTIONS only), so each test builds
+# and tears down its mesh inline.
+import contextlib
+
+
+@contextlib.asynccontextmanager
+async def _mesh():
+    from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh
+
+    mesh = KafkaWireMesh(BOOTSTRAP)
+    await mesh.start()
+    try:
+        yield mesh
+    finally:
+        await mesh.stop()
+
+
+async def test_publish_subscribe_round_trip():
+    async with _mesh() as mesh:
+        await _run_round_trip(mesh)
+
+
+async def _run_round_trip(mesh):
+    topic = f"wire-live-{uuid.uuid4().hex[:8]}"
+    await mesh.ensure_topics([topic])
+    got = []
+
+    async def handler(rec):
+        got.append((rec.key, rec.value, rec.headers))
+
+    sub = await mesh.subscribe([topic], handler, group_id="wire-live-g")
+    await mesh.publish(topic, b"v1", key=b"k1", headers={"h": "x"})
+    for _ in range(200):
+        if got:
+            break
+        await asyncio.sleep(0.05)
+    assert got == [(b"k1", b"v1", {"h": "x"})]
+    await sub.stop()
+
+
+async def test_agent_round_trip_over_real_broker():
+    from calfkit_tpu.client import Client
+    from calfkit_tpu.engine import TestModelClient
+    from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh
+    from calfkit_tpu.nodes import Agent
+    from calfkit_tpu.worker import Worker
+
+    async with _mesh() as mesh:
+        client_mesh = KafkaWireMesh(BOOTSTRAP)
+        await client_mesh.start()
+        agent = Agent(
+            f"wire_live_{uuid.uuid4().hex[:6]}",
+            model=TestModelClient(custom_output_text="over-real-kafka"),
+        )
+        async with Worker([agent], mesh=mesh, owns_transport=False):
+            client = Client.connect(client_mesh)
+            result = await client.agent(agent.name).execute("go", timeout=60)
+            assert result.output == "over-real-kafka"
+            await client.close()
+        await client_mesh.stop()

@@ -295,3 +295,70 @@ class TestBareFileSpecs:
             (str(tmp_path / "team_a2.py"), str(tmp_path / "team_b2.py"))
         )
         assert len(nodes) == 1  # one node_id -> one serving instance
+
+
+class TestKafkadDevBroker:
+    """`ck dev --kafka`: the managed kafkad broker (the real Kafka wire
+    protocol as the dev mesh, mirroring the reference's Kafka-compatible
+    bundled dev broker)."""
+
+    def test_ensure_and_stop_kafkad(self, dev_env):
+        from calfkit_tpu.cli._dev_state import (
+            broker_status,
+            ensure_broker,
+            stop_broker,
+        )
+        from calfkit_tpu.mesh.kafka_wire import find_kafkad
+
+        if find_kafkad() is None:
+            import pytest
+
+            pytest.skip("kafkad not built")
+        info = ensure_broker(19393, "kafkad")
+        try:
+            assert info.kind == "kafkad"
+            assert info.url == "kafka+wire://127.0.0.1:19393"
+            assert broker_status(19393, "kafkad")["up"]
+            # connect-or-spawn: a second ensure connects, doesn't respawn
+            again = ensure_broker(19393, "kafkad")
+            assert not again.spawned
+            assert again.pid == info.pid
+            # the meshd registry is independent: its metadata file knows
+            # nothing about the kafkad pid even on the same port
+            assert broker_status(19393, "meshd")["pid"] is None
+        finally:
+            assert stop_broker(19393, "kafkad")
+        assert not broker_status(19393, "kafkad")["up"]
+
+    async def test_worker_and_client_over_managed_kafkad(self, dev_env):
+        from calfkit_tpu.cli._dev_state import ensure_broker, stop_broker
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import TestModelClient
+        from calfkit_tpu.mesh.kafka_wire import find_kafkad
+        from calfkit_tpu.mesh.urls import mesh_from_url
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        if find_kafkad() is None:
+            import pytest
+
+            pytest.skip("kafkad not built")
+        info = ensure_broker(19394, "kafkad")
+        try:
+            mesh = mesh_from_url(info.url)
+            client_mesh = mesh_from_url(info.url)
+            await client_mesh.start()
+            agent = Agent(
+                "dev_kafka_agent",
+                model=TestModelClient(custom_output_text="dev over kafka"),
+            )
+            async with Worker([agent], mesh=mesh, owns_transport=True):
+                client = Client.connect(client_mesh)
+                result = await client.agent("dev_kafka_agent").execute(
+                    "hi", timeout=60
+                )
+                assert result.output == "dev over kafka"
+                await client.close()
+            await client_mesh.stop()
+        finally:
+            stop_broker(19394, "kafkad")

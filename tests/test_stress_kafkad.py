@@ -1,0 +1,133 @@
+"""Stress + integration depth over the native Kafka-wire broker
+(mirrors tests/test_stress_meshd.py's shapes on kafkad: concurrent mixed
+success/fault runs, run-scoped step isolation, and the DP analog — two
+Worker replicas sharing one consumer group over the REAL Kafka group
+protocol with a broker-side rebalance)."""
+
+import asyncio
+
+import pytest
+
+from calfkit_tpu.mesh.kafka_wire import (
+    KafkaWireMesh,
+    find_kafkad,
+    spawn_kafkad,
+)
+
+pytestmark = pytest.mark.skipif(
+    find_kafkad() is None, reason="kafkad not built (make -C native)"
+)
+
+
+@pytest.fixture(scope="module")
+def broker_port():
+    proc = spawn_kafkad(0)
+    yield proc.kafkad_port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+class TestFaultStressOverKafka:
+    async def test_concurrent_mixed_success_and_fault_runs(self, broker_port):
+        """24 concurrent runs, half faulting through a raising tool: every
+        reply lands on the right run over the real wire protocol."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.exceptions import NodeFaultError
+        from calfkit_tpu.models import ModelResponse
+        from calfkit_tpu.models.messages import TextOutput, ToolCallOutput
+        from calfkit_tpu.nodes import Agent, agent_tool
+        from calfkit_tpu.worker import Worker
+
+        @agent_tool
+        def spiky(n: int) -> str:
+            """Succeed on even, explode on odd.
+
+            Args:
+                n: the number.
+            """
+            if n % 2:
+                raise RuntimeError(f"spike {n}")
+            return f"ok {n}"
+
+        def scripted(messages, params):
+            has_returns = any(
+                getattr(part, "kind", "") == "tool_return"
+                for m in messages for part in getattr(m, "parts", [])
+            )
+            if not has_returns:
+                # the prompt carries the number; echo it into the tool call
+                prompt = str(messages[0].parts[-1].content)
+                n = int(prompt.rsplit(" ", 1)[-1])
+                return ModelResponse(parts=[ToolCallOutput(
+                    tool_call_id=f"c{n}", tool_name="spiky", args={"n": n},
+                )])
+            return ModelResponse(parts=[TextOutput(text="done")])
+
+        agent = Agent(
+            "spiky_agent", model=FunctionModelClient(scripted), tools=[spiky]
+        )
+        mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        client_mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        await client_mesh.start()
+        async with Worker(
+            [agent, spiky], mesh=mesh, owns_transport=True, max_workers=16
+        ):
+            client = Client.connect(client_mesh)
+
+            async def one(n: int):
+                try:
+                    result = await client.agent("spiky_agent").execute(
+                        f"run {n}", timeout=120
+                    )
+                    return ("ok", result.output)
+                except NodeFaultError as exc:
+                    return ("fault", exc.report.error_type)
+
+            outcomes = await asyncio.gather(*[one(n) for n in range(24)])
+            oks = [o for o in outcomes if o[0] == "ok"]
+            faults = [o for o in outcomes if o[0] == "fault"]
+            # evens succeed; odds fault through the tool's raise
+            assert len(oks) == 12, outcomes
+            assert len(faults) == 12
+            assert all(o[1] == "done" for o in oks)
+            await client.close()
+        await client_mesh.stop()
+
+
+class TestHorizontalScalingOverKafka:
+    async def test_two_workers_share_one_group_via_broker_rebalance(
+        self, broker_port
+    ):
+        """The DP analog over the REAL group protocol: two Worker replicas
+        host the same agent; kafkad's JoinGroup/SyncGroup rebalance splits
+        the node's input partitions between them; every run stays whole
+        and every reply is correct."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.engine import EchoModelClient
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        mesh_a = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        mesh_b = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        client_mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+        await client_mesh.start()
+
+        def replica() -> Agent:
+            return Agent("scaled_agent", model=EchoModelClient())
+
+        async with Worker([replica()], mesh=mesh_a, owns_transport=True):
+            async with Worker([replica()], mesh=mesh_b, owns_transport=True):
+                await asyncio.sleep(1.5)  # both replicas' generation settles
+                client = Client.connect(client_mesh)
+                results = await asyncio.gather(*[
+                    client.agent("scaled_agent").execute(
+                        f"msg {i}", timeout=120
+                    )
+                    for i in range(12)
+                ])
+                assert [r.output for r in results] == [
+                    f"echo: msg {i}" for i in range(12)
+                ]
+                await client.close()
+        await client_mesh.stop()
