@@ -24,8 +24,6 @@ import time
 from dataclasses import dataclass
 from pathlib import Path
 
-DEFAULT_DEV_PORT = 19092
-
 # the managed dev brokers: meshd (native line protocol) and kafkad (the
 # real Kafka wire protocol — closest to the reference's bundled Tansu
 # dev broker, which is itself Kafka-compatible)
@@ -81,6 +79,17 @@ def _port_open(port: int, timeout: float = 0.5) -> bool:
         return False
 
 
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly ``n`` bytes (recv may legally return partial reads)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return buf
+        buf += chunk
+    return buf
+
+
 def _probe_kind(port: int, kind: str, timeout: float = 0.5) -> bool:
     """Protocol-aware liveness: an open port is only 'our broker' if it
     answers the kind's own protocol (a meshd squatting the port must not
@@ -90,11 +99,11 @@ def _probe_kind(port: int, kind: str, timeout: float = 0.5) -> bool:
             s.settimeout(timeout)
             if kind == "meshd":
                 s.sendall(b"PING\n")
-                return s.recv(16).startswith(b"PONG")
+                return _recv_exact(s, 4) == b"PONG"
             # kafkad: ApiVersions v0 (api_key 18) with correlation id 7
             req = (b"\x00\x12" b"\x00\x00" b"\x00\x00\x00\x07" b"\xff\xff")
             s.sendall(len(req).to_bytes(4, "big") + req)
-            header = s.recv(8)
+            header = _recv_exact(s, 8)
             return (
                 len(header) == 8
                 and int.from_bytes(header[4:8], "big") == 7

@@ -136,9 +136,10 @@ def dev_chat(agent_name: str | None, port: int | None, use_kafka: bool) -> None:
     kind = "kafkad" if use_kafka else "meshd"
     status = broker_status(port, kind)
     if not status["up"]:
+        flag = " --kafka" if use_kafka else ""
         raise click.ClickException(
-            f"dev broker is down on port {status['port']} — start it with "
-            "`ck dev mesh` (or `ck dev serve file.py:agent`)"
+            f"{kind} is down on port {status['port']} — start it with "
+            f"`ck dev mesh{flag}` (or `ck dev serve{flag} file.py:agent`)"
         )
     try:
         asyncio.run(_chat(mesh_from_url(status["url"]), agent_name))
@@ -147,16 +148,18 @@ def dev_chat(agent_name: str | None, port: int | None, use_kafka: bool) -> None:
 
 
 @dev_group.command("status")
-@click.option("--port", default=None, type=int,
-              help="broker port (default: 19092 meshd, 19392 kafkad)")
 @click.option("--stats", is_flag=True,
               help="also query live agents + engine metrics off the mesh")
-def dev_status(port: int | None, stats: bool) -> None:
-    """Broker + daemon liveness (add --stats for mesh-level detail)."""
+def dev_status(stats: bool) -> None:
+    """Broker + daemon liveness (add --stats for mesh-level detail).
+
+    Each broker kind is probed on the port this registry recorded for it
+    (falling back to its default), so custom ``ck dev mesh --port``
+    spawns show up without re-passing the port here."""
     from calfkit_tpu.cli._dev_state import broker_status, list_daemons
 
     statuses = [
-        broker_status(port, kind) for kind in ("meshd", "kafkad")
+        broker_status(None, kind) for kind in ("meshd", "kafkad")
     ]
     for broker in statuses:
         state = "up" if broker["up"] else "down"

@@ -1,0 +1,76 @@
+"""Agents over the REAL Kafka wire protocol — zero external dependencies.
+
+Production meshes are Kafka-compatible clusters; this example runs the
+same shape locally: it spawns ``kafkad`` (the in-repo native broker
+speaking the real Kafka wire protocol — RecordBatch v2, consumer groups,
+offset commits), hosts an agent on a ``KafkaWireMesh`` worker connection,
+and talks to it from a SEPARATE client connection.  Swap the bootstrap
+string for a real Kafka/Redpanda cluster and nothing else changes.
+
+Build the broker once with ``make -C native``.
+
+Run:  python examples/kafka_mesh.py
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+from calfkit_tpu import Client, Worker  # noqa: E402
+from calfkit_tpu.engine import TestModelClient  # noqa: E402
+from calfkit_tpu.mesh import KafkaWireMesh  # noqa: E402
+from calfkit_tpu.mesh.kafka_wire import find_kafkad, spawn_kafkad  # noqa: E402
+from calfkit_tpu.nodes import Agent, agent_tool  # noqa: E402
+
+
+@agent_tool
+def lookup_order(order_id: str) -> dict:
+    """Look up an order's status.
+
+    Args:
+        order_id: The order to check.
+    """
+    return {"order_id": order_id, "status": "shipped", "eta_days": 2}
+
+
+async def main() -> None:
+    if find_kafkad() is None:
+        print("kafkad not built — run `make -C native` first")
+        return
+    broker = spawn_kafkad(0)  # port 0: OS-assigned, reported on stdout
+    bootstrap = f"127.0.0.1:{broker.kafkad_port}"
+    print(f"kafkad up on {bootstrap} (real Kafka wire protocol)")
+    try:
+        # worker and client as SEPARATE broker connections — the
+        # production topology, not an in-process shortcut
+        worker_mesh = KafkaWireMesh(bootstrap)
+        client_mesh = KafkaWireMesh(bootstrap)
+        await client_mesh.start()
+
+        agent = Agent(
+            "order_desk",
+            model=TestModelClient(
+                custom_output_text="Order 742 has shipped; ETA 2 days."
+            ),
+            instructions="Answer order questions using the lookup tool.",
+            tools=[lookup_order],
+        )
+        async with Worker(
+            [agent, lookup_order], mesh=worker_mesh, owns_transport=True
+        ):
+            client = Client.connect(client_mesh)
+            result = await client.agent("order_desk").execute(
+                "Where is order 742?", timeout=60
+            )
+            print(f"RESULT over kafka: {result.output}")
+            await client.close()
+        await client_mesh.stop()
+    finally:
+        broker.terminate()
+        broker.wait(timeout=5)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

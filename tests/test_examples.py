@@ -28,6 +28,7 @@ EXAMPLES = [
     ("topic_provisioning", "examples/topic_provisioning.py",
      "second pass: ok"),
     ("rpc_worker", "examples/rpc_worker.py", "HELLO MESH RPC"),
+    ("kafka_mesh", "examples/kafka_mesh.py", "RESULT over kafka:"),
 ]
 
 
@@ -36,6 +37,11 @@ EXAMPLES = [
     ids=[name for name, _, _ in EXAMPLES],
 )
 def test_example_runs(script: str, expect: str):
+    if "kafka" in script:
+        from calfkit_tpu.mesh.kafka_wire import find_kafkad
+
+        if find_kafkad() is None:
+            pytest.skip("kafkad not built (make -C native)")
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, script)],
         capture_output=True,
