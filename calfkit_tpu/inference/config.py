@@ -67,6 +67,11 @@ class RuntimeConfig:
     dp: int = 1  # data/batch-parallel replicas of the serving engine
     decode_steps_per_dispatch: int = 8  # tokens generated per scheduler tick
     prefill_chunk: int = 512  # prompts pad/bucket to multiples of this
+    # admission-wave width cap: more requests per prefill dispatch fills a
+    # drained batch in fewer device round trips (burst TTFT), at the cost
+    # of a larger prefill scratch (wave x bucket KV) and one extra jit
+    # variant per power-of-two step.  Waves stay power-of-two sized.
+    max_prefill_wave: int = 8
     # interleave long-prompt prefills with decode: an admission advances one
     # prefill_chunk per scheduler pass instead of blocking decode for the
     # whole bucket (vLLM-style chunked prefill; inter-token latency of

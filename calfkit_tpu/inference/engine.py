@@ -250,6 +250,15 @@ class InferenceEngine:
                 f"unsupported attention_impl {rt.attention_impl!r} "
                 "(auto | xla | pallas | pallas_interpret)"
             )
+        if rt.max_prefill_wave < 1:
+            raise ValueError("max_prefill_wave must be >= 1")
+        if rt.max_prefill_wave & (rt.max_prefill_wave - 1):
+            # waves are power-of-two trimmed; a non-power-of-two cap would
+            # silently behave as the next power down — reject it loudly
+            raise ValueError(
+                f"max_prefill_wave must be a power of two "
+                f"(got {rt.max_prefill_wave})"
+            )
         self.params = place_params(params, shardings)
 
         B, S = rt.max_batch_size, rt.max_seq_len
@@ -959,14 +968,15 @@ class InferenceEngine:
         wave_bucket = bucket_of(wave[0])
         while (
             len(wave) < len(self._free)
-            and len(wave) < 8
+            and len(wave) < self.runtime.max_prefill_wave
             and (peeked := self._peek_pending()) is not None
             and bucket_of(peeked) == wave_bucket
         ):
             wave.append(self._next_pending())
         # wave sizes are power-of-two so each prefill bucket compiles at
-        # most 4 jit variants (R in 1,2,4,8) instead of 8; trimmed
-        # requests go to the FRONT carry list, preserving arrival order
+        # most log2(max_prefill_wave)+1 jit variants (R in 1,2,4,...)
+        # instead of one per width; trimmed requests go to the FRONT
+        # carry list, preserving arrival order
         keep = 1
         while keep * 2 <= len(wave):
             keep *= 2

@@ -193,10 +193,18 @@ class TestElisionOverTcp:
 
 @pytest.mark.skipif(meshd_missing, reason="meshd not built (make -C native)")
 class TestFanoutCrashResume:
-    async def test_worker_crash_mid_batch_second_worker_closes(self, broker):
+    async def test_worker_crash_mid_batch_second_worker_closes(
+        self, broker, tmp_path
+    ):
         """Worker A opens a durable fan-out batch and dies before any fold;
         worker B (same node, same group) folds the sibling replies against
-        the compacted tables and finishes the run."""
+        the compacted tables and finishes the run.
+
+        Determinism: the tool GATES on a sentinel file the test writes only
+        after worker A is fully stopped — no fold can exist while A lives,
+        so the handover cannot flake on scheduler/ktables timing (the old
+        fixed-sleep version raced A's graceful drain against the first
+        fold and lost under CPU contention)."""
         from calfkit_tpu import protocol
         from calfkit_tpu.nodes import agent_tool, handler
         from calfkit_tpu.nodes.base import BaseNodeDef
@@ -242,16 +250,21 @@ class TestFanoutCrashResume:
                 )
                 return ReturnCall(parts=[TextPart(text=",".join(results))])
 
+        gate = tmp_path / "worker_a_is_dead"
+
         @agent_tool
-        def slow_double(x: int) -> int:
+        async def slow_double(x: int) -> int:
             """Double, slowly.
 
             Args:
                 x: Input.
             """
-            import time
-
-            time.sleep(1.0)  # slow enough that worker A dies before folds
+            # async gate-wait: the tool worker's loop (heartbeats, polls)
+            # keeps running while folds are held back until A is stopped
+            for _ in range(600):
+                if gate.exists():
+                    break
+                await asyncio.sleep(0.05)
             return x * 2
 
         fan_mesh_a = TcpMesh(f"127.0.0.1:{PORT}")
@@ -269,12 +282,17 @@ class TestFanoutCrashResume:
 
         caller = Caller(caller_mesh)
         await caller.start()
+
         await caller.call("agent.crashfan.private.input", [])
 
-        # give worker A just enough time to OPEN the batch + dispatch
-        await asyncio.sleep(0.5)
+        # let the call delivery start; worker A's graceful stop() drains
+        # the in-flight delivery, so the batch OPEN + dispatch always
+        # completes before A goes down — and the gated tool guarantees no
+        # fold exists yet
+        await asyncio.sleep(0.3)
         await worker_a.stop()  # "crash": no folds processed on A
         await fan_mesh_a.stop()
+        gate.write_text("dead")  # now the tool may reply
 
         fan_mesh_b = TcpMesh(f"127.0.0.1:{PORT}")
         await fan_mesh_b.start()

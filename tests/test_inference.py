@@ -1398,3 +1398,78 @@ class TestAttnAutoResolution:
         assert winners == {
             "decode": "pallas", "paged_decode": "xla", "prefill": "xla",
         }
+
+
+class TestPrefillWaveWidth:
+    """max_prefill_wave: admission-wave width is a serving knob (burst
+    TTFT vs prefill-scratch memory), power-of-two trimmed."""
+
+    async def test_wide_wave_admits_in_one_dispatch(self):
+        engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=16, max_seq_len=128,
+                          prefill_chunk=16, decode_steps_per_dispatch=4,
+                          max_prefill_wave=16),
+        )
+        waves: list[int] = []
+        original = engine._prefill_wave
+
+        def spy(wave, bucket):
+            waves.append(len(wave))
+            return original(wave, bucket)
+
+        engine._prefill_wave = spy
+        await engine.start()
+        outs = await asyncio.gather(*[
+            _gen_n(engine, [2 + i, 3, 4], 6) for i in range(16)
+        ])
+        assert all(len(o) == 6 for o in outs)
+        # a drained 16-slot batch fills in far fewer dispatches than the
+        # old fixed cap of 8 would allow; the widest wave used the knob
+        assert max(waves) > 8, waves
+        await engine.stop()
+
+    async def test_narrow_wave_caps_at_one(self):
+        engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=4, max_seq_len=128,
+                          prefill_chunk=16, decode_steps_per_dispatch=4,
+                          max_prefill_wave=1),
+        )
+        waves: list[int] = []
+        original = engine._prefill_wave
+
+        def spy(wave, bucket):
+            waves.append(len(wave))
+            return original(wave, bucket)
+
+        engine._prefill_wave = spy
+        await engine.start()
+        outs = await asyncio.gather(*[
+            _gen_n(engine, [2 + i, 3], 5) for i in range(6)
+        ])
+        assert all(len(o) == 5 for o in outs)
+        assert set(waves) == {1}
+        await engine.stop()
+
+    def test_invalid_width_rejected(self):
+        with pytest.raises(ValueError, match="max_prefill_wave"):
+            InferenceEngine(
+                CFG,
+                RuntimeConfig(max_batch_size=2, max_seq_len=128,
+                              prefill_chunk=16, max_prefill_wave=0),
+            )
+
+
+async def _gen_n(engine, prompt, n):
+    return [t async for t in engine.generate(prompt, max_new_tokens=n)]
+
+
+class TestPrefillWaveValidation:
+    def test_non_power_of_two_rejected_loudly(self):
+        with pytest.raises(ValueError, match="power of two"):
+            InferenceEngine(
+                CFG,
+                RuntimeConfig(max_batch_size=16, max_seq_len=128,
+                              prefill_chunk=16, max_prefill_wave=12),
+            )
