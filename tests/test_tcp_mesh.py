@@ -135,3 +135,23 @@ class TestEndToEndOverMeshd:
         await worker.stop()
         await worker_mesh.stop()
         await client_mesh.stop()
+
+
+class TestSpawnPortZero:
+    """Port-0 spawning (r3 advisor: no probe-then-spawn TOCTOU race) —
+    the broker binds an OS port and reports it on stdout."""
+
+    def test_meshd_port_zero_reports_and_serves(self):
+        import socket
+
+        proc = spawn_meshd(0)
+        try:
+            assert proc.meshd_port > 0
+            with socket.create_connection(
+                ("127.0.0.1", proc.meshd_port), timeout=2
+            ) as s:
+                s.sendall(b"PING\n")
+                assert s.recv(16).startswith(b"PONG")
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
