@@ -7,6 +7,7 @@ binds an OS-assigned port, and the bound port is reported on stdout as
 
 from __future__ import annotations
 
+import os
 import select
 import subprocess
 import time
@@ -50,9 +51,14 @@ def spawn_port_reporting(
         proc.stdout.close()
         raise error(message)
 
+    # non-blocking accumulate until a full line: a child that writes a
+    # partial line without a newline (stale/wedged binary) must hit the
+    # deadline below, not hang the caller in a blocking readline
+    os.set_blocking(proc.stdout.fileno(), False)
     deadline = time.time() + timeout
-    while True:
-        if proc.poll() is not None:
+    buf = b""
+    while b"\n" not in buf:
+        if proc.poll() is not None and not buf:
             proc.stdout.close()
             raise RuntimeError(
                 f"{name} exited immediately (code {proc.returncode}) — is "
@@ -62,14 +68,29 @@ def spawn_port_reporting(
             [proc.stdout], [], [], min(0.25, max(0.0, deadline - time.time()))
         )
         if ready:
-            break
+            chunk = proc.stdout.read(4096)
+            if chunk:
+                buf += chunk
+                continue
+            if chunk == b"":  # pipe EOF: the child can never report now
+                if proc.poll() is not None:
+                    proc.stdout.close()
+                    raise RuntimeError(
+                        f"{name} exited immediately (code {proc.returncode}) "
+                        f"— is port {port} already in use?"
+                    )
+                _kill(
+                    f"{name} closed stdout without reporting its bound port "
+                    "— stale binary? run `make -C native`",
+                    RuntimeError,
+                )
         if time.time() >= deadline:
             _kill(
                 f"{name} did not report its bound port within {timeout:.0f}s "
                 "— stale binary? run `make -C native`",
                 TimeoutError,
             )
-    line = proc.stdout.readline().decode(errors="replace").strip()
+    line = buf.split(b"\n", 1)[0].decode(errors="replace").strip()
     try:
         reported = int(line.removeprefix("PORT "))
     except ValueError:

@@ -75,11 +75,54 @@ for _i in range(256):
     _CRC_TABLE.append(_c)
 
 
-def crc32c(data: bytes) -> int:
+def _crc32c_py(data: bytes) -> int:
     c = 0xFFFFFFFF
     for b in data:
         c = _CRC_TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
     return c ^ 0xFFFFFFFF
+
+
+def _load_crc32c():
+    """Prefer the in-repo native library (SSE4.2 / slice-by-8 — memory
+    speed) so always-on CRC verification can't stall the event loop; the
+    pure-Python table is the dependency-free fallback."""
+    try:
+        from calfkit_tpu.mesh._native import find_native_binary
+
+        path = find_native_binary("libcrc32c.so", "CALFKIT_CRC32C")
+        if path is None:
+            return _crc32c_py
+        import ctypes
+
+        lib = ctypes.CDLL(path)
+        lib.calfkit_crc32c.restype = ctypes.c_uint32
+        lib.calfkit_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+        fn = lib.calfkit_crc32c
+        if fn(b"123456789", 9) != 0xE3069283:  # self-check before trusting
+            return _crc32c_py
+
+        def _crc32c_native(data: bytes) -> int:
+            return fn(data, len(data))
+
+        return _crc32c_native
+    except Exception:  # noqa: BLE001
+        return _crc32c_py
+
+
+crc32c = _load_crc32c()
+
+# largest record_set decoded ON the event loop: with native crc32c the
+# whole decode is memory-speed; the pure-Python fallback (~100 ns/byte)
+# gets a much lower bar so crc verification can't starve heartbeats
+_SYNC_DECODE_MAX = 65536 if crc32c.__name__ == "_crc32c_native" else 8192
+
+
+async def _decode_off_loop(blob: bytes):
+    """Decode a fetch's record_set, moving big blobs to a worker thread
+    (mirrors the publish path's encode offload)."""
+    if len(blob) > _SYNC_DECODE_MAX:
+        return await asyncio.to_thread(decode_record_batches, blob)
+    return decode_record_batches(blob)
 
 
 # ------------------------------------------------------------------ codecs
@@ -237,10 +280,32 @@ def encode_record_batch(
     return out.done()
 
 
+_COMPRESSION_NAMES = {1: "gzip", 2: "snappy", 3: "lz4", 4: "zstd"}
+
+
+def _decompress_records(codec: int, payload: bytes) -> bytes:
+    """Inflate a compressed RecordBatch records-section (real brokers —
+    kafkad and this module's producer never compress).  gzip rides the
+    stdlib; the other codecs raise loudly instead of mis-parsing."""
+    if codec == 1:
+        import gzip
+
+        return gzip.decompress(payload)
+    name = _COMPRESSION_NAMES.get(codec, f"codec-{codec}")
+    raise RecordBatchError(
+        f"compressed RecordBatch ({name}) unsupported by the native wire "
+        f"client — configure the producing side for gzip or no compression"
+    )
+
+
 def decode_record_batches(
     blob: bytes,
 ) -> "list[tuple[int, int, bytes | None, bytes | None, list[tuple[str, bytes]]]]":
-    """Fetch record_set → [(offset, timestamp_ms, key, value, headers)]."""
+    """Fetch record_set → [(offset, timestamp_ms, key, value, headers)].
+
+    A truncated TRAILING batch (broker max_bytes cut) is dropped silently
+    per the Kafka contract; corruption anywhere else raises a typed
+    :class:`RecordBatchError` instead of a raw struct/index error."""
     out = []
     r = _R(blob)
     n = len(blob)
@@ -250,53 +315,74 @@ def decode_record_batches(
         batch_end = r.pos + batch_len
         if batch_end > n:
             break  # truncated trailing batch (broker max_bytes cut)
-        r.i32()  # partitionLeaderEpoch
-        magic = r.i8()
-        if magic != 2:
-            r.pos = batch_end
-            continue
-        r.i32()  # crc (transport is TCP; same-process tests)
-        r.i16()  # attributes
-        r.i32()  # lastOffsetDelta
-        first_ts = r.i64()
-        r.i64()  # maxTimestamp
-        r.i64()  # producerId
-        r.i16()  # producerEpoch
-        r.i32()  # baseSequence
-        count = r.i32()
-        for _ in range(count):
-            rec_len = r.varlong()
-            rec_end = r.pos + rec_len
-            r.i8()  # attributes
-            ts_delta = r.varlong()
-            off_delta = r.varlong()
-            klen = r.varlong()
-            key = None
-            if klen >= 0:
-                key = r.buf[r.pos:r.pos + klen]
-                r.pos += klen
-            vlen = r.varlong()
-            value = None
-            if vlen >= 0:
-                value = r.buf[r.pos:r.pos + vlen]
-                r.pos += vlen
-            headers = []
-            hcount = r.varlong()
-            for _ in range(hcount):
-                hklen = r.varlong()
-                hk = r.buf[r.pos:r.pos + hklen].decode("utf-8", "replace")
-                r.pos += hklen
-                hvlen = r.varlong()
-                hv = b""
-                if hvlen >= 0:
-                    hv = r.buf[r.pos:r.pos + hvlen]
-                    r.pos += hvlen
-                headers.append((hk, hv))
-            r.pos = rec_end
-            out.append(
-                (base_offset + off_delta, first_ts + ts_delta, key, value,
-                 headers)
-            )
+        if batch_len < 49:  # smaller than the v2 header that must follow
+            raise RecordBatchError(f"batchLength {batch_len} below header size")
+        try:
+            r.i32()  # partitionLeaderEpoch
+            magic = r.i8()
+            if magic != 2:
+                r.pos = batch_end
+                continue
+            crc = r.i32() & 0xFFFFFFFF
+            # crc covers attrs..end; verified on EVERY batch (native crc32c
+            # makes this memory-speed) so a corrupt frame raises typed
+            # instead of decoding to garbage records
+            if crc32c(r.buf[r.pos:batch_end]) != crc:
+                raise RecordBatchError("RecordBatch crc32c mismatch")
+            attrs = r.i16()
+            r.i32()  # lastOffsetDelta
+            first_ts = r.i64()
+            r.i64()  # maxTimestamp
+            r.i64()  # producerId
+            r.i16()  # producerEpoch
+            r.i32()  # baseSequence
+            count = r.i32()
+            codec = attrs & 0x07
+            if codec:
+                rr = _R(_decompress_records(codec, r.buf[r.pos:batch_end]))
+            else:
+                rr = r
+            for _ in range(count):
+                rec_len = rr.varlong()
+                rec_end = rr.pos + rec_len
+                if rec_len < 0 or rec_end > len(rr.buf):
+                    raise RecordBatchError(f"record length {rec_len} overruns batch")
+                rr.i8()  # attributes
+                ts_delta = rr.varlong()
+                off_delta = rr.varlong()
+                klen = rr.varlong()
+                key = None
+                if klen >= 0:
+                    key = rr.buf[rr.pos:rr.pos + klen]
+                    rr.pos += klen
+                vlen = rr.varlong()
+                value = None
+                if vlen >= 0:
+                    value = rr.buf[rr.pos:rr.pos + vlen]
+                    rr.pos += vlen
+                headers = []
+                hcount = rr.varlong()
+                if hcount < 0:
+                    raise RecordBatchError(f"negative header count {hcount}")
+                for _ in range(hcount):
+                    hklen = rr.varlong()
+                    hk = rr.buf[rr.pos:rr.pos + hklen].decode("utf-8", "replace")
+                    rr.pos += hklen
+                    hvlen = rr.varlong()
+                    hv = b""
+                    if hvlen >= 0:
+                        hv = rr.buf[rr.pos:rr.pos + hvlen]
+                        rr.pos += hvlen
+                    headers.append((hk, hv))
+                if rr.pos > rec_end:
+                    raise RecordBatchError("record fields overran record length")
+                rr.pos = rec_end
+                out.append(
+                    (base_offset + off_delta, first_ts + ts_delta, key, value,
+                     headers)
+                )
+        except (struct.error, IndexError) as exc:
+            raise RecordBatchError(f"corrupt RecordBatch: {exc}") from exc
         r.pos = batch_end
     return out
 
@@ -343,6 +429,15 @@ class KafkaWireError(Exception):
     def __init__(self, api: str, code: int):
         self.code = code
         super().__init__(f"{api} error_code={code}")
+
+
+class RecordBatchError(KafkaWireError):
+    """A RecordBatch that cannot be parsed safely (corrupt frame, crc
+    mismatch, or a compression codec the native client does not speak)."""
+
+    def __init__(self, message: str):
+        self.code = -1
+        Exception.__init__(self, message)
 
 
 ERR_OFFSET_OUT_OF_RANGE = 1
@@ -800,6 +895,8 @@ class _WireConsumer:
         self._positions: dict[tuple[str, int], int] = {}
         self._member_id = ""
         self._generation = -1
+        self._group_had_no_partitions = False
+        self._poison_logged: dict[tuple[str, int], float] = {}
         self._rejoin = asyncio.Event()
         self._stopped = False
         self._task: asyncio.Task[None] | None = None
@@ -873,15 +970,27 @@ class _WireConsumer:
             for part in info["partitions"]
         }
 
+    async def _resolve_tap_positions(self) -> None:
+        assigned = list(await self._assignment_all_partitions())
+        if not assigned:
+            return
+        offsets = await self._client.list_offsets(
+            assigned, earliest=not self._from_latest
+        )
+        self._positions = {tp: offsets.get(tp, 0) for tp in assigned}
+
     async def _run_tap(self) -> None:
         if not self._positions:  # first attach; a retry keeps its positions
-            assigned = list(await self._assignment_all_partitions())
-            offsets = await self._client.list_offsets(
-                assigned, earliest=not self._from_latest
-            )
-            self._positions = {tp: offsets.get(tp, 0) for tp in assigned}
+            await self._resolve_tap_positions()
         self.started.set()
         while not self._stopped:
+            if not self._positions:
+                # zero partitions at attach (auto-create off, or the topic
+                # is created later): keep re-resolving instead of leaving
+                # the subscription permanently dead while looking started
+                await asyncio.sleep(1.0)
+                await self._resolve_tap_positions()
+                continue
             await self._fetch_once()
 
     async def _run_group_cycle(self) -> None:
@@ -913,6 +1022,11 @@ class _WireConsumer:
             for topic, parts in assignment.items()
             for part in parts
         ]
+        # distinguish "topic has no partitions anywhere" (watch for them to
+        # appear) from "peers hold them all" (stay idle, keep membership)
+        self._group_had_no_partitions = (
+            not assigned and not await self._assignment_all_partitions()
+        )
         committed = await self._client.offset_fetch(self._group, assigned)
         missing = [tp for tp in assigned if tp not in committed]
         if missing:
@@ -928,8 +1042,25 @@ class _WireConsumer:
             self._heartbeat_loop(), name=f"kafka-wire-hb-{self._group}"
         )
         last_commit = time.monotonic()
+        last_empty_check = time.monotonic()
         try:
             while not self._stopped and not self._rejoin.is_set():
+                if not self._positions:
+                    # empty assignment: either the topic has no partitions
+                    # yet (created later / auto-create off) or other members
+                    # hold them all.  Re-check metadata on a slow cadence and
+                    # force a rebalance ONLY when partitions newly appear —
+                    # rejoining because peers hold the partitions would
+                    # thrash the whole group.
+                    await asyncio.sleep(0.5)
+                    if (
+                        self._group_had_no_partitions
+                        and time.monotonic() - last_empty_check >= 5.0
+                    ):
+                        last_empty_check = time.monotonic()
+                        if await self._assignment_all_partitions():
+                            break  # partitions appeared → rejoin cycle
+                    continue
                 await self._fetch_once()
                 if time.monotonic() - last_commit >= self._commit_interval:
                     # ACK-first auto-commit: cadence independent of handler
@@ -963,12 +1094,32 @@ class _WireConsumer:
             self._client.conn.host, self._client.conn.port,
             client_id="calfkit-hb",
         )
+        failures = 0
         try:
             while not self._stopped:
                 await asyncio.sleep(interval)
-                code = await hb.heartbeat(
-                    self._group, self._generation, self._member_id
-                )
+                try:
+                    code = await hb.heartbeat(
+                        self._group, self._generation, self._member_id
+                    )
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001
+                    # transport error (broker restart, idle reap): retry
+                    # with backoff; a persistent failure must force a rejoin
+                    # instead of leaving the consumer fetching heartbeat-less
+                    # until the session expires server-side
+                    failures += 1
+                    if failures >= 3:
+                        logger.warning(
+                            "kafka-wire heartbeat to group %s failing; "
+                            "forcing rejoin", self._group,
+                        )
+                        self._rejoin.set()
+                        return
+                    await asyncio.sleep(min(0.25 * 2 ** failures, 2.0))
+                    continue
+                failures = 0
                 if code in (
                     ERR_REBALANCE_IN_PROGRESS, ERR_ILLEGAL_GENERATION,
                     ERR_UNKNOWN_MEMBER,
@@ -977,6 +1128,19 @@ class _WireConsumer:
                     return
         finally:
             await hb.close()
+
+    def _poison_warn(self, topic: str, part: int, exc: Exception) -> None:
+        """Log a poison batch loudly but at most once per ~30s per
+        partition — the fetch loop retries it forever."""
+        now = time.monotonic()
+        last = self._poison_logged.get((topic, part), 0.0)
+        if now - last >= 30.0:
+            self._poison_logged[(topic, part)] = now
+            logger.error(
+                "kafka-wire: undecodable RecordBatch on %s[%d] at offset "
+                "%s — partition stalled (will retry): %s",
+                topic, part, self._positions.get((topic, part)), exc,
+            )
 
     async def _fetch_once(self) -> None:
         if not self._positions:
@@ -1006,7 +1170,17 @@ class _WireConsumer:
                 continue
             if not blob:
                 continue
-            for off, ts_ms, key, value, headers in decode_record_batches(blob):
+            try:
+                batches = await _decode_off_loop(blob)
+            except RecordBatchError as exc:
+                # poison batch (crc mismatch / unsupported codec): stall
+                # THIS partition loudly without advancing past data, and
+                # without propagating — propagation would exit the group
+                # cycle and rebalance-thrash every member at ~1 Hz
+                self._poison_warn(topic, part, exc)
+                await asyncio.sleep(1.0)
+                continue
+            for off, ts_ms, key, value, headers in batches:
                 position = self._positions.get((topic, part), 0)
                 if off < position:
                     continue  # batch includes pre-position records
@@ -1290,7 +1464,20 @@ class _WireTableReader(TableReader):
                     continue
                 if err or not blob:
                     continue
-                for off, _ts, key, value, _headers in decode_record_batches(blob):
+                try:
+                    batches = await _decode_off_loop(blob)
+                except RecordBatchError:
+                    # poison batch: keep the pump task ALIVE (a dead pump
+                    # would turn start() timeouts opaque and freeze the
+                    # view silently after catch-up) and keep it loud
+                    logger.exception(
+                        "kafka-wire table %s[%d]: undecodable RecordBatch; "
+                        "view stalled at offset %s",
+                        self._topic, part, self._fetch_positions.get(part),
+                    )
+                    await asyncio.sleep(1.0)
+                    continue
+                for off, _ts, key, value, _headers in batches:
                     if off < self._fetch_positions.get(part, 0):
                         continue
                     text_key = (key or b"").decode("utf-8", errors="replace")

@@ -46,8 +46,10 @@ def mesh_from_url(url: str) -> MeshTransport:
 
             from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh
 
-            logging.getLogger(__name__).info(
-                "aiokafka not installed; using the native kafka wire client"
+            logging.getLogger(__name__).warning(
+                "aiokafka not installed; using the native kafka wire client "
+                "(PLAINTEXT, gzip-or-uncompressed batches only — use "
+                "kafka+wire:// to opt in explicitly)"
             )
             return KafkaWireMesh(bootstrap)
     raise ValueError(

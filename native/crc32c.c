@@ -1,0 +1,94 @@
+/* crc32c (Castagnoli) as a tiny shared library for the Python wire client.
+ *
+ * RecordBatch v2's crc field is crc32c over attributes..end; verifying it
+ * in pure Python costs ~100 ns/byte, which would stall the asyncio loop on
+ * multi-MiB fetches.  This library does it at memory speed: the SSE4.2
+ * crc32 instruction when the CPU has it, a slice-by-8 table otherwise.
+ *
+ * ABI: uint32_t calfkit_crc32c(const uint8_t *data, size_t n)
+ * (matches the pure-Python fallback in calfkit_tpu/mesh/kafka_wire.py).
+ */
+#include <stddef.h>
+#include <stdint.h>
+
+#define POLY 0x82F63B78u
+
+static uint32_t table[8][256];
+static int table_ready = 0;
+
+static void init_table(void) {
+    for (int i = 0; i < 256; i++) {
+        uint32_t c = (uint32_t)i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (POLY ^ (c >> 1)) : (c >> 1);
+        table[0][i] = c;
+    }
+    for (int i = 0; i < 256; i++) {
+        uint32_t c = table[0][i];
+        for (int s = 1; s < 8; s++) {
+            c = table[0][c & 0xFF] ^ (c >> 8);
+            table[s][i] = c;
+        }
+    }
+    table_ready = 1;
+}
+
+static uint32_t crc_sw(uint32_t c, const uint8_t *p, size_t n) {
+    if (!table_ready) init_table();
+    while (n && ((uintptr_t)p & 7)) {
+        c = table[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+        n--;
+    }
+    while (n >= 8) {
+        uint64_t v;
+        __builtin_memcpy(&v, p, 8);
+        v ^= c;
+        c = table[7][v & 0xFF] ^ table[6][(v >> 8) & 0xFF] ^
+            table[5][(v >> 16) & 0xFF] ^ table[4][(v >> 24) & 0xFF] ^
+            table[3][(v >> 32) & 0xFF] ^ table[2][(v >> 40) & 0xFF] ^
+            table[1][(v >> 48) & 0xFF] ^ table[0][(v >> 56) & 0xFF];
+        p += 8;
+        n -= 8;
+    }
+    while (n--) c = table[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+    return c;
+}
+
+#if defined(__x86_64__) || defined(__i386__)
+__attribute__((target("sse4.2")))
+static uint32_t crc_hw(uint32_t c, const uint8_t *p, size_t n) {
+    while (n && ((uintptr_t)p & 7)) {
+        c = __builtin_ia32_crc32qi(c, *p++);
+        n--;
+    }
+#if defined(__x86_64__)
+    while (n >= 8) {
+        uint64_t v;
+        __builtin_memcpy(&v, p, 8);
+        c = (uint32_t)__builtin_ia32_crc32di(c, v);
+        p += 8;
+        n -= 8;
+    }
+#endif
+    while (n >= 4) {
+        uint32_t v;
+        __builtin_memcpy(&v, p, 4);
+        c = __builtin_ia32_crc32si(c, v);
+        p += 4;
+        n -= 4;
+    }
+    while (n--) c = __builtin_ia32_crc32qi(c, *p++);
+    return c;
+}
+#endif
+
+uint32_t calfkit_crc32c(const uint8_t *data, size_t n) {
+    uint32_t c = 0xFFFFFFFFu;
+#if defined(__x86_64__) || defined(__i386__)
+    if (__builtin_cpu_supports("sse4.2"))
+        c = crc_hw(c, data, n);
+    else
+#endif
+        c = crc_sw(c, data, n);
+    return c ^ 0xFFFFFFFFu;
+}

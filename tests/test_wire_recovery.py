@@ -1,0 +1,172 @@
+"""Recovery behaviors pinned from the round-4 advisor findings.
+
+1. A groupless tap attached to a zero-partition topic must come alive
+   when partitions appear (not sleep forever looking started).
+2. The heartbeat loop must force a rejoin on persistent transport
+   failure (broker restart) instead of exiting silently and leaving the
+   consumer fetching heartbeat-less until the session expires.
+3. ``spawn_port_reporting`` must honor its deadline even when the child
+   writes a partial line with no newline.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import stat
+
+import pytest
+
+from calfkit_tpu.mesh._native import spawn_port_reporting
+from calfkit_tpu.mesh.kafka_wire import _WireConsumer, encode_record_batch
+from calfkit_tpu.mesh.transport import Record
+
+
+class _FakeClient:
+    """Stands in for KafkaWireClient: a topic whose partition count is
+    mutable after attach."""
+
+    def __init__(self):
+        self.partitions: list[int] = []
+        self.records: dict[int, list[bytes]] = {}
+
+    async def metadata(self, topics):
+        return {
+            "brokers": [(0, "127.0.0.1", 0)],
+            "topics": {"t": {"error": 0, "partitions": list(self.partitions)}},
+        }
+
+    async def list_offsets(self, wants, *, earliest=False):
+        return {tp: 0 for tp in wants}
+
+    async def fetch(self, wants, *, max_wait_ms=300, max_bytes=0):
+        out = []
+        for topic, part, off in wants:
+            blobs = self.records.get(part, [])
+            blob = b"".join(blobs[off:]) if off < len(blobs) else b""
+            out.append((topic, part, 0, blob))
+        if not any(blob for *_x, blob in out):
+            await asyncio.sleep(0.05)
+        return out
+
+    async def close(self):
+        pass
+
+
+class TestTapRevival:
+    def test_zero_partition_tap_revives_when_partitions_appear(self):
+        async def run() -> None:
+            got: list[Record] = []
+
+            async def deliver(record: Record) -> None:
+                got.append(record)
+
+            consumer = _WireConsumer(
+                "127.0.0.1", 0, ["t"], None, False, deliver
+            )
+            fake = _FakeClient()
+            consumer._client = fake  # type: ignore[assignment]
+            consumer.start()
+            # subscription reports started despite zero partitions...
+            await asyncio.wait_for(consumer.started.wait(), timeout=5)
+            assert consumer._positions == {}
+            # ...then the topic gains a partition with a record
+            fake.partitions = [0]
+            fake.records[0] = [encode_record_batch([(b"k", b"late", [])], 1)]
+            deadline = asyncio.get_running_loop().time() + 10
+            while not got and asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.1)
+            await consumer.stop()
+            assert got and got[0].value == b"late"
+
+        asyncio.run(run())
+
+
+class TestPoisonBatch:
+    def test_poison_partition_stalls_without_killing_the_consumer(self):
+        """A crc-corrupt batch on one partition must not kill the consume
+        loop nor block the OTHER partition (review finding r5)."""
+
+        async def run() -> None:
+            got: list[Record] = []
+
+            async def deliver(record: Record) -> None:
+                got.append(record)
+
+            consumer = _WireConsumer(
+                "127.0.0.1", 0, ["t"], None, False, deliver
+            )
+            fake = _FakeClient()
+            fake.partitions = [0, 1]
+            poison = bytearray(encode_record_batch([(b"p", b"bad", [])], 1))
+            poison[-1] ^= 0xFF  # crc mismatch
+            fake.records[0] = [bytes(poison)]
+            fake.records[1] = [encode_record_batch([(b"k", b"good", [])], 1)]
+            consumer._client = fake  # type: ignore[assignment]
+            consumer.start()
+            await asyncio.wait_for(consumer.started.wait(), timeout=5)
+            deadline = asyncio.get_running_loop().time() + 10
+            while not got and asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.05)
+            # loop alive, good partition delivered, poison not skipped
+            assert [r.value for r in got] == [b"good"]
+            assert consumer._positions[("t", 0)] == 0
+            assert not consumer._task.done()
+            await consumer.stop()
+
+        asyncio.run(run())
+
+
+class TestHeartbeatRejoin:
+    def test_persistent_heartbeat_failure_forces_rejoin(self, monkeypatch):
+        async def run() -> None:
+            consumer = _WireConsumer(
+                "127.0.0.1", 0, ["t"], "g", False, lambda r: None,
+                session_timeout_ms=1500,
+            )
+            consumer._member_id = "m-1"
+            consumer._generation = 3
+
+            class _DeadHB:
+                def __init__(self, *a, **k):
+                    pass
+
+                async def heartbeat(self, *a):
+                    raise ConnectionResetError("broker restarted")
+
+                async def close(self):
+                    pass
+
+            monkeypatch.setattr(
+                "calfkit_tpu.mesh.kafka_wire.KafkaWireClient", _DeadHB
+            )
+            await asyncio.wait_for(consumer._heartbeat_loop(), timeout=15)
+            assert consumer._rejoin.is_set()
+
+        asyncio.run(run())
+
+
+class TestSpawnDeadline:
+    def _script(self, tmp_path, body: str) -> str:
+        path = tmp_path / "fake_broker.sh"
+        path.write_text("#!/bin/sh\n" + body)
+        path.chmod(path.stat().st_mode | stat.S_IEXEC)
+        return str(path)
+
+    def test_partial_line_without_newline_hits_deadline(self, tmp_path):
+        script = self._script(tmp_path, "printf 'PORT 12'\nsleep 60\n")
+        with pytest.raises(TimeoutError, match="did not report"):
+            spawn_port_reporting(script, 0, name="fake", timeout=1.5)
+        # and the child did not outlive the failure
+        assert "fake_broker" not in os.popen("ps -eo args").read()
+
+    def test_line_assembled_across_partial_writes(self, tmp_path):
+        script = self._script(
+            tmp_path, "printf 'PORT '\nsleep 0.3\necho 4242\nsleep 30\n"
+        )
+        proc, port = spawn_port_reporting(script, 0, name="fake", timeout=5)
+        try:
+            assert port == 4242
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
