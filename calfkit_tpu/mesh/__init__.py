@@ -17,12 +17,14 @@ or any real Kafka/Redpanda) are the production adapters.
 """
 
 from calfkit_tpu.mesh.transport import MeshTransport, Record, Subscription
+from calfkit_tpu.mesh.connection import ConnectionProfile
 from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
-from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh
+from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh, WireSecurity
 from calfkit_tpu.mesh.memory import InMemoryMesh
 from calfkit_tpu.mesh.tables import TableReader, TableWriter
 
 __all__ = [
+    "ConnectionProfile",
     "InMemoryMesh",
     "KafkaWireMesh",
     "KeyOrderedDispatcher",
@@ -31,4 +33,5 @@ __all__ = [
     "Subscription",
     "TableReader",
     "TableWriter",
+    "WireSecurity",
 ]

@@ -27,7 +27,7 @@ def find_native_binary(name: str, env_var: str) -> str | None:
 
 def spawn_port_reporting(
     binary: str, port: int, *, name: str, start_new_session: bool = False,
-    timeout: float = 10.0,
+    timeout: float = 10.0, extra_args=(),
 ) -> tuple[subprocess.Popen, int]:
     """Spawn a PORT-reporting broker and return (proc, bound_port).
 
@@ -36,7 +36,7 @@ def spawn_port_reporting(
     select; a binary that never prints ``PORT`` (stale build) is killed,
     reaped, and reported."""
     proc = subprocess.Popen(
-        [binary, str(port)],
+        [binary, str(port), *extra_args],
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
         start_new_session=start_new_session,

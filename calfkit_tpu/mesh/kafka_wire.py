@@ -23,10 +23,16 @@ dispatch), but with zero third-party dependencies.
 from __future__ import annotations
 
 import asyncio
+import base64
+import hashlib
+import hmac
 import logging
+import os
+import ssl as ssl_module
 import struct
 import time
-from typing import Awaitable, Callable
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable, Mapping
 
 from calfkit_tpu.mesh.connection import DEFAULT_MAX_MESSAGE_BYTES
 from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
@@ -48,9 +54,11 @@ def find_kafkad() -> str | None:
     return find_native_binary("kafkad", "CALFKIT_KAFKAD")
 
 
-def spawn_kafkad(port: int = 0, *, start_new_session: bool = False):
+def spawn_kafkad(port: int = 0, *, start_new_session: bool = False,
+                 sasl: str | None = None):
     """Spawn the native Kafka-wire broker; port 0 = OS-assigned (reported
-    on stdout as ``PORT <n>``, exposed as ``proc.kafkad_port``)."""
+    on stdout as ``PORT <n>``, exposed as ``proc.kafkad_port``).
+    ``sasl="user:pass"`` requires SASL/PLAIN from every connection."""
     from calfkit_tpu.mesh._native import spawn_port_reporting
 
     binary = find_kafkad()
@@ -60,7 +68,8 @@ def spawn_kafkad(port: int = 0, *, start_new_session: bool = False):
             "CALFKIT_KAFKAD"
         )
     proc, bound = spawn_port_reporting(
-        binary, port, name="kafkad", start_new_session=start_new_session
+        binary, port, name="kafkad", start_new_session=start_new_session,
+        extra_args=["--sasl", sasl] if sasl else (),
     )
     proc.kafkad_port = bound  # type: ignore[attr-defined]
     return proc
@@ -290,7 +299,10 @@ def _decompress_records(codec: int, payload: bytes) -> bytes:
     if codec == 1:
         import gzip
 
-        return gzip.decompress(payload)
+        try:
+            return gzip.decompress(payload)
+        except Exception as exc:  # noqa: BLE001 — BadGzipFile/zlib.error/EOFError
+            raise RecordBatchError(f"corrupt gzip RecordBatch: {exc}") from exc
     name = _COMPRESSION_NAMES.get(codec, f"codec-{codec}")
     raise RecordBatchError(
         f"compressed RecordBatch ({name}) unsupported by the native wire "
@@ -315,14 +327,20 @@ def decode_record_batches(
         batch_end = r.pos + batch_len
         if batch_end > n:
             break  # truncated trailing batch (broker max_bytes cut)
-        if batch_len < 49:  # smaller than the v2 header that must follow
-            raise RecordBatchError(f"batchLength {batch_len} below header size")
+        if batch_len < 9:  # can't even hold epoch+magic+crc in any format
+            raise RecordBatchError(f"batchLength {batch_len} not plausible")
         try:
             r.i32()  # partitionLeaderEpoch
             magic = r.i8()
             if magic != 2:
+                # legacy v0/v1 message-set entry (magic shares this offset
+                # across all formats): skip cleanly, don't size-check it
                 r.pos = batch_end
                 continue
+            if batch_len < 49:  # smaller than the v2 header that must follow
+                raise RecordBatchError(
+                    f"batchLength {batch_len} below header size"
+                )
             crc = r.i32() & 0xFFFFFFFF
             # crc covers attrs..end; verified on EVERY batch (native crc32c
             # makes this memory-speed) so a corrupt frame raises typed
@@ -424,6 +442,161 @@ def partition_for(key: bytes | None, n: int, counter: list[int]) -> int:
     return (murmur2(key) & 0x7FFFFFFF) % n
 
 
+# --------------------------------------------------------------- security
+_SUPPORTED_PROTOCOLS = ("PLAINTEXT", "SSL", "SASL_PLAINTEXT", "SASL_SSL")
+_SUPPORTED_MECHANISMS = ("PLAIN", "SCRAM-SHA-256", "SCRAM-SHA-512")
+_SECURITY_KEYS = (
+    "security_protocol", "ssl_context", "sasl_mechanism",
+    "sasl_plain_username", "sasl_plain_password",
+)
+
+
+@dataclass(frozen=True)
+class WireSecurity:
+    """The wire client's security config, parsed from the same
+    aiokafka-style ``security=`` mapping :class:`ConnectionProfile`
+    carries (reference: calfkit/client/_connection.py:39-110 threads
+    SSL/SASL through every client the same way).  Anything the native
+    client cannot honor fails LOUDLY at construction — a secured cluster
+    must never be contacted with security silently dropped."""
+
+    protocol: str = "PLAINTEXT"
+    ssl_context: "ssl_module.SSLContext | None" = None
+    sasl_mechanism: str | None = None
+    username: str | None = None
+    password: str | None = None
+
+    @property
+    def uses_tls(self) -> bool:
+        return self.protocol in ("SSL", "SASL_SSL")
+
+    @property
+    def uses_sasl(self) -> bool:
+        return self.protocol in ("SASL_PLAINTEXT", "SASL_SSL")
+
+    @classmethod
+    def from_security_kwargs(cls, security: "Mapping[str, Any]") -> "WireSecurity":
+        unknown = sorted(set(security) - set(_SECURITY_KEYS))
+        if unknown:
+            raise ValueError(
+                f"security keys {unknown} are not supported by the native "
+                f"kafka wire client (supported: {list(_SECURITY_KEYS)}); "
+                "install aiokafka and use kafka:// for other mechanisms"
+            )
+        protocol = str(security.get("security_protocol", "PLAINTEXT")).upper()
+        if protocol not in _SUPPORTED_PROTOCOLS:
+            raise ValueError(
+                f"security_protocol {protocol!r} unsupported by the native "
+                f"wire client (supported: {list(_SUPPORTED_PROTOCOLS)})"
+            )
+        mechanism = security.get("sasl_mechanism")
+        if mechanism is not None:
+            mechanism = str(mechanism).upper()
+            if mechanism not in _SUPPORTED_MECHANISMS:
+                raise ValueError(
+                    f"sasl_mechanism {mechanism!r} unsupported by the native "
+                    f"wire client (supported: {list(_SUPPORTED_MECHANISMS)}); "
+                    "install aiokafka and use kafka:// for GSSAPI/OAUTHBEARER"
+                )
+        out = cls(
+            protocol=protocol,
+            ssl_context=security.get("ssl_context"),
+            sasl_mechanism=mechanism,
+            username=security.get("sasl_plain_username"),
+            password=security.get("sasl_plain_password"),
+        )
+        if out.ssl_context is not None and not out.uses_tls:
+            raise ValueError(
+                f"ssl_context given but security_protocol is {protocol} — "
+                "use SSL or SASL_SSL (refusing to connect in cleartext "
+                "when TLS material was supplied)"
+            )
+        if out.uses_sasl:
+            if not out.sasl_mechanism:
+                raise ValueError(f"{protocol} requires sasl_mechanism")
+            if out.username is None or out.password is None:
+                raise ValueError(
+                    f"{protocol} requires sasl_plain_username and "
+                    "sasl_plain_password"
+                )
+        elif out.sasl_mechanism:
+            raise ValueError(
+                "sasl_mechanism given but security_protocol is "
+                f"{protocol} (use SASL_PLAINTEXT or SASL_SSL)"
+            )
+        return out
+
+    def resolved_ssl_context(self) -> "ssl_module.SSLContext | None":
+        if not self.uses_tls:
+            return None
+        return self.ssl_context or ssl_module.create_default_context()
+
+
+PLAINTEXT = WireSecurity()
+
+
+class ScramClient:
+    """RFC 5802 SCRAM client (SHA-256 / SHA-512), stdlib only.
+
+    Three-message exchange: ``first()`` → server-first → ``final()`` →
+    server-final → ``verify()`` (which authenticates the SERVER — a
+    man-in-the-middle cannot forge the v= signature without the password).
+    """
+
+    def __init__(self, mechanism: str, username: str, password: str,
+                 cnonce: str | None = None):
+        self._hash = {
+            "SCRAM-SHA-256": hashlib.sha256,
+            "SCRAM-SHA-512": hashlib.sha512,
+        }[mechanism]
+        self._username = username
+        self._password = password.encode("utf-8")
+        self._cnonce = cnonce or base64.b64encode(os.urandom(24)).decode()
+        self._first_bare = ""
+        self._auth_message = b""
+        self._salted = b""
+
+    @staticmethod
+    def _escape(name: str) -> str:
+        return name.replace("=", "=3D").replace(",", "=2C")
+
+    def first(self) -> bytes:
+        self._first_bare = f"n={self._escape(self._username)},r={self._cnonce}"
+        return ("n,," + self._first_bare).encode("utf-8")
+
+    def final(self, server_first: bytes) -> bytes:
+        text = server_first.decode("utf-8")
+        fields = dict(f.split("=", 1) for f in text.split(","))
+        snonce, salt_b64, iterations = fields["r"], fields["s"], int(fields["i"])
+        if not snonce.startswith(self._cnonce):
+            raise KafkaWireError("scram: server nonce does not extend ours", -1)
+        self._salted = hashlib.pbkdf2_hmac(
+            self._hash().name, self._password,
+            base64.b64decode(salt_b64), iterations,
+        )
+        client_key = hmac.new(self._salted, b"Client Key", self._hash).digest()
+        stored_key = self._hash(client_key).digest()
+        without_proof = f"c=biws,r={snonce}"
+        self._auth_message = ",".join(
+            [self._first_bare, text, without_proof]
+        ).encode("utf-8")
+        client_sig = hmac.new(stored_key, self._auth_message, self._hash).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, client_sig))
+        return (
+            without_proof + ",p=" + base64.b64encode(proof).decode()
+        ).encode("utf-8")
+
+    def verify(self, server_final: bytes) -> None:
+        text = server_final.decode("utf-8")
+        fields = dict(f.split("=", 1) for f in text.split(","))
+        if "e" in fields:
+            raise KafkaWireError(f"scram: server error {fields['e']}", -1)
+        server_key = hmac.new(self._salted, b"Server Key", self._hash).digest()
+        expected = hmac.new(server_key, self._auth_message, self._hash).digest()
+        if base64.b64decode(fields["v"]) != expected:
+            raise KafkaWireError("scram: server signature mismatch", -1)
+
+
 # --------------------------------------------------------------- protocol
 class KafkaWireError(Exception):
     def __init__(self, api: str, code: int):
@@ -450,9 +623,11 @@ class _Conn:
     """One broker connection; requests serialized (responses arrive in
     order per connection on every Kafka-compatible broker)."""
 
-    def __init__(self, host: str, port: int, client_id: str = "calfkit"):
+    def __init__(self, host: str, port: int, client_id: str = "calfkit",
+                 security: WireSecurity = PLAINTEXT):
         self.host, self.port = host, port
         self.client_id = client_id
+        self.security = security
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._lock = asyncio.Lock()
@@ -460,8 +635,55 @@ class _Conn:
 
     async def connect(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
-            self.host, self.port
+            self.host, self.port,
+            ssl=self.security.resolved_ssl_context(),
         )
+        self._correlation = 0
+        if self.security.uses_sasl:
+            try:
+                await self._sasl_authenticate()
+            except BaseException:
+                # a half-authenticated connection must not stay installed:
+                # the next request() would reuse it, skip connect(), and
+                # surface an opaque read error instead of the auth failure
+                self._drop()
+                raise
+
+    async def _sasl_authenticate(self) -> None:
+        """SaslHandshake v1 + SaslAuthenticate v0 on the fresh connection
+        (v1 handshake = tokens ride wrapped SaslAuthenticate frames)."""
+        mechanism = self.security.sasl_mechanism or "PLAIN"
+        w = _W()
+        w.string(mechanism)
+        r = await self._roundtrip(17, 1, w.done())
+        err = r.i16()
+        if err:
+            raise KafkaWireError(f"sasl_handshake({mechanism})", err)
+
+        async def auth_round(token: bytes) -> bytes:
+            body = _W()
+            body.bytes_(token)
+            resp = await self._roundtrip(36, 0, body.done())
+            code = resp.i16()
+            message = resp.string()
+            auth = resp.bytes_() or b""
+            if code:
+                raise KafkaWireError(
+                    f"sasl_authenticate: {message or 'failed'}", code
+                )
+            return auth
+
+        user = self.security.username or ""
+        password = self.security.password or ""
+        if mechanism == "PLAIN":
+            await auth_round(
+                b"\0" + user.encode("utf-8") + b"\0" + password.encode("utf-8")
+            )
+        else:
+            scram = ScramClient(mechanism, user, password)
+            server_first = await auth_round(scram.first())
+            server_final = await auth_round(scram.final(server_first))
+            scram.verify(server_final)
 
     async def close(self) -> None:
         if self._writer is not None:
@@ -485,41 +707,46 @@ class _Conn:
         async with self._lock:
             if self._writer is None:
                 await self.connect()
-                self._correlation = 0
-            self._correlation += 1
-            header = _W()
-            header.i16(api_key)
-            header.i16(version)
-            header.i32(self._correlation)
-            header.string(self.client_id)
-            payload = header.done() + body
-            try:
-                self._writer.write(struct.pack(">i", len(payload)) + payload)
-                await self._writer.drain()
-                szbuf = await self._reader.readexactly(4)
-                size = struct.unpack(">i", szbuf)[0]
-                blob = await self._reader.readexactly(size)
-            except BaseException:
-                # a cancellation (the fetch long-poll is where stop() lands)
-                # or transport error mid-exchange leaves an unread response
-                # in the stream — every later request would read the stale
-                # frame and mis-correlate.  Drop the connection so the next
-                # call starts clean.
-                self._drop()
-                raise
-            r = _R(blob)
-            correlation = r.i32()
-            if correlation != self._correlation:
-                self._drop()
-                raise KafkaWireError("correlation-mismatch", -1)
-            return r
+            return await self._roundtrip(api_key, version, body)
+
+    async def _roundtrip(self, api_key: int, version: int, body: bytes) -> _R:
+        """One request/response on the live connection.  Callers hold the
+        lock (request) or own the fresh connection (connect's SASL)."""
+        self._correlation += 1
+        header = _W()
+        header.i16(api_key)
+        header.i16(version)
+        header.i32(self._correlation)
+        header.string(self.client_id)
+        payload = header.done() + body
+        try:
+            self._writer.write(struct.pack(">i", len(payload)) + payload)
+            await self._writer.drain()
+            szbuf = await self._reader.readexactly(4)
+            size = struct.unpack(">i", szbuf)[0]
+            blob = await self._reader.readexactly(size)
+        except BaseException:
+            # a cancellation (the fetch long-poll is where stop() lands)
+            # or transport error mid-exchange leaves an unread response
+            # in the stream — every later request would read the stale
+            # frame and mis-correlate.  Drop the connection so the next
+            # call starts clean.
+            self._drop()
+            raise
+        r = _R(blob)
+        correlation = r.i32()
+        if correlation != self._correlation:
+            self._drop()
+            raise KafkaWireError("correlation-mismatch", -1)
+        return r
 
 
 class KafkaWireClient:
     """Low-level typed API calls over one connection."""
 
-    def __init__(self, host: str, port: int, client_id: str = "calfkit"):
-        self.conn = _Conn(host, port, client_id)
+    def __init__(self, host: str, port: int, client_id: str = "calfkit",
+                 security: WireSecurity = PLAINTEXT):
+        self.conn = _Conn(host, port, client_id, security=security)
 
     async def close(self) -> None:
         await self.conn.close()
@@ -884,8 +1111,12 @@ class _WireConsumer:
         *,
         session_timeout_ms: int = 10000,
         commit_interval_s: float = 1.0,
+        security: WireSecurity = PLAINTEXT,
     ):
-        self._client = KafkaWireClient(host, port, client_id="calfkit-consumer")
+        self._security = security
+        self._client = KafkaWireClient(
+            host, port, client_id="calfkit-consumer", security=security
+        )
         self._topics = topics
         self._group = group_id
         self._from_latest = from_latest
@@ -1092,7 +1323,7 @@ class _WireConsumer:
         interval = max(self._session_ms / 3000.0, 0.5)
         hb = KafkaWireClient(
             self._client.conn.host, self._client.conn.port,
-            client_id="calfkit-hb",
+            client_id="calfkit-hb", security=self._security,
         )
         failures = 0
         try:
@@ -1209,6 +1440,11 @@ class KafkaWireMesh(MeshTransport):
     Kafka-compatible broker (``native/bin/kafkad`` in-image; real
     Kafka/Redpanda in production).
 
+    Security rides the same :class:`ConnectionProfile` as the aiokafka
+    adapter: TLS (``security_protocol="SSL"``), SASL PLAIN and
+    SCRAM-SHA-256/512 (``SASL_PLAINTEXT`` / ``SASL_SSL``) are spoken
+    natively; anything else fails loudly at construction.
+
     Known limit: the client holds connections to the FIRST bootstrap
     broker only (no per-partition leader routing) — correct for kafkad
     and single-node/proxied clusters; multi-node clusters whose
@@ -1217,22 +1453,56 @@ class KafkaWireMesh(MeshTransport):
 
     def __init__(
         self,
-        bootstrap_servers: str,
+        bootstrap_servers: str | None = None,
         *,
-        max_message_bytes: int = DEFAULT_MAX_MESSAGE_BYTES,
+        profile: "ConnectionProfile | None" = None,
+        security: "Mapping[str, Any] | None" = None,
+        max_message_bytes: int | None = None,
         default_partitions: int = 8,
     ):
+        from calfkit_tpu.mesh.connection import ConnectionProfile
+
+        if profile is None:
+            if not bootstrap_servers:
+                raise ValueError("bootstrap_servers (or profile=) required")
+            profile = ConnectionProfile(
+                bootstrap_servers=bootstrap_servers,
+                max_message_bytes=(
+                    max_message_bytes if max_message_bytes is not None
+                    else DEFAULT_MAX_MESSAGE_BYTES
+                ),
+                security=dict(security or {}),
+            )
+        else:
+            # profile= owns every connection knob (same conflict rule as
+            # KafkaMesh): silently ignoring a kwarg would hide a config bug
+            conflicts = [
+                name for name, value in (
+                    ("bootstrap_servers", bootstrap_servers),
+                    ("security", security),
+                    ("max_message_bytes", max_message_bytes),
+                ) if value is not None
+            ]
+            if conflicts:
+                raise ValueError(
+                    f"profile= conflicts with {conflicts}: set these on the "
+                    "ConnectionProfile instead"
+                )
+        self._profile = profile
+        # parse EARLY so unsupported security fails at construction, not
+        # first I/O
+        self._security = WireSecurity.from_security_kwargs(profile.security)
         # "host:port[,host:port...]" — a single-connection client uses the
         # FIRST entry (all partitions live on one coordinator for kafkad;
         # against a real cluster the first broker answers metadata/produce
         # and every API we speak); a bare host defaults to 9092
-        first = bootstrap_servers.split(",")[0].strip()
+        first = profile.bootstrap_servers.split(",")[0].strip()
         host, _, port = first.rpartition(":")
         if not host:
             host, port = first, ""
         self._host = host or "127.0.0.1"
         self._port = int(port) if port else 9092
-        self._max_bytes = max_message_bytes
+        self._max_bytes = profile.max_message_bytes
         self._default_partitions = default_partitions
         self._producer: KafkaWireClient | None = None
         self._partition_counts: dict[str, int] = {}
@@ -1246,11 +1516,16 @@ class KafkaWireMesh(MeshTransport):
     def max_message_bytes(self) -> int:
         return self._max_bytes
 
+    @property
+    def profile(self):
+        return self._profile
+
     async def start(self) -> None:
         if self._started:
             return
         self._producer = KafkaWireClient(
-            self._host, self._port, client_id="calfkit-producer"
+            self._host, self._port, client_id="calfkit-producer",
+            security=self._security,
         )
         await self._producer.conn.connect()
         self._started = True
@@ -1366,7 +1641,8 @@ class KafkaWireMesh(MeshTransport):
             # topics must exist before a groupless tap resolves "latest"
             await self._producer.metadata(topics)
         consumer = _WireConsumer(
-            self._host, self._port, topics, group_id, from_latest, deliver
+            self._host, self._port, topics, group_id, from_latest, deliver,
+            security=self._security,
         )
         consumer.start()
         self._consumers.append(consumer)
@@ -1420,7 +1696,8 @@ class _WireTableReader(TableReader):
 
     async def start(self, *, timeout: float = 30.0) -> None:
         self._client = KafkaWireClient(
-            self._mesh._host, self._mesh._port, client_id="calfkit-table"
+            self._mesh._host, self._mesh._port, client_id="calfkit-table",
+            security=self._mesh._security,
         )
         # own fetch loop (not _WireConsumer): the barrier needs each
         # record's PARTITION, which the transport Record doesn't carry

@@ -169,6 +169,40 @@ class TestCompression:
         with pytest.raises(RecordBatchError):
             decode_record_batches(bytes(blob))
 
+    def test_valid_crc_but_corrupt_gzip_is_typed(self):
+        """crc32c can be VALID over a broken gzip stream (buggy producer
+        compressor): the decompression failure itself must stay typed —
+        an escaped BadGzipFile would rebalance-thrash group consumers."""
+        records = [(b"k", b"v" * 50, [])]
+        plain = encode_record_batch(records, 1)
+        header, recblob = plain[:61], plain[61:]
+        broken = bytearray(gzip.compress(recblob))
+        broken[-2] ^= 0xFF  # corrupt, then crc computed over the corruption
+        body = bytearray(header[21:61])
+        struct.pack_into(">h", body, 0, 1)
+        crcbody = bytes(body) + bytes(broken)
+        out = bytearray(header[:21])
+        struct.pack_into(">i", out, 8, 9 + len(crcbody))
+        crc = crc32c(crcbody)
+        struct.pack_into(
+            ">i", out, 17, crc - (1 << 32) if crc >= (1 << 31) else crc
+        )
+        with pytest.raises(RecordBatchError, match="gzip"):
+            decode_record_batches(bytes(out) + crcbody)
+
+
+class TestLegacyFormats:
+    def test_small_legacy_v1_entry_is_skipped_not_poison(self):
+        """A pre-0.11 v0/v1 message-set entry (magic != 2, smaller than
+        the v2 header) must skip cleanly — raising would stall the
+        partition forever on old segments."""
+        # v1 entry: offset(8) size(4) crc(4) magic=1 attrs(1) ts(8) key(-1) val(-1)
+        legacy = struct.pack(">qi", 0, 22) + struct.pack(">i", 0) + b"\x01\x00"
+        legacy += struct.pack(">q", 123) + struct.pack(">ii", -1, -1)
+        follow = encode_record_batch([(b"k", b"modern", [])], 5)
+        out = decode_record_batches(legacy + follow)
+        assert [v for *_x, v, _h in out] == [b"modern"]
+
 
 @pytest.mark.skipif(find_kafkad() is None, reason="kafkad not built")
 class TestBrokerBarrage:
