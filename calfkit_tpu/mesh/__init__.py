@@ -10,10 +10,11 @@ communication backend"):
 3. topic admin (``ensure_topics``).
 
 ``InMemoryMesh`` is a full single-process implementation — it is both the
-offline test substrate and the ``ck dev`` zero-setup mesh.  ``KafkaMesh``
-(gated on aiokafka) and ``KafkaWireMesh`` (the dependency-free native
-wire-protocol client; pairs with the in-repo ``native/bin/kafkad`` broker
-or any real Kafka/Redpanda) are the production adapters.
+offline test substrate and the ``ck dev`` zero-setup mesh.
+``KafkaWireMesh`` — the dependency-free native wire-protocol client with
+leader/coordinator routing, TLS and SASL — is the production adapter; it
+pairs with the in-repo ``native/bin/kafkad`` broker or any real
+Kafka/Redpanda cluster.
 """
 
 from calfkit_tpu.mesh.transport import MeshTransport, Record, Subscription

@@ -1,8 +1,8 @@
-"""Native Kafka wire-protocol client + MeshTransport (no aiokafka).
+"""Native Kafka wire-protocol client + MeshTransport (zero deps).
 
 The reference's production transport depends on aiokafka against a real
-broker; this image ships neither, so the kafka lane could never run
-in-image (VERDICT r3 item 4).  This module closes that gap natively: an
+broker; this image ships neither, so that lane could never run in-image
+(VERDICT r3 item 4).  This module closes the gap natively: an
 asyncio client speaking the REAL Kafka wire protocol — RecordBatch v2
 (crc32c, zigzag varints), consumer groups with generations and
 client-side range assignment, offset commit/fetch — against any
@@ -55,10 +55,13 @@ def find_kafkad() -> str | None:
 
 
 def spawn_kafkad(port: int = 0, *, start_new_session: bool = False,
-                 sasl: str | None = None):
+                 sasl: str | None = None, advertise_port: int | None = None):
     """Spawn the native Kafka-wire broker; port 0 = OS-assigned (reported
     on stdout as ``PORT <n>``, exposed as ``proc.kafkad_port``).
-    ``sasl="user:pass"`` requires SASL/PLAIN from every connection."""
+    ``sasl="user:pass"`` requires SASL/PLAIN from every connection;
+    ``advertise_port`` is the ``advertised.listeners`` equivalent (what
+    metadata/find_coordinator report — set it when a TLS terminator or
+    port-forward sits in front of the broker)."""
     from calfkit_tpu.mesh._native import spawn_port_reporting
 
     binary = find_kafkad()
@@ -67,9 +70,14 @@ def spawn_kafkad(port: int = 0, *, start_new_session: bool = False,
             "kafkad binary not found: run `make -C native` or set "
             "CALFKIT_KAFKAD"
         )
+    extra: list[str] = []
+    if sasl:
+        extra += ["--sasl", sasl]
+    if advertise_port:
+        extra += ["--advertise-port", str(advertise_port)]
     proc, bound = spawn_port_reporting(
         binary, port, name="kafkad", start_new_session=start_new_session,
-        extra_args=["--sasl", sasl] if sasl else (),
+        extra_args=extra,
     )
     proc.kafkad_port = bound  # type: ignore[attr-defined]
     return proc
@@ -481,7 +489,7 @@ class WireSecurity:
             raise ValueError(
                 f"security keys {unknown} are not supported by the native "
                 f"kafka wire client (supported: {list(_SECURITY_KEYS)}); "
-                "install aiokafka and use kafka:// for other mechanisms"
+                "supply supported keys or terminate security out-of-process"
             )
         protocol = str(security.get("security_protocol", "PLAINTEXT")).upper()
         if protocol not in _SUPPORTED_PROTOCOLS:
@@ -496,7 +504,7 @@ class WireSecurity:
                 raise ValueError(
                     f"sasl_mechanism {mechanism!r} unsupported by the native "
                     f"wire client (supported: {list(_SUPPORTED_MECHANISMS)}); "
-                    "install aiokafka and use kafka:// for GSSAPI/OAUTHBEARER"
+                    "GSSAPI/OAUTHBEARER need an out-of-process authenticator"
                 )
         out = cls(
             protocol=protocol,
@@ -741,15 +749,52 @@ class _Conn:
         return r
 
 
+ERR_NOT_LEADER = 6
+ERR_NOT_COORDINATOR = 16
+
+
 class KafkaWireClient:
-    """Low-level typed API calls over one connection."""
+    """Typed API calls with metadata-driven per-partition leader routing.
+
+    One ``_Conn`` per broker address: produce/fetch/list_offsets go to the
+    partition leader learned from Metadata, group APIs go to the group
+    coordinator learned from FindCoordinator, everything else rides the
+    bootstrap connection.  Against single-node brokers (kafkad) every
+    route resolves to the bootstrap address and behavior is unchanged;
+    against a spread-leader cluster each request lands on the right
+    broker, with NOT_LEADER / NOT_COORDINATOR triggering a refresh +
+    single retry."""
 
     def __init__(self, host: str, port: int, client_id: str = "calfkit",
                  security: WireSecurity = PLAINTEXT):
-        self.conn = _Conn(host, port, client_id, security=security)
+        self._client_id = client_id
+        self._security = security
+        self._conns: dict[tuple[str, int], _Conn] = {}
+        self.conn = self._get_conn(host, port)  # bootstrap/control
+        # routing state, refreshed from Metadata / FindCoordinator
+        self._leaders: dict[tuple[str, int], tuple[str, int]] = {}
+        self._coordinator: tuple[str, int] | None = None
+
+    def _get_conn(self, host: str, port: int) -> _Conn:
+        conn = self._conns.get((host, port))
+        if conn is None:
+            conn = _Conn(host, port, self._client_id, security=self._security)
+            self._conns[(host, port)] = conn
+        return conn
+
+    def _leader_conn(self, topic: str, part: int) -> _Conn:
+        addr = self._leaders.get((topic, part))
+        return self._get_conn(*addr) if addr else self.conn
+
+    def _coord_conn(self) -> _Conn:
+        return (
+            self._get_conn(*self._coordinator) if self._coordinator
+            else self.conn
+        )
 
     async def close(self) -> None:
-        await self.conn.close()
+        for conn in self._conns.values():
+            await conn.close()
 
     async def metadata(self, topics: list[str] | None) -> dict:
         w = _W()
@@ -762,12 +807,14 @@ class KafkaWireClient:
         r = await self.conn.request(3, 1, w.done())
         nbrokers = r.i32()
         brokers = []
+        nodes: dict[int, tuple[str, int]] = {}
         for _ in range(nbrokers):
             node = r.i32()
             host = r.string()
             port = r.i32()
             r.string()  # rack
             brokers.append((node, host, port))
+            nodes[node] = (host, port)
         r.i32()  # controller
         out: dict = {"brokers": brokers, "topics": {}}
         for _ in range(r.i32()):
@@ -778,14 +825,24 @@ class KafkaWireClient:
             for _ in range(r.i32()):
                 r.i16()  # partition error
                 idx = r.i32()
-                r.i32()  # leader
+                leader = r.i32()
                 for _ in range(r.i32()):
                     r.i32()
                 for _ in range(r.i32()):
                     r.i32()
                 parts.append(idx)
+                if leader in nodes:
+                    self._leaders[(name, idx)] = nodes[leader]
+                else:
+                    self._leaders.pop((name, idx), None)  # leaderless
             out["topics"][name] = {"error": err, "partitions": sorted(parts)}
         return out
+
+    async def _refresh_leaders(self, topics: "list[str]") -> None:
+        try:
+            await self.metadata(sorted(set(topics)))
+        except Exception:  # noqa: BLE001 — routing refresh is best-effort
+            logger.warning("kafka-wire metadata refresh failed", exc_info=True)
 
     async def create_topics(
         self, topics: list[str], partitions: int, *, compacted: bool = False
@@ -823,28 +880,40 @@ class KafkaWireClient:
         w.i32(1)
         w.i32(partition)
         w.bytes_(batch)
-        r = await self.conn.request(0, 3, w.done())
-        base = -1
-        for _ in range(r.i32()):
-            r.string()
+        body = w.done()
+        for attempt in (0, 1):
+            conn = self._leader_conn(topic, partition)
+            try:
+                r = await conn.request(0, 3, body)
+            except (OSError, EOFError):
+                # leader connection died (EOFError covers the clean-close
+                # IncompleteReadError signature): re-learn topology once
+                if attempt == 0 and conn is not self.conn:
+                    await self._refresh_leaders([topic])
+                    continue
+                raise
+            base = -1
+            err = 0
             for _ in range(r.i32()):
-                r.i32()  # partition
-                err = r.i16()
-                base = r.i64()
-                r.i64()  # log_append_time
-                if err:
-                    raise KafkaWireError("produce", err)
-        return base
+                r.string()
+                for _ in range(r.i32()):
+                    r.i32()  # partition
+                    err = r.i16()
+                    base = r.i64()
+                    r.i64()  # log_append_time
+            if err == ERR_NOT_LEADER and attempt == 0:
+                await self._refresh_leaders([topic])
+                continue
+            if err:
+                raise KafkaWireError("produce", err)
+            return base
+        # unreachable: attempt 1 always returned or raised above
+        raise AssertionError("produce retry loop exhausted")
 
-    async def fetch(
-        self,
-        wants: "list[tuple[str, int, int]]",
-        *,
-        max_wait_ms: int = 300,
-        max_bytes: int = 4 * 1024 * 1024,
+    async def _fetch_on(
+        self, conn: _Conn, wants: "list[tuple[str, int, int]]",
+        max_wait_ms: int, max_bytes: int,
     ) -> "list[tuple[str, int, int, bytes]]":
-        """wants: [(topic, partition, offset)] →
-        [(topic, partition, error, record_set)]"""
         w = _W()
         w.i32(-1)            # replica
         w.i32(max_wait_ms)
@@ -862,7 +931,7 @@ class KafkaWireClient:
                 w.i32(part)
                 w.i64(off)
                 w.i32(max_bytes)
-        r = await self.conn.request(1, 4, w.done())
+        r = await conn.request(1, 4, w.done())
         r.i32()  # throttle
         out = []
         for _ in range(r.i32()):
@@ -880,32 +949,98 @@ class KafkaWireClient:
                 out.append((topic, part, err, blob or b""))
         return out
 
+    async def fetch(
+        self,
+        wants: "list[tuple[str, int, int]]",
+        *,
+        max_wait_ms: int = 300,
+        max_bytes: int = 4 * 1024 * 1024,
+    ) -> "list[tuple[str, int, int, bytes]]":
+        """wants: [(topic, partition, offset)] →
+        [(topic, partition, error, record_set)] — one request per leader
+        broker, long-polled concurrently."""
+        if not wants:
+            return []
+        by_conn: dict[_Conn, list[tuple[str, int, int]]] = {}
+        for topic, part, off in wants:
+            by_conn.setdefault(self._leader_conn(topic, part), []).append(
+                (topic, part, off)
+            )
+        if len(by_conn) <= 1:
+            conn, conn_wants = next(iter(by_conn.items()))
+            out = await self._fetch_on(conn, conn_wants, max_wait_ms, max_bytes)
+        else:
+            chunks = await asyncio.gather(*(
+                self._fetch_on(conn, conn_wants, max_wait_ms, max_bytes)
+                for conn, conn_wants in by_conn.items()
+            ), return_exceptions=True)
+            out = []
+            first_error: BaseException | None = None
+            for chunk in chunks:
+                if isinstance(chunk, BaseException):
+                    first_error = first_error or chunk
+                else:
+                    out.extend(chunk)
+            if first_error is not None:
+                # a dead leader poisons only its chunk; re-learn topology
+                # and surface the failure (the consume loop retries)
+                await self._refresh_leaders(
+                    sorted({t for t, *_x in wants})
+                )
+                if not out:
+                    raise first_error
+        stale = [
+            (topic, part) for topic, part, err, _b in out
+            if err == ERR_NOT_LEADER
+        ]
+        if stale:
+            for tp in stale:
+                self._leaders.pop(tp, None)
+            await self._refresh_leaders(sorted({t for t, _p in stale}))
+        return out
+
     async def list_offsets(
         self, wants: "list[tuple[str, int]]", *, earliest: bool = False
     ) -> dict:
-        w = _W()
-        w.i32(-1)
-        by_topic: dict[str, list[int]] = {}
+        by_conn: dict[_Conn, list[tuple[str, int]]] = {}
         for topic, part in wants:
-            by_topic.setdefault(topic, []).append(part)
-        w.i32(len(by_topic))
-        for topic, parts in by_topic.items():
-            w.string(topic)
-            w.i32(len(parts))
-            for part in parts:
-                w.i32(part)
-                w.i64(-2 if earliest else -1)
-        r = await self.conn.request(2, 1, w.done())
-        out = {}
-        for _ in range(r.i32()):
-            topic = r.string()
+            by_conn.setdefault(self._leader_conn(topic, part), []).append(
+                (topic, part)
+            )
+
+        async def one(conn: _Conn, conn_wants: "list[tuple[str, int]]") -> dict:
+            w = _W()
+            w.i32(-1)
+            by_topic: dict[str, list[int]] = {}
+            for topic, part in conn_wants:
+                by_topic.setdefault(topic, []).append(part)
+            w.i32(len(by_topic))
+            for topic, parts in by_topic.items():
+                w.string(topic)
+                w.i32(len(parts))
+                for part in parts:
+                    w.i32(part)
+                    w.i64(-2 if earliest else -1)
+            r = await conn.request(2, 1, w.done())
+            found: dict = {}
             for _ in range(r.i32()):
-                part = r.i32()
-                err = r.i16()
-                r.i64()  # timestamp
-                off = r.i64()
-                if not err:
-                    out[(topic, part)] = off
+                topic = r.string()
+                for _ in range(r.i32()):
+                    part = r.i32()
+                    err = r.i16()
+                    r.i64()  # timestamp
+                    off = r.i64()
+                    if not err:
+                        found[(topic, part)] = off
+            return found
+
+        out: dict = {}
+        # concurrent like fetch(): barrier/position-resolve sits on the
+        # worker-startup hot path — pay max(RTT), not sum(RTT)
+        for found in await asyncio.gather(
+            *(one(conn, ws) for conn, ws in by_conn.items())
+        ):
+            out.update(found)
         return out
 
     async def find_coordinator(self, group: str) -> tuple[str, int]:
@@ -916,7 +1051,19 @@ class KafkaWireClient:
         if err:
             raise KafkaWireError("find_coordinator", err)
         r.i32()  # node
-        return r.string(), r.i32()
+        host, port = r.string(), r.i32()
+        self._coordinator = (host, port)
+        return host, port
+
+    async def ensure_coordinator(self, group: str) -> None:
+        """Resolve + cache the group coordinator so group APIs route to
+        it (real clusters host a group on ONE broker; kafkad reports
+        itself)."""
+        if self._coordinator is None:
+            await self.find_coordinator(group)
+
+    def forget_coordinator(self) -> None:
+        self._coordinator = None
 
     async def join_group(
         self, group: str, member_id: str, topics: list[str],
@@ -937,7 +1084,7 @@ class KafkaWireClient:
         w.i32(1)
         w.string("range")
         w.bytes_(meta.done())
-        r = await self.conn.request(11, 2, w.done())
+        r = await self._coord_conn().request(11, 2, w.done())
         r.i32()  # throttle
         err = r.i16()
         if err:
@@ -983,7 +1130,7 @@ class KafkaWireClient:
                 w.bytes_(blob.done())
         else:
             w.i32(0)
-        r = await self.conn.request(14, 1, w.done())
+        r = await self._coord_conn().request(14, 1, w.done())
         r.i32()  # throttle
         err = r.i16()
         if err:
@@ -1004,7 +1151,7 @@ class KafkaWireClient:
         w.string(group)
         w.i32(generation)
         w.string(member_id)
-        r = await self.conn.request(12, 1, w.done())
+        r = await self._coord_conn().request(12, 1, w.done())
         r.i32()  # throttle
         return r.i16()
 
@@ -1012,7 +1159,7 @@ class KafkaWireClient:
         w = _W()
         w.string(group)
         w.string(member_id)
-        r = await self.conn.request(13, 1, w.done())
+        r = await self._coord_conn().request(13, 1, w.done())
         r.i32()
         r.i16()
 
@@ -1036,7 +1183,7 @@ class KafkaWireClient:
                 w.i32(part)
                 w.i64(off)
                 w.string(None)  # metadata
-        r = await self.conn.request(8, 2, w.done())
+        r = await self._coord_conn().request(8, 2, w.done())
         for _ in range(r.i32()):
             r.string()
             for _ in range(r.i32()):
@@ -1061,7 +1208,7 @@ class KafkaWireClient:
             w.i32(len(parts))
             for part in parts:
                 w.i32(part)
-        r = await self.conn.request(9, 1, w.done())
+        r = await self._coord_conn().request(9, 1, w.done())
         out = {}
         for _ in range(r.i32()):
             topic = r.string()
@@ -1182,6 +1329,10 @@ class _WireConsumer:
                     ERR_UNKNOWN_MEMBER,
                 ):
                     continue  # rejoin immediately
+                if exc.code == ERR_NOT_COORDINATOR:
+                    # coordinator moved (real clusters): re-find + rejoin
+                    self._client.forget_coordinator()
+                    continue
                 logger.warning(
                     "kafka-wire consumer error on %s: %s; retrying",
                     self._topics, exc,
@@ -1225,6 +1376,7 @@ class _WireConsumer:
             await self._fetch_once()
 
     async def _run_group_cycle(self) -> None:
+        await self._client.ensure_coordinator(self._group)
         join = await self._client.join_group(
             self._group, self._member_id, self._topics,
             session_timeout_ms=self._session_ms,
@@ -1330,6 +1482,7 @@ class _WireConsumer:
             while not self._stopped:
                 await asyncio.sleep(interval)
                 try:
+                    await hb.ensure_coordinator(self._group)
                     code = await hb.heartbeat(
                         self._group, self._generation, self._member_id
                     )
@@ -1351,6 +1504,9 @@ class _WireConsumer:
                     await asyncio.sleep(min(0.25 * 2 ** failures, 2.0))
                     continue
                 failures = 0
+                if code == ERR_NOT_COORDINATOR:
+                    hb.forget_coordinator()
+                    continue
                 if code in (
                     ERR_REBALANCE_IN_PROGRESS, ERR_ILLEGAL_GENERATION,
                     ERR_UNKNOWN_MEMBER,
@@ -1384,13 +1540,20 @@ class _WireConsumer:
         results = await self._client.fetch(wants, max_wait_ms=300)
         for topic, part, err, blob in results:
             if err == ERR_OFFSET_OUT_OF_RANGE:
-                # retention moved log-start past our position (real
-                # brokers): re-resolve instead of silently stalling the
-                # partition forever
+                # retention moved log-start past our position, or the
+                # broker restarted with a shorter log (kafkad is
+                # memory-only): re-resolve LOUDLY instead of silently
+                # stalling the partition forever
                 fresh = await self._client.list_offsets(
                     [(topic, part)], earliest=not self._from_latest
                 )
-                self._positions[(topic, part)] = fresh.get((topic, part), 0)
+                new_off = fresh.get((topic, part), 0)
+                logger.warning(
+                    "kafka-wire: %s[%d] position %s out of range; broker "
+                    "log truncated or restarted — resetting to %s",
+                    topic, part, self._positions.get((topic, part)), new_off,
+                )
+                self._positions[(topic, part)] = new_off
                 continue
             if err:
                 logger.warning(
@@ -1436,7 +1599,8 @@ class _WireConsumer:
 # ------------------------------------------------------------- transport
 class KafkaWireMesh(MeshTransport):
     """MeshTransport over the native wire client — same contract mapping
-    as KafkaMesh, zero third-party dependencies.  Points at any
+    the reference's aiokafka transport defines, zero third-party
+    dependencies.  Points at any
     Kafka-compatible broker (``native/bin/kafkad`` in-image; real
     Kafka/Redpanda in production).
 
@@ -1445,11 +1609,10 @@ class KafkaWireMesh(MeshTransport):
     SCRAM-SHA-256/512 (``SASL_PLAINTEXT`` / ``SASL_SSL``) are spoken
     natively; anything else fails loudly at construction.
 
-    Known limit: the client holds connections to the FIRST bootstrap
-    broker only (no per-partition leader routing) — correct for kafkad
-    and single-node/proxied clusters; multi-node clusters whose
-    partition leaders are spread across brokers need the aiokafka
-    adapter (``KafkaMesh``) for now."""
+    Multi-node clusters: produce/fetch/list_offsets route to each
+    partition's leader and group APIs to the group coordinator, both
+    learned from metadata with refresh-and-retry on NOT_LEADER /
+    NOT_COORDINATOR — one client, any Kafka-compatible topology."""
 
     def __init__(
         self,
@@ -1475,7 +1638,7 @@ class KafkaWireMesh(MeshTransport):
             )
         else:
             # profile= owns every connection knob (same conflict rule as
-            # KafkaMesh): silently ignoring a kwarg would hide a config bug
+            # the reference adapter): silently ignoring a kwarg would hide a config bug
             conflicts = [
                 name for name, value in (
                     ("bootstrap_servers", bootstrap_servers),
@@ -1492,10 +1655,10 @@ class KafkaWireMesh(MeshTransport):
         # parse EARLY so unsupported security fails at construction, not
         # first I/O
         self._security = WireSecurity.from_security_kwargs(profile.security)
-        # "host:port[,host:port...]" — a single-connection client uses the
-        # FIRST entry (all partitions live on one coordinator for kafkad;
-        # against a real cluster the first broker answers metadata/produce
-        # and every API we speak); a bare host defaults to 9092
+        # "host:port[,host:port...]" — the FIRST entry seeds the bootstrap
+        # connection; partition leaders and the group coordinator are then
+        # learned from metadata and dialed directly.  A bare host defaults
+        # to 9092.
         first = profile.bootstrap_servers.split(",")[0].strip()
         host, _, port = first.rpartition(":")
         if not host:
@@ -1716,55 +1879,92 @@ class _WireTableReader(TableReader):
 
     async def _pump(self) -> None:
         while not self._stopped:
-            wants = [
-                (self._topic, part, off)
-                for part, off in self._fetch_positions.items()
-            ]
-            if not wants:
-                await asyncio.sleep(0.2)
-                continue
             try:
-                results = await self._client.fetch(wants, max_wait_ms=300)
+                await self._pump_once(self._view, self._fetch_positions)
             except asyncio.CancelledError:
                 raise
             except Exception:  # noqa: BLE001
+                # transport failure: the broker may have restarted with a
+                # fresh (shorter) log whose high watermark can even equal
+                # our stale position — undetectable at the fetch level.
+                # Rebuild into a SHADOW view and swap atomically when
+                # caught up: the live view keeps serving reads meanwhile
+                # (read-your-writes across transient drops), and ghosts
+                # of a restarted broker's lost world vanish at the swap.
+                logger.warning(
+                    "kafka-wire table %s: transport error; rebuilding the "
+                    "view from the log start", self._topic, exc_info=True,
+                )
                 await asyncio.sleep(0.5)
+                await self._rebuild()
                 continue
-            for _topic, part, err, blob in results:
-                if err == ERR_OFFSET_OUT_OF_RANGE:
-                    fresh = await self._client.list_offsets(
-                        [(self._topic, part)], earliest=True
-                    )
-                    self._fetch_positions[part] = fresh.get(
-                        (self._topic, part), 0
-                    )
-                    continue
-                if err or not blob:
-                    continue
-                try:
-                    batches = await _decode_off_loop(blob)
-                except RecordBatchError:
-                    # poison batch: keep the pump task ALIVE (a dead pump
-                    # would turn start() timeouts opaque and freeze the
-                    # view silently after catch-up) and keep it loud
-                    logger.exception(
-                        "kafka-wire table %s[%d]: undecodable RecordBatch; "
-                        "view stalled at offset %s",
-                        self._topic, part, self._fetch_positions.get(part),
-                    )
-                    await asyncio.sleep(1.0)
-                    continue
-                for off, _ts, key, value, _headers in batches:
-                    if off < self._fetch_positions.get(part, 0):
-                        continue
-                    text_key = (key or b"").decode("utf-8", errors="replace")
-                    if text_key:
-                        if value:
-                            self._view[text_key] = value
-                        else:
-                            self._view.pop(text_key, None)
-                    self._fetch_positions[part] = off + 1
             self._advanced.set()
+
+    async def _rebuild(self) -> None:
+        try:
+            meta = await self._client.metadata([self._topic])
+            parts = meta["topics"].get(self._topic, {}).get("partitions", [])
+            ends = await self._client.list_offsets(
+                [(self._topic, p) for p in parts]
+            )
+            shadow: dict[str, bytes] = {}
+            positions = {p: 0 for p in parts}
+            while not self._stopped and any(
+                positions[p] < ends.get((self._topic, p), 0) for p in parts
+            ):
+                await self._pump_once(shadow, positions)
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — broker (still) down; the outer
+            return  # loop fails its next fetch and retries the rebuild
+        self._view = shadow
+        self._fetch_positions = positions
+        self._advanced.set()
+
+    async def _pump_once(
+        self, view: "dict[str, bytes]", positions: "dict[int, int]"
+    ) -> None:
+        """One fetch round applied to (view, positions); per-partition
+        errors handled here, transport errors propagate to the caller."""
+        wants = [
+            (self._topic, part, off) for part, off in positions.items()
+        ]
+        if not wants:
+            await asyncio.sleep(0.2)
+            return
+        results = await self._client.fetch(wants, max_wait_ms=300)
+        for _topic, part, err, blob in results:
+            if err == ERR_OFFSET_OUT_OF_RANGE:
+                fresh = await self._client.list_offsets(
+                    [(self._topic, part)], earliest=True
+                )
+                positions[part] = fresh.get((self._topic, part), 0)
+                continue
+            if err or not blob:
+                continue
+            try:
+                batches = await _decode_off_loop(blob)
+            except RecordBatchError:
+                # poison batch: keep the pump task ALIVE (a dead pump
+                # would turn start() timeouts opaque and freeze the
+                # view silently after catch-up) and keep it loud
+                logger.exception(
+                    "kafka-wire table %s[%d]: undecodable RecordBatch; "
+                    "view stalled at offset %s",
+                    self._topic, part, positions.get(part),
+                )
+                await asyncio.sleep(1.0)
+                continue
+            for off, _ts, key, value, _headers in batches:
+                if off < positions.get(part, 0):
+                    continue
+                text_key = (key or b"").decode("utf-8", errors="replace")
+                if text_key:
+                    if value:
+                        view[text_key] = value
+                    else:
+                        view.pop(text_key, None)
+                positions[part] = off + 1
 
     async def stop(self) -> None:
         self._stopped = True

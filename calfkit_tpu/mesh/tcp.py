@@ -135,7 +135,7 @@ class TcpMesh(MeshTransport):
     async def stop(self) -> None:
         self._started = False
         # table readers own their conn + pump; stopping the mesh must not
-        # leak them (same discipline as KafkaMesh)
+        # leak them (same discipline as KafkaWireMesh)
         for reader in list(self._readers):
             with contextlib.suppress(Exception):
                 await reader.stop()

@@ -151,7 +151,7 @@ async def provision(
         if not names:
             return
         # layering note: in-repo transports implement ensure_topics
-        # idempotently (KafkaMesh does its own batch→per-topic exists
+        # idempotently (KafkaWireMesh does its own batch→per-topic exists
         # handling), so this fallback is the cross-transport safety net for
         # implementations that DO surface already-exists errors
         try:

@@ -204,6 +204,7 @@ std::condition_variable g_group_cv;  // group state changed (join/sync blocks)
 std::map<std::string, Topic> g_topics;
 std::map<std::string, Group> g_groups;
 int g_port = 0;
+int g_advertise_port = 0;  // advertised.listeners equivalent (defaults to g_port)
 constexpr int32_t kDefaultPartitions = 8;
 
 int64_t now_ms() {
@@ -224,6 +225,7 @@ Topic& topic_ref_locked(const std::string& name, int32_t partitions = kDefaultPa
 
 // error codes
 constexpr int16_t ERR_NONE = 0;
+constexpr int16_t ERR_OFFSET_OUT_OF_RANGE = 1;
 constexpr int16_t ERR_UNKNOWN_TOPIC = 3;
 constexpr int16_t ERR_ILLEGAL_GENERATION = 22;
 constexpr int16_t ERR_UNKNOWN_MEMBER = 25;
@@ -375,7 +377,7 @@ void handle_metadata(Reader& r, Writer& w) {
   else for (const auto& name : names) topic_ref_locked(name);  // auto-create
   // brokers
   w.i32(1);
-  w.i32(0); w.str("127.0.0.1"); w.i32(g_port); w.null_str();  // rack
+  w.i32(0); w.str("127.0.0.1"); w.i32(g_advertise_port); w.null_str();  // rack
   w.i32(0);  // controller_id
   w.i32(int32_t(names.size()));
   for (const auto& name : names) {
@@ -509,9 +511,20 @@ void handle_fetch(Reader& r, Writer& w) {
       }
       Partition& pa = it->second.partitions[size_t(want.part)];
       int64_t hw = pa.high_watermark();
+      // a position BEYOND the log end means the consumer knows a world
+      // this broker does not (broker restart wiped the memory-only log):
+      // answer OFFSET_OUT_OF_RANGE like real Kafka so clients re-resolve
+      // loudly instead of long-polling a dead position forever.
+      // off == hw is the normal caught-up wait.
+      if (want.off > hw || want.off < 0) {
+        w.i16(ERR_OFFSET_OUT_OF_RANGE); w.i64(hw); w.i64(hw);
+        w.i32(-1);  // aborted_transactions (null)
+        w.i32(-1);  // record_set null
+        continue;
+      }
       w.i16(ERR_NONE); w.i64(hw); w.i64(hw);
       w.i32(-1);  // aborted_transactions (null)
-      if (want.off >= hw || want.off < 0) { w.i32(-1); continue; }
+      if (want.off >= hw) { w.i32(-1); continue; }
       // cap records by the partition max_bytes request (approximate:
       // stop before exceeding, always include at least one)
       size_t first = size_t(want.off), last = first;
@@ -565,7 +578,7 @@ void handle_list_offsets(Reader& r, Writer& w) {
 void handle_find_coordinator(Reader& r, Writer& w) {
   r.str();  // group id — single node: always us
   w.i16(ERR_NONE);
-  w.i32(0); w.str("127.0.0.1"); w.i32(g_port);
+  w.i32(0); w.str("127.0.0.1"); w.i32(g_advertise_port);
 }
 
 // complete a pending rebalance if every current member has rejoined (or
@@ -763,9 +776,19 @@ void handle_offset_commit(Reader& r, Writer& w) {
   int32_t ntopics = r.i32();
   std::lock_guard<std::mutex> lk(g_mu);
   Group& g = g_groups[group_id];
-  // commits from a stale generation still record (commit-on-revoke lands
-  // right before rejoin); unknown members commit too (simple consumers)
-  (void)generation; (void)member_id;
+  // real-Kafka validation: generation -1 commits are simple-consumer
+  // writes and always land; generation-tagged commits must come from a
+  // KNOWN member of the CURRENT generation.  Without this, a client's
+  // commit-on-revoke after a broker restart would poison the fresh
+  // (memory-only) world with positions from the lost one, silently
+  // stalling every consumer past the new log end.
+  int16_t err = ERR_NONE;
+  if (generation >= 0) {
+    if (g.members.find(member_id) == g.members.end())
+      err = ERR_UNKNOWN_MEMBER;
+    else if (generation != g.generation)
+      err = ERR_ILLEGAL_GENERATION;
+  }
   w.i32(ntopics);
   for (int32_t t = 0; t < ntopics; t++) {
     std::string name = r.str();
@@ -776,9 +799,9 @@ void handle_offset_commit(Reader& r, Writer& w) {
       int32_t part = r.i32();
       int64_t off = r.i64();
       r.str();  // metadata
-      g.offsets[{name, part}] = off;
+      if (err == ERR_NONE) g.offsets[{name, part}] = off;
       w.i32(part);
-      w.i16(ERR_NONE);
+      w.i16(err);
     }
   }
 }
@@ -1001,6 +1024,14 @@ int main(int argc, char** argv) {
   crc_init();
   int port = argc > 1 ? atoi(argv[1]) : 19192;
   for (int i = 2; i < argc; i++) {
+    if (std::string(argv[i]) == "--advertise-port") {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "--advertise-port expects a port\n");
+        return 2;
+      }
+      g_advertise_port = atoi(argv[++i]);
+      continue;
+    }
     if (std::string(argv[i]) == "--sasl") {
       if (i + 1 >= argc) {  // fail CLOSED: never start open when auth was asked for
         fprintf(stderr, "--sasl expects user:pass\n");
@@ -1034,6 +1065,7 @@ int main(int argc, char** argv) {
       port = ntohs(addr.sin_port);
   }
   g_port = port;
+  if (g_advertise_port == 0) g_advertise_port = port;
   listen(server, 64);
   printf("PORT %d\n", port);
   fflush(stdout);

@@ -1,0 +1,44 @@
+"""Run the Llama-3-8B readiness dryrun and commit the evidence
+(VERDICT r4 item 1 fallback: when the chip is wedged, prove the 8B TP=8
+config cannot die on first contact).
+
+Writes LLAMA8B_READY.json: {ok, wall_s, n_devices, budget | error}.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parents[1]
+
+
+def main() -> int:
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 8
+    started = time.time()
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "__graft_entry__.py"), "llama8b", str(n)],
+        capture_output=True, text=True, timeout=1800, cwd=ROOT,
+    )
+    wall = round(time.time() - started, 1)
+    out: dict = {"n_devices": n, "wall_s": wall, "ok": proc.returncode == 0}
+    if proc.returncode == 0:
+        lines = proc.stdout.strip().splitlines()
+        out["stdout_tail"] = lines[-1:]
+        # the budget the dryrun ACTUALLY asserted, not a re-derivation
+        for line in lines:
+            if line.startswith("BUDGET "):
+                out["budget"] = json.loads(line.removeprefix("BUDGET "))
+                break
+    else:
+        out["error"] = (proc.stderr or proc.stdout).strip()[-4000:]
+    (ROOT / "LLAMA8B_READY.json").write_text(json.dumps(out, indent=1) + "\n")
+    print(json.dumps({k: v for k, v in out.items() if k != "error"}))
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -47,24 +47,3 @@ def pytest_pyfunc_call(pyfuncitem: pytest.Function):
     return True
 
 
-@pytest.fixture
-def kafka_fake_broker():
-    """The in-process aiokafka fake, installed for one test: yields a fresh
-    bootstrap id (connections sharing it share one broker world).  Skips
-    when a real aiokafka is installed (the real -m kafka lane covers it)."""
-    import uuid as _uuid
-
-    try:
-        import aiokafka  # noqa: F401
-
-        pytest.skip("real aiokafka present; fake lane not needed")
-    except ImportError:
-        pass
-    from tests import _aiokafka_fake
-
-    _aiokafka_fake.install()
-    try:
-        yield f"fake-broker-{_uuid.uuid4().hex[:8]}"
-    finally:
-        _aiokafka_fake.uninstall()
-        _aiokafka_fake.reset()

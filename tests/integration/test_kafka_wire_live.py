@@ -7,7 +7,7 @@ points the identical wire client at a real cluster when one is provided:
     CALF_TEST_KAFKA_BOOTSTRAP=localhost:9092 \
         python -m pytest -m kafka tests/integration/test_kafka_wire_live.py
 
-Unlike the aiokafka lane (test_kafka_mesh.py), this needs NO extra
+This needs NO extra
 Python dependency — the client is the in-repo wire implementation.
 """
 

@@ -1,0 +1,178 @@
+"""kafkad restart semantics (VERDICT r4 item 5).
+
+kafkad is memory-only: a restart loses offsets, records, and compacted
+tables.  The pinned contract is that consumers observe this as a LOUD
+reset (OFFSET_OUT_OF_RANGE → warning log → re-resolve) or a clean
+rejoin — never a silent forever-stall — and the mesh keeps working for
+traffic produced after the restart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+
+import pytest
+
+from calfkit_tpu.mesh.kafka_wire import (
+    ERR_OFFSET_OUT_OF_RANGE,
+    KafkaWireClient,
+    KafkaWireMesh,
+    encode_record_batch,
+    find_kafkad,
+    spawn_kafkad,
+)
+
+pytestmark = pytest.mark.skipif(
+    find_kafkad() is None, reason="kafkad not built (make -C native)"
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestOffsetBeyondLog:
+    def test_fetch_past_log_end_is_out_of_range(self):
+        """A position beyond the high watermark (the restart signature)
+        answers OFFSET_OUT_OF_RANGE, not a silent empty long-poll."""
+
+        async def run(port: int) -> None:
+            client = KafkaWireClient("127.0.0.1", port)
+            try:
+                await client.create_topics(["oor"], 1)
+                await client.produce(
+                    "oor", 0, encode_record_batch([(b"k", b"v", [])], 1)
+                )
+                results = await client.fetch([("oor", 0, 5)], max_wait_ms=50)
+                assert results[0][2] == ERR_OFFSET_OUT_OF_RANGE
+                # caught-up position (== hwm) stays the normal quiet wait
+                results = await client.fetch([("oor", 0, 1)], max_wait_ms=50)
+                assert results[0][2] == 0 and results[0][3] == b""
+            finally:
+                await client.close()
+
+        proc = spawn_kafkad(0)
+        try:
+            asyncio.run(run(proc.kafkad_port))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+
+class TestBrokerRestart:
+    def test_consumer_survives_restart_with_loud_reset(self, caplog):
+        """Kill + restart the broker under a live subscription: the
+        consumer must log the reset and deliver post-restart traffic."""
+        port = _free_port()
+        proc = spawn_kafkad(port)
+
+        async def run() -> None:
+            nonlocal proc
+            mesh = KafkaWireMesh(f"127.0.0.1:{port}")
+            await mesh.start()
+            got: list[bytes] = []
+            arrived = asyncio.Event()
+
+            async def handler(rec):
+                got.append(rec.value)
+                arrived.set()
+
+            try:
+                await mesh.ensure_topics(["restart.topic"])
+                sub = await mesh.subscribe(
+                    ["restart.topic"], handler, group_id="restart-g"
+                )
+                await mesh.publish("restart.topic", b"before", key=b"k")
+                await asyncio.wait_for(arrived.wait(), 15)
+                assert got == [b"before"]
+                arrived.clear()
+
+                # hard-kill and restart on the SAME port: memory-only log
+                # is gone, group state is gone
+                proc.kill()
+                proc.wait(timeout=5)
+                proc = spawn_kafkad(port)
+
+                # publish resumes (producer reconnects; retry during the
+                # startup race) and the consumer must receive it
+                deadline = asyncio.get_running_loop().time() + 30
+                while True:
+                    try:
+                        await mesh.publish(
+                            "restart.topic", b"after", key=b"k"
+                        )
+                        break
+                    except Exception:  # noqa: BLE001 — broker coming up
+                        if asyncio.get_running_loop().time() > deadline:
+                            raise
+                        await asyncio.sleep(0.3)
+                await asyncio.wait_for(arrived.wait(), 30)
+                assert got[-1] == b"after"
+                await sub.stop()
+            finally:
+                await mesh.stop()
+
+        with caplog.at_level(logging.WARNING, logger="calfkit_tpu.mesh.kafka_wire"):
+            try:
+                asyncio.run(run())
+            finally:
+                proc.terminate()
+                proc.wait(timeout=5)
+        # the loss was LOUD: either the group rejoined (join logs nothing
+        # but positions came from a fresh world) or the tap/fetch path
+        # warned about the rewind; at minimum the consumer-error retry or
+        # out-of-range warning must have fired
+        assert any(
+            "out of range" in rec.message or "consumer error" in rec.message
+            or "heartbeat" in rec.message
+            for rec in caplog.records
+        ), [rec.message for rec in caplog.records]
+
+    def test_table_reader_recovers_after_restart(self):
+        """Compacted-table views re-resolve from the new (empty) world
+        and keep serving writes made after the restart."""
+        port = _free_port()
+        proc = spawn_kafkad(port)
+
+        async def run() -> None:
+            nonlocal proc
+            mesh = KafkaWireMesh(f"127.0.0.1:{port}")
+            await mesh.start()
+            try:
+                await mesh.ensure_topics(["restart.table"], compacted=True)
+                writer = mesh.table_writer("restart.table")
+                await writer.put("k1", b"v1")
+                reader = mesh.table_reader("restart.table")
+                await reader.start()
+                assert reader.get("k1") == b"v1"
+
+                proc.kill()
+                proc.wait(timeout=5)
+                proc = spawn_kafkad(port)
+
+                deadline = asyncio.get_running_loop().time() + 30
+                while True:
+                    try:
+                        await writer.put("k2", b"v2")
+                        break
+                    except Exception:  # noqa: BLE001
+                        if asyncio.get_running_loop().time() > deadline:
+                            raise
+                        await asyncio.sleep(0.3)
+                while reader.get("k2") is None:
+                    if asyncio.get_running_loop().time() > deadline:
+                        raise AssertionError("table never saw post-restart write")
+                    await asyncio.sleep(0.2)
+                await reader.stop()
+            finally:
+                await mesh.stop()
+
+        try:
+            asyncio.run(run())
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)

@@ -251,16 +251,19 @@ class TestTlsRoundTrip:
 
     def test_ssl_round_trip(self, tmp_path):
         crt, key = _make_cert(tmp_path)
-        proc = spawn_kafkad(0)
-        backend_port = proc.kafkad_port
+        # the broker must ADVERTISE the TLS front door: leader/coordinator
+        # routing dials the advertised address directly, so a terminator
+        # in front of the broker needs advertised.listeners pointed at it
+        # (kafkad: --advertise-port) exactly as with real Kafka
+        backend = {"port": 0}
 
-        async def run() -> None:
+        async def run(proc_holder) -> None:
             server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
             server_ctx.load_cert_chain(crt, key)
 
             async def proxy(reader, writer):
                 up_r, up_w = await asyncio.open_connection(
-                    "127.0.0.1", backend_port
+                    "127.0.0.1", backend["port"]
                 )
 
                 async def pump(src, dst):
@@ -285,6 +288,9 @@ class TestTlsRoundTrip:
                 proxy, "127.0.0.1", 0, ssl=server_ctx
             )
             tls_port = tls_server.sockets[0].getsockname()[1]
+            proc = spawn_kafkad(0, advertise_port=tls_port)
+            proc_holder.append(proc)
+            backend["port"] = proc.kafkad_port
 
             client_ctx = ssl.create_default_context(cafile=crt)
             mesh = KafkaWireMesh(f"127.0.0.1:{tls_port}", security={
@@ -312,11 +318,13 @@ class TestTlsRoundTrip:
                 tls_server.close()
                 await tls_server.wait_closed()
 
+        procs: list = []
         try:
-            asyncio.run(run())
+            asyncio.run(run(procs))
         finally:
-            proc.terminate()
-            proc.wait(timeout=5)
+            for proc in procs:
+                proc.terminate()
+                proc.wait(timeout=5)
 
     def test_untrusted_cert_rejected(self, tmp_path):
         crt, key = _make_cert(tmp_path)
