@@ -55,13 +55,16 @@ def find_kafkad() -> str | None:
 
 
 def spawn_kafkad(port: int = 0, *, start_new_session: bool = False,
-                 sasl: str | None = None, advertise_port: int | None = None):
+                 sasl: str | None = None, advertise_port: int | None = None,
+                 log_dir: str | None = None):
     """Spawn the native Kafka-wire broker; port 0 = OS-assigned (reported
     on stdout as ``PORT <n>``, exposed as ``proc.kafkad_port``).
     ``sasl="user:pass"`` requires SASL/PLAIN from every connection;
     ``advertise_port`` is the ``advertised.listeners`` equivalent (what
     metadata/find_coordinator report — set it when a TLS terminator or
-    port-forward sits in front of the broker)."""
+    port-forward sits in front of the broker); ``log_dir`` turns on the
+    append-only WAL: topics, records, and committed offsets survive a
+    broker restart (without it retention is memory-only)."""
     from calfkit_tpu.mesh._native import spawn_port_reporting
 
     binary = find_kafkad()
@@ -75,6 +78,8 @@ def spawn_kafkad(port: int = 0, *, start_new_session: bool = False,
         extra += ["--sasl", sasl]
     if advertise_port:
         extra += ["--advertise-port", str(advertise_port)]
+    if log_dir:
+        extra += ["--log-dir", str(log_dir)]
     proc, bound = spawn_port_reporting(
         binary, port, name="kafkad", start_new_session=start_new_session,
         extra_args=extra,

@@ -13,20 +13,24 @@ body on failure — which the agent turn runner converts into a typed
 """
 
 from calfkit_tpu.providers.anthropic import AnthropicModelClient
+from calfkit_tpu.providers.bedrock import BedrockModelClient
 from calfkit_tpu.providers.fallback import (
     FallbackExhaustedError,
     FallbackModelClient,
 )
 from calfkit_tpu.providers.gemini import GeminiModelClient
 from calfkit_tpu.providers.http import ModelAPIError
+from calfkit_tpu.providers.mistral import MistralModelClient
 from calfkit_tpu.providers.openai import OpenAIModelClient
 from calfkit_tpu.providers.openai_responses import OpenAIResponsesModelClient
 
 __all__ = [
     "AnthropicModelClient",
+    "BedrockModelClient",
     "FallbackExhaustedError",
     "FallbackModelClient",
     "GeminiModelClient",
+    "MistralModelClient",
     "ModelAPIError",
     "OpenAIModelClient",
     "OpenAIResponsesModelClient",

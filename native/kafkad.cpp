@@ -28,7 +28,9 @@
 #include <arpa/inet.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <errno.h>
 #include <signal.h>
+#include <string.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -213,12 +215,93 @@ int64_t now_ms() {
   return int64_t(ts.tv_sec) * 1000 + ts.tv_nsec / 1000000;
 }
 
-Topic& topic_ref_locked(const std::string& name, int32_t partitions = kDefaultPartitions) {
+// ----------------------------------------------------------- WAL (opt-in)
+// `kafkad <port> --log-dir <dir>` makes the dev broker DURABLE: every
+// topic creation, record append, and committed offset is appended to
+// <dir>/wal.log (length-prefixed, crc32c-guarded frames) and replayed on
+// boot.  Without the flag, retention is memory-only (Tansu-dev-broker
+// parity) and a restart is a fresh world — the documented trade.
+FILE* g_wal = nullptr;     // non-null = durability on
+bool g_replaying = false;  // suppress re-logging during boot replay
+uint32_t crc32c(const uint8_t* data, size_t n);
+
+struct WalWriter {
+  std::vector<uint8_t> buf;
+  void u8(uint8_t v) { buf.push_back(v); }
+  void u16(uint16_t v) { buf.push_back(uint8_t(v >> 8)); buf.push_back(uint8_t(v)); }
+  void i32w(int32_t v) { for (int i = 3; i >= 0; i--) buf.push_back(uint8_t(uint32_t(v) >> (8 * i))); }
+  void i64w(int64_t v) { for (int i = 7; i >= 0; i--) buf.push_back(uint8_t(uint64_t(v) >> (8 * i))); }
+  void str(const std::string& s) { u16(uint16_t(s.size())); buf.insert(buf.end(), s.begin(), s.end()); }
+  void blob(const std::optional<std::vector<uint8_t>>& b) {
+    if (!b) { i32w(-1); return; }
+    i32w(int32_t(b->size()));
+    buf.insert(buf.end(), b->begin(), b->end());
+  }
+};
+
+bool g_wal_failed = false;  // loud-once latch: never silently memory-only
+
+void wal_io_check(bool ok) {  // caller holds g_mu
+  if (ok) {
+    if (g_wal_failed)
+      fprintf(stderr, "kafkad: WAL writes recovered\n");
+    g_wal_failed = false;
+    return;
+  }
+  if (!g_wal_failed)
+    fprintf(stderr,
+            "kafkad: WAL WRITE FAILED (disk full / fs error?) — durability "
+            "is DEGRADED until writes recover: %s\n", strerror(errno));
+  g_wal_failed = true;
+}
+
+void wal_append(const WalWriter& w) {  // caller holds g_mu; flush deferred
+  if (!g_wal || g_replaying) return;
+  uint32_t len = uint32_t(w.buf.size());
+  uint32_t crc = crc32c(w.buf.data(), w.buf.size());
+  uint8_t head[8];
+  for (int i = 0; i < 4; i++) head[i] = uint8_t(len >> (8 * (3 - i)));
+  for (int i = 0; i < 4; i++) head[4 + i] = uint8_t(crc >> (8 * (3 - i)));
+  bool ok = fwrite(head, 1, 8, g_wal) == 8 &&
+            fwrite(w.buf.data(), 1, w.buf.size(), g_wal) == w.buf.size();
+  wal_io_check(ok);
+}
+
+void wal_flush() {  // caller holds g_mu; one flush per handler mutation
+  if (!g_wal || g_replaying) return;
+  wal_io_check(fflush(g_wal) == 0);
+}
+
+void wal_log_topic(const std::string& name, int32_t partitions, bool compacted) {
+  WalWriter w;
+  w.u8('T'); w.str(name); w.i32w(partitions); w.u8(compacted ? 1 : 0);
+  wal_append(w);
+}
+
+void wal_log_record(const std::string& topic, int32_t part, const StoredRecord& rec) {
+  WalWriter w;
+  w.u8('R'); w.str(topic); w.i32w(part); w.i64w(rec.timestamp_ms);
+  w.blob(rec.key); w.blob(rec.value);
+  w.i32w(int32_t(rec.headers.size()));
+  for (const auto& h : rec.headers) { w.str(h.first); w.blob(std::optional<std::vector<uint8_t>>(h.second)); }
+  wal_append(w);
+}
+
+void wal_log_offset(const std::string& group, const std::string& topic, int32_t part, int64_t off) {
+  WalWriter w;
+  w.u8('O'); w.str(group); w.str(topic); w.i32w(part); w.i64w(off);
+  wal_append(w);
+}
+
+Topic& topic_ref_locked(const std::string& name, int32_t partitions = kDefaultPartitions,
+                        bool compacted = false) {
   auto it = g_topics.find(name);
   if (it == g_topics.end()) {
     Topic t;
     t.partitions.resize(size_t(partitions));
+    t.compacted = compacted;
     it = g_topics.emplace(name, std::move(t)).first;
+    wal_log_topic(name, partitions, compacted);
   }
   return it->second;
 }
@@ -374,7 +457,7 @@ void handle_metadata(Reader& r, Writer& w) {
   for (int32_t i = 0; i < n; i++) names.push_back(r.str());
   std::lock_guard<std::mutex> lk(g_mu);
   if (n < 0) for (const auto& kv : g_topics) names.push_back(kv.first);
-  else for (const auto& name : names) topic_ref_locked(name);  // auto-create
+  else { for (const auto& name : names) topic_ref_locked(name); wal_flush(); }  // auto-create
   // brokers
   w.i32(1);
   w.i32(0); w.str("127.0.0.1"); w.i32(g_advertise_port); w.null_str();  // rack
@@ -422,6 +505,7 @@ void handle_produce(Reader& r, Writer& w) {
             for (auto& rec : recs) {
               rec.offset = pa.high_watermark();
               if (rec.timestamp_ms <= 0) rec.timestamp_ms = ts;
+              wal_log_record(name, part, rec);
               pa.log.push_back(std::move(rec));
             }
           }
@@ -429,6 +513,7 @@ void handle_produce(Reader& r, Writer& w) {
         results.push_back(std::move(res));
       }
     }
+    wal_flush();
   }
   g_data_cv.notify_all();
   // group results by topic, preserving order
@@ -799,11 +884,15 @@ void handle_offset_commit(Reader& r, Writer& w) {
       int32_t part = r.i32();
       int64_t off = r.i64();
       r.str();  // metadata
-      if (err == ERR_NONE) g.offsets[{name, part}] = off;
+      if (err == ERR_NONE) {
+        g.offsets[{name, part}] = off;
+        wal_log_offset(group_id, name, part, off);
+      }
       w.i32(part);
       w.i16(err);
     }
   }
+  wal_flush();
 }
 
 void handle_offset_fetch(Reader& r, Writer& w) {
@@ -863,8 +952,8 @@ void handle_create_topics(Reader& r, Writer& w) {
     bool compacted = req.second < 0;
     int32_t parts = compacted ? -req.second : req.second;
     bool existed = g_topics.count(req.first) > 0;
-    Topic& t = topic_ref_locked(req.first, parts);
-    if (!existed) t.compacted = compacted;
+    topic_ref_locked(req.first, parts, compacted);
+    wal_flush();
     w.str(req.first);
     w.i16(existed ? int16_t(36) : ERR_NONE);  // 36 = TOPIC_ALREADY_EXISTS
   }
@@ -1018,12 +1107,100 @@ void serve(int fd) {
   close(fd);
 }
 
+// WAL boot replay: frames are length+crc prefixed; a torn/corrupt tail
+// (crash mid-append) ends replay cleanly at the last good frame.
+void wal_replay(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (!f) return;
+  g_replaying = true;
+  std::vector<uint8_t> frame;
+  size_t replayed = 0;
+  long good_end = 0;  // file offset after the last fully-valid frame
+  for (;;) {
+    uint8_t head[8];
+    if (fread(head, 1, 8, f) != 8) break;
+    uint32_t len = (uint32_t(head[0]) << 24) | (uint32_t(head[1]) << 16) |
+                   (uint32_t(head[2]) << 8) | head[3];
+    uint32_t crc = (uint32_t(head[4]) << 24) | (uint32_t(head[5]) << 16) |
+                   (uint32_t(head[6]) << 8) | head[7];
+    if (len == 0 || len > (64u << 20)) break;
+    frame.resize(len);
+    if (fread(frame.data(), 1, len, f) != len) break;
+    if (crc32c(frame.data(), len) != crc) break;
+    Reader r(frame.data(), frame.size());
+    uint8_t kind = r.i8();
+    if (kind == 'T') {
+      std::string name = r.str();
+      int32_t parts = r.i32();
+      bool compacted = r.i8() != 0;
+      topic_ref_locked(name, parts, compacted);
+    } else if (kind == 'R') {
+      std::string topic = r.str();
+      int32_t part = r.i32();
+      StoredRecord rec;
+      rec.timestamp_ms = r.i64();
+      rec.key = r.bytes();
+      rec.value = r.bytes();
+      int32_t nheaders = r.i32();
+      for (int32_t h = 0; h < nheaders && r.ok; h++) {
+        std::string hk = r.str();
+        auto hv = r.bytes();
+        rec.headers.emplace_back(hk, hv ? *hv : std::vector<uint8_t>());
+      }
+      if (!r.ok) break;
+      Topic& t = topic_ref_locked(topic);
+      if (part >= 0 && size_t(part) < t.partitions.size()) {
+        Partition& pa = t.partitions[size_t(part)];
+        rec.offset = pa.high_watermark();
+        pa.log.push_back(std::move(rec));
+      }
+    } else if (kind == 'O') {
+      std::string group = r.str();
+      std::string topic = r.str();
+      int32_t part = r.i32();
+      int64_t off = r.i64();
+      if (r.ok) g_groups[group].offsets[{topic, part}] = off;
+    } else {
+      break;  // unknown frame kind: stop at the last understood state
+    }
+    if (!r.ok) break;
+    replayed++;
+    good_end = ftell(f);
+  }
+  bool torn = ftell(f) != good_end || fgetc(f) != EOF;
+  fclose(f);
+  if (torn) {
+    // a torn/corrupt tail must be CUT, not appended after: replay stops
+    // at the tear, so anything written beyond it would be silently lost
+    // on the NEXT restart
+    if (truncate(path.c_str(), good_end) != 0)
+      fprintf(stderr, "kafkad: could not truncate torn WAL tail of %s: %s\n",
+              path.c_str(), strerror(errno));
+    else
+      fprintf(stderr, "kafkad: truncated torn WAL tail of %s at %ld\n",
+              path.c_str(), good_end);
+  }
+  g_replaying = false;
+  if (replayed)
+    fprintf(stderr, "kafkad: replayed %zu WAL frames from %s\n",
+            replayed, path.c_str());
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
   crc_init();
   int port = argc > 1 ? atoi(argv[1]) : 19192;
+  std::string log_dir;
   for (int i = 2; i < argc; i++) {
+    if (std::string(argv[i]) == "--log-dir") {
+      if (i + 1 >= argc) {
+        fprintf(stderr, "--log-dir expects a directory\n");
+        return 2;
+      }
+      log_dir = argv[++i];
+      continue;
+    }
     if (std::string(argv[i]) == "--advertise-port") {
       if (i + 1 >= argc) {
         fprintf(stderr, "--advertise-port expects a port\n");
@@ -1048,6 +1225,15 @@ int main(int argc, char** argv) {
     }
   }
   signal(SIGPIPE, SIG_IGN);
+  if (!log_dir.empty()) {
+    std::string wal_path = log_dir + "/wal.log";
+    wal_replay(wal_path);
+    g_wal = fopen(wal_path.c_str(), "ab");
+    if (!g_wal) {
+      fprintf(stderr, "kafkad: cannot open %s for append\n", wal_path.c_str());
+      return 2;  // durability was asked for: fail closed, don't run volatile
+    }
+  }
   int server = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   setsockopt(server, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));

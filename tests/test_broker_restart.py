@@ -132,6 +132,115 @@ class TestBrokerRestart:
             for rec in caplog.records
         ), [rec.message for rec in caplog.records]
 
+    def test_wal_makes_restart_lossless(self, tmp_path):
+        """With --log-dir, records + committed offsets survive the
+        restart: the consumer resumes exactly where it left off and
+        nothing is redelivered or lost."""
+        port = _free_port()
+        proc = spawn_kafkad(port, log_dir=str(tmp_path))
+
+        async def run() -> None:
+            nonlocal proc
+            mesh = KafkaWireMesh(f"127.0.0.1:{port}")
+            await mesh.start()
+            got: list[bytes] = []
+            arrived = asyncio.Event()
+
+            async def handler(rec):
+                got.append(rec.value)
+                arrived.set()
+
+            try:
+                await mesh.ensure_topics(["wal.topic"])
+                sub = await mesh.subscribe(
+                    ["wal.topic"], handler, group_id="wal-g"
+                )
+                await mesh.publish("wal.topic", b"one", key=b"k")
+                await asyncio.wait_for(arrived.wait(), 15)
+                arrived.clear()
+                # let the ACK-first auto-commit land before the kill
+                await asyncio.sleep(1.5)
+
+                proc.kill()
+                proc.wait(timeout=5)
+                proc = spawn_kafkad(port, log_dir=str(tmp_path))
+
+                deadline = asyncio.get_running_loop().time() + 30
+                while True:
+                    try:
+                        await mesh.publish("wal.topic", b"two", key=b"k")
+                        break
+                    except Exception:  # noqa: BLE001
+                        if asyncio.get_running_loop().time() > deadline:
+                            raise
+                        await asyncio.sleep(0.3)
+                await asyncio.wait_for(arrived.wait(), 30)
+                # no loss AND no redelivery: the committed offset survived
+                assert got == [b"one", b"two"], got
+                await sub.stop()
+            finally:
+                await mesh.stop()
+
+        try:
+            asyncio.run(run())
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    def test_wal_survives_torn_tail(self, tmp_path):
+        """A crash mid-append leaves a torn frame; replay must stop at
+        the last good frame and the broker must serve normally."""
+        port = _free_port()
+        proc = spawn_kafkad(port, log_dir=str(tmp_path))
+
+        async def seed() -> None:
+            client = KafkaWireClient("127.0.0.1", port)
+            try:
+                await client.create_topics(["torn"], 1)
+                await client.produce(
+                    "torn", 0, encode_record_batch([(b"k", b"kept", [])], 1)
+                )
+            finally:
+                await client.close()
+
+        asyncio.run(seed())
+        proc.kill()
+        proc.wait(timeout=5)
+        with open(tmp_path / "wal.log", "ab") as wal:
+            wal.write(b"\x00\x00\x00\x20TORNFRAME")  # length promises more
+
+        proc = spawn_kafkad(port, log_dir=str(tmp_path))
+
+        async def check(expect: list[bytes], *, produce: bytes | None) -> None:
+            client = KafkaWireClient("127.0.0.1", port)
+            try:
+                results = await client.fetch([("torn", 0, 0)], max_wait_ms=200)
+                from calfkit_tpu.mesh.kafka_wire import decode_record_batches
+
+                records = decode_record_batches(results[0][3])
+                assert [v for *_x, v, _h in records] == expect
+                if produce is not None:
+                    await client.produce(
+                        "torn", 0,
+                        encode_record_batch([(b"k", produce, [])], 2),
+                    )
+            finally:
+                await client.close()
+
+        try:
+            # restart 1: tail truncated, pre-crash record intact, and a
+            # POST-crash write lands after the cut...
+            asyncio.run(check([b"kept"], produce=b"after-crash"))
+            proc.terminate()
+            proc.wait(timeout=5)
+            # ...restart 2: the post-crash write SURVIVES (the torn tail
+            # was cut, not appended after — review finding r5)
+            proc = spawn_kafkad(port, log_dir=str(tmp_path))
+            asyncio.run(check([b"kept", b"after-crash"], produce=None))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
     def test_table_reader_recovers_after_restart(self):
         """Compacted-table views re-resolve from the new (empty) world
         and keep serving writes made after the restart."""

@@ -1,0 +1,374 @@
+"""Bedrock + Mistral model clients (VERDICT r4 missing-item 5: the
+provider-breadth remainder on the shared http seam).
+
+Bedrock is exercised at three seams, each against an independent oracle:
+the SigV4 signer against the published AWS test-suite vector, the binary
+eventstream decoder against frames ENCODED by a test-local writer, and
+the Converse mapping against httpx.MockTransport.  Mistral pins exactly
+its documented deviations from the OpenAI shape.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import struct
+import zlib
+
+import httpx
+import pytest
+
+from calfkit_tpu.engine.model_client import (
+    ModelRequestParameters,
+    ModelSettings,
+    ResponseDone,
+    TextDelta,
+)
+from calfkit_tpu.models.capability import ToolDef
+from calfkit_tpu.models.messages import (
+    ModelRequest,
+    ModelResponse,
+    RetryPart,
+    ToolCallOutput,
+    ToolReturnPart,
+    UserPart,
+)
+from calfkit_tpu.providers import (
+    BedrockModelClient,
+    MistralModelClient,
+    ModelAPIError,
+)
+from calfkit_tpu.providers.bedrock import (
+    decode_event_frames,
+    render_converse,
+    sigv4_headers,
+)
+
+TOOL = ToolDef(
+    name="lookup",
+    description="Look things up.",
+    parameters_schema={
+        "type": "object",
+        "properties": {"q": {"type": "string"}},
+        "required": ["q"],
+    },
+)
+
+HISTORY = [
+    ModelRequest(parts=[UserPart(content="find the answer")],
+                 instructions="be brief"),
+    ModelResponse(parts=[ToolCallOutput(
+        tool_call_id="c1", tool_name="lookup", args={"q": "answer"})]),
+    ModelRequest(parts=[ToolReturnPart(
+        tool_call_id="c1", tool_name="lookup", content="42")]),
+]
+
+
+class TestSigV4:
+    def test_aws_published_vector(self):
+        """The AWS SigV4 documentation example (IAM ListUsers,
+        2015-08-30) — an oracle this implementation did not produce."""
+        headers = sigv4_headers(
+            method="GET",
+            url="https://iam.amazonaws.com/?Action=ListUsers&Version=2010-05-08",
+            region="us-east-1",
+            service="iam",
+            access_key="AKIDEXAMPLE",
+            secret_key="wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY",
+            now=datetime.datetime(2015, 8, 30, 12, 36, 0,
+                                  tzinfo=datetime.timezone.utc),
+            extra_headers={
+                "content-type":
+                    "application/x-www-form-urlencoded; charset=utf-8",
+            },
+        )
+        assert headers["Authorization"] == (
+            "AWS4-HMAC-SHA256 "
+            "Credential=AKIDEXAMPLE/20150830/us-east-1/iam/aws4_request, "
+            "SignedHeaders=content-type;host;x-amz-date, "
+            "Signature=5d672d79c15b13162d9279b0855cfba6"
+            "789a8edb4c82c400e06b5924a6f2b5d7"
+        )
+
+    def test_session_token_is_signed_and_sent(self):
+        headers = sigv4_headers(
+            method="POST", url="https://bedrock-runtime.us-east-1.amazonaws.com/x",
+            region="us-east-1", service="bedrock",
+            access_key="AK", secret_key="SK", session_token="TOKEN",
+            payload=b"{}",
+        )
+        assert headers["X-Amz-Security-Token"] == "TOKEN"
+        assert "x-amz-security-token" in headers["Authorization"]
+
+
+def encode_event_frame(headers: dict[str, str], payload: bytes) -> bytes:
+    """Test-local eventstream WRITER (independent of the decoder)."""
+    hdr = b""
+    for name, value in headers.items():
+        raw_name = name.encode()
+        raw_value = value.encode()
+        hdr += bytes([len(raw_name)]) + raw_name + b"\x07"
+        hdr += struct.pack(">H", len(raw_value)) + raw_value
+    total = 12 + len(hdr) + len(payload) + 4
+    prelude = struct.pack(">II", total, len(hdr))
+    prelude += struct.pack(">I", zlib.crc32(prelude))
+    body = prelude + hdr + payload
+    return body + struct.pack(">I", zlib.crc32(body))
+
+
+class TestEventStreamCodec:
+    def test_round_trip_and_partial_frames(self):
+        frame_a = encode_event_frame(
+            {":event-type": "contentBlockDelta"}, b'{"x":1}'
+        )
+        frame_b = encode_event_frame({":event-type": "messageStop"}, b"{}")
+        blob = frame_a + frame_b
+        # feed byte by byte: frames must come out exactly at boundaries
+        buffer = bytearray()
+        seen = []
+        for i in range(len(blob)):
+            buffer.extend(blob[i:i + 1])
+            seen.extend(decode_event_frames(buffer))
+        assert [h[":event-type"] for h, _p in seen] == [
+            "contentBlockDelta", "messageStop",
+        ]
+        assert seen[0][1] == b'{"x":1}'
+        assert not buffer  # fully consumed
+
+    def test_corrupt_crc_is_typed(self):
+        frame = bytearray(encode_event_frame({":event-type": "x"}, b"{}"))
+        frame[-1] ^= 0xFF
+        with pytest.raises(ModelAPIError, match="crc"):
+            decode_event_frames(frame)
+
+    def test_corrupt_prelude_is_typed(self):
+        frame = bytearray(encode_event_frame({":event-type": "x"}, b"{}"))
+        frame[0] ^= 0x01
+        with pytest.raises(ModelAPIError, match="crc|implausible"):
+            decode_event_frames(frame)
+
+
+def _bedrock(handler) -> BedrockModelClient:
+    return BedrockModelClient(
+        "anthropic.claude-test", region="us-east-1",
+        access_key="AK", secret_key="SK",
+        http_client=httpx.AsyncClient(transport=httpx.MockTransport(handler)),
+    )
+
+
+class TestBedrockConverse:
+    def test_render_merges_adjacent_roles(self):
+        system, turns = render_converse(HISTORY)
+        assert system == [{"text": "be brief"}]
+        assert [t["role"] for t in turns] == ["user", "assistant", "user"]
+        assert turns[1]["content"][0]["toolUse"]["input"] == {"q": "answer"}
+        assert turns[2]["content"][0]["toolResult"]["toolUseId"] == "c1"
+
+    def test_retry_part_becomes_error_tool_result(self):
+        _s, turns = render_converse([
+            ModelResponse(parts=[ToolCallOutput(
+                tool_call_id="c9", tool_name="lookup", args={})]),
+            ModelRequest(parts=[RetryPart(
+                content="bad args", tool_call_id="c9", tool_name="lookup")]),
+        ])
+        result = turns[-1]["content"][0]["toolResult"]
+        assert result["status"] == "error"
+
+    async def test_request_mapping_and_parse(self):
+        captured = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            captured["url"] = str(request.url)
+            captured["payload"] = json.loads(request.content)
+            captured["auth"] = request.headers.get("Authorization", "")
+            return httpx.Response(200, json={
+                "output": {"message": {"role": "assistant", "content": [
+                    {"text": "the answer is 42"},
+                ]}},
+                "stopReason": "end_turn",
+                "usage": {"inputTokens": 10, "outputTokens": 5},
+            })
+
+        client = _bedrock(handler)
+        response = await client.request(
+            HISTORY, ModelSettings(max_tokens=64, temperature=0.5),
+            ModelRequestParameters(tool_defs=[TOOL]),
+        )
+        assert "/model/anthropic.claude-test/converse" in captured["url"]
+        assert captured["auth"].startswith("AWS4-HMAC-SHA256")
+        assert captured["payload"]["inferenceConfig"] == {
+            "maxTokens": 64, "temperature": 0.5,
+        }
+        spec = captured["payload"]["toolConfig"]["tools"][0]["toolSpec"]
+        assert spec["name"] == "lookup"
+        assert response.text() == "the answer is 42"
+        assert response.usage.input_tokens == 10
+        await client.aclose()
+
+    async def test_structured_output_forces_any_tool_choice(self):
+        captured = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            captured["payload"] = json.loads(request.content)
+            return httpx.Response(200, json={
+                "output": {"message": {"role": "assistant", "content": [
+                    {"toolUse": {"toolUseId": "t1", "name": "lookup",
+                                 "input": {"q": "x"}}},
+                ]}},
+                "usage": {},
+            })
+
+        client = _bedrock(handler)
+        response = await client.request(
+            HISTORY, None,
+            ModelRequestParameters(tool_defs=[TOOL], allow_text_output=False),
+        )
+        assert captured["payload"]["toolConfig"]["toolChoice"] == {"any": {}}
+        call = response.tool_calls()[0]
+        assert call.tool_name == "lookup"
+        assert json.loads(call.args) == {"q": "x"}
+        await client.aclose()
+
+    async def test_http_error_is_typed(self):
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(403, json={"message": "no creds"})
+
+        client = _bedrock(handler)
+        with pytest.raises(ModelAPIError) as info:
+            await client.request(HISTORY)
+        assert info.value.status == 403
+        await client.aclose()
+
+    async def test_stream_text_tool_and_usage(self):
+        frames = b"".join([
+            encode_event_frame(
+                {":event-type": "messageStart", ":message-type": "event"},
+                json.dumps({"role": "assistant"}).encode()),
+            encode_event_frame(
+                {":event-type": "contentBlockDelta", ":message-type": "event"},
+                json.dumps({"contentBlockIndex": 0,
+                            "delta": {"text": "half "}}).encode()),
+            encode_event_frame(
+                {":event-type": "contentBlockDelta", ":message-type": "event"},
+                json.dumps({"contentBlockIndex": 0,
+                            "delta": {"text": "done"}}).encode()),
+            encode_event_frame(
+                {":event-type": "contentBlockStart", ":message-type": "event"},
+                json.dumps({"contentBlockIndex": 1, "start": {"toolUse": {
+                    "toolUseId": "t7", "name": "lookup"}}}).encode()),
+            encode_event_frame(
+                {":event-type": "contentBlockDelta", ":message-type": "event"},
+                json.dumps({"contentBlockIndex": 1, "delta": {
+                    "toolUse": {"input": '{"q":'}}}).encode()),
+            encode_event_frame(
+                {":event-type": "contentBlockDelta", ":message-type": "event"},
+                json.dumps({"contentBlockIndex": 1, "delta": {
+                    "toolUse": {"input": '"x"}'}}}).encode()),
+            encode_event_frame(
+                {":event-type": "messageStop", ":message-type": "event"},
+                json.dumps({"stopReason": "tool_use"}).encode()),
+            encode_event_frame(
+                {":event-type": "metadata", ":message-type": "event"},
+                json.dumps({"usage": {"inputTokens": 3,
+                                      "outputTokens": 9}}).encode()),
+        ])
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            assert "/converse-stream" in str(request.url)
+            return httpx.Response(200, content=frames)
+
+        client = _bedrock(handler)
+        deltas, done = [], None
+        async for item in client.request_stream(HISTORY):
+            if isinstance(item, TextDelta):
+                deltas.append(item.text)
+            elif isinstance(item, ResponseDone):
+                done = item.response
+        assert "".join(deltas) == "half done"
+        assert done.text() == "half done"
+        call = done.tool_calls()[0]
+        assert (call.tool_call_id, call.tool_name) == ("t7", "lookup")
+        assert json.loads(call.args) == {"q": "x"}
+        assert done.usage.output_tokens == 9
+        await client.aclose()
+
+    async def test_stream_without_message_stop_raises(self):
+        frames = encode_event_frame(
+            {":event-type": "contentBlockDelta", ":message-type": "event"},
+            json.dumps({"delta": {"text": "trunc"}}).encode())
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, content=frames)
+
+        client = _bedrock(handler)
+        with pytest.raises(ModelAPIError, match="messageStop"):
+            async for _ in client.request_stream(HISTORY):
+                pass
+        await client.aclose()
+
+    async def test_midstream_exception_frame_is_typed(self):
+        frames = encode_event_frame(
+            {":message-type": "exception",
+             ":exception-type": "throttlingException"},
+            b'{"message":"slow down"}')
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            return httpx.Response(200, content=frames)
+
+        client = _bedrock(handler)
+        with pytest.raises(ModelAPIError, match="throttlingException"):
+            async for _ in client.request_stream(HISTORY):
+                pass
+        await client.aclose()
+
+
+class TestMistral:
+    def _client(self, handler) -> MistralModelClient:
+        return MistralModelClient(
+            "mistral-test", api_key="k",
+            http_client=httpx.AsyncClient(
+                transport=httpx.MockTransport(handler)),
+        )
+
+    async def test_tool_choice_any_and_tool_name_threading(self):
+        captured = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            captured["url"] = str(request.url)
+            captured["payload"] = json.loads(request.content)
+            return httpx.Response(200, json={
+                "choices": [{"message": {"role": "assistant",
+                                         "content": "ok"}}],
+                "usage": {"prompt_tokens": 1, "completion_tokens": 1},
+                "model": "mistral-test",
+            })
+
+        client = self._client(handler)
+        response = await client.request(
+            HISTORY, None,
+            ModelRequestParameters(tool_defs=[TOOL], allow_text_output=False),
+        )
+        assert captured["url"] == "https://api.mistral.ai/v1/chat/completions"
+        payload = captured["payload"]
+        assert payload["tool_choice"] == "any"
+        tool_message = next(
+            m for m in payload["messages"] if m.get("role") == "tool"
+        )
+        assert tool_message["name"] == "lookup"  # Mistral deviation
+        assert response.text() == "ok"
+        await client.aclose()
+
+    async def test_max_tokens_never_reasoning_spelled(self):
+        captured = {}
+
+        def handler(request: httpx.Request) -> httpx.Response:
+            captured["payload"] = json.loads(request.content)
+            return httpx.Response(200, json={
+                "choices": [{"message": {"content": "x"}}], "usage": {},
+            })
+
+        client = self._client(handler)
+        await client.request(HISTORY, ModelSettings(max_tokens=7))
+        assert captured["payload"]["max_tokens"] == 7
+        assert "max_completion_tokens" not in captured["payload"]
+        await client.aclose()
