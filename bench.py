@@ -29,10 +29,12 @@ def _bench_config():
     n_dev = len(jax.devices())
     platform = jax.devices()[0].platform
     choice = os.environ.get("CALFKIT_BENCH_CONFIG", "auto")
-    if choice not in ("auto", "smoke", "tinyllama", "tinyllama_cpu", "llama8b"):
+    if choice not in ("auto", "smoke", "tinyllama", "tinyllama_cpu",
+                      "llama8b", "llama8b_int4"):
         raise ValueError(
             f"CALFKIT_BENCH_CONFIG={choice!r} "
-            "(want auto | smoke | tinyllama | tinyllama_cpu | llama8b)"
+            "(want auto | smoke | tinyllama | tinyllama_cpu | llama8b | "
+            "llama8b_int4)"
         )
     if choice == "auto":
         choice = "smoke" if platform == "cpu" else "tinyllama"
@@ -64,6 +66,15 @@ def _bench_config():
             quantization="int8", kv_layout="paged", random_quantized=True,
             # 32 slots x 4 pages reserve (64+128+1 tokens) + headroom
             num_kv_pages=32 * 4 + 65,
+        )
+    if choice == "llama8b_int4":
+        # int4 weights (~4 GB): half the int8 weight stream — the freed
+        # HBM funds a 2x batch (64 slots) for even better occupancy
+        return dict(
+            preset="llama-3-8b", bs=64, max_seq=1024, prefill_chunk=128,
+            steps=32, requests=256, new_tokens=128, prompt_len=64,
+            quantization="int4", kv_layout="paged", random_quantized=True,
+            num_kv_pages=64 * 4 + 65,
         )
     return dict(
         # requests = 4x bs so the measured region is steady-state-dominated
@@ -115,7 +126,9 @@ def _perf_model(model, cfg, wall_tps: float, occupancy: float) -> dict:
     ctx = cfg["prompt_len"] + cfg["new_tokens"] / 2.0
     attn_flops = 4.0 * model.n_layers * model.d_model * ctx
     flops_per_token = 2.0 * params + attn_flops
-    weight_bytes = params * (1 if cfg.get("quantization") == "int8" else 2)
+    weight_bytes = params * {
+        "int8": 1.0, "int4": 0.5,
+    }.get(cfg.get("quantization"), 2.0)
     kv_bytes = 2.0 * model.n_layers * model.n_kv_heads * model.head_dim * ctx * 2
     effective_bs = max(cfg["bs"] * max(occupancy, 0.0), 1e-9)
     bytes_per_token = weight_bytes / effective_bs + kv_bytes
@@ -163,7 +176,9 @@ async def run() -> dict:
         # tree — the whole chip for 8B)
         from calfkit_tpu.inference.quant import random_quantized_params_host
 
-        params = random_quantized_params_host(model)
+        params = random_quantized_params_host(
+            model, bits=4 if cfg.get("quantization") == "int4" else 8
+        )
     engine = InferenceEngine(model, runtime, params=params)
     await engine.start()
 

@@ -209,10 +209,13 @@ class JaxLocalModelClient(ModelClient):
             config = config_from_hf(self._checkpoint)
             mesh = make_mesh(tp=runtime.tp, dp=runtime.dp)
             shardings = param_shardings(config, mesh)
-            if runtime.quantization == "int8":
+            if runtime.quantization in ("int8", "int4"):
                 from calfkit_tpu.inference.quant import quantize_shardings
 
-                shardings = quantize_shardings(shardings)
+                shardings = quantize_shardings(
+                    shardings,
+                    bits=8 if runtime.quantization == "int8" else 4,
+                )
             params = load_params(
                 self._checkpoint,
                 config,

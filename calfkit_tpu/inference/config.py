@@ -90,8 +90,10 @@ class RuntimeConfig:
     # sparse buckets = few compiles, dense = tighter HBM reads
     window_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
     compilation_cache_dir: str | None = "~/.cache/calfkit_tpu_xla"
-    # "int8" = weight-only quantization: halves decode HBM traffic and fits
-    # Llama-3-8B on one 16 GB chip; None = native dtype
+    # weight-only quantization: "int8" halves decode HBM traffic and fits
+    # Llama-3-8B on one 16 GB chip; "int4" (packed nibbles, group-128
+    # scales) halves the weight stream again (~4 GB for 8B — margin for
+    # KV pages / batch width); None = native dtype
     quantization: str | None = None
 
     def pages_per_seq(self) -> int:

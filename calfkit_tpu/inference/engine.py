@@ -224,18 +224,30 @@ class InferenceEngine:
                 config.param_count / 1e9,
             )
             params = M.init_params(config, jax.random.key(seed))
-        if rt.quantization == "int8":
+        if rt.quantization in ("int8", "int4"):
             from calfkit_tpu.inference.quant import (
+                align_quant_sharding_keys,
                 is_quantized,
+                is_quantized4,
                 quantize_params,
                 quantize_shardings,
             )
 
-            if not is_quantized(params.get("layers", {}).get("wq")):
+            bits = 8 if rt.quantization == "int8" else 4
+            wq = params.get("layers", {}).get("wq")
+            matching = is_quantized(wq) if bits == 8 else is_quantized4(wq)
+            if (is_quantized(wq) or is_quantized4(wq)) and not matching:
+                raise ValueError(
+                    f"params are pre-quantized at the other bitness than "
+                    f"runtime quantization={rt.quantization!r}"
+                )
+            if not matching:
                 # consume: free each full-precision tensor as it quantizes
                 # (peak ~1x model size — the 8B random-init path needs this)
-                params = quantize_params(params, consume=True)
-            shardings = quantize_shardings(shardings)
+                params = quantize_params(params, consume=True, bits=bits)
+            shardings = quantize_shardings(shardings, bits=bits)
+            if bits == 4:
+                shardings = align_quant_sharding_keys(shardings, params)
         elif rt.quantization is not None:
             raise ValueError(f"unsupported quantization {rt.quantization!r}")
         if rt.chunked_prefill and rt.max_seq_len % rt.prefill_chunk:

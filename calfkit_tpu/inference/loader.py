@@ -76,15 +76,17 @@ def load_params(
 ) -> dict[str, Any]:
     """Load + transpose + stack + shard-place the checkpoint.
 
-    ``quantize="int8"`` quantizes each matmul weight ON HOST before the
-    device_put, so device memory never holds a full-precision copy — the
-    path that fits Llama-3-8B on one 16 GB chip.  Pass shardings already
-    expanded by :func:`calfkit_tpu.inference.quant.quantize_shardings`.
+    ``quantize="int8"``/``"int4"`` quantizes each matmul weight ON HOST
+    before the device_put, so device memory never holds a full-precision
+    copy — the path that fits Llama-3-8B on one 16 GB chip (int8) or in
+    ~4 GB of weights (int4, packed nibbles + group scales).  Pass
+    shardings already expanded by
+    :func:`calfkit_tpu.inference.quant.quantize_shardings`.
     """
     import jax
     from safetensors import safe_open
 
-    if quantize not in (None, "int8"):
+    if quantize not in (None, "int8", "int4"):
         raise ValueError(f"unsupported quantization {quantize!r}")
 
     path = Path(path)
@@ -115,7 +117,8 @@ def _build_params(
     D, H, K, hd = config.d_model, config.n_heads, config.n_kv_heads, config.head_dim
     L = config.n_layers
     _quant_axes: dict[str, tuple[int, ...]] = {}
-    if quantize == "int8":
+    _bits = 8 if quantize == "int8" else 4
+    if quantize in ("int8", "int4"):
         from calfkit_tpu.inference.quant import (
             LAYER_REDUCTION_AXES,
             LM_HEAD_REDUCTION_AXES,
@@ -128,9 +131,18 @@ def _build_params(
         if axes is not None:
             from calfkit_tpu.inference.quant import quantize_array_host
 
-            q = quantize_array_host(arr, axes)
+            q = quantize_array_host(arr, axes, bits=_bits)
+            packed_key = next(k for k in q if k != "scale")
+            packed_sh = sharding.get(packed_key, sharding.get("__q4__"))
+            if packed_sh is None:
+                # a silent fallback here would device_put int4 bytes under
+                # an int8 spec — fail loudly on the bits mismatch instead
+                raise ValueError(
+                    f"shardings for {name!r} were expanded for a different "
+                    f"quantization than quantize={'int4' if _bits == 4 else 'int8'!r}"
+                )
             return {
-                "q8": jax.device_put(q["q8"], sharding["q8"]),
+                packed_key: jax.device_put(q[packed_key], packed_sh),
                 "scale": jax.device_put(q["scale"], sharding["scale"]),
             }
         return jax.device_put(arr.astype(np.dtype(config.dtype)), sharding)

@@ -70,7 +70,7 @@ run_job() {  # $1 = name, $2... = command
 
 all_done() {
   local f
-  for j in bench_tinyllama profile_attn bench_llama8b tpu_lane; do
+  for j in bench_tinyllama profile_attn bench_llama8b bench_llama8b_int4 tpu_lane; do
     [ -f "tpu_results/$j.done" ] && continue
     f=0; [ -f "tpu_results/$j.failcount" ] && f=$(cat "tpu_results/$j.failcount")
     [ "$f" -ge "${MAX_JOB_FAILS:-3}" ] && continue
@@ -93,6 +93,8 @@ while ! all_done; do
     probe || continue
     JOB_TIMEOUT=4800 run_job bench_llama8b env CALFKIT_BENCH_CONFIG=llama8b python bench.py || true
     probe || continue
+    JOB_TIMEOUT=4800 run_job bench_llama8b_int4 env CALFKIT_BENCH_CONFIG=llama8b_int4 python bench.py || true
+    probe || continue
     run_job tpu_lane env CALFKIT_TESTS_TPU=1 python -m pytest -q || true
   else
     echo "[opportunist] $(date -u +%H:%M:%S) chip wedged" >> tpu_results/watcher.log
@@ -102,7 +104,7 @@ while ! all_done; do
 done
 # distinguish captured vs gave-up in the terminal record
 summary=""
-for j in bench_tinyllama profile_attn bench_llama8b tpu_lane; do
+for j in bench_tinyllama profile_attn bench_llama8b bench_llama8b_int4 tpu_lane; do
   if [ -f "tpu_results/$j.done" ]; then summary="$summary $j=done"
   else summary="$summary $j=gave-up"; fi
 done

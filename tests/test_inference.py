@@ -322,6 +322,163 @@ class TestQuantization:
         await engine.stop()
 
 
+class TestInt4Quantization:
+    """int4 weight-only (r5): packed nibbles + group-wise scales — half
+    the decode weight stream of int8 again."""
+
+    def test_pack_round_trip_is_exact_on_grid_values(self):
+        from calfkit_tpu.inference.quant import dequant, quantize_tensor4
+
+        # values that ARE representable (q * scale for q in [-7, 7]) must
+        # survive quantize→dequant bit-exactly
+        rng = np.random.default_rng(3)
+        q = rng.integers(-7, 8, size=(4, 256, 6)).astype(np.float32)
+        w = jnp.asarray(q * 0.035)  # one scale per whole axis group
+        leaf = quantize_tensor4(w, (1,), group=128)
+        key = next(k for k in leaf if k != "scale")
+        assert leaf[key].dtype == jnp.uint8
+        assert leaf[key].shape == (4, 128, 6)  # axis halved
+        assert leaf["scale"].shape == (4, 2, 6)  # 256/128 groups
+        back = dequant(leaf, jnp.float32)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(w), rtol=1e-6)
+
+    def test_group_scales_beat_per_channel_on_outliers(self):
+        from calfkit_tpu.inference.quant import dequant, quantize_tensor4
+
+        # one huge outlier in group 0 must not destroy group 1's precision
+        w = np.full((1, 256), 0.01, np.float32)
+        w[0, 0] = 100.0
+        leaf = quantize_tensor4(jnp.asarray(w), (1,), group=128)
+        back = np.asarray(dequant(leaf, jnp.float32))
+        assert abs(back[0, 0] - 100.0) < 100.0 / 7 + 1e-6
+        # group 1 (no outlier) keeps small values accurately
+        np.testing.assert_allclose(back[0, 128:], w[0, 128:], rtol=0.2)
+
+    def test_host_and_device_quantizers_agree(self):
+        from calfkit_tpu.inference.quant import (
+            quantize_array_host,
+            quantize_tensor4,
+        )
+
+        rng = np.random.default_rng(11)
+        w = rng.standard_normal((3, 256, 4)).astype(np.float32)
+        device = quantize_tensor4(jnp.asarray(w), (1,))
+        host = quantize_array_host(w, (1,), bits=4)
+        assert set(device) == set(host)
+        key = next(k for k in device if k != "scale")
+        np.testing.assert_array_equal(np.asarray(device[key]), host[key])
+        np.testing.assert_allclose(
+            np.asarray(device["scale"]), host["scale"], rtol=1e-6
+        )
+
+    def test_forward_parity_with_fp(self, params):
+        """int4 logits track fp, and the error is QUANTIZATION noise (it
+        shrinks monotonically as groups refine) — not an implementation
+        bug.  On this 64-dim toy the default-group correlation ~0.95 is
+        the intrinsic 4-bit floor (measured: g=64→0.948, g=4→0.983,
+        g=2→0.993; real models average over 4096-wide fan-ins)."""
+        from calfkit_tpu.inference.quant import (
+            LAYER_REDUCTION_AXES,
+            LM_HEAD_REDUCTION_AXES,
+            quantize_tensor4,
+        )
+
+        B, S = 2, 10
+        toks = jax.random.randint(jax.random.key(7), (B, S), 3, CFG.vocab_size)
+        pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+        lens = jnp.full((B,), S)
+
+        def logits(p):
+            cache = M.make_empty_cache(CFG, B, 32, dtype=jnp.float32)
+            out, _ = M.forward(p, CFG, toks, pos, cache, lens)
+            return np.asarray(out, np.float32).ravel()
+
+        def quantized(group):
+            out = {"embed": params["embed"],
+                   "final_norm": params["final_norm"], "layers": {}}
+            for name, w in params["layers"].items():
+                if name in LAYER_REDUCTION_AXES:
+                    out["layers"][name] = quantize_tensor4(
+                        w, LAYER_REDUCTION_AXES[name], group=group)
+                else:
+                    out["layers"][name] = w
+            if "lm_head" in params:
+                out["lm_head"] = quantize_tensor4(
+                    params["lm_head"], LM_HEAD_REDUCTION_AXES, group=group)
+            return out
+
+        fp = logits(params)
+        corr_default = np.corrcoef(fp, logits(quantized(128)))[0, 1]
+        corr_fine = np.corrcoef(fp, logits(quantized(4)))[0, 1]
+        assert corr_default > 0.93, f"int4 diverged (corr={corr_default:.4f})"
+        assert corr_fine > 0.97, f"fine-group int4 diverged ({corr_fine:.4f})"
+        # the noise-source pin: refining groups must REDUCE the error
+        assert corr_fine > corr_default
+
+    def test_sharded_placement_and_forward(self, params):
+        from calfkit_tpu.inference.quant import (
+            align_quant_sharding_keys,
+            quantize_params,
+            quantize_shardings,
+        )
+        from calfkit_tpu.inference.sharding import param_shardings, place_params
+
+        mesh = make_mesh(tp=2, dp=1)
+        qparams = quantize_params(params, bits=4)
+        qshard = align_quant_sharding_keys(
+            quantize_shardings(param_shardings(CFG, mesh), bits=4), qparams
+        )
+        placed = place_params(qparams, qshard)
+        key = next(k for k in placed["layers"]["wq"] if k != "scale")
+        assert placed["layers"]["wq"][key].dtype == jnp.uint8
+
+    async def test_engine_runs_int4(self):
+        engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=2, max_seq_len=128, prefill_chunk=16,
+                          decode_steps_per_dispatch=4, quantization="int4"),
+        )
+        await engine.start()
+        out = [t async for t in engine.generate([1, 5, 9], max_new_tokens=10)]
+        assert len(out) == 10
+        again = [t async for t in engine.generate([1, 5, 9], max_new_tokens=10)]
+        assert again == out  # deterministic under quantization too
+        await engine.stop()
+
+    def test_bitness_mismatch_fails_loudly(self):
+        from calfkit_tpu.inference.quant import random_quantized_params_host
+
+        params = random_quantized_params_host(CFG, bits=4)
+        with pytest.raises(ValueError, match="other bitness"):
+            InferenceEngine(
+                CFG,
+                RuntimeConfig(max_batch_size=2, max_seq_len=64,
+                              prefill_chunk=16, quantization="int8"),
+                params=params,
+            )
+
+    async def test_engine_runs_int4_paged_on_tp_mesh(self):
+        """The 8B-shape path in miniature: host-built int4 params + paged
+        KV on a tp=2 mesh (exercises the sharded unpack/reshape under
+        GSPMD)."""
+        from calfkit_tpu.inference.quant import random_quantized_params_host
+
+        params = random_quantized_params_host(CFG, bits=4)
+        engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=2, max_seq_len=64, prefill_chunk=16,
+                          decode_steps_per_dispatch=4, quantization="int4",
+                          kv_layout="paged", page_size=16, num_kv_pages=17,
+                          tp=2, dp=1),
+            params=params,
+            mesh=make_mesh(tp=2, dp=1),
+        )
+        await engine.start()
+        out = [t async for t in engine.generate([1, 5, 9], max_new_tokens=6)]
+        assert len(out) == 6
+        await engine.stop()
+
+
 class TestPallasAttention:
     def test_interpret_matches_xla_merged(self, params):
         """The Pallas kernel (interpret mode) must match the XLA merged
