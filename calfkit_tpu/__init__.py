@@ -21,23 +21,56 @@ from typing import TYPE_CHECKING, Any
 __version__ = "0.1.0"
 
 _LAZY: dict[str, str] = {
+    # caller surface + serving host
     "Client": "calfkit_tpu.client",
+    "AgentGateway": "calfkit_tpu.client",
+    "InvocationHandle": "calfkit_tpu.client",
+    "InvocationResult": "calfkit_tpu.client",
+    "EventStream": "calfkit_tpu.client",
+    "RunCompleted": "calfkit_tpu.client",
+    "RunFailed": "calfkit_tpu.client",
+    "Mesh": "calfkit_tpu.client",
     "Worker": "calfkit_tpu.worker",
+    # node kinds + selectors
     "Agent": "calfkit_tpu.nodes",
     "StatelessAgent": "calfkit_tpu.nodes",
+    "BaseNodeDef": "calfkit_tpu.nodes",
     "agent_tool": "calfkit_tpu.nodes",
     "consumer": "calfkit_tpu.nodes",
     "ConsumerNode": "calfkit_tpu.nodes",
     "Tools": "calfkit_tpu.nodes",
+    "render_fault_for_model": "calfkit_tpu.nodes",
+    "surface_to_model": "calfkit_tpu.nodes",
     "Toolbox": "calfkit_tpu.mcp",
     "Toolboxes": "calfkit_tpu.mcp",
     "MCPToolboxNode": "calfkit_tpu.mcp",
     "MCPServerSpec": "calfkit_tpu.mcp",
     "Messaging": "calfkit_tpu.peers",
     "Handoff": "calfkit_tpu.peers",
+    # faults + exceptions
     "NodeFaultError": "calfkit_tpu.exceptions",
+    "ClientTimeoutError": "calfkit_tpu.exceptions",
+    "ClientClosedError": "calfkit_tpu.exceptions",
+    "DeserializationError": "calfkit_tpu.exceptions",
+    "MeshUnavailableError": "calfkit_tpu.exceptions",
+    "LifecycleConfigError": "calfkit_tpu.exceptions",
     "FaultTypes": "calfkit_tpu.models",
+    "ErrorReport": "calfkit_tpu.models",
+    "ExceptionInfo": "calfkit_tpu.models",
+    # control plane + provisioning + tuning
+    "ControlPlaneConfig": "calfkit_tpu.controlplane",
+    "ControlPlaneRecord": "calfkit_tpu.controlplane",
+    "ControlPlaneStamp": "calfkit_tpu.controlplane",
+    "ControlPlaneView": "calfkit_tpu.controlplane",
+    "ProvisioningConfig": "calfkit_tpu.provisioning",
+    "FanoutConfig": "calfkit_tpu.tuning",
+    # transports
     "InMemoryMesh": "calfkit_tpu.mesh",
+    "TcpMesh": "calfkit_tpu.mesh",
+    "KafkaWireMesh": "calfkit_tpu.mesh",
+    "ConnectionProfile": "calfkit_tpu.mesh",
+    "WireSecurity": "calfkit_tpu.mesh",
+    # model clients (local TPU path + remote adapters)
     "JaxLocalModelClient": "calfkit_tpu.inference",
     "EchoModelClient": "calfkit_tpu.engine",
     "FunctionModelClient": "calfkit_tpu.engine",
@@ -45,6 +78,8 @@ _LAZY: dict[str, str] = {
     "OpenAIResponsesModelClient": "calfkit_tpu.providers",
     "AnthropicModelClient": "calfkit_tpu.providers",
     "GeminiModelClient": "calfkit_tpu.providers",
+    "MistralModelClient": "calfkit_tpu.providers",
+    "BedrockModelClient": "calfkit_tpu.providers",
     "FallbackModelClient": "calfkit_tpu.providers",
 }
 

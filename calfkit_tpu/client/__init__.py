@@ -2,6 +2,18 @@
 
 from calfkit_tpu.client.caller import AgentGateway, Client
 from calfkit_tpu.client.events import EventStream
-from calfkit_tpu.client.hub import Hub, InvocationHandle
+from calfkit_tpu.client.hub import Hub, InvocationHandle, RunCompleted, RunFailed
+from calfkit_tpu.client.mesh import Mesh
+from calfkit_tpu.models.node_result import InvocationResult
 
-__all__ = ["AgentGateway", "Client", "EventStream", "Hub", "InvocationHandle"]
+__all__ = [
+    "AgentGateway",
+    "Client",
+    "EventStream",
+    "Hub",
+    "InvocationHandle",
+    "InvocationResult",
+    "Mesh",
+    "RunCompleted",
+    "RunFailed",
+]

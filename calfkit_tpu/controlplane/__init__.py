@@ -5,10 +5,13 @@ from calfkit_tpu.controlplane.config import ControlPlaneConfig
 from calfkit_tpu.controlplane.publisher import ControlPlanePublisher
 from calfkit_tpu.controlplane.view import ControlPlaneView
 from calfkit_tpu.controlplane.plane import ControlPlane
+from calfkit_tpu.models.records import ControlPlaneRecord, ControlPlaneStamp
 
 __all__ = [
     "ControlPlane",
     "ControlPlaneConfig",
+    "ControlPlaneRecord",
+    "ControlPlaneStamp",
     "ControlPlanePublisher",
     "ControlPlaneView",
 ]

@@ -58,6 +58,7 @@ class MCPSession:
         self._timeout = request_timeout
         self._ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future[Any]] = {}
+        self._dead: str | None = None  # set when the reader can't recover
         self._proc: asyncio.subprocess.Process | None = None
         self._reader_task: asyncio.Task[None] | None = None
         self._http: Any = None
@@ -71,6 +72,10 @@ class MCPSession:
                 stdout=asyncio.subprocess.PIPE,
                 stderr=asyncio.subprocess.DEVNULL,
                 env={**__import__("os").environ, **self.spec.env} or None,
+                # asyncio's default 64 KiB stream limit would KILL the
+                # read loop on any large tool result; 32 MiB covers real
+                # MCP payloads
+                limit=32 * 1024 * 1024,
             )
             self._reader_task = asyncio.get_running_loop().create_task(
                 self._read_stdio(), name=f"mcp-{self.spec.name}-reader"
@@ -114,13 +119,15 @@ class MCPSession:
         if self._http is not None:
             await self._http.aclose()
             self._http = None
-        for future in self._pending.values():
-            if not future.done():
-                future.set_exception(MCPError("session closed"))
+        self._fail_pending("session closed")
         self._pending.clear()
 
     # -------------------------------------------------------------- rpc
     async def request(self, method: str, params: dict[str, Any]) -> dict[str, Any]:
+        if self._dead is not None:
+            # fail FAST and typed: a dead reader can never resolve a
+            # future, so parking one would hang to the raw timeout
+            raise MCPError(f"session dead: {self._dead}")
         rpc_id = next(self._ids)
         message = {"jsonrpc": "2.0", "id": rpc_id, "method": method, "params": params}
         if self._proc is not None:
@@ -162,8 +169,17 @@ class MCPSession:
     def _unwrap(payload: dict[str, Any]) -> dict[str, Any]:
         if "error" in payload:
             error = payload["error"]
-            raise MCPError(f"[{error.get('code')}] {error.get('message')}")
-        return payload.get("result", {})
+            if isinstance(error, dict):  # hostile servers send anything
+                raise MCPError(
+                    f"[{error.get('code')}] {error.get('message')}"
+                )
+            raise MCPError(str(error)[:500])
+        result = payload.get("result", {})
+        if not isinstance(result, dict):
+            raise MCPError(
+                f"server returned non-object result: {str(result)[:200]}"
+            )
+        return result
 
     # ------------------------------------------------------------- stdio
     async def _write_stdio(self, message: dict[str, Any]) -> None:
@@ -171,55 +187,101 @@ class MCPSession:
         self._proc.stdin.write(json.dumps(message).encode() + b"\n")
         await self._proc.stdin.drain()
 
+    def _fail_pending(self, message: str) -> None:
+        self._dead = message
+        for future in self._pending.values():
+            if not future.done():
+                future.set_exception(MCPError(message))
+
     async def _read_stdio(self) -> None:
         assert self._proc is not None and self._proc.stdout is not None
         while True:
-            line = await self._proc.stdout.readline()
+            try:
+                line = await self._proc.stdout.readline()
+            except ValueError:
+                # line beyond even the raised stream limit: the framing is
+                # lost mid-line — the session cannot recover, fail LOUDLY
+                # instead of leaving callers to time out
+                logger.error(
+                    "mcp %s: oversized line broke stream framing",
+                    self.spec.name,
+                )
+                self._fail_pending("server line exceeded the stream limit")
+                return
             if not line:
                 logger.warning("mcp %s: server closed stdout", self.spec.name)
-                for future in self._pending.values():
-                    if not future.done():
-                        future.set_exception(MCPError("server exited"))
+                self._fail_pending("server exited")
                 return
             try:
                 payload = json.loads(line)
             except ValueError:
                 logger.debug("mcp %s: non-JSON line ignored", self.spec.name)
                 continue
-            rpc_id = payload.get("id")
-            if rpc_id is not None and rpc_id in self._pending:
-                future = self._pending[rpc_id]
-                if not future.done():
-                    try:
-                        future.set_result(self._unwrap(payload))
-                    except MCPError as exc:
-                        future.set_exception(exc)
-            elif payload.get("method") == "notifications/tools/list_changed":
-                if self._on_tools_changed is not None:
-                    result = self._on_tools_changed()
-                    if asyncio.iscoroutine(result):
-                        # offload: never block the receive loop (reference:
-                        # mcp_toolbox re-list offload)
-                        asyncio.get_running_loop().create_task(result)
+            if not isinstance(payload, dict):
+                # a list/str/number frame must not kill the read loop (it
+                # used to: .get on a list) — every in-flight AND future
+                # request would silently hang to timeout
+                logger.debug("mcp %s: non-object frame ignored", self.spec.name)
+                continue
+            try:
+                self._handle_frame(payload)
+            except Exception:  # noqa: BLE001 — one hostile frame must not
+                logger.exception(  # take down the whole session's reader
+                    "mcp %s: frame handling failed", self.spec.name
+                )
+
+    def _handle_frame(self, payload: dict[str, Any]) -> None:
+        rpc_id = payload.get("id")
+        if rpc_id is not None and rpc_id in self._pending:
+            future = self._pending[rpc_id]
+            if not future.done():
+                try:
+                    future.set_result(self._unwrap(payload))
+                except MCPError as exc:
+                    future.set_exception(exc)
+        elif payload.get("method") == "notifications/tools/list_changed":
+            if self._on_tools_changed is not None:
+                result = self._on_tools_changed()
+                if asyncio.iscoroutine(result):
+                    # offload: never block the receive loop (reference:
+                    # mcp_toolbox re-list offload)
+                    asyncio.get_running_loop().create_task(result)
 
     # ------------------------------------------------------------- tools
     async def list_tools(self) -> list[dict[str, Any]]:
         tools: list[dict[str, Any]] = []
         cursor: str | None = None
+        seen_cursors: set[str] = set()
         while True:
             params: dict[str, Any] = {"cursor": cursor} if cursor else {}
             result = await self.request("tools/list", params)
-            tools.extend(result.get("tools", []))
+            page = result.get("tools", [])
+            if isinstance(page, list):
+                tools.extend(t for t in page if isinstance(t, dict))
             cursor = result.get("nextCursor")
             if not cursor:
                 return tools
+            if not isinstance(cursor, str):
+                raise MCPError(
+                    f"non-string nextCursor: {str(cursor)[:100]}"
+                )
+            if cursor in seen_cursors or len(seen_cursors) >= 1000:
+                # a repeating/unbounded cursor would spin this loop forever
+                raise MCPError(
+                    f"tools/list pagination did not terminate "
+                    f"(cursor {str(cursor)[:60]!r} repeated or >1000 pages)"
+                )
+            seen_cursors.add(cursor)
 
     async def call_tool(self, name: str, args: dict[str, Any]) -> Any:
         result = await self.request(
             "tools/call", {"name": name, "arguments": args}
         )
         if result.get("isError"):
-            raise MCPError(_content_text(result.get("content", [])))
+            content = result.get("content", [])
+            raise MCPError(
+                _content_text(content) or str(content)[:200] or "tool error"
+            )
         content = result.get("content", [])
         structured = result.get("structuredContent")
         if structured is not None:
@@ -227,7 +289,10 @@ class MCPSession:
         return _content_text(content)
 
 
-def _content_text(content: list[dict[str, Any]]) -> str:
+def _content_text(content: Any) -> str:
+    if not isinstance(content, list):
+        return ""
     return "\n".join(
-        c.get("text", "") for c in content if c.get("type") == "text"
+        str(c.get("text", "")) for c in content
+        if isinstance(c, dict) and c.get("type") == "text"
     )

@@ -23,6 +23,7 @@ from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
 from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh, WireSecurity
 from calfkit_tpu.mesh.memory import InMemoryMesh
 from calfkit_tpu.mesh.tables import TableReader, TableWriter
+from calfkit_tpu.mesh.tcp import TcpMesh
 
 __all__ = [
     "ConnectionProfile",
@@ -34,5 +35,6 @@ __all__ = [
     "Subscription",
     "TableReader",
     "TableWriter",
+    "TcpMesh",
     "WireSecurity",
 ]

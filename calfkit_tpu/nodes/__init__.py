@@ -1,6 +1,12 @@
 """Node kinds over the shared kernel (SURVEY.md §1 layers 2-3)."""
 
-from calfkit_tpu.nodes.agent import Agent, BaseAgentNodeDef, StatelessAgent
+from calfkit_tpu.nodes.agent import (
+    Agent,
+    BaseAgentNodeDef,
+    StatelessAgent,
+    render_fault_for_model,
+    surface_to_model,
+)
 
 from calfkit_tpu.nodes.base import BaseNodeDef, NodeRunContext, handler
 from calfkit_tpu.nodes.consumer import ConsumerContext, ConsumerNode, consumer
@@ -27,6 +33,8 @@ from calfkit_tpu.nodes.tool import (
 )
 
 __all__ = [
+    "surface_to_model",
+    "render_fault_for_model",
     "Agent",
     "BaseAgentNodeDef",
     "BaseNodeDef",

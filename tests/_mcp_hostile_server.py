@@ -1,0 +1,79 @@
+"""A HOSTILE stdio MCP server for robustness tests: answers initialize
+correctly, then responds to tool calls per a scripted misbehavior chosen
+by argv[1].  The client must survive every mode without its read loop
+dying or its pending futures hanging forever.
+
+Modes:
+- garbage-frames: interleaves non-JSON, non-object JSON, and unknown-id
+  frames before every real response
+- malformed-error: error member is a bare string; then a non-object
+  result
+- huge-line: emits a ~1 MiB response (legal — must NOT break framing)
+- cursor-loop: tools/list pagination repeats the same cursor forever
+- weird-content: tools/call returns non-list content / non-dict entries
+"""
+
+import json
+import sys
+
+MODE = sys.argv[1] if len(sys.argv) > 1 else "garbage-frames"
+
+
+def send(obj):
+    sys.stdout.write(json.dumps(obj) + "\n")
+    sys.stdout.flush()
+
+
+def send_raw(text):
+    sys.stdout.write(text + "\n")
+    sys.stdout.flush()
+
+
+def reply(rpc_id, result):
+    send({"jsonrpc": "2.0", "id": rpc_id, "result": result})
+
+
+calls = 0
+for line in sys.stdin:
+    try:
+        message = json.loads(line)
+    except ValueError:
+        continue
+    method = message.get("method")
+    rpc_id = message.get("id")
+    if method == "initialize":
+        reply(rpc_id, {"serverInfo": {"name": f"hostile-{MODE}"}})
+        continue
+    if rpc_id is None:
+        continue  # notification
+    if MODE == "garbage-frames":
+        send_raw("this is not json at all {{{")
+        send_raw(json.dumps([1, 2, 3]))
+        send_raw(json.dumps("just a string"))
+        send_raw(json.dumps(42))
+        send({"jsonrpc": "2.0", "id": 999999, "result": {"stray": True}})
+        if method == "tools/list":
+            reply(rpc_id, {"tools": [{
+                "name": "echo", "description": "echo",
+                "inputSchema": {"type": "object", "properties": {}},
+            }]})
+        else:
+            reply(rpc_id, {"content": [{"type": "text", "text": "survived"}]})
+    elif MODE == "malformed-error":
+        calls += 1
+        if calls == 1:
+            send({"jsonrpc": "2.0", "id": rpc_id, "error": "just a string"})
+        else:
+            send({"jsonrpc": "2.0", "id": rpc_id, "result": 42})
+    elif MODE == "huge-line":
+        reply(rpc_id, {"content": [{"type": "text", "text": "x" * (1 << 20)}]})
+    elif MODE == "cursor-loop":
+        reply(rpc_id, {"tools": [], "nextCursor": "same-cursor-forever"})
+    elif MODE == "weird-content":
+        calls += 1
+        if calls == 1:
+            reply(rpc_id, {"content": "not a list"})
+        else:
+            reply(rpc_id, {"content": [
+                "not a dict", {"type": "text", "text": "ok"}, {"type": "image"},
+            ]})

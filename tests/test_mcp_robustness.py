@@ -1,0 +1,106 @@
+"""MCP transport vs a HOSTILE stdio server (r5 adversarial depth on the
+JSON-RPC seam, mirroring the wire-codec fuzz philosophy: one bad frame
+must never kill the session, hang pending requests, or spin forever).
+
+Regression pins: non-object JSON frames used to crash the read loop
+(every request then hung to timeout); the 64 KiB asyncio default stream
+limit used to break framing on any large tool result; a repeating
+pagination cursor used to loop list_tools forever.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+from pathlib import Path
+
+import pytest
+
+from calfkit_tpu.mcp import MCPServerSpec
+from calfkit_tpu.mcp.transport import MCPError, MCPSession
+
+HOSTILE = str(Path(__file__).parent / "_mcp_hostile_server.py")
+
+
+def _session(mode: str, timeout: float = 10.0) -> MCPSession:
+    return MCPSession(
+        MCPServerSpec(name=f"hostile-{mode}",
+                      command=[sys.executable, HOSTILE, mode]),
+        request_timeout=timeout,
+    )
+
+
+class TestHostileFrames:
+    async def test_garbage_frames_do_not_kill_the_read_loop(self):
+        session = _session("garbage-frames")
+        await session.start()
+        try:
+            # repeated requests keep working through interleaved garbage
+            for _ in range(3):
+                tools = await session.list_tools()
+                assert [t["name"] for t in tools] == ["echo"]
+            out = await session.call_tool("echo", {})
+            assert out == "survived"
+        finally:
+            await session.stop()
+
+    async def test_malformed_error_and_result_are_typed(self):
+        session = _session("malformed-error")
+        await session.start()
+        try:
+            with pytest.raises(MCPError, match="just a string"):
+                await session.call_tool("x", {})
+            with pytest.raises(MCPError, match="non-object result"):
+                await session.call_tool("x", {})
+        finally:
+            await session.stop()
+
+    async def test_large_tool_result_survives(self):
+        """A ~1 MiB response is LEGAL — the old 64 KiB asyncio stream
+        limit broke framing and killed the session."""
+        session = _session("huge-line")
+        await session.start()
+        try:
+            out = await session.call_tool("big", {})
+            assert len(out) == 1 << 20
+        finally:
+            await session.stop()
+
+    async def test_cursor_loop_terminates_typed(self):
+        session = _session("cursor-loop")
+        await session.start()
+        try:
+            with pytest.raises(MCPError, match="did not terminate"):
+                await asyncio.wait_for(session.list_tools(), timeout=30)
+        finally:
+            await session.stop()
+
+    async def test_dead_session_fails_fast_and_typed(self):
+        """Once the server is gone, requests must raise MCPError
+        immediately — not park a future for the full 30 s timeout."""
+        session = _session("garbage-frames")
+        await session.start()
+        try:
+            session._proc.kill()
+            await session._proc.wait()
+            # let the reader observe EOF and mark the session dead
+            deadline = asyncio.get_running_loop().time() + 5
+            while session._dead is None:
+                if asyncio.get_running_loop().time() > deadline:
+                    raise AssertionError("reader never marked session dead")
+                await asyncio.sleep(0.05)
+            started = asyncio.get_running_loop().time()
+            with pytest.raises(MCPError, match="session dead"):
+                await session.call_tool("echo", {})
+            assert asyncio.get_running_loop().time() - started < 1.0
+        finally:
+            await session.stop()
+
+    async def test_weird_content_shapes_do_not_crash(self):
+        session = _session("weird-content")
+        await session.start()
+        try:
+            assert await session.call_tool("x", {}) == ""  # non-list content
+            assert await session.call_tool("x", {}) == "ok"  # mixed entries
+        finally:
+            await session.stop()

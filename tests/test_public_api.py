@@ -1,0 +1,65 @@
+"""The top-level import surface a reference user lands on.
+
+Reference anchor: calfkit/__init__.py exports the whole user vocabulary
+from the package root; this pin keeps ours equivalent (every name lazily
+importable, no heavy deps at import time) so `from calfkit_tpu import X`
+works for everything docs/migrating.md promises.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+
+class TestPublicSurface:
+    def test_every_lazy_export_resolves(self):
+        import calfkit_tpu
+
+        for name in calfkit_tpu._LAZY:
+            assert getattr(calfkit_tpu, name) is not None, name
+
+    def test_core_vocabulary_present(self):
+        import calfkit_tpu as ck
+
+        # the names the migration guide promises, spot-checked by family
+        for name in (
+            "Client", "Worker", "Agent", "StatelessAgent", "agent_tool",
+            "consumer", "Tools", "Toolbox", "Messaging", "Handoff",
+            "InvocationHandle", "InvocationResult", "EventStream",
+            "NodeFaultError", "ClientTimeoutError", "ErrorReport",
+            "FaultTypes", "InMemoryMesh", "KafkaWireMesh",
+            "ConnectionProfile", "JaxLocalModelClient", "OpenAIModelClient",
+            "BedrockModelClient", "MistralModelClient",
+        ):
+            assert getattr(ck, name) is not None, name
+
+    def test_unknown_name_raises_attribute_error(self):
+        import calfkit_tpu
+
+        try:
+            calfkit_tpu.DefinitelyNotAThing
+        except AttributeError as exc:
+            assert "DefinitelyNotAThing" in str(exc)
+        else:
+            raise AssertionError("missing name resolved")
+
+    def test_import_is_lazy(self):
+        """`import calfkit_tpu` must not eagerly import any subsystem —
+        CLI startup and pure-client processes stay light.  (This image's
+        sitecustomize preloads jax into EVERY interpreter, so the pin is
+        on calfkit_tpu's own submodules, not on jax.)"""
+        code = (
+            "import sys; import calfkit_tpu; "
+            "heavy = [m for m in sys.modules if m.startswith("
+            "('calfkit_tpu.inference', 'calfkit_tpu.engine', "
+            "'calfkit_tpu.nodes', 'calfkit_tpu.client', "
+            "'calfkit_tpu.providers', 'calfkit_tpu.mesh'))]; "
+            "assert not heavy, heavy; print('lazy ok')"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "lazy ok" in out.stdout
