@@ -15,6 +15,7 @@ from __future__ import annotations
 import contextlib
 import fcntl
 import json
+import logging
 import os
 import signal
 import socket
@@ -27,6 +28,8 @@ from pathlib import Path
 # the managed dev brokers: meshd (native line protocol) and kafkad (the
 # real Kafka wire protocol — closest to the reference's bundled Tansu
 # dev broker, which is itself Kafka-compatible)
+logger = logging.getLogger(__name__)
+
 BROKER_KINDS = {
     "meshd": {"default_port": 19092, "scheme": "tcp"},
     "kafkad": {"default_port": 19392, "scheme": "kafka+wire"},
@@ -137,7 +140,8 @@ def _broker_meta(kind: str) -> Path:
 
 
 def ensure_broker(
-    port: int | None = None, kind: str = "meshd"
+    port: int | None = None, kind: str = "meshd", *,
+    durable: "bool | None" = None,
 ) -> BrokerInfo:
     """Connect to a live dev broker, or spawn one — exactly one, even when
     multiple ``ck dev`` invocations race (the reference's file-lock
@@ -147,10 +151,22 @@ def ensure_broker(
     if port is None:
         port = default_port(kind)
     if _probe_kind(port, kind):
+        if durable and not _recorded_durable(port, kind):
+            logger.warning(
+                "a NON-durable %s broker is already up on port %d; "
+                "--durable has no effect until it is restarted "
+                "(ck dev stop, then ck dev mesh --kafka --durable)",
+                kind, port,
+            )
         return BrokerInfo(
             port=port, pid=_read_broker_pid(port, kind), spawned=False,
             kind=kind,
         )
+    if durable is None:
+        # unstated durability INHERITS what this registry last spawned on
+        # the port — `ck dev serve --kafka` must not silently demote a
+        # broker the user created with --durable
+        durable = _recorded_durable(port, kind)
     if _port_open(port):
         # something else is listening: claiming it would point daemons'
         # wire clients at the wrong protocol
@@ -168,7 +184,19 @@ def ensure_broker(
                     spawned=False, kind=kind,
                 )
             if kind == "kafkad":
-                from calfkit_tpu.mesh.kafka_wire import spawn_kafkad as spawn
+                from calfkit_tpu.mesh.kafka_wire import spawn_kafkad
+
+                kwargs = {}
+                if durable:
+                    # per-PORT WAL dir: two brokers must never share a log
+                    wal_dir = dev_dir() / f"kafkad-wal-{port}"
+                    wal_dir.mkdir(parents=True, exist_ok=True)
+                    kwargs["log_dir"] = str(wal_dir)
+
+                def spawn(p, *, start_new_session=False):
+                    return spawn_kafkad(
+                        p, start_new_session=start_new_session, **kwargs
+                    )
             else:
                 from calfkit_tpu.mesh.tcp import spawn_meshd as spawn
 
@@ -176,11 +204,20 @@ def ensure_broker(
             # broker (daemons pointed at it) down with it
             proc = spawn(port, start_new_session=True)
             _broker_meta(kind).write_text(
-                json.dumps({"port": port, "pid": proc.pid, "kind": kind})
+                json.dumps({"port": port, "pid": proc.pid, "kind": kind,
+                            "durable": bool(durable)})
             )
             return BrokerInfo(port=port, pid=proc.pid, spawned=True, kind=kind)
         finally:
             fcntl.flock(lock, fcntl.LOCK_UN)
+
+
+def _recorded_durable(port: int, kind: str) -> bool:
+    with contextlib.suppress(Exception):
+        meta = json.loads(_broker_meta(kind).read_text())
+        if meta.get("port") == port:
+            return bool(meta.get("durable"))
+    return False
 
 
 def _read_broker_pid(port: int, kind: str = "meshd") -> int | None:

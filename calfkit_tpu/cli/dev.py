@@ -59,7 +59,11 @@ def dev_run(specs: tuple[str, ...], agent_name: str | None) -> None:
               help="manage the kafkad broker (real Kafka wire protocol) "
                    "instead of meshd")
 @click.option("--detach", is_flag=True, help="leave the broker running and return")
-def dev_mesh(port: int | None, use_kafka: bool, detach: bool) -> None:
+@click.option("--durable", is_flag=True,
+              help="kafkad only: keep topics/records/offsets across broker "
+                   "restarts (append-only WAL under the dev dir)")
+def dev_mesh(port: int | None, use_kafka: bool, detach: bool,
+             durable: bool) -> None:
     """Ensure the native dev broker is up — connect-or-spawn.
 
     Default broker is meshd (native line protocol); ``--kafka`` manages
@@ -71,8 +75,10 @@ def dev_mesh(port: int | None, use_kafka: bool, detach: bool) -> None:
     from calfkit_tpu.cli._dev_state import ensure_broker
 
     kind = "kafkad" if use_kafka else "meshd"
+    if durable and not use_kafka:
+        raise click.ClickException("--durable requires --kafka (kafkad WAL)")
     try:
-        info = ensure_broker(port, kind)
+        info = ensure_broker(port, kind, durable=durable)
     except (FileNotFoundError, RuntimeError, TimeoutError) as exc:
         raise click.ClickException(str(exc)) from exc
     verb = "spawned" if info.spawned else "already up"

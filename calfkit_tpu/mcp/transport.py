@@ -280,7 +280,8 @@ class MCPSession:
         if result.get("isError"):
             content = result.get("content", [])
             raise MCPError(
-                _content_text(content) or str(content)[:200] or "tool error"
+                _content_text(content)
+                or (str(content)[:200] if content else "tool error")
             )
         content = result.get("content", [])
         structured = result.get("structuredContent")

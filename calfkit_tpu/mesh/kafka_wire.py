@@ -139,6 +139,14 @@ crc32c = _load_crc32c()
 _SYNC_DECODE_MAX = 65536 if crc32c.__name__ == "_crc32c_native" else 8192
 
 
+def fetch_floor(max_message_bytes: int) -> int:
+    """The consumer fetch budget implied by the producer message budget
+    (the ConnectionProfile coordinated-knob law): floored at 4 MiB, and
+    always max_message_bytes + framing headroom so the biggest legal
+    message is always fetchable."""
+    return max(4 * 1024 * 1024, max_message_bytes + 64 * 1024)
+
+
 async def _decode_off_loop(blob: bytes):
     """Decode a fetch's record_set, moving big blobs to a worker thread
     (mirrors the publish path's encode offload)."""
@@ -1264,8 +1272,15 @@ class _WireConsumer:
         session_timeout_ms: int = 10000,
         commit_interval_s: float = 1.0,
         security: WireSecurity = PLAINTEXT,
+        max_message_bytes: int = DEFAULT_MAX_MESSAGE_BYTES,
     ):
         self._security = security
+        # the coordinated-knob law (ConnectionProfile): the consumer fetch
+        # budget must FLOOR at the producer message budget, or the biggest
+        # legal message could never be fetched (brokers do return at least
+        # one oversized message per fetch — KIP-74 — but honoring the
+        # budget keeps multi-record batches flowing too)
+        self._fetch_max_bytes = fetch_floor(max_message_bytes)
         self._client = KafkaWireClient(
             host, port, client_id="calfkit-consumer", security=security
         )
@@ -1542,7 +1557,9 @@ class _WireConsumer:
             (topic, part, off)
             for (topic, part), off in self._positions.items()
         ]
-        results = await self._client.fetch(wants, max_wait_ms=300)
+        results = await self._client.fetch(
+            wants, max_wait_ms=300, max_bytes=self._fetch_max_bytes
+        )
         for topic, part, err, blob in results:
             if err == ERR_OFFSET_OUT_OF_RANGE:
                 # retention moved log-start past our position, or the
@@ -1810,7 +1827,7 @@ class KafkaWireMesh(MeshTransport):
             await self._producer.metadata(topics)
         consumer = _WireConsumer(
             self._host, self._port, topics, group_id, from_latest, deliver,
-            security=self._security,
+            security=self._security, max_message_bytes=self._max_bytes,
         )
         consumer.start()
         self._consumers.append(consumer)
@@ -1857,6 +1874,7 @@ class _WireTableReader(TableReader):
         self._view: dict[str, bytes] = {}
         self._client: KafkaWireClient | None = None
         self._fetch_positions: dict[int, int] = {}
+        self._fetch_max_bytes = fetch_floor(mesh.max_message_bytes)
         self._task: asyncio.Task[None] | None = None
         self._stopped = False
         self._advanced = asyncio.Event()
@@ -1937,7 +1955,9 @@ class _WireTableReader(TableReader):
         if not wants:
             await asyncio.sleep(0.2)
             return
-        results = await self._client.fetch(wants, max_wait_ms=300)
+        results = await self._client.fetch(
+            wants, max_wait_ms=300, max_bytes=self._fetch_max_bytes
+        )
         for _topic, part, err, blob in results:
             if err == ERR_OFFSET_OUT_OF_RANGE:
                 fresh = await self._client.list_offsets(

@@ -62,7 +62,11 @@ class _Conn:
 
     async def open(self) -> None:
         self._reader, self._writer = await asyncio.open_connection(
-            self._host, self._port
+            self._host, self._port,
+            # asyncio's default 64 KiB stream limit would break the
+            # newline-delimited protocol on any record past ~48 KiB
+            # (base64 inflates 4/3): budget for the biggest legal message
+            limit=32 * 1024 * 1024,
         )
 
     async def close(self) -> None:

@@ -362,3 +362,64 @@ class TestKafkadDevBroker:
             await client_mesh.stop()
         finally:
             stop_broker(19394, "kafkad")
+
+
+class TestDurableDevBroker:
+    def test_durable_kafkad_survives_restart(self, dev_env):
+        """`ck dev mesh --kafka --durable`: records + offsets live in the
+        dev dir's WAL, so a broker restart keeps the dev mesh's state."""
+        import asyncio
+
+        from calfkit_tpu.cli._dev_state import ensure_broker, stop_broker
+        from calfkit_tpu.mesh.kafka_wire import (
+            KafkaWireClient,
+            encode_record_batch,
+            find_kafkad,
+        )
+
+        if find_kafkad() is None:
+            pytest.skip("kafkad not built")
+        port = 19893
+        info = ensure_broker(port, "kafkad", durable=True)
+        assert info.spawned
+
+        async def produce() -> None:
+            client = KafkaWireClient("127.0.0.1", port)
+            try:
+                await client.create_topics(["dev.durable"], 1)
+                await client.produce(
+                    "dev.durable", 0,
+                    encode_record_batch([(b"k", b"sticky", [])], 1),
+                )
+            finally:
+                await client.close()
+
+        asyncio.run(produce())
+        assert stop_broker(port, "kafkad")
+        from calfkit_tpu.cli._dev_state import broker_status
+
+        for _ in range(50):
+            if not broker_status(port, "kafkad")["up"]:
+                break
+            time.sleep(0.1)
+
+        info = ensure_broker(port, "kafkad", durable=True)
+        assert info.spawned
+
+        async def check() -> None:
+            from calfkit_tpu.mesh.kafka_wire import decode_record_batches
+
+            client = KafkaWireClient("127.0.0.1", port)
+            try:
+                results = await client.fetch(
+                    [("dev.durable", 0, 0)], max_wait_ms=300
+                )
+                records = decode_record_batches(results[0][3])
+                assert [v for *_x, v, _h in records] == [b"sticky"]
+            finally:
+                await client.close()
+
+        try:
+            asyncio.run(check())
+        finally:
+            stop_broker(port, "kafkad")

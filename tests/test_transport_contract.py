@@ -240,6 +240,30 @@ class TestPublishSubscribeContract:
         assert seen[0]["n"] == "1"
         await mesh.stop()
 
+    async def test_max_size_message_round_trips(self, transport):
+        """The BIGGEST legal message (exactly max_message_bytes) must be
+        deliverable — the coordinated-knob law: the consumer fetch budget
+        floors at the producer budget, or the largest legal message
+        could starve (reference: ConnectionProfile's fetch floor)."""
+        make, topic = transport
+        mesh = await make()
+        name = topic("c.maxsize")
+        await mesh.ensure_topics([name])
+        payload = bytes(
+            (i * 31 + 7) % 251 for i in range(mesh.max_message_bytes)
+        )
+        got: asyncio.Queue = asyncio.Queue()
+
+        async def handler(record):
+            await got.put(record.value)
+
+        sub = await mesh.subscribe([name], handler, group_id=topic("g-max"))
+        await mesh.publish(name, payload, key=b"k")
+        received = await asyncio.wait_for(got.get(), timeout=30)
+        assert received == payload  # intact, bit-for-bit
+        await sub.stop()
+        await mesh.stop()
+
     async def test_oversized_publish_rejected(self, transport):
         make, topic = transport
         mesh = await make()
