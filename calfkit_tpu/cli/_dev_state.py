@@ -150,7 +150,7 @@ def ensure_broker(
         raise ValueError(f"unknown broker kind {kind!r}")
     if port is None:
         port = default_port(kind)
-    if _probe_kind(port, kind):
+    def _connected() -> BrokerInfo:
         if durable and not _recorded_durable(port, kind):
             logger.warning(
                 "a NON-durable %s broker is already up on port %d; "
@@ -162,27 +162,44 @@ def ensure_broker(
             port=port, pid=_read_broker_pid(port, kind), spawned=False,
             kind=kind,
         )
+
+    if _probe_kind(port, kind):
+        return _connected()
     if durable is None:
         # unstated durability INHERITS what this registry last spawned on
         # the port — `ck dev serve --kafka` must not silently demote a
         # broker the user created with --durable
         durable = _recorded_durable(port, kind)
     if _port_open(port):
-        # something else is listening: claiming it would point daemons'
-        # wire clients at the wrong protocol
-        raise RuntimeError(
-            f"port {port} is occupied by something that does not speak "
-            f"the {kind} protocol — pick another --port"
-        )
+        # something is listening but the protocol probe above missed it.
+        # That is EITHER a foreign listener, or a broker another racer
+        # spawned between our two checks (bind happens before the probe
+        # endpoint answers) — re-probe briefly before declaring foreign,
+        # else a concurrent `ck dev` race misclassifies its sibling's
+        # fresh broker and errors spuriously.
+        for _ in range(10):
+            # short probe timeout: a FOREIGN listener never answers, and
+            # this path must stay a quick error (~1s), while a sibling's
+            # fresh broker answers within the first try or two
+            if _probe_kind(port, kind, timeout=0.1):
+                return _connected()
+            if not _port_open(port):
+                break  # listener vanished: fall through to the spawn path
+            time.sleep(0.05)
+        else:
+            # consistently open but never speaks our protocol: foreign —
+            # claiming it would point daemons' wire clients at the wrong
+            # protocol
+            raise RuntimeError(
+                f"port {port} is occupied by something that does not speak "
+                f"the {kind} protocol — pick another --port"
+            )
     lock_path = dev_dir() / f"broker-{kind}.lock"
     with open(lock_path, "w") as lock:
         fcntl.flock(lock, fcntl.LOCK_EX)  # losers wait here while one spawns
         try:
             if _probe_kind(port, kind):  # the winner got it up while we waited
-                return BrokerInfo(
-                    port=port, pid=_read_broker_pid(port, kind),
-                    spawned=False, kind=kind,
-                )
+                return _connected()
             if kind == "kafkad":
                 from calfkit_tpu.mesh.kafka_wire import spawn_kafkad
 

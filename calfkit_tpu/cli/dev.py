@@ -78,7 +78,9 @@ def dev_mesh(port: int | None, use_kafka: bool, detach: bool,
     if durable and not use_kafka:
         raise click.ClickException("--durable requires --kafka (kafkad WAL)")
     try:
-        info = ensure_broker(port, kind, durable=durable)
+        # flag unset -> None: inherit the port's recorded durability (a
+        # crashed durable broker must not be silently demoted on respawn)
+        info = ensure_broker(port, kind, durable=True if durable else None)
     except (FileNotFoundError, RuntimeError, TimeoutError) as exc:
         raise click.ClickException(str(exc)) from exc
     verb = "spawned" if info.spawned else "already up"

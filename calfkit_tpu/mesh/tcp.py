@@ -54,8 +54,10 @@ def _dec(field: str) -> bytes:
 class _Conn:
     """One broker connection; the protocol is strict request→response."""
 
-    def __init__(self, host: str, port: int):
+    def __init__(self, host: str, port: int,
+                 limit: int = 32 * 1024 * 1024):
         self._host, self._port = host, port
+        self._limit = limit
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._lock = asyncio.Lock()
@@ -66,7 +68,7 @@ class _Conn:
             # asyncio's default 64 KiB stream limit would break the
             # newline-delimited protocol on any record past ~48 KiB
             # (base64 inflates 4/3): budget for the biggest legal message
-            limit=32 * 1024 * 1024,
+            limit=self._limit,
         )
 
     async def close(self) -> None:
@@ -114,6 +116,12 @@ class TcpMesh(MeshTransport):
         self._host = host or "127.0.0.1"
         self._port = int(port or DEFAULT_PORT)
         self._max_bytes = max_message_bytes
+        # stream budget for one protocol line: base64 of the biggest legal
+        # message (4/3 inflation) + frame overhead — derived, so a bigger
+        # configured budget can't pass the publish guard then die on read
+        self._line_limit = max(
+            32 * 1024 * 1024, max_message_bytes * 4 // 3 + 64 * 1024
+        )
         self._poll_timeout_ms = poll_timeout_ms
         self._control: _Conn | None = None
         self._pumps: list[asyncio.Task[None]] = []
@@ -130,7 +138,7 @@ class TcpMesh(MeshTransport):
     async def start(self) -> None:
         if self._started:
             return
-        self._control = _Conn(self._host, self._port)
+        self._control = _Conn(self._host, self._port, limit=self._line_limit)
         await self._control.open()
         if await self._control.request("PING") != "PONG":
             raise ConnectionError("meshd did not answer PING")
@@ -226,7 +234,7 @@ class TcpMesh(MeshTransport):
         stopping = asyncio.Event()
         mode = "latest" if from_latest else "earliest"
         for name in topics:
-            conn = _Conn(self._host, self._port)
+            conn = _Conn(self._host, self._port, limit=self._line_limit)
             await conn.open()
             response = await conn.request(f"SUB {name} {group_id or '-'} {mode}")
             sub_id = response.split()[1]
@@ -350,7 +358,8 @@ class _TcpTableReader(TableReader):
 
     async def start(self, *, timeout: float = 30.0) -> None:
         await self._mesh.ensure_topics([self._topic])
-        self._conn = _Conn(self._mesh._host, self._mesh._port)
+        self._conn = _Conn(self._mesh._host, self._mesh._port,
+                          limit=self._mesh._line_limit)
         await self._conn.open()
         response = await self._conn.request(f"SUB {self._topic} - earliest")
         sub_id = response.split()[1]

@@ -423,3 +423,39 @@ class TestDurableDevBroker:
             asyncio.run(check())
         finally:
             stop_broker(port, "kafkad")
+
+    def test_unstated_durability_inherits_recorded(self, dev_env):
+        """A respawn WITHOUT the flag (durable=None — what `ck dev serve`
+        passes) must inherit the port's recorded durability instead of
+        silently demoting a durable broker (review finding r5)."""
+        from calfkit_tpu.cli._dev_state import (
+            _recorded_durable,
+            ensure_broker,
+            stop_broker,
+        )
+        from calfkit_tpu.mesh.kafka_wire import find_kafkad
+
+        if find_kafkad() is None:
+            pytest.skip("kafkad not built")
+        import os
+        import signal as _signal
+
+        port = 19894
+        info = ensure_broker(port, "kafkad", durable=True)
+        assert info.spawned and _recorded_durable(port, "kafkad")
+        # CRASH (not a clean `ck dev stop`, which forgets the record):
+        # the broker dies, the meta survives, and a respawn must inherit
+        os.kill(info.pid, _signal.SIGKILL)
+        for _ in range(50):
+            from calfkit_tpu.cli._dev_state import broker_status
+
+            if not broker_status(port, "kafkad")["up"]:
+                break
+            time.sleep(0.1)
+        # respawn with durability UNSTATED: meta must keep durable=True
+        info = ensure_broker(port, "kafkad")
+        try:
+            assert info.spawned
+            assert _recorded_durable(port, "kafkad")
+        finally:
+            stop_broker(port, "kafkad")

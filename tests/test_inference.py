@@ -457,6 +457,27 @@ class TestInt4Quantization:
                 params=params,
             )
 
+    async def test_int4_long_context_sp_lane(self):
+        """int4 weights under the sequence-parallel ring-prefill lane:
+        dequant of packed+grouped leaves must compile and serve inside
+        shard_map over the sp mesh (weights replicated, sequence
+        sharded)."""
+        if len(jax.devices()) < 8:
+            pytest.skip("needs the virtual 8-device mesh")
+        engine = InferenceEngine(
+            CFG,
+            RuntimeConfig(max_batch_size=4, max_seq_len=64, prefill_chunk=16,
+                          decode_steps_per_dispatch=4, long_context=True,
+                          long_new_cap=8, tp=2, dp=4, quantization="int4"),
+        )
+        await engine.start()
+        assert engine._sp_mesh().shape["sp"] == 8
+        prompt = [(11 * i + 5) % CFG.vocab_size for i in range(100)]
+        got = [t async for t in engine.generate(prompt, max_new_tokens=8)]
+        assert len(got) == 8
+        assert engine.stats.long_requests == 1
+        await engine.stop()
+
     async def test_engine_runs_int4_paged_on_tp_mesh(self):
         """The 8B-shape path in miniature: host-built int4 params + paged
         KV on a tp=2 mesh (exercises the sharded unpack/reshape under
