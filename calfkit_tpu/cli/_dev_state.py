@@ -151,7 +151,10 @@ def ensure_broker(
     if port is None:
         port = default_port(kind)
     def _connected() -> BrokerInfo:
-        if durable and not _recorded_durable(port, kind):
+        # warn only on a POSITIVE non-durable record: no record at all
+        # means unknown provenance (racing sibling mid-meta-write) and a
+        # spurious warning would be a lie
+        if durable and _recorded_durable(port, kind) is False:
             logger.warning(
                 "a NON-durable %s broker is already up on port %d; "
                 "--durable has no effect until it is restarted "
@@ -169,7 +172,7 @@ def ensure_broker(
         # unstated durability INHERITS what this registry last spawned on
         # the port — `ck dev serve --kafka` must not silently demote a
         # broker the user created with --durable
-        durable = _recorded_durable(port, kind)
+        durable = bool(_recorded_durable(port, kind))
     if _port_open(port):
         # something is listening but the protocol probe above missed it.
         # That is EITHER a foreign listener, or a broker another racer
@@ -229,12 +232,15 @@ def ensure_broker(
             fcntl.flock(lock, fcntl.LOCK_UN)
 
 
-def _recorded_durable(port: int, kind: str) -> bool:
+def _recorded_durable(port: int, kind: str) -> "bool | None":
+    """True/False when this registry recorded the port's broker; None
+    when there is no record (unknown provenance — e.g. a sibling racer's
+    broker whose meta isn't written yet)."""
     with contextlib.suppress(Exception):
         meta = json.loads(_broker_meta(kind).read_text())
         if meta.get("port") == port:
             return bool(meta.get("durable"))
-    return False
+    return None
 
 
 def _read_broker_pid(port: int, kind: str = "meshd") -> int | None:

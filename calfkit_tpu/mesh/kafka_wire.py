@@ -139,12 +139,19 @@ crc32c = _load_crc32c()
 _SYNC_DECODE_MAX = 65536 if crc32c.__name__ == "_crc32c_native" else 8192
 
 
+# keys + header bytes get their own budget alongside the value budget —
+# the fetch floor covers both, so the biggest legal RECORD always fits
+KEY_HEADERS_CAP = 1024 * 1024
+
+
 def fetch_floor(max_message_bytes: int) -> int:
     """The consumer fetch budget implied by the producer message budget
     (the ConnectionProfile coordinated-knob law): floored at 4 MiB, and
-    always max_message_bytes + framing headroom so the biggest legal
-    message is always fetchable."""
-    return max(4 * 1024 * 1024, max_message_bytes + 64 * 1024)
+    always max_message_bytes + the key/headers cap + framing headroom so
+    the biggest legal record is always fetchable."""
+    return max(
+        4 * 1024 * 1024, max_message_bytes + KEY_HEADERS_CAP + 64 * 1024
+    )
 
 
 async def _decode_off_loop(blob: bytes):
@@ -1771,6 +1778,15 @@ class KafkaWireMesh(MeshTransport):
             raise ValueError(
                 f"message of {len(value)} bytes exceeds "
                 f"max_message_bytes={self._max_bytes}"
+            )
+        header_bytes = sum(
+            len(hk.encode()) + len(hv.encode())
+            for hk, hv in (headers or {}).items()
+        )
+        if len(key or b"") + header_bytes > KEY_HEADERS_CAP:
+            raise ValueError(
+                f"key+headers of {len(key or b'') + header_bytes} bytes "
+                f"exceed the {KEY_HEADERS_CAP}-byte budget"
             )
         if self._producer is None:
             raise RuntimeError("mesh not started")

@@ -37,6 +37,9 @@ from calfkit_tpu.mesh.transport import (
 logger = logging.getLogger(__name__)
 
 DEFAULT_PORT = 19092
+# keys + rendered headers get their own budget (they ride every protocol
+# line alongside the value; the stream limits are derived from BOTH)
+KEY_HEADERS_CAP = 1024 * 1024
 
 
 def _enc(data: bytes | None) -> str:
@@ -116,11 +119,13 @@ class TcpMesh(MeshTransport):
         self._host = host or "127.0.0.1"
         self._port = int(port or DEFAULT_PORT)
         self._max_bytes = max_message_bytes
-        # stream budget for one protocol line: base64 of the biggest legal
-        # message (4/3 inflation) + frame overhead — derived, so a bigger
-        # configured budget can't pass the publish guard then die on read
+        # stream budget for one protocol line: base64 (4/3 inflation) of
+        # the biggest legal value PLUS the key/headers cap + frame
+        # overhead — derived, so a bigger configured budget can't pass
+        # the publish guard then die on read
         self._line_limit = max(
-            32 * 1024 * 1024, max_message_bytes * 4 // 3 + 64 * 1024
+            32 * 1024 * 1024,
+            (max_message_bytes + KEY_HEADERS_CAP) * 4 // 3 + 64 * 1024,
         )
         self._poll_timeout_ms = poll_timeout_ms
         self._control: _Conn | None = None
@@ -195,6 +200,11 @@ class TcpMesh(MeshTransport):
         if self._control is None:
             raise RuntimeError("mesh not started")
         headers_json = json.dumps(headers or {}).encode()
+        if len(key or b"") + len(headers_json) > KEY_HEADERS_CAP:
+            raise ValueError(
+                f"key+headers of {len(key or b'') + len(headers_json)} bytes "
+                f"exceed the {KEY_HEADERS_CAP}-byte budget"
+            )
         response = await self._control.request(
             f"PUB {topic} {_enc(key)} {_enc(value)} {_enc(headers_json)}"
         )

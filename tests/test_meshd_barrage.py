@@ -1,0 +1,104 @@
+"""meshd under hostile input (r5: the kafkad corrupt-frame barrage's
+sibling for the line-protocol broker) — garbage lines, oversized fields,
+bad base64, torn writes, and abrupt disconnects must never crash or
+wedge the dev broker other clients depend on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import socket
+
+import pytest
+
+from calfkit_tpu.mesh.tcp import TcpMesh, find_meshd, spawn_meshd
+
+pytestmark = pytest.mark.skipif(
+    find_meshd() is None, reason="meshd not built (make -C native)"
+)
+
+
+@pytest.fixture()
+def broker_port():
+    proc = spawn_meshd(0)
+    yield proc.meshd_port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+def _alive(port: int) -> bool:
+    with socket.create_connection(("127.0.0.1", port), 5) as s:
+        s.sendall(b"PING\n")
+        s.settimeout(5)
+        got = b""
+        while len(got) < 4:  # recv may legally return partial reads
+            chunk = s.recv(4 - len(got))
+            if not chunk:
+                return False
+            got += chunk
+        return got == b"PONG"
+
+
+class TestMeshdBarrage:
+    def test_garbage_line_barrage(self, broker_port):
+        rng = random.Random(53)
+        for i in range(150):
+            with socket.create_connection(("127.0.0.1", broker_port), 5) as s:
+                kind = i % 5
+                if kind == 0:  # random binary garbage + newline
+                    s.sendall(rng.randbytes(rng.randint(1, 400)) + b"\n")
+                elif kind == 1:  # known verb, wrong arity/fields
+                    s.sendall(b"PUB\n")
+                    s.sendall(b"PUB topic\n")
+                    s.sendall(b"POLL notanumber x y\n")
+                elif kind == 2:  # bad base64 in every field slot
+                    s.sendall(b"PUB t !!! ??? %%%\n")
+                elif kind == 3:  # torn write: no newline, then hang up
+                    s.sendall(b"PUB half-a-comm")
+                else:  # huge single line (1 MiB of x)
+                    s.sendall(b"NOPE " + b"x" * (1 << 20) + b"\n")
+                # abrupt close without reading any response
+        assert _alive(broker_port)
+
+    def test_half_open_connections_do_not_wedge(self, broker_port):
+        # open many connections that never send anything, then verify the
+        # broker still serves; meshd threads block on read, which is fine
+        # as long as new connections keep being accepted
+        conns = [
+            socket.create_connection(("127.0.0.1", broker_port), 5)
+            for _ in range(64)
+        ]
+        try:
+            assert _alive(broker_port)
+        finally:
+            for conn in conns:
+                conn.close()
+
+    def test_real_traffic_flows_after_barrage(self, broker_port):
+        async def run() -> None:
+            mesh = TcpMesh(f"127.0.0.1:{broker_port}")
+            await mesh.start()
+            await mesh.ensure_topics(["post.barrage"])
+            got = asyncio.Event()
+            vals: list[bytes] = []
+
+            async def handler(record):
+                vals.append(record.value)
+                got.set()
+
+            sub = await mesh.subscribe(
+                ["post.barrage"], handler, group_id="pb"
+            )
+            await mesh.publish("post.barrage", b"still-works", key=b"k")
+            await asyncio.wait_for(got.wait(), 15)
+            assert vals == [b"still-works"]
+            await sub.stop()
+            await mesh.stop()
+
+        # barrage first, then the full transport path
+        rng = random.Random(59)
+        for _ in range(40):
+            with socket.create_connection(("127.0.0.1", broker_port), 5) as s:
+                s.sendall(rng.randbytes(rng.randint(1, 200)) + b"\n")
+        asyncio.run(run())

@@ -237,3 +237,26 @@ class TestRound4FeaturesOnChip:
         assert len(out) == 6
         assert engine.stats.long_requests == 1
         await engine.stop()
+
+    async def test_int4_engine_on_chip(self):
+        """int4 packed weights (r5): unpack + group-scale dequant compiles
+        and serves deterministically on the accelerator, and matches the
+        same engine's tokens across runs."""
+        from calfkit_tpu.inference.config import RuntimeConfig, preset
+        from calfkit_tpu.inference.engine import InferenceEngine
+
+        _chip()
+        engine = InferenceEngine(
+            preset("debug"),
+            RuntimeConfig(max_batch_size=2, max_seq_len=128, prefill_chunk=16,
+                          decode_steps_per_dispatch=4, quantization="int4",
+                          kv_layout="paged", page_size=16, num_kv_pages=33),
+            seed=11,
+        )
+        await engine.start()
+        prompt = [3, 141, 59, 26]
+        out = [t async for t in engine.generate(prompt, max_new_tokens=12)]
+        again = [t async for t in engine.generate(prompt, max_new_tokens=12)]
+        await engine.stop()
+        assert len(out) == 12
+        assert again == out
