@@ -1,16 +1,21 @@
-"""The frozen connection profile threaded to every Kafka client.
+"""The frozen connection profile threaded to the Kafka wire client.
 
 Reference: calfkit/client/_connection.py:39-110 — one validated object owns
-bootstrap + security + message budget, and every producer/consumer/admin
-derives its kwargs from it, so the coordinated knobs cannot drift apart:
+bootstrap + security + message budget, and every client the transport
+creates derives its behavior from it, so the coordinated knobs cannot
+drift apart:
 
-- ``max_message_bytes`` is BOTH the producer guard (``max_request_size``)
-  and the consumer fetch floor (``max_partition_fetch_bytes`` and
-  ``fetch_max_bytes`` are raised to at least the budget, so a max-size
-  message can always be fetched — a producer-side-only budget deadlocks
-  consumption of the biggest legal message).
-- ``enable_idempotence`` is tri-state (None = broker default) and reaches
-  every producer.
+- ``max_message_bytes`` is BOTH the producer guard (``publish`` rejects
+  bigger values) and the consumer fetch floor
+  (``kafka_wire.fetch_floor``), so the biggest legal record can always
+  be fetched — a producer-side-only budget would starve consumption of
+  the largest legal message.
+- ``security`` parses into :class:`calfkit_tpu.mesh.kafka_wire.WireSecurity`
+  (TLS + SASL PLAIN/SCRAM); anything unsupported fails loudly at
+  construction.
+- ``enable_idempotence=True`` is REJECTED by the wire mesh (no
+  idempotent-producer sequencing in the native client) — never silently
+  honored as at-least-once.
 - Raw kwargs that would bypass a coordinated knob are **rejected by name**
   (reference: caller.py:148-165) with a pointer at the right knob.
 """
@@ -22,7 +27,6 @@ from dataclasses import dataclass, field
 from typing import Any, Mapping
 
 DEFAULT_MAX_MESSAGE_BYTES = 5 * 1024 * 1024
-_AIOKAFKA_DEFAULT_FETCH_MAX = 50 * 1024 * 1024
 
 # kwarg name -> the knob that owns it
 REJECTED_SECURITY_KWARGS: dict[str, str] = {
@@ -41,7 +45,7 @@ REJECTED_SECURITY_KWARGS: dict[str, str] = {
 
 @dataclass(frozen=True)
 class ConnectionProfile:
-    """Validated once; derives kwargs for every client kind."""
+    """Validated once; one object owns every coordinated connection knob."""
 
     bootstrap_servers: str
     max_message_bytes: int = DEFAULT_MAX_MESSAGE_BYTES
@@ -67,35 +71,15 @@ class ConnectionProfile:
                 f"security= must not carry coordinated kwargs: {hints}"
             )
 
-    # ------------------------------------------------------------- kwargs
-    def common_kwargs(self) -> dict[str, Any]:
-        return {"bootstrap_servers": self.bootstrap_servers, **self.security}
-
-    def producer_kwargs(self) -> dict[str, Any]:
-        kwargs = dict(
-            self.common_kwargs(),
-            client_id=self.client_id,
-            max_request_size=self.max_message_bytes,  # producer guard
-            acks="all",
-        )
-        if self.enable_idempotence is not None:
-            kwargs["enable_idempotence"] = self.enable_idempotence
-        return kwargs
-
-    def consumer_kwargs(
-        self, *, group_id: str | None, from_latest: bool
-    ) -> dict[str, Any]:
-        return dict(
-            self.common_kwargs(),
-            group_id=group_id,
-            auto_offset_reset="latest" if from_latest else "earliest",
-            enable_auto_commit=group_id is not None,
-            # consumer fetch FLOOR: both bounds at least the budget
-            max_partition_fetch_bytes=self.max_message_bytes,
-            fetch_max_bytes=max(
-                self.max_message_bytes, _AIOKAFKA_DEFAULT_FETCH_MAX
-            ),
-        )
-
-    def admin_kwargs(self) -> dict[str, Any]:
-        return self.common_kwargs()
+    # -------------------------------------------------------------- laws
+    # The coordinated knobs are CONSUMED by the native wire client:
+    # - max_message_bytes is both the producer guard (publish rejects
+    #   bigger values) and the consumer fetch floor
+    #   (kafka_wire.fetch_floor(max_message_bytes)), so the biggest legal
+    #   record is always fetchable;
+    # - security parses into kafka_wire.WireSecurity (TLS + SASL), with
+    #   anything unsupported failing loudly at construction;
+    # - enable_idempotence=True is REJECTED by KafkaWireMesh (the native
+    #   client's retry-once produce cannot guarantee exactly-once
+    #   sequencing) — a profile asking for it must not be silently
+    #   honored as at-least-once.

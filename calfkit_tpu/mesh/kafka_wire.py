@@ -1280,6 +1280,7 @@ class _WireConsumer:
         commit_interval_s: float = 1.0,
         security: WireSecurity = PLAINTEXT,
         max_message_bytes: int = DEFAULT_MAX_MESSAGE_BYTES,
+        client_id: str = "calfkit-consumer",
     ):
         self._security = security
         # the coordinated-knob law (ConnectionProfile): the consumer fetch
@@ -1289,12 +1290,13 @@ class _WireConsumer:
         # budget keeps multi-record batches flowing too)
         self._fetch_max_bytes = fetch_floor(max_message_bytes)
         self._client = KafkaWireClient(
-            host, port, client_id="calfkit-consumer", security=security
+            host, port, client_id=client_id, security=security
         )
         self._topics = topics
         self._group = group_id
         self._from_latest = from_latest
         self._deliver = deliver
+        self._client_id = client_id
         self._session_ms = session_timeout_ms
         self._commit_interval = commit_interval_s
         self._positions: dict[tuple[str, int], int] = {}
@@ -1502,7 +1504,7 @@ class _WireConsumer:
         interval = max(self._session_ms / 3000.0, 0.5)
         hb = KafkaWireClient(
             self._client.conn.host, self._client.conn.port,
-            client_id="calfkit-hb", security=self._security,
+            client_id=f"{self._client_id}-hb", security=self._security,
         )
         failures = 0
         try:
@@ -1681,6 +1683,14 @@ class KafkaWireMesh(MeshTransport):
                     "ConnectionProfile instead"
                 )
         self._profile = profile
+        if profile.enable_idempotence:
+            # retry-once produce (NOT_LEADER / dead-leader EOF) cannot
+            # guarantee exactly-once sequencing; honoring the flag
+            # silently as at-least-once would be a lie
+            raise ValueError(
+                "enable_idempotence=True is not supported by the native "
+                "wire client (no idempotent-producer sequencing); unset it"
+            )
         # parse EARLY so unsupported security fails at construction, not
         # first I/O
         self._security = WireSecurity.from_security_kwargs(profile.security)
@@ -1716,7 +1726,8 @@ class KafkaWireMesh(MeshTransport):
         if self._started:
             return
         self._producer = KafkaWireClient(
-            self._host, self._port, client_id="calfkit-producer",
+            self._host, self._port,
+            client_id=f"{self._profile.client_id}-producer",
             security=self._security,
         )
         await self._producer.conn.connect()
@@ -1844,6 +1855,7 @@ class KafkaWireMesh(MeshTransport):
         consumer = _WireConsumer(
             self._host, self._port, topics, group_id, from_latest, deliver,
             security=self._security, max_message_bytes=self._max_bytes,
+            client_id=f"{self._profile.client_id}-consumer",
         )
         consumer.start()
         self._consumers.append(consumer)
@@ -1898,7 +1910,8 @@ class _WireTableReader(TableReader):
 
     async def start(self, *, timeout: float = 30.0) -> None:
         self._client = KafkaWireClient(
-            self._mesh._host, self._mesh._port, client_id="calfkit-table",
+            self._mesh._host, self._mesh._port,
+            client_id=f"{self._mesh._profile.client_id}-table",
             security=self._mesh._security,
         )
         # own fetch loop (not _WireConsumer): the barrier needs each

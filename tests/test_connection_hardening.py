@@ -21,39 +21,50 @@ from calfkit_tpu.provisioning.provisioner import (
 
 class TestConnectionProfile:
     def test_producer_guard_and_consumer_floor(self):
-        profile = ConnectionProfile("host:9092", max_message_bytes=10_000_000)
-        prod = profile.producer_kwargs()
-        assert prod["max_request_size"] == 10_000_000
-        assert prod["acks"] == "all"
-        cons = profile.consumer_kwargs(group_id="g", from_latest=False)
-        assert cons["max_partition_fetch_bytes"] == 10_000_000
-        # floor: never below the budget, never below the client default
-        assert cons["fetch_max_bytes"] >= 10_000_000
-        big = ConnectionProfile("host:9092", max_message_bytes=100_000_000)
-        assert big.consumer_kwargs(group_id=None, from_latest=True)[
-            "fetch_max_bytes"
-        ] == 100_000_000
+        """max_message_bytes is BOTH the producer guard and the consumer
+        fetch floor — the wire client derives its fetch budget from it so
+        the biggest legal record is always fetchable."""
+        from calfkit_tpu.mesh.kafka_wire import KEY_HEADERS_CAP, fetch_floor
 
-    def test_idempotence_tristate(self):
-        default = ConnectionProfile("h:9")
-        assert "enable_idempotence" not in default.producer_kwargs()
-        on = ConnectionProfile("h:9", enable_idempotence=True)
-        assert on.producer_kwargs()["enable_idempotence"] is True
-        off = ConnectionProfile("h:9", enable_idempotence=False)
-        assert off.producer_kwargs()["enable_idempotence"] is False
+        assert fetch_floor(10_000_000) >= 10_000_000 + KEY_HEADERS_CAP
+        # small budgets still get the 4 MiB floor (multi-record batches)
+        assert fetch_floor(1) == 4 * 1024 * 1024
+        # monotone: a bigger budget never shrinks the fetch budget
+        assert fetch_floor(100_000_000) > fetch_floor(10_000_000)
 
-    def test_security_threads_to_every_client(self):
+    def test_idempotence_rejected_loudly_by_wire_mesh(self):
+        """The native client's retry-once produce cannot guarantee
+        exactly-once sequencing; a profile asking for idempotence must
+        fail at construction, never be silently honored as
+        at-least-once."""
+        from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh
+
+        profile = ConnectionProfile("h:9", enable_idempotence=True)
+        with pytest.raises(ValueError, match="enable_idempotence"):
+            KafkaWireMesh(profile=profile)
+        # tri-state: None (default) and explicit False are fine
+        KafkaWireMesh(profile=ConnectionProfile("h:9"))
+        KafkaWireMesh(
+            profile=ConnectionProfile("h:9", enable_idempotence=False)
+        )
+
+    def test_security_and_client_id_thread_to_the_wire_client(self):
+        from calfkit_tpu.mesh.kafka_wire import KafkaWireMesh
+
         profile = ConnectionProfile(
             "h:9",
-            security={"security_protocol": "SASL_SSL", "sasl_mechanism": "PLAIN"},
+            client_id="svc-x",
+            security={
+                "security_protocol": "SASL_PLAINTEXT",
+                "sasl_mechanism": "PLAIN",
+                "sasl_plain_username": "u",
+                "sasl_plain_password": "p",
+            },
         )
-        for kwargs in (
-            profile.producer_kwargs(),
-            profile.consumer_kwargs(group_id="g", from_latest=False),
-            profile.admin_kwargs(),
-        ):
-            assert kwargs["security_protocol"] == "SASL_SSL"
-            assert kwargs["sasl_mechanism"] == "PLAIN"
+        mesh = KafkaWireMesh(profile=profile)
+        assert mesh._security.uses_sasl
+        assert mesh._security.username == "u"
+        assert mesh._profile.client_id == "svc-x"
 
     @pytest.mark.parametrize(
         "kwarg",
@@ -63,15 +74,6 @@ class TestConnectionProfile:
     def test_coordinated_kwargs_rejected_by_name(self, kwarg):
         with pytest.raises(ValueError, match=kwarg):
             ConnectionProfile("h:9", security={kwarg: "x"})
-
-    def test_group_semantics(self):
-        profile = ConnectionProfile("h:9")
-        tap = profile.consumer_kwargs(group_id=None, from_latest=True)
-        assert tap["auto_offset_reset"] == "latest"
-        assert tap["enable_auto_commit"] is False
-        member = profile.consumer_kwargs(group_id="g", from_latest=False)
-        assert member["auto_offset_reset"] == "earliest"
-        assert member["enable_auto_commit"] is True
 
 
 class _NamedError(Exception):
@@ -185,14 +187,9 @@ class TestReviewRegressions:
         sec: dict = {}
         profile = ConnectionProfile("h:9", security=sec)
         sec["acks"] = 0  # mutate AFTER construction
-        # the profile holds its OWN copy: the leaked key must be absent from
-        # every derived kwargs dict (admin/consumer don't re-override acks,
-        # so they are the observable surface for this guard)
-        assert "acks" not in profile.admin_kwargs()
-        assert "acks" not in profile.consumer_kwargs(
-            group_id="g", from_latest=False
-        )
-        assert profile.producer_kwargs()["acks"] == "all"
+        # the profile holds its OWN copy: the leaked key must be absent
+        # from the security mapping the wire client parses
+        assert "acks" not in profile.security
 
     def test_max_attempts_lower_bound(self):
         with pytest.raises(Exception):
