@@ -71,15 +71,3 @@ class ConnectionProfile:
                 f"security= must not carry coordinated kwargs: {hints}"
             )
 
-    # -------------------------------------------------------------- laws
-    # The coordinated knobs are CONSUMED by the native wire client:
-    # - max_message_bytes is both the producer guard (publish rejects
-    #   bigger values) and the consumer fetch floor
-    #   (kafka_wire.fetch_floor(max_message_bytes)), so the biggest legal
-    #   record is always fetchable;
-    # - security parses into kafka_wire.WireSecurity (TLS + SASL), with
-    #   anything unsupported failing loudly at construction;
-    # - enable_idempotence=True is REJECTED by KafkaWireMesh (the native
-    #   client's retry-once produce cannot guarantee exactly-once
-    #   sequencing) — a profile asking for it must not be silently
-    #   honored as at-least-once.

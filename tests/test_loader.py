@@ -1,0 +1,172 @@
+"""HF checkpoint loader (r5: previously untested e2e).
+
+Builds a REAL safetensors checkpoint on disk in HF Llama naming (the
+transposed [out, in] projection layout), then pins:
+- config.json → ModelConfig mapping,
+- bf16 load: loaded params serve with logit parity against the same
+  weights constructed directly,
+- int8 / int4 host-side quantized load: quantized leaf structure +
+  engine serves end to end from the loaded tree,
+- sharded placement on a tp mesh.
+
+Reference anchor: checkpointing-is-loading (SURVEY §5); the reference
+has no local model path — this is the TPU-build's equivalent of its
+provider-credential plumbing tests.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from calfkit_tpu.inference import model as M
+from calfkit_tpu.inference.config import preset
+from calfkit_tpu.inference.loader import config_from_hf, load_params
+from calfkit_tpu.inference.sharding import make_mesh, param_shardings
+
+CFG = preset("debug")
+
+
+@pytest.fixture(scope="module")
+def checkpoint(tmp_path_factory):
+    """A debug-sized HF-style checkpoint whose weights equal
+    init_params(CFG, key(0)) — so loads can be compared elementwise."""
+    from safetensors.numpy import save_file
+
+    path = tmp_path_factory.mktemp("hf-ckpt")
+    params = M.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    D, H, K, hd = CFG.d_model, CFG.n_heads, CFG.n_kv_heads, CFG.head_dim
+    def c(arr: np.ndarray) -> np.ndarray:
+        # safetensors serializes the underlying buffer: a transposed VIEW
+        # would silently store the un-transposed bytes
+        return np.ascontiguousarray(arr)
+
+    tensors: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(params["embed"], np.float32),
+        "model.norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    if "lm_head" in params:
+        tensors["lm_head.weight"] = c(np.asarray(params["lm_head"], np.float32).T)
+    layers = params["layers"]
+    for i in range(CFG.n_layers):
+        pre = f"model.layers.{i}."
+        tensors[pre + "self_attn.q_proj.weight"] = c(
+            np.asarray(layers["wq"][i], np.float32).reshape(D, H * hd).T
+        )
+        tensors[pre + "self_attn.k_proj.weight"] = c(
+            np.asarray(layers["wk"][i], np.float32).reshape(D, K * hd).T
+        )
+        tensors[pre + "self_attn.v_proj.weight"] = c(
+            np.asarray(layers["wv"][i], np.float32).reshape(D, K * hd).T
+        )
+        tensors[pre + "self_attn.o_proj.weight"] = c(
+            np.asarray(layers["wo"][i], np.float32).reshape(H * hd, D).T
+        )
+        tensors[pre + "mlp.gate_proj.weight"] = c(np.asarray(
+            layers["w_gate"][i], np.float32).T)
+        tensors[pre + "mlp.up_proj.weight"] = c(np.asarray(
+            layers["w_up"][i], np.float32).T)
+        tensors[pre + "mlp.down_proj.weight"] = c(np.asarray(
+            layers["w_down"][i], np.float32).T)
+        tensors[pre + "input_layernorm.weight"] = np.asarray(
+            layers["attn_norm"][i], np.float32)
+        tensors[pre + "post_attention_layernorm.weight"] = np.asarray(
+            layers["mlp_norm"][i], np.float32)
+    save_file(tensors, str(path / "model.safetensors"))
+    (path / "config.json").write_text(json.dumps({
+        "vocab_size": CFG.vocab_size,
+        "hidden_size": CFG.d_model,
+        "num_hidden_layers": CFG.n_layers,
+        "num_attention_heads": CFG.n_heads,
+        "num_key_value_heads": CFG.n_kv_heads,
+        "intermediate_size": CFG.d_ff,
+        "rope_theta": CFG.rope_theta,
+        "rms_norm_eps": CFG.norm_eps,
+        "max_position_embeddings": CFG.max_seq_len,
+        "tie_word_embeddings": CFG.tie_embeddings,
+    }))
+    return path, params
+
+
+class TestConfigFromHF:
+    def test_maps_every_field(self, checkpoint):
+        path, _params = checkpoint
+        config = config_from_hf(path)
+        for attr in ("vocab_size", "d_model", "n_layers", "n_heads",
+                     "n_kv_heads", "d_ff", "rope_theta", "norm_eps",
+                     "max_seq_len", "tie_embeddings"):
+            assert getattr(config, attr) == getattr(CFG, attr), attr
+
+
+class TestLoadParams:
+    def _logits(self, params):
+        B, S = 2, 8
+        toks = jax.random.randint(jax.random.key(3), (B, S), 3, CFG.vocab_size)
+        pos = jnp.broadcast_to(jnp.arange(S), (B, S))
+        lens = jnp.full((B,), S)
+        cache = M.make_empty_cache(CFG, B, 32, dtype=jnp.float32)
+        out, _ = M.forward(params, CFG, toks, pos, cache, lens)
+        return np.asarray(out, np.float32)
+
+    def test_bf16_load_matches_direct_params(self, checkpoint):
+        path, params = checkpoint
+        config = config_from_hf(path)
+        shardings = param_shardings(config, make_mesh(tp=1, dp=1))
+        loaded = load_params(path, config, shardings)
+        # loaded weights pass through the HF transpose/reshape round trip
+        # and back: logits must match thedirectly-constructed fp32 params to
+        # bf16 tolerance
+        want = self._logits(params)
+        got = self._logits(loaded)
+        np.testing.assert_allclose(got, want, rtol=0.05, atol=0.05)
+
+    @pytest.mark.parametrize("quantize", ["int8", "int4"])
+    async def test_quantized_load_serves(self, checkpoint, quantize):
+        from calfkit_tpu.inference.config import RuntimeConfig
+        from calfkit_tpu.inference.engine import InferenceEngine
+        from calfkit_tpu.inference.quant import (
+            align_quant_sharding_keys,
+            is_quantized,
+            is_quantized4,
+            quantize_shardings,
+        )
+
+        path, _params = checkpoint
+        config = config_from_hf(path)
+        bits = 8 if quantize == "int8" else 4
+        shardings = quantize_shardings(
+            param_shardings(config, make_mesh(tp=1, dp=1)), bits=bits
+        )
+        loaded = load_params(path, config, shardings, quantize=quantize)
+        wq = loaded["layers"]["wq"]
+        assert (is_quantized if bits == 8 else is_quantized4)(wq)
+        engine = InferenceEngine(
+            config,
+            RuntimeConfig(max_batch_size=2, max_seq_len=64, prefill_chunk=16,
+                          decode_steps_per_dispatch=4, quantization=quantize),
+            params=loaded,
+        )
+        await engine.start()
+        out = [t async for t in engine.generate([1, 5, 9], max_new_tokens=6)]
+        assert len(out) == 6
+        await engine.stop()
+
+    def test_tp_sharded_placement(self, checkpoint):
+        path, _params = checkpoint
+        config = config_from_hf(path)
+        mesh = make_mesh(tp=2, dp=1)
+        loaded = load_params(path, config, param_shardings(config, mesh))
+        spec = loaded["layers"]["wq"].sharding.spec
+        assert "tp" in tuple(spec), spec
+
+    def test_bits_mismatch_fails_loudly(self, checkpoint):
+        path, _params = checkpoint
+        config = config_from_hf(path)
+        shardings = param_shardings(config, make_mesh(tp=1, dp=1))
+        # shardings NOT expanded for quantization but int4 load requested
+        with pytest.raises((ValueError, AttributeError, KeyError)):
+            load_params(path, config, shardings, quantize="int4")
