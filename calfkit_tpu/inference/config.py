@@ -90,6 +90,13 @@ class RuntimeConfig:
     # sparse buckets = few compiles, dense = tighter HBM reads
     window_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
     compilation_cache_dir: str | None = "~/.cache/calfkit_tpu_xla"
+    # automatic prefix caching (vLLM-APC analog): requests whose prompt
+    # shares a full-page-aligned prefix with an earlier request reuse its
+    # KV pages instead of re-prefilling them — the agent-serving win
+    # (same instructions/history re-sent every turn).  Requires
+    # kv_layout="paged" AND chunked_prefill=True (reuse seeds the chunk
+    # lane's scratch and starts at the reused offset).
+    prefix_cache: bool = False
     # weight-only quantization: "int8" halves decode HBM traffic and fits
     # Llama-3-8B on one 16 GB chip; "int4" (packed nibbles, group-128
     # scales) halves the weight stream again (~4 GB for 8B — margin for

@@ -149,6 +149,11 @@ class GenRequest:
     seed: int | None = None  # None → engine-derived per-admission stream
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
     pages: list[int] = field(default_factory=list)  # paged-KV reservation
+    # prefix caching: reused token count, the shared (cache-owned) page
+    # prefix of ``pages``, and the prompt's full-page chain hashes
+    reuse_len: int = 0
+    shared_pages: list[int] = field(default_factory=list)
+    page_hashes: list = field(default_factory=list)
     slot: int = -1
     generated: int = 0
     prefill_ms: float = 0.0
@@ -175,6 +180,8 @@ class EngineStats:
     short_dispatches: int = 0
     long_requests: int = 0  # served via the sequence-parallel lane
     long_dispatches: int = 0  # sp-lane decode dispatches (whole-mesh units)
+    prefix_hits: int = 0  # admissions that reused cached prefix pages
+    prefix_reused_tokens: int = 0  # prompt tokens NOT re-prefilled
 
     @property
     def tokens_per_second(self) -> float:
@@ -302,12 +309,28 @@ class InferenceEngine:
             self._v = jax.device_put(pool_v, pool_sh)
             self._tables = jnp.zeros((B, rt.pages_per_seq()), jnp.int32)
             self._page_alloc = PageAllocator(n_pages)
+            self._prefix: Any = None
+            if rt.prefix_cache:
+                if not rt.chunked_prefill:
+                    raise ValueError(
+                        "prefix_cache=True requires chunked_prefill=True "
+                        "(reuse seeds the chunk lane's scratch)"
+                    )
+                from calfkit_tpu.inference.paged import PrefixCache
+
+                self._prefix = PrefixCache()
             logger.info(
                 "paged KV pool: %d pages x %d tokens (%.2f GB)",
                 n_pages, rt.page_size,
                 2 * self._k.size * self._k.dtype.itemsize / 1e9,
             )
         else:
+            self._prefix = None
+            if rt.prefix_cache:
+                raise ValueError(
+                    "prefix_cache=True requires kv_layout='paged' "
+                    "(reuse shares pages between requests)"
+                )
             cache_sh = cache_sharding(config, self.mesh, B)
             self._k = jax.device_put(
                 jnp.zeros(
@@ -648,6 +671,40 @@ class InferenceEngine:
         self._prefill_jits[("chunk", chunk, rows)] = fn
         return fn
 
+    def _seed_scratch_jit(self, bucket: int, n_pages: int, rows: int) -> Any:
+        """Fresh chunk-lane scratch with every row's first ``n_pages``
+        pages gathered from the paged pool (prefix-cache reuse; ids is
+        [rows, n_pages]).  One compile per (bucket, n_pages, rows) —
+        reuse lengths are page-aligned, so the variant count is bounded
+        by bucket/page times the power-of-two wave widths."""
+        key = ("seed", bucket, n_pages, rows)
+        fn = self._prefill_jits.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.config
+        page = self.runtime.page_size
+
+        def seed(pool_k, pool_v, ids):
+            def gather(pool_side):
+                g = pool_side[:, ids]  # [L, R, n, K, page, hd]
+                L, R, n, K, ps, hd = g.shape
+                return g.transpose(0, 1, 3, 2, 4, 5).reshape(
+                    L, R, K, n * ps, hd
+                )
+
+            shape = (
+                cfg.n_layers, rows, cfg.n_kv_heads, bucket, cfg.head_dim
+            )
+            sk = jnp.zeros(shape, pool_k.dtype)
+            sv = jnp.zeros(shape, pool_v.dtype)
+            sk = sk.at[:, :, :, : n_pages * page].set(gather(pool_k))
+            sv = sv.at[:, :, :, : n_pages * page].set(gather(pool_v))
+            return sk, sv
+
+        fn = jax.jit(seed)
+        self._prefill_jits[key] = fn
+        return fn
+
     def _finalize_jit(self, bucket: int, rows: int, sampled: bool) -> Any:
         """The chunked wave's landing: scatter the finished scratch into the
         cache (rows or pages), install sampling state, sample first tokens
@@ -958,6 +1015,55 @@ class InferenceEngine:
             rt.pages_per_seq(),
         )
 
+    def _plan_prefix_reuse(self, request: GenRequest, bucket: int) -> int:
+        """Longest cached, alignment-safe prompt prefix for ``request``
+        (0 when caching is off or nothing matches).  Sets reuse_len /
+        shared_pages / page_hashes on the request; recomputed fresh on
+        every attempt (a carried-back request must not keep stale pages).
+
+        Alignment: reuse must be whole PAGES (sharing granularity) and a
+        whole number of CHUNKS (the chunk lane resumes at the reused
+        offset), and at least the final chunk always recomputes (the
+        first token samples from the last chunk's logits)."""
+        request.reuse_len = 0
+        request.shared_pages = []
+        if self._prefix is None:
+            return 0
+        rt = self.runtime
+        ps = rt.page_size
+        if not request.page_hashes:  # prompt is immutable: hash ONCE
+            from calfkit_tpu.inference.paged import chain_hashes
+
+            request.page_hashes = chain_hashes(request.prompt, ps)
+        if not request.page_hashes:
+            return 0
+        matched = self._prefix.lookup(request.page_hashes)
+        if not matched:
+            return 0
+        chunk = min(rt.prefill_chunk, bucket)
+        align = ps * chunk // math.gcd(ps, chunk)
+        candidate = min(
+            len(matched) * ps,
+            len(request.prompt) - 1,  # never reuse the final position
+            bucket - chunk,           # at least one chunk recomputes
+        )
+        reuse = (candidate // align) * align
+        if reuse <= 0:
+            return 0
+        request.reuse_len = reuse
+        request.shared_pages = matched[: reuse // ps]
+        return reuse
+
+    def _alloc_with_eviction(self, slot: int, n: int) -> "list[int] | None":
+        pages = self._page_alloc.alloc(slot, n)
+        if pages is None and self._prefix is not None:
+            # idle cache entries are reclaimable capacity, not a leak
+            self._prefix.evict(
+                n - self._page_alloc.free_pages, self._page_alloc
+            )
+            pages = self._page_alloc.alloc(slot, n)
+        return pages
+
     def _bucket_of(self, prompt_len: int) -> int:
         rt = self.runtime
         return min(
@@ -978,12 +1084,26 @@ class InferenceEngine:
 
         wave: list[GenRequest] = [self._next_pending()]
         wave_bucket = bucket_of(wave[0])
+        head_reuse = self._plan_prefix_reuse(wave[0], wave_bucket)
         while (
             len(wave) < len(self._free)
             and len(wave) < self.runtime.max_prefill_wave
             and (peeked := self._peek_pending()) is not None
             and bucket_of(peeked) == wave_bucket
         ):
+            # one offset per wave: only requests whose reuse TRIMS to the
+            # head's length batch together (an identical-prompt burst —
+            # the headline workload — batches fully once page 1 lands)
+            planned = self._plan_prefix_reuse(peeked, wave_bucket)
+            if head_reuse == 0 and planned != 0:
+                break
+            if head_reuse > 0:
+                if planned < head_reuse:
+                    break
+                peeked.reuse_len = head_reuse
+                peeked.shared_pages = peeked.shared_pages[
+                    : head_reuse // self.runtime.page_size
+                ]
             wave.append(self._next_pending())
         # wave sizes are power-of-two so each prefill bucket compiles at
         # most log2(max_prefill_wave)+1 jit variants (R in 1,2,4,...)
@@ -999,15 +1119,23 @@ class InferenceEngine:
             granted: list[GenRequest] = []
             for i, request in enumerate(wave):
                 slot = self._free.pop()
-                pages = self._page_alloc.alloc(
-                    slot, self._reserve_pages(request, wave_bucket)
-                )
+                need = self._reserve_pages(request, wave_bucket)
+                shared: list[int] = []
+                if request.reuse_len:
+                    shared = request.shared_pages
+                    self._prefix.acquire(shared)
+                    need -= len(shared)
+                pages = self._alloc_with_eviction(slot, need)
                 if pages is None:
+                    if shared:
+                        self._prefix.release(shared)
+                        request.reuse_len = 0
+                        request.shared_pages = []
                     self._free.append(slot)
                     self._carry = wave[i:] + self._carry
                     break
                 request.slot = slot
-                request.pages = pages
+                request.pages = shared + pages
                 granted.append(request)
             wave = granted
             if not wave:
@@ -1320,7 +1448,7 @@ class InferenceEngine:
         ]
 
     def _paged_wave_args(self, wave: list[GenRequest], bucket: int) -> list:
-        from calfkit_tpu.inference.paged import table_row
+        from calfkit_tpu.inference.paged import TRASH_PAGE, table_row
 
         R = len(wave)
         page = self.runtime.page_size
@@ -1332,6 +1460,13 @@ class InferenceEngine:
             page_rows[r] = table_row(request.pages, pmax)
             # prefill writes whole bucket pages; reservation covers them
             scatter_ids[r] = page_rows[r, :npg]
+            if request.reuse_len:
+                # reused pages are SHARED read-only: route their scatter
+                # writes to the trash page (the scratch region is a copy
+                # of what they already hold anyway)
+                scatter_ids[r, : request.reuse_len // self.runtime.page_size] = (
+                    TRASH_PAGE
+                )
         return [self._tables, jnp.asarray(page_rows), jnp.asarray(scatter_ids)]
 
     def _land_wave(
@@ -1405,14 +1540,31 @@ class InferenceEngine:
                 cfg.n_layers, R, cfg.n_kv_heads, bucket, cfg.head_dim
             )
             dtype = self._k.dtype
+            reuse = wave[0].reuse_len  # uniform across the wave
+            if reuse:
+                # seed the scratch with the cached prefix K/V (each row's
+                # pages gathered from the pool) and resume the chunk loop
+                # at the reused offset — the chunk jit's offset is data,
+                # so no new compile per reuse length
+                npg_r = reuse // self.runtime.page_size
+                ids = np.asarray(
+                    [request.pages[:npg_r] for request in wave], np.int32
+                )
+                scratch = self._seed_scratch_jit(bucket, npg_r, R)(
+                    self._k, self._v, jnp.asarray(ids)
+                )
+                self.stats.prefix_hits += len(wave)
+                self.stats.prefix_reused_tokens += reuse * len(wave)
+            else:
+                scratch = (
+                    jnp.zeros(scratch_shape, dtype),
+                    jnp.zeros(scratch_shape, dtype),
+                )
             self._inflight = dict(
                 wave=wave, bucket=bucket, chunk=chunk,
-                n_chunks=-(-bucket // chunk), idx=0,
+                n_chunks=-(-bucket // chunk), idx=reuse // chunk,
                 arrays=self._wave_arrays(wave, bucket),
-                scratch=(
-                    jnp.zeros(scratch_shape, dtype),
-                    jnp.zeros(scratch_shape, dtype),
-                ),
+                scratch=scratch,
                 started=time.perf_counter(),
             )
         finished = await asyncio.to_thread(self._advance_inflight)
@@ -1461,7 +1613,42 @@ class InferenceEngine:
         firsts = np.asarray(firsts)  # sync before timing (real latency)
         elapsed_ms = (time.perf_counter() - inf["started"]) * 1000.0
         self._land_wave(wave, arrays["true_lens"], firsts, elapsed_ms)
+        if self._prefix is not None:
+            for request in wave:
+                self._register_prefix_pages(request)
         return True
+
+    def _register_prefix_pages(self, request: GenRequest) -> None:
+        """After landing: publish the request's freshly-written
+        full-prompt pages into the prefix cache.  Ownership transfers
+        from the allocator (so retirement can't free shared pages under
+        later readers); the owning slot holds a reference until it
+        retires.  Decode never writes these pages: its first write lands
+        at position prompt_len, which lives past every registered page."""
+        if request.slot == -1:  # retired during its own prefill
+            return
+        ps = self.runtime.page_size
+        full = len(request.prompt) // ps
+        if len(request.page_hashes) < full:
+            # non-head wave members skip reuse PLANNING (a reusing head
+            # rides a singleton wave) but still register their pages
+            from calfkit_tpu.inference.paged import chain_hashes
+
+            request.page_hashes = chain_hashes(request.prompt, ps)
+        reused = len(request.shared_pages)
+        fresh: list[int] = []
+        for i in range(reused, full):
+            page = request.pages[i]
+            if self._prefix.register(request.page_hashes[i], page):
+                fresh.append(page)
+            else:
+                # another request registered this chain position first;
+                # this duplicate page stays private and frees at retire
+                break
+        if fresh:
+            self._page_alloc.transfer_out(request.slot, fresh)
+            self._prefix.acquire(fresh)
+            request.shared_pages = request.shared_pages + fresh
 
     def _decode_tick(self) -> None:
         active_mask = np.zeros((self.runtime.max_batch_size,), bool)
@@ -1567,6 +1754,11 @@ class InferenceEngine:
         occupies ``_active``)."""
         self._active.pop(request.slot, None)
         if self._paged:
+            if self._prefix is not None and request.shared_pages:
+                # shared pages return to the CACHE (refcount), never to
+                # the free list while other readers may hold them
+                self._prefix.release(request.shared_pages)
+                request.shared_pages = []
             self._page_alloc.free(request.slot)
         self._free.append(request.slot)
         request.slot = -1

@@ -64,6 +64,112 @@ class PageAllocator:
         """Return ``slot``'s pages to the pool (idempotent)."""
         self._free.extend(self._held.pop(slot, ()))
 
+    def transfer_out(self, slot: int, pages: "list[int]") -> None:
+        """Move ``pages`` out of ``slot``'s holding WITHOUT freeing them —
+        ownership passes to the prefix cache (so a later ``free(slot)``
+        cannot return shared pages to the pool under live readers)."""
+        held = self._held.get(slot)
+        if held is None:
+            return
+        moving = set(pages)
+        self._held[slot] = [p for p in held if p not in moving]
+
+    def give_back(self, pages: "list[int]") -> None:
+        """Return cache-owned pages to the pool (prefix-cache eviction)."""
+        self._free.extend(pages)
+
+
+def chain_hashes(prompt: "list[int]", page_size: int) -> "list[bytes]":
+    """Position-dependent content hash per FULL page of the prompt:
+    hash_i = H(hash_{i-1} || tokens[i*ps:(i+1)*ps]).  Chaining makes a
+    page's identity its entire prefix, so equal pages at different
+    positions (or after different histories) never alias."""
+    import hashlib
+
+    out: list[bytes] = []
+    prev = b""
+    for i in range(len(prompt) // page_size):
+        h = hashlib.blake2b(digest_size=16)
+        h.update(prev)
+        h.update(np.asarray(
+            prompt[i * page_size:(i + 1) * page_size], np.int32
+        ).tobytes())
+        prev = h.digest()
+        out.append(prev)
+    return out
+
+
+class PrefixCache:
+    """Automatic prefix caching over the page pool (the vLLM-APC analog,
+    sized for agent serving: every run of the same agent re-sends the
+    same instruction/history prefix, so its KV pages are recomputed
+    per-turn without this).
+
+    Ownership protocol: a landed request's full-prompt pages transfer
+    from the allocator to this cache (``PageAllocator.transfer_out``);
+    live requests hold references; zero-reference entries sit in an LRU
+    and are evicted back to the allocator when admission runs dry.  All
+    mutation happens from the engine's scheduler flow (same
+    single-writer discipline as the allocator)."""
+
+    def __init__(self) -> None:
+        from collections import OrderedDict
+
+        self._entries: dict[bytes, int] = {}      # chain hash -> page
+        self._hash_of: dict[int, bytes] = {}
+        self._refs: dict[int, int] = {}            # live slot references
+        self._lru: "OrderedDict[bytes, None]" = OrderedDict()
+
+    @property
+    def size(self) -> int:
+        return len(self._entries)
+
+    def lookup(self, hashes: "list[bytes]") -> "list[int]":
+        """Longest cached chain prefix → its pages, in sequence order."""
+        pages: list[int] = []
+        for h in hashes:
+            page = self._entries.get(h)
+            if page is None:
+                break
+            pages.append(page)
+        return pages
+
+    def acquire(self, pages: "list[int]") -> None:
+        for page in pages:
+            self._refs[page] += 1
+            self._lru.pop(self._hash_of[page], None)
+
+    def release(self, pages: "list[int]") -> None:
+        for page in pages:
+            self._refs[page] -= 1
+            if self._refs[page] <= 0:
+                self._lru[self._hash_of[page]] = None
+
+    def register(self, h: bytes, page: int) -> bool:
+        """False when the hash is already cached (the caller's duplicate
+        page stays private to its slot and frees at retirement)."""
+        if h in self._entries:
+            return False
+        self._entries[h] = page
+        self._hash_of[page] = h
+        self._refs[page] = 0
+        return True
+
+    def evict(self, need: int, allocator: PageAllocator) -> int:
+        """Pop up to ``need`` zero-reference entries (oldest released
+        first) back into the allocator's free list.  Evicting a chain's
+        middle page strands its suffix entries (unreachable by lookup);
+        they drain through this same LRU once released."""
+        freed = 0
+        while freed < need and self._lru:
+            h, _ = self._lru.popitem(last=False)
+            page = self._entries.pop(h)
+            del self._hash_of[page]
+            del self._refs[page]
+            allocator.give_back([page])
+            freed += 1
+        return freed
+
 
 def pages_needed(total_tokens: int, page_size: int) -> int:
     return -(-total_tokens // page_size)

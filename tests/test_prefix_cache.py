@@ -1,0 +1,220 @@
+"""Automatic prefix caching (r5): paged-KV page reuse across requests
+sharing a prompt prefix — the agent-serving win (instructions + history
+re-sent every turn re-prefill nothing but the new tail).
+
+Pinned here:
+- exact token parity: a reusing request generates the SAME tokens as a
+  fresh engine (the reused pages hold bit-identical K/V),
+- reuse actually happens (stats) and only at page+chunk alignment,
+- divergent suffixes after a shared prefix stay independent,
+- page accounting: no leaks across admission/retire/eviction; shared
+  pages never return to the free list while readers hold them,
+- eviction reclaims idle cache pages when admission runs dry,
+- the cache itself (unit): chain hashing, LRU eviction, ownership.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from calfkit_tpu.inference.config import RuntimeConfig, preset
+from calfkit_tpu.inference.engine import InferenceEngine
+from calfkit_tpu.inference.paged import (
+    PageAllocator,
+    PrefixCache,
+    chain_hashes,
+)
+
+CFG = preset("debug")
+
+
+def _runtime(**overrides) -> RuntimeConfig:
+    base = dict(
+        max_batch_size=4, max_seq_len=128, prefill_chunk=16,
+        decode_steps_per_dispatch=4, kv_layout="paged", page_size=16,
+        num_kv_pages=64, chunked_prefill=True, prefix_cache=True,
+    )
+    base.update(overrides)
+    return RuntimeConfig(**base)
+
+
+async def _generate(engine, prompt, n=8):
+    return [t async for t in engine.generate(prompt, max_new_tokens=n)]
+
+
+class TestChainHashes:
+    def test_position_dependence(self):
+        # equal page content after different prefixes must not alias
+        a = chain_hashes([1] * 32, 16)
+        b = chain_hashes([2] * 16 + [1] * 16, 16)
+        assert a[1] != b[1]
+        assert len(a) == 2
+
+    def test_partial_page_excluded(self):
+        assert len(chain_hashes([1] * 31, 16)) == 1
+
+
+class TestPrefixCacheUnit:
+    def test_register_acquire_release_evict(self):
+        alloc = PageAllocator(8)
+        cache = PrefixCache()
+        pages = alloc.alloc(0, 3)
+        hashes = chain_hashes([5] * 48, 16)
+        for h, p in zip(hashes, pages):
+            assert cache.register(h, p)
+        alloc.transfer_out(0, pages)
+        cache.acquire(pages)
+        alloc.free(0)  # slot frees nothing: ownership transferred
+        assert alloc.free_pages == 8 - 1 - 3
+        assert cache.lookup(hashes) == pages
+        # held pages are not evictable
+        assert cache.evict(3, alloc) == 0
+        cache.release(pages)
+        assert cache.evict(2, alloc) == 2
+        assert alloc.free_pages == 8 - 1 - 1
+        # evicting the chain head strands the tail for lookup
+        assert cache.lookup(hashes) == []
+
+    def test_duplicate_register_refused(self):
+        cache = PrefixCache()
+        h = chain_hashes([1] * 16, 16)[0]
+        assert cache.register(h, 3)
+        assert not cache.register(h, 4)
+        assert cache.lookup([h]) == [3]
+
+
+class TestEngineReuse:
+    def test_token_parity_and_reuse(self):
+        """Same prompt twice: second admission reuses pages and yields
+        IDENTICAL tokens; a fresh engine agrees."""
+
+        async def run() -> None:
+            prompt = [(7 * i + 3) % CFG.vocab_size for i in range(50)]
+            engine = InferenceEngine(CFG, _runtime(), seed=5)
+            await engine.start()
+            first = await _generate(engine, prompt)
+            assert engine.stats.prefix_hits == 0
+            second = await _generate(engine, prompt)
+            assert second == first
+            assert engine.stats.prefix_hits == 1
+            # alignment: lcm(page=16, chunk=16)=16; cap at min(48, 49, 48)
+            assert engine.stats.prefix_reused_tokens == 48
+            await engine.stop()
+
+            fresh = InferenceEngine(CFG, _runtime(), seed=5)
+            await fresh.start()
+            control = await _generate(fresh, prompt)
+            await fresh.stop()
+            assert control == first
+
+        asyncio.run(run())
+
+    def test_divergent_suffix_after_shared_prefix(self):
+        """Two prompts sharing 2 pages then diverging: the shared pages
+        are reused, and each result matches its own fresh-engine run."""
+
+        async def run() -> None:
+            shared = [(11 * i + 5) % CFG.vocab_size for i in range(32)]
+            a = shared + [9, 9, 9, 9, 9, 9, 9, 9, 9, 9, 9, 9, 9, 9, 9, 9, 1]
+            b = shared + [4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 4, 2]
+            engine = InferenceEngine(CFG, _runtime(), seed=9)
+            await engine.start()
+            got_a = await _generate(engine, a)
+            got_b = await _generate(engine, b)
+            assert engine.stats.prefix_hits == 1  # b reused a's prefix
+            assert engine.stats.prefix_reused_tokens == 32
+            await engine.stop()
+
+            for prompt, got in ((a, got_a), (b, got_b)):
+                fresh = InferenceEngine(CFG, _runtime(), seed=9)
+                await fresh.start()
+                assert await _generate(fresh, prompt) == got
+                await fresh.stop()
+
+        asyncio.run(run())
+
+    def test_no_page_leaks_across_reuse_and_retire(self):
+        async def run() -> None:
+            engine = InferenceEngine(CFG, _runtime(), seed=3)
+            await engine.start()
+            prompt = [(3 * i + 1) % CFG.vocab_size for i in range(40)]
+            for _ in range(4):
+                await _generate(engine, prompt, n=4)
+            alloc = engine._page_alloc
+            cache = engine._prefix
+            # every page is either free or cache-held; nothing vanished
+            assert alloc.free_pages + cache.size == 64 - 1
+            assert not alloc.held_slots
+            # draining the cache returns the pool to full
+            cache.evict(cache.size, alloc)
+            assert alloc.free_pages == 64 - 1
+            await engine.stop()
+
+        asyncio.run(run())
+
+    def test_eviction_reclaims_idle_cache_under_pressure(self):
+        """A tiny pool: cached pages from request 1 must be evicted to
+        admit request 2's different prompt — loudly accounted, no
+        deadlock."""
+
+        async def run() -> None:
+            engine = InferenceEngine(
+                CFG, _runtime(num_kv_pages=13, max_batch_size=2), seed=7
+            )
+            await engine.start()
+            p1 = [(5 * i + 2) % CFG.vocab_size for i in range(40)]
+            p2 = [(7 * i + 3) % CFG.vocab_size for i in range(40)]
+            out1 = await _generate(engine, p1, n=4)
+            assert engine._prefix.size > 0
+            out2 = await _generate(engine, p2, n=4)
+            assert out1 and out2
+            await engine.stop()
+
+        asyncio.run(run())
+
+    def test_concurrent_same_prompt_burst(self):
+        """A burst of identical prompts (the 128-agent shape in
+        miniature): all complete, all agree, pool balances."""
+
+        async def run() -> None:
+            engine = InferenceEngine(CFG, _runtime(), seed=11)
+            await engine.start()
+            prompt = [(13 * i + 7) % CFG.vocab_size for i in range(40)]
+            results = await asyncio.gather(
+                *[_generate(engine, prompt, n=5) for _ in range(6)]
+            )
+            assert all(r == results[0] for r in results)
+            alloc, cache = engine._page_alloc, engine._prefix
+            assert alloc.free_pages + cache.size == 64 - 1
+            assert not alloc.held_slots
+            await engine.stop()
+
+        asyncio.run(run())
+
+    def test_reusing_burst_batches_into_one_wave(self):
+        """Once the prefix is cached, a burst of reusing requests must
+        BATCH (review finding: the singleton restriction would serialize
+        the feature's own headline workload)."""
+
+        async def run() -> None:
+            engine = InferenceEngine(CFG, _runtime(), seed=13)
+            await engine.start()
+            prompt = [(17 * i + 5) % CFG.vocab_size for i in range(40)]
+            await _generate(engine, prompt, n=3)  # populate the cache
+            results = await asyncio.gather(
+                *[_generate(engine, prompt, n=3) for _ in range(4)]
+            )
+            assert all(r == results[0] for r in results)
+            assert engine.stats.prefix_hits == 4
+            assert engine.stats.prefix_reused_tokens == 4 * 32
+            await engine.stop()
+
+        asyncio.run(run())
+
+    def test_prefix_cache_requires_paged_and_chunked(self):
+        with pytest.raises(ValueError, match="paged"):
+            InferenceEngine(CFG, _runtime(kv_layout="dense"))
+        with pytest.raises(ValueError, match="chunked"):
+            InferenceEngine(CFG, _runtime(chunked_prefill=False))
