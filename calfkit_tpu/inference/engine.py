@@ -1054,6 +1054,15 @@ class InferenceEngine:
         request.shared_pages = matched[: reuse // ps]
         return reuse
 
+    def _drop_reuse_plan(self, request: GenRequest) -> None:
+        """Undo a formation-time acquisition for a request that will NOT
+        be served this pass (alloc failure / wave trim) — re-admission
+        replans from scratch."""
+        if self._prefix is not None and request.shared_pages:
+            self._prefix.release(request.shared_pages)
+        request.reuse_len = 0
+        request.shared_pages = []
+
     def _alloc_with_eviction(self, slot: int, n: int) -> "list[int] | None":
         pages = self._page_alloc.alloc(slot, n)
         if pages is None and self._prefix is not None:
@@ -1085,6 +1094,11 @@ class InferenceEngine:
         wave: list[GenRequest] = [self._next_pending()]
         wave_bucket = bucket_of(wave[0])
         head_reuse = self._plan_prefix_reuse(wave[0], wave_bucket)
+        if head_reuse:
+            # acquire at FORMATION: a later member's _alloc_with_eviction
+            # must never reclaim pages an earlier-planned member still
+            # needs (acquired pages are not evictable)
+            self._prefix.acquire(wave[0].shared_pages)
         while (
             len(wave) < len(self._free)
             and len(wave) < self.runtime.max_prefill_wave
@@ -1104,6 +1118,7 @@ class InferenceEngine:
                 peeked.shared_pages = peeked.shared_pages[
                     : head_reuse // self.runtime.page_size
                 ]
+                self._prefix.acquire(peeked.shared_pages)
             wave.append(self._next_pending())
         # wave sizes are power-of-two so each prefill bucket compiles at
         # most log2(max_prefill_wave)+1 jit variants (R in 1,2,4,...)
@@ -1112,6 +1127,8 @@ class InferenceEngine:
         keep = 1
         while keep * 2 <= len(wave):
             keep *= 2
+        for trimmed in wave[keep:]:  # balance formation-time acquisitions
+            self._drop_reuse_plan(trimmed)
         self._carry = wave[keep:] + self._carry
         wave = wave[:keep]
         if self._paged:
@@ -1120,18 +1137,16 @@ class InferenceEngine:
             for i, request in enumerate(wave):
                 slot = self._free.pop()
                 need = self._reserve_pages(request, wave_bucket)
-                shared: list[int] = []
-                if request.reuse_len:
-                    shared = request.shared_pages
-                    self._prefix.acquire(shared)
-                    need -= len(shared)
+                shared = request.shared_pages  # acquired at formation
+                need -= len(shared)
                 pages = self._alloc_with_eviction(slot, need)
                 if pages is None:
-                    if shared:
-                        self._prefix.release(shared)
-                        request.reuse_len = 0
-                        request.shared_pages = []
                     self._free.append(slot)
+                    # EVERY carried member's acquisition must be undone,
+                    # or its refcount leaks and the pages become
+                    # unevictable forever
+                    for carried in wave[i:]:
+                        self._drop_reuse_plan(carried)
                     self._carry = wave[i:] + self._carry
                     break
                 request.slot = slot
@@ -1148,6 +1163,8 @@ class InferenceEngine:
                 self._page_alloc.free(request.slot)
                 self._free.append(request.slot)
                 request.slot = -1
+                request.pages = []
+                self._drop_reuse_plan(request)
             self._carry = wave[keep:] + self._carry
             wave = wave[:keep]
         else:
@@ -1630,8 +1647,9 @@ class InferenceEngine:
         ps = self.runtime.page_size
         full = len(request.prompt) // ps
         if len(request.page_hashes) < full:
-            # non-head wave members skip reuse PLANNING (a reusing head
-            # rides a singleton wave) but still register their pages
+            # safety net only: _plan_prefix_reuse hashes every planned
+            # request, so this recompute should be unreachable — but
+            # registration must never index past a stale hash list
             from calfkit_tpu.inference.paged import chain_hashes
 
             request.page_hashes = chain_hashes(request.prompt, ps)

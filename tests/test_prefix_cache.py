@@ -213,8 +213,76 @@ class TestEngineReuse:
 
         asyncio.run(run())
 
+    def test_trimmed_reusing_request_releases_its_acquisition(self):
+        """A reusing request trimmed out of a wave (power-of-two keep)
+        must release its formation-time page acquisition — a leaked
+        refcount would make those pages unevictable forever (review
+        finding r5)."""
+
+        async def run() -> None:
+            engine = InferenceEngine(CFG, _runtime(), seed=17)
+            await engine.start()
+            prompt = [(23 * i + 11) % CFG.vocab_size for i in range(40)]
+            await _generate(engine, prompt, n=3)  # populate
+            # 3 concurrent reusers: wave forms as 3, trims to 2, carries 1
+            # (which re-plans and serves next pass)
+            results = await asyncio.gather(
+                *[_generate(engine, prompt, n=3) for _ in range(3)]
+            )
+            assert all(r == results[0] for r in results)
+            alloc, cache = engine._page_alloc, engine._prefix
+            assert alloc.free_pages + cache.size == 64 - 1
+            assert not alloc.held_slots
+            # nothing holds references anymore: the WHOLE cache drains
+            assert cache.evict(cache.size, alloc) >= 1
+            assert alloc.free_pages == 64 - 1
+            await engine.stop()
+
+        asyncio.run(run())
+
     def test_prefix_cache_requires_paged_and_chunked(self):
         with pytest.raises(ValueError, match="paged"):
             InferenceEngine(CFG, _runtime(kv_layout="dense"))
         with pytest.raises(ValueError, match="chunked"):
             InferenceEngine(CFG, _runtime(chunked_prefill=False))
+
+
+class TestAgentServingReuse:
+    def test_repeat_agent_runs_reuse_instruction_prefix(self):
+        """The product story: two runs of the same agent re-send the same
+        rendered instructions+prompt; with prefix_cache on, the second
+        run's prefill reuses the first's pages — measured end-to-end
+        through client -> mesh -> agent -> engine."""
+
+        async def run() -> None:
+            from calfkit_tpu import Agent, Client, InMemoryMesh, Worker
+            from calfkit_tpu.inference.client import JaxLocalModelClient
+
+            engine = InferenceEngine(
+                CFG,
+                _runtime(max_seq_len=512, num_kv_pages=160, max_batch_size=2),
+                seed=21,
+            )
+            model = JaxLocalModelClient(engine=engine, max_new_tokens=4)
+            agent = Agent(
+                name="cached",
+                model=model,
+                instructions=(
+                    "You are a terse assistant for the prefix-cache test. "
+                    "Answer with the shortest possible reply every time. "
+                    "This instruction block is deliberately long enough to "
+                    "span several KV pages so reuse is measurable."
+                ),
+            )
+            mesh = InMemoryMesh()
+            async with Worker([agent], mesh=mesh):
+                client = Client.connect(mesh)
+                await client.agent("cached").execute("hello there", timeout=60)
+                assert engine.stats.prefix_reused_tokens == 0
+                await client.agent("cached").execute("hello there", timeout=60)
+                assert engine.stats.prefix_hits >= 1
+                assert engine.stats.prefix_reused_tokens > 0
+                await client.close()
+            await engine.stop()
+
+        asyncio.run(run())
