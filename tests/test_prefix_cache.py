@@ -240,6 +240,27 @@ class TestEngineReuse:
 
         asyncio.run(run())
 
+    def test_reuse_on_tp_sharded_mesh(self):
+        """Shared pages under GSPMD: the seed gather runs over a pool
+        sharded on the KV-head axis (tp=2), with token parity."""
+
+        async def run() -> None:
+            from calfkit_tpu.inference.sharding import make_mesh
+
+            engine = InferenceEngine(
+                CFG, _runtime(tp=2, dp=1), mesh=make_mesh(tp=2, dp=1),
+                seed=19,
+            )
+            await engine.start()
+            prompt = [(29 * i + 13) % CFG.vocab_size for i in range(50)]
+            first = await _generate(engine, prompt, n=6)
+            second = await _generate(engine, prompt, n=6)
+            assert second == first
+            assert engine.stats.prefix_hits == 1
+            await engine.stop()
+
+        asyncio.run(run())
+
     def test_prefix_cache_requires_paged_and_chunked(self):
         with pytest.raises(ValueError, match="paged"):
             InferenceEngine(CFG, _runtime(kv_layout="dense"))
