@@ -47,3 +47,33 @@ def pytest_pyfunc_call(pyfuncitem: pytest.Function):
     return True
 
 
+
+
+async def churn_abandon(engine, prompt, rng, max_new_tokens=12):
+    """One churn consumer: stream, abandoning mid-stream a third of the
+    time (the cancellation path).  Shared by the paged churn stress and
+    its prefix-cache variant so the harness cannot silently diverge."""
+    agen = engine.generate(prompt, max_new_tokens=max_new_tokens)
+    got = 0
+    try:
+        async for _ in agen:
+            got += 1
+            if rng.random() < 0.33 and got >= 2:
+                break
+    finally:
+        await agen.aclose()
+    return got
+
+
+async def drain_engine(engine):
+    """Wait (bounded) for slots/queues/pages to fully drain; callers
+    assert the final state so a timeout fails LOUDLY."""
+    import asyncio as _asyncio
+
+    for _ in range(100):
+        if (
+            not engine._active and not engine._pending
+            and not engine._carry and not engine._page_alloc.held_slots
+        ):
+            break
+        await _asyncio.sleep(0.05)

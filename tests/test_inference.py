@@ -1279,29 +1279,15 @@ class TestEngineStress:
         )
         await engine.start()
 
-        async def one(i: int) -> int:
-            prompt = [2 + (i % 17), 3, 4, 5 + (i % 7)]
-            agen = engine.generate(prompt, max_new_tokens=12)
-            got = 0
-            try:
-                async for _ in agen:
-                    got += 1
-                    if rng.random() < 0.33 and got >= 2:
-                        break  # abandon mid-stream -> cancellation path
-            finally:
-                await agen.aclose()
-            return got
+        from tests.conftest import churn_abandon, drain_engine
 
-        counts = await asyncio.gather(*[one(i) for i in range(40)])
+        counts = await asyncio.gather(*[
+            churn_abandon(engine, [2 + (i % 17), 3, 4, 5 + (i % 7)], rng)
+            for i in range(40)
+        ])
         assert all(c >= 2 for c in counts)
         # drain: all slots free, no pages held, nothing pending
-        for _ in range(100):
-            if (
-                not engine._active and not engine._pending
-                and not engine._carry and not engine._page_alloc.held_slots
-            ):
-                break
-            await asyncio.sleep(0.05)
+        await drain_engine(engine)
         # loud on timeout: a leak in ANY of the four pools must fail, not
         # silently fall through the wait loop
         assert not engine._active and not engine._pending and not engine._carry

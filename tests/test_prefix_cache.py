@@ -261,6 +261,49 @@ class TestEngineReuse:
 
         asyncio.run(run())
 
+    def test_churn_with_random_cancels_under_prefix_cache(self):
+        """The paged churn stress (40 requests, third abandon mid-stream)
+        with caching ON and prompts drawn from a small shared pool: slots
+        drain, and every page is free or cache-held — cancellation under
+        reuse releases acquisitions like clean retirement does."""
+
+        async def run() -> None:
+            import random
+
+            from tests.conftest import churn_abandon, drain_engine
+
+            rng = random.Random(7)
+            engine = InferenceEngine(CFG, _runtime(), seed=23)
+            await engine.start()
+            prompts = [
+                [(p * 13 + j) % CFG.vocab_size for j in range(36)]
+                for p in range(3)
+            ]
+            counts = await asyncio.gather(*[
+                churn_abandon(engine, prompts[i % 3], rng)
+                for i in range(40)
+            ])
+            assert all(c >= 2 for c in counts)
+            await drain_engine(engine)
+            assert not engine._active and not engine._pending
+            assert not engine._carry
+            assert not engine._page_alloc.held_slots
+            assert sorted(engine._free) == list(range(4))
+            # the retire heap must not pin any retired request's memory
+            assert all(e[2] is None for e in engine._retire_heap)
+            alloc, cache = engine._page_alloc, engine._prefix
+            assert alloc.free_pages + cache.size == 64 - 1
+            assert engine.stats.prefix_hits > 0  # reuse really happened
+            # draining the cache returns the pool to exactly full
+            cache.evict(cache.size, alloc)
+            assert alloc.free_pages == 64 - 1
+            # engine still serves correctly after the churn
+            out = await _generate(engine, prompts[0], n=5)
+            assert len(out) == 5
+            await engine.stop()
+
+        asyncio.run(run())
+
     def test_prefix_cache_requires_paged_and_chunked(self):
         with pytest.raises(ValueError, match="paged"):
             InferenceEngine(CFG, _runtime(kv_layout="dense"))
