@@ -1,8 +1,11 @@
 """Every example must actually run — as a subprocess, exactly as documented.
 
 The reference ships examples as living documentation; here they are kept
-living by CI.  Each run uses the in-memory mesh and deterministic models, so
-the suite needs no broker, no weights, no network.
+living by CI.  Each run uses the in-memory mesh and deterministic models
+(no broker, no weights, no network) — except ``local_serving``, which
+deliberately runs the REAL inference engine on the debug preset with
+random weights (its assertion is about prefix-cache stats, not output
+content).
 """
 
 import os
@@ -29,6 +32,8 @@ EXAMPLES = [
      "second pass: ok"),
     ("rpc_worker", "examples/rpc_worker.py", "HELLO MESH RPC"),
     ("kafka_mesh", "examples/kafka_mesh.py", "RESULT over kafka:"),
+    ("local_serving", "examples/local_serving/agent_on_engine.py",
+     "prefix cache reused"),
 ]
 
 
