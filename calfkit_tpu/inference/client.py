@@ -284,6 +284,10 @@ class JaxLocalModelClient(ModelClient):
         }
         if engine._paged:
             snapshot["free_pages"] = engine._page_alloc.free_pages
+            if engine._prefix is not None:
+                snapshot["prefix_cached_pages"] = engine._prefix.size
+                snapshot["prefix_hits"] = stats.prefix_hits
+                snapshot["prefix_reused_tokens"] = stats.prefix_reused_tokens
         try:  # accelerator memory pressure, where the backend reports it
             mem = jax.local_devices()[0].memory_stats() or {}
             if "bytes_in_use" in mem:
