@@ -350,3 +350,43 @@ class TestAgentServingReuse:
             await engine.stop()
 
         asyncio.run(run())
+
+
+class TestMultiTenantSharedEngine:
+    def test_two_agents_share_one_engine_with_distinct_prefixes(self):
+        """Multi-tenant serving: two agents ride ONE model client/engine;
+        each agent's instruction prefix caches independently (chained
+        hashes keep them distinct) and both keep serving concurrently."""
+
+        async def run() -> None:
+            from calfkit_tpu import Agent, Client, InMemoryMesh, Worker
+            from calfkit_tpu.inference.client import JaxLocalModelClient
+
+            engine = InferenceEngine(
+                CFG,
+                _runtime(max_seq_len=512, num_kv_pages=200, max_batch_size=4),
+                seed=29,
+            )
+            model = JaxLocalModelClient(engine=engine, max_new_tokens=4)
+            pad = "This block spans multiple KV pages for reuse. " * 3
+            alpha = Agent(name="alpha", model=model,
+                          instructions="You are agent ALPHA. " + pad)
+            beta = Agent(name="beta", model=model,
+                         instructions="You are agent BETA.  " + pad)
+            mesh = InMemoryMesh()
+            async with Worker([alpha, beta], mesh=mesh):
+                client = Client.connect(mesh)
+                for _ in range(2):  # second round reuses BOTH prefixes
+                    await asyncio.gather(
+                        client.agent("alpha").execute("go", timeout=120),
+                        client.agent("beta").execute("go", timeout=120),
+                    )
+                assert engine.stats.prefix_hits >= 2
+                assert engine.stats.prefix_reused_tokens > 0
+                await client.close()
+            alloc, cache = engine._page_alloc, engine._prefix
+            assert alloc.free_pages + cache.size == 200 - 1
+            assert not alloc.held_slots
+            await engine.stop()
+
+        asyncio.run(run())
