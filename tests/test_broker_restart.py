@@ -19,6 +19,7 @@ from calfkit_tpu.mesh.kafka_wire import (
     ERR_OFFSET_OUT_OF_RANGE,
     KafkaWireClient,
     KafkaWireMesh,
+    decode_record_batches,
     encode_record_batch,
     find_kafkad,
     spawn_kafkad,
@@ -237,6 +238,64 @@ class TestBrokerRestart:
             # was cut, not appended after — review finding r5)
             proc = spawn_kafkad(port, log_dir=str(tmp_path))
             asyncio.run(check([b"kept", b"after-crash"], produce=None))
+        finally:
+            proc.terminate()
+            proc.wait(timeout=5)
+
+    def test_wal_mid_file_corruption_cuts_at_last_good_frame(self, tmp_path):
+        """A flipped byte INSIDE an early WAL frame: replay keeps every
+        frame before it, drops everything after (chain integrity — a
+        half-trusted log is worse than a short one), and truncates so
+        post-restart writes land cleanly."""
+        port = _free_port()
+
+        async def fetch_values(*, produce: bytes | None = None) -> list[bytes]:
+            client = KafkaWireClient("127.0.0.1", port)
+            try:
+                results = await client.fetch([("mid", 0, 0)], max_wait_ms=200)
+                values = [
+                    v for *_x, v, _h in decode_record_batches(results[0][3])
+                ]
+                if produce is not None:
+                    await client.produce(
+                        "mid", 0,
+                        encode_record_batch([(b"k", produce, [])], 2),
+                    )
+                return values
+            finally:
+                await client.close()
+
+        async def seed() -> None:
+            client = KafkaWireClient("127.0.0.1", port)
+            try:
+                await client.create_topics(["mid"], 1)
+                for value in (b"one", b"two", b"three"):
+                    await client.produce(
+                        "mid", 0, encode_record_batch([(b"k", value, [])], 1)
+                    )
+            finally:
+                await client.close()
+
+        proc = spawn_kafkad(port, log_dir=str(tmp_path))
+        try:
+            asyncio.run(seed())
+            proc.kill()
+            proc.wait(timeout=5)
+            wal = (tmp_path / "wal.log").read_bytes()
+            # flip a byte ~60% in: inside the frame holding "two"
+            corrupt = bytearray(wal)
+            corrupt[int(len(corrupt) * 0.6)] ^= 0xFF
+            (tmp_path / "wal.log").write_bytes(bytes(corrupt))
+
+            proc = spawn_kafkad(port, log_dir=str(tmp_path))
+            values = asyncio.run(fetch_values(produce=b"post"))
+            # a strict prefix survived; nothing after the corruption
+            assert values in ([b"one"], [b"one", b"two"]), values
+
+            proc.terminate()
+            proc.wait(timeout=5)
+            proc = spawn_kafkad(port, log_dir=str(tmp_path))
+            assert asyncio.run(fetch_values())[-1] == b"post"
         finally:
             proc.terminate()
             proc.wait(timeout=5)
