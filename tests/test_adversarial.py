@@ -309,3 +309,64 @@ class TestFanoutCrashResume:
         await fan_mesh_b.stop()
         await tool_mesh.stop()
         await caller_mesh.stop()
+
+
+class TestHostileClientInbox:
+    async def test_inbox_junk_barrage_does_not_break_live_runs(self):
+        """A hostile/buggy producer blasts the client's inbox (non-JSON,
+        non-object JSON, junk step/envelope frames, a VALID envelope with
+        an unknown correlation): the client's decode floor must absorb it
+        all — in-flight runs complete, later runs work, nothing crashes."""
+        import json
+        import random
+
+        from calfkit_tpu import Agent, Client, InMemoryMesh, Worker, protocol
+        from calfkit_tpu.engine import EchoModelClient
+
+        rng = random.Random(67)
+        mesh = InMemoryMesh()
+        agent = Agent(name="steady", model=EchoModelClient(),
+                      instructions="reply")
+        async with Worker([agent], mesh=mesh):
+            client = Client.connect(mesh)
+            inbox = client.inbox_topic
+
+            async def blast() -> None:
+                for i in range(60):
+                    kind = i % 5
+                    if kind == 0:  # non-JSON
+                        value = rng.randbytes(rng.randint(1, 200))
+                        headers = {protocol.HDR_WIRE: "envelope",
+                                   protocol.HDR_CORRELATION: "junk"}
+                    elif kind == 1:  # JSON non-object
+                        value = json.dumps([1, 2, 3]).encode()
+                        headers = {protocol.HDR_WIRE: "envelope",
+                                   protocol.HDR_CORRELATION: "junk"}
+                    elif kind == 2:  # junk step frame
+                        value = b'{"steps": "not-a-list"}'
+                        headers = {protocol.HDR_WIRE: "step",
+                                   protocol.HDR_CORRELATION: "junk"}
+                    elif kind == 3:  # headerless garbage
+                        value = rng.randbytes(32)
+                        headers = {}
+                    else:  # VALID envelope, unknown correlation
+                        from calfkit_tpu.models.session_context import Envelope
+                        from calfkit_tpu.models import ReturnMessage, TextPart
+
+                        value = Envelope(reply=ReturnMessage(
+                            parts=[TextPart(text="stray")]
+                        )).to_wire()
+                        headers = {protocol.HDR_WIRE: "envelope",
+                                   protocol.HDR_CORRELATION: f"ghost-{i}",
+                                   protocol.HDR_TASK: "ghost"}
+                    await mesh.publish(inbox, value, key=b"junk",
+                                       headers=headers)
+                    await asyncio.sleep(0)
+
+            run = client.agent("steady").execute("are you alive", timeout=30)
+            result, _ = await asyncio.gather(run, blast())
+            assert result.output
+            # the client keeps serving after the barrage
+            again = await client.agent("steady").execute("still?", timeout=30)
+            assert again.output
+            await client.close()
