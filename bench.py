@@ -247,8 +247,14 @@ async def run() -> dict:
     ttft_p50_ms, ttft_error, ttft_transport = await _ttft_phase(engine)
     await engine.stop()
 
+    spec_row = await _spec_phase(model, cfg)
+
     total = sum(counts)
     wall_tps = total / wall / n_dev
+    # the 2,000 tok/s/chip bar is STATED for Llama-3-8B TP=8 — comparing a
+    # smaller model's throughput against it flatters the number, so any
+    # other config reports vs_baseline: null with an explicit note
+    is_baseline_model = model.name == "llama-3-8b"
     return {
         "metric": (
             f"decode_tok_s_per_chip[{model.name} bs={cfg['bs']}"
@@ -258,8 +264,16 @@ async def run() -> dict:
         ),
         "value": round(wall_tps, 1),
         "unit": "tok/s/chip",
-        "vs_baseline": round(wall_tps / 2000.0, 3),
+        "vs_baseline": (
+            round(wall_tps / 2000.0, 3) if is_baseline_model else None
+        ),
+        **(
+            {}
+            if is_baseline_model
+            else {"vs_baseline_note": "baseline_model_mismatch"}
+        ),
         "detail": {
+            **({"speculative": spec_row} if spec_row else {}),
             "decode_only_tok_s_per_chip": round(decode_tps, 1),
             "mean_batch_occupancy": round(mean_occupancy, 3),
             # dispatch counts per occupancy quartile [0-25%, .., 75-100%]
@@ -275,6 +289,73 @@ async def run() -> dict:
             **_perf_model(model, cfg, wall_tps, mean_occupancy),
         },
     }
+
+
+async def _spec_phase(model, cfg) -> dict | None:
+    """Speculative-decoding row: a fresh engine at the same model config
+    with the n-gram drafter on, driven by agent-shaped (self-repetitive)
+    prompts.  Reports measured tokens_per_dispatch and acceptance_rate —
+    the speculation win is measured here, never asserted (SPEC_DECODE.json
+    carries the host-stub scheduler-level artifact)."""
+    import time as _time
+
+    from calfkit_tpu.inference.config import RuntimeConfig, SpecConfig
+    from calfkit_tpu.inference.engine import InferenceEngine
+
+    if model.param_count > 2e9:
+        # the spec row builds a SECOND engine with fresh random params; at
+        # 8B that doubles HBM for an auxiliary detail row — skip (the
+        # host-stub SPEC_DECODE.json artifact carries speculation evidence)
+        return {"skipped": "model too large for the auxiliary spec row"}
+    engine = None
+    try:
+        runtime = RuntimeConfig(
+            max_batch_size=min(8, cfg["bs"]),
+            max_seq_len=cfg["max_seq"],
+            prefill_chunk=cfg["prefill_chunk"],
+            decode_steps_per_dispatch=cfg["steps"],
+            quantization=cfg.get("quantization"),
+            kv_layout=cfg.get("kv_layout", "dense"),
+            num_kv_pages=cfg.get("num_kv_pages", 0),
+            speculative=SpecConfig(k=4),
+        )
+        engine = InferenceEngine(model, runtime)
+        await engine.start()
+        pattern = [11, 7, 23, 5, 17, 9, 13, 3]
+        new_tokens = min(cfg["new_tokens"], 32)
+
+        async def one(i: int) -> int:
+            # repeated structure = the n-gram drafter's home turf
+            prompt = ([31 + i] + pattern * 3)[: cfg["max_seq"] // 4]
+            n = 0
+            async for _ in engine.generate(prompt, max_new_tokens=new_tokens):
+                n += 1
+            return n
+
+        await asyncio.gather(*[one(i) for i in range(4)])  # warm compiles
+        from calfkit_tpu.inference.engine import EngineStats
+
+        stats = engine.stats = EngineStats()
+        started = _time.perf_counter()
+        counts = await asyncio.gather(*[one(i) for i in range(16)])
+        wall = _time.perf_counter() - started
+        return {
+            "drafter": "ngram",
+            "k": 4,
+            "requests": len(counts),
+            "tokens_per_dispatch": round(stats.tokens_per_dispatch, 3),
+            "acceptance_rate": round(stats.acceptance_rate, 4),
+            "spec_proposed": stats.spec_proposed,
+            "spec_accepted": stats.spec_accepted,
+            "wall_tok_s": round(sum(counts) / wall, 1),
+        }
+    except Exception as e:  # noqa: BLE001 - the spec row is auxiliary detail
+        return {"error": f"{type(e).__name__}: {e}"}
+    finally:
+        # a leaked engine would keep its scheduler task + a whole second
+        # model's HBM alive through the remaining bench phases
+        if engine is not None:
+            await engine.stop()
 
 
 class _BenchTokenizer:
@@ -664,7 +745,7 @@ def main() -> None:
             "metric": "decode_tok_s_per_chip[unrunnable]",
             "value": 0.0,
             "unit": "tok/s/chip",
-            "vs_baseline": 0.0,
+            "vs_baseline": None,
         }
         error = (error or "") + (
             f" | cpu fallback failed rc={rc}: {(out + chr(10) + err)[-400:]}"

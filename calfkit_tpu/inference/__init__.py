@@ -11,13 +11,19 @@ inference class is actually constructed.
 
 from typing import Any
 
-from calfkit_tpu.inference.config import ModelConfig, PRESETS, RuntimeConfig
+from calfkit_tpu.inference.config import (
+    ModelConfig,
+    PRESETS,
+    RuntimeConfig,
+    SpecConfig,
+)
 
 __all__ = [
     "JaxLocalModelClient",
     "ModelConfig",
     "PRESETS",
     "RuntimeConfig",
+    "SpecConfig",
     "assert_engine_fits",
     "initialize_multihost",
 ]

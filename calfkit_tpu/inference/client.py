@@ -151,6 +151,8 @@ class JaxLocalModelClient(ModelClient):
         tool_call_parser: ToolCallParser = default_tool_call_parser,
         max_new_tokens: int = 512,
         seed: int = 0,
+        draft_checkpoint: str | None = None,  # speculative draft weights
+        draft_params: Any = None,
     ):
         self._checkpoint = checkpoint
         self._config_spec = config
@@ -161,6 +163,8 @@ class JaxLocalModelClient(ModelClient):
         self._parser = tool_call_parser
         self._max_new_tokens = max_new_tokens
         self._seed = seed
+        self._draft_checkpoint = draft_checkpoint
+        self._draft_params = draft_params
         self._start_lock: asyncio.Lock | None = None
 
     @property
@@ -201,6 +205,28 @@ class JaxLocalModelClient(ModelClient):
         from calfkit_tpu.inference.sharding import make_mesh, param_shardings
 
         runtime = self._runtime or RuntimeConfig()
+        draft_params = self._draft_params
+        if self._draft_checkpoint is not None and draft_params is None:
+            if runtime.speculative is None or runtime.speculative.draft is None:
+                # same loudness as the engine's draft_params validation: a
+                # draft checkpoint that silently never loads would leave
+                # the user speculating on the wrong drafter
+                raise InferenceError(
+                    "draft_checkpoint given but RuntimeConfig.speculative"
+                    ".draft is unset — set SpecConfig(draft=<ModelConfig>)"
+                )
+            # the draft model loads through the SAME loader/sharding path
+            # as the target (its own, smaller, config)
+            from calfkit_tpu.inference.loader import load_params as _load
+
+            draft_cfg = runtime.speculative.draft
+            draft_params = _load(
+                self._draft_checkpoint,
+                draft_cfg,
+                param_shardings(
+                    draft_cfg, make_mesh(tp=runtime.tp, dp=runtime.dp)
+                ),
+            )
         params = None
         if self._checkpoint is not None:
             from calfkit_tpu.inference.loader import config_from_hf, load_params
@@ -227,6 +253,7 @@ class JaxLocalModelClient(ModelClient):
             return InferenceEngine(
                 config, runtime, params=params, mesh=mesh,
                 sampling=self._sampling, seed=self._seed,
+                draft_params=draft_params,
             )
         if isinstance(self._config_spec, str):
             config = preset(self._config_spec)
@@ -237,7 +264,8 @@ class JaxLocalModelClient(ModelClient):
                 "JaxLocalModelClient needs a checkpoint path or a config"
             )
         return InferenceEngine(
-            config, runtime, sampling=self._sampling, seed=self._seed
+            config, runtime, sampling=self._sampling, seed=self._seed,
+            draft_params=draft_params,
         )
 
     def _default_tokenizer(self) -> Any:
@@ -282,6 +310,18 @@ class JaxLocalModelClient(ModelClient):
             "decode_tokens": stats.decode_tokens,
             "decode_dispatches": stats.decode_dispatches,
         }
+        if rt.speculative is not None:
+            snapshot["speculative"] = {
+                "k": rt.speculative.k,
+                "drafter": (
+                    "draft-model" if rt.speculative.draft is not None
+                    else "ngram"
+                ),
+                "spec_proposed": stats.spec_proposed,
+                "spec_accepted": stats.spec_accepted,
+                "acceptance_rate": round(stats.acceptance_rate, 4),
+                "tokens_per_dispatch": round(stats.tokens_per_dispatch, 3),
+            }
         if engine._paged:
             snapshot["free_pages"] = engine._page_alloc.free_pages
             if engine._prefix is not None:

@@ -48,6 +48,41 @@ class ModelConfig:
 
 
 @dataclass(frozen=True)
+class SpecConfig:
+    """Speculative-decoding knobs (off unless ``RuntimeConfig.speculative``
+    is set).
+
+    Decode is memory-bandwidth-bound: a normal decode step reads every
+    weight to emit ONE token per request.  Speculation drafts ``k``
+    candidate tokens cheaply, then a single **verify** dispatch scores all
+    k+1 positions against the KV cache — the full weight-read is amortized
+    over every accepted token.  Greedy output is token-exact vs
+    non-speculative greedy; sampled output keeps the target-model
+    distribution via rejection sampling (``sampler.spec_accept_slots``).
+
+    Two drafters behind one seam (:mod:`calfkit_tpu.inference.spec`):
+
+    - ``draft is None`` → **n-gram prompt lookup**: propose the
+      continuation of the most recent earlier occurrence of the sequence
+      tail within prompt + generated history.  No extra weights, no extra
+      device work — the agent-serving workload (tool-call JSON, repeated
+      instructions, quoted context) is exactly where it hits.
+    - ``draft`` set → a second, smaller **draft model** proposes greedily
+      from its own KV cache; loaded through the same init/loader/sharding
+      path as the target (pass ``draft_params`` to the engine for real
+      checkpoints).
+    """
+
+    k: int = 4  # drafted tokens per verify wave (verify scores k+1)
+    # n-gram lookup: longest/shortest tail length to match (longer tails
+    # first: more context, fewer false continuations)
+    ngram_max: int = 3
+    ngram_min: int = 1
+    # the draft-model seam: a second, smaller architecture.  None → n-gram.
+    draft: "ModelConfig | None" = None
+
+
+@dataclass(frozen=True)
 class RuntimeConfig:
     """Serving-engine knobs (reference analog: the model config block the
     TPU build adds to the provider, SURVEY.md §5 config notes)."""
@@ -86,6 +121,11 @@ class RuntimeConfig:
     long_context: bool = False
     long_new_cap: int = 512  # max new tokens a long request may generate
     long_max_prompt: int = 0  # prompt-length ceiling; 0 → 8 x max_seq_len
+    # long-lane budget negotiation: by default a request whose
+    # max_new_tokens exceeds long_new_cap FAULTS with a typed error (the
+    # caller's budget is a contract, not a suggestion); True restores the
+    # explicit opt-in behavior of clamping to the cap with a warning
+    long_clamp_new_tokens: bool = False
     # decode attention window buckets (each is one jit specialization);
     # sparse buckets = few compiles, dense = tighter HBM reads
     window_buckets: tuple[int, ...] = (256, 1024, 4096, 16384)
@@ -97,6 +137,10 @@ class RuntimeConfig:
     # kv_layout="paged" AND chunked_prefill=True (reuse seeds the chunk
     # lane's scratch and starts at the reused offset).
     prefix_cache: bool = False
+    # speculative decoding: None = off (zero change to the decode path);
+    # a SpecConfig turns every decode tick into draft-k + one batched
+    # verify dispatch scoring k+1 positions per sequence (see SpecConfig)
+    speculative: "SpecConfig | None" = None
     # weight-only quantization: "int8" halves decode HBM traffic and fits
     # Llama-3-8B on one 16 GB chip; "int4" (packed nibbles, group-128
     # scales) halves the weight stream again (~4 GB for 8B — margin for

@@ -41,7 +41,11 @@ from jax import lax
 from calfkit_tpu.exceptions import InferenceError
 from calfkit_tpu.inference import model as M
 from calfkit_tpu.inference.config import ModelConfig, RuntimeConfig
-from calfkit_tpu.inference.sampler import SamplingParams, sample_slots
+from calfkit_tpu.inference.sampler import (
+    SamplingParams,
+    sample_slots,
+    spec_accept_slots,
+)
 from calfkit_tpu.inference.sharding import (
     cache_sharding,
     make_mesh,
@@ -54,6 +58,34 @@ logger = logging.getLogger(__name__)
 _DONE = object()
 
 _ATTN_PROFILE_CACHE: "tuple[tuple, dict | None] | None" = None
+
+
+def _host_feature_tag() -> str:
+    """Fingerprint of the executing host's CPU feature set, mixed into the
+    persistent compilation-cache path.
+
+    XLA:CPU AOT artifacts embed the COMPILE machine's feature list; loading
+    one produced on a wider-featured host risks SIGILL (the stale
+    ``+amx-fp16`` cache warning in MULTICHIP_r05.json).  Keying the cache
+    directory by the host's own features makes cross-host artifact reuse
+    structurally impossible — a different machine simply compiles into its
+    own subdirectory.
+    """
+    import hashlib
+    import platform
+
+    feats = platform.machine() or "unknown"
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.lower().startswith(("flags", "features")):
+                    feats += " " + " ".join(
+                        sorted(line.split(":", 1)[-1].split())
+                    )
+                    break
+    except OSError:
+        pass  # non-Linux: the machine string alone still splits per-arch
+    return hashlib.blake2b(feats.encode(), digest_size=6).hexdigest()
 
 
 def _load_attn_profile() -> dict | None:
@@ -147,6 +179,11 @@ class GenRequest:
     stop_tokens: frozenset[int]
     sampling: SamplingParams | None = None  # None → engine default
     seed: int | None = None  # None → engine-derived per-admission stream
+    # speculative decoding only: prompt + every emitted token, maintained
+    # by _record_token — the n-gram drafter matches against it and the
+    # draft model catches its KV up from it.  None when speculation is off
+    # (the non-spec hot path never pays the append).
+    history: "list[int] | None" = None
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
     pages: list[int] = field(default_factory=list)  # paged-KV reservation
     # prefix caching: reused token count, the shared (cache-owned) page
@@ -182,6 +219,13 @@ class EngineStats:
     long_dispatches: int = 0  # sp-lane decode dispatches (whole-mesh units)
     prefix_hits: int = 0  # admissions that reused cached prefix pages
     prefix_reused_tokens: int = 0  # prompt tokens NOT re-prefilled
+    # speculative decoding: drafts offered to verify dispatches, and how
+    # many were accepted (each accepted draft is a token the engine did
+    # NOT pay a full weight-read dispatch for)
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    spec_emitted: int = 0  # tokens emitted by verify dispatches (device)
+    spec_rows: int = 0  # Σ over verify dispatches of active rows
 
     @property
     def tokens_per_second(self) -> float:
@@ -192,6 +236,25 @@ class EngineStats:
         if not self.decode_dispatches:
             return 0.0
         return self.occupancy_sum / self.decode_dispatches
+
+    @property
+    def acceptance_rate(self) -> float:
+        """Fraction of drafted tokens the verify dispatch accepted."""
+        if not self.spec_proposed:
+            return 0.0
+        return self.spec_accepted / self.spec_proposed
+
+    @property
+    def tokens_per_dispatch(self) -> float:
+        """Tokens emitted PER SEQUENCE per verify dispatch — the axis
+        speculation moves: 1.0 is the non-speculative ratio (one forward,
+        one token), k+1 is full acceptance; every point above 1 is a
+        weight read the sequence did not pay for.  Batch-aggregate
+        throughput is a different axis (occupancy) — this metric
+        deliberately excludes it."""
+        if not self.spec_rows:
+            return 0.0
+        return self.spec_emitted / self.spec_rows
 
 
 class InferenceEngine:
@@ -204,6 +267,7 @@ class InferenceEngine:
         mesh: Any = None,
         sampling: SamplingParams | None = None,
         seed: int = 0,
+        draft_params: Any = None,  # speculative draft-model weights
     ):
         self.config = config
         self.runtime = runtime or RuntimeConfig()
@@ -211,13 +275,19 @@ class InferenceEngine:
         rt = self.runtime
         if rt.compilation_cache_dir:
             # persistent XLA cache: window/prefill specializations compile
-            # once per machine, not once per process
+            # once per machine, not once per process.  The directory is
+            # keyed by the host's CPU features (_host_feature_tag): AOT
+            # artifacts from a differently-featured machine must never
+            # load here (SIGILL risk — MULTICHIP_r05 postmortem).
             import os
 
             try:
                 jax.config.update(
                     "jax_compilation_cache_dir",
-                    os.path.expanduser(rt.compilation_cache_dir),
+                    os.path.join(
+                        os.path.expanduser(rt.compilation_cache_dir),
+                        f"host-{_host_feature_tag()}",
+                    ),
                 )
                 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
             except Exception:  # noqa: BLE001 - cache is best-effort
@@ -278,6 +348,19 @@ class InferenceEngine:
                 f"max_prefill_wave must be a power of two "
                 f"(got {rt.max_prefill_wave})"
             )
+        self._spec = rt.speculative
+        self._drafter: Any = None
+        if self._spec is not None:
+            if self._spec.k < 1:
+                raise ValueError(
+                    f"speculative.k must be >= 1 (got {self._spec.k})"
+                )
+            if self._spec.draft is None and draft_params is not None:
+                raise ValueError(
+                    "draft_params given but speculative.draft is unset"
+                )
+        elif draft_params is not None:
+            raise ValueError("draft_params given but speculation is off")
         self.params = place_params(params, shardings)
 
         B, S = rt.max_batch_size, rt.max_seq_len
@@ -382,8 +465,20 @@ class InferenceEngine:
         self._running = False
         self.stats = EngineStats()
 
-        self._decode_jits: dict[tuple[int, int], Any] = {}  # (window, steps)
-        self._prefill_jits: dict[tuple[int, int], Any] = {}
+        self._decode_jits: dict[tuple, Any] = {}  # (window, steps, ...)
+        self._prefill_jits: dict[tuple, Any] = {}
+        if self._spec is not None:
+            from calfkit_tpu.inference.spec import build_drafter
+
+            self._drafter = build_drafter(
+                self._spec, rt, self.mesh,
+                draft_params=draft_params, seed=seed + 3,
+            )
+            logger.info(
+                "speculative decoding on: %s drafter, k=%d",
+                "draft-model" if self._spec.draft is not None else "ngram",
+                self._spec.k,
+            )
 
     # ------------------------------------------------------------ jit build
     def _resolved_attn_impl(self, path: str = "decode") -> str:
@@ -533,6 +628,90 @@ class InferenceEngine:
 
         fn = jax.jit(decode, donate_argnums=(1, 2))
         self._decode_jits[(wpages, steps, sampled, "paged")] = fn
+        return fn
+
+    def _verify_jit(self, window: int, S: int, sampled: bool) -> Any:
+        """The speculative VERIFY dispatch: feed [last, d_0..d_{S-2}] per
+        row, score all S positions in one forward against the cache,
+        accept a ragged per-row prefix (``sampler.spec_accept_slots``),
+        consolidate the chunk's K/V, and advance each row's length by its
+        own ``emitted`` — ragged acceptance needs no physical rollback
+        because rejected slots land beyond the advanced length and the
+        next wave's chunk overwrites them (the same garbage-beyond-length
+        law the decode ring already relies on)."""
+        if self._paged:
+            return self._verify_jit_paged(window, S, sampled)
+        key = ("verify", window, S, sampled)
+        fn = self._decode_jits.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.config
+        attn_impl = self._resolved_attn_impl("decode")
+
+        def verify(params, k, v, last, lens, active, drafts, ndraft,
+                   slot_keys, temp, top_k, top_p):
+            kw = k[:, :, :, :window]
+            vw = v[:, :, :, :window]
+            tokens = jnp.concatenate([last[:, None], drafts], axis=1)
+            logits, ring = M.verify_step_ring(
+                params, cfg, tokens, (kw, vw), lens, attn_impl=attn_impl
+            )
+            out_toks, emitted = spec_accept_slots(
+                logits, drafts, ndraft, lens, slot_keys, temp, top_k,
+                top_p, sampled=sampled,
+            )
+            emitted = jnp.where(active, emitted, 0)
+            k, v = M.consolidate_ring((k, v), ring, lens)
+            idx = jnp.clip(emitted - 1, 0, S - 1)
+            new_last = jnp.where(
+                active,
+                jnp.take_along_axis(out_toks, idx[:, None], axis=1)[:, 0],
+                last,
+            )
+            return k, v, new_last, lens + emitted, out_toks, emitted
+
+        fn = jax.jit(verify, donate_argnums=(1, 2))
+        self._decode_jits[key] = fn
+        return fn
+
+    def _verify_jit_paged(self, window: int, S: int, sampled: bool) -> Any:
+        page = self.runtime.page_size
+        wpages = -(-window // page)
+        key = ("verify", wpages, S, sampled, "paged")
+        fn = self._decode_jits.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.config
+        attn_impl = self._resolved_attn_impl("paged_decode")
+
+        def verify(params, k, v, tables, last, lens, active, drafts,
+                   ndraft, slot_keys, temp, top_k, top_p):
+            tokens = jnp.concatenate([last[:, None], drafts], axis=1)
+            logits, ring = M.verify_step_ring_paged(
+                params, cfg, tokens, (k, v), tables, lens,
+                wpages=wpages, attn_impl=attn_impl,
+            )
+            out_toks, emitted = spec_accept_slots(
+                logits, drafts, ndraft, lens, slot_keys, temp, top_k,
+                top_p, sampled=sampled,
+            )
+            emitted = jnp.where(active, emitted, 0)
+            # inactive rows scatter to the trash page; writes past a
+            # row's reservation hit its table row's trash padding —
+            # shared (prefix-cache) pages are never touched because the
+            # chunk starts at lens >= prompt_len, past every registered
+            # page (the same invariant plain decode relies on)
+            k2, v2 = M.consolidate_ring_paged((k, v), ring, tables, lens, active)
+            idx = jnp.clip(emitted - 1, 0, S - 1)
+            new_last = jnp.where(
+                active,
+                jnp.take_along_axis(out_toks, idx[:, None], axis=1)[:, 0],
+                last,
+            )
+            return k2, v2, new_last, lens + emitted, out_toks, emitted
+
+        fn = jax.jit(verify, donate_argnums=(1, 2))
+        self._decode_jits[key] = fn
         return fn
 
     def _short_steps(self) -> int:
@@ -826,12 +1005,30 @@ class InferenceEngine:
             sampling=sampling,
             seed=seed,
         )
+        if self._drafter is not None and not long_lane:
+            # drafters read prompt + emitted history (the long lane decodes
+            # through its own sp dispatch and never speculates)
+            request.history = list(prompt)
         if long_lane:
             if max_new_tokens > self.runtime.long_new_cap:
-                # the carried fresh cache is statically sized by the cap
+                # the carried fresh cache is statically sized by the cap,
+                # so the budget CANNOT be honored — fault by default (the
+                # caller's token budget is a contract; silently shrinking
+                # it corrupted downstream accounting) unless the caller
+                # explicitly negotiated clamping via the config flag
+                if not self.runtime.long_clamp_new_tokens:
+                    raise InferenceError(
+                        f"long-context request asked for {max_new_tokens} "
+                        f"new tokens but long_new_cap is "
+                        f"{self.runtime.long_new_cap}; lower "
+                        f"max_new_tokens, raise RuntimeConfig.long_new_cap, "
+                        "or opt in to clamping with "
+                        "RuntimeConfig(long_clamp_new_tokens=True)"
+                    )
                 request.max_new_tokens = self.runtime.long_new_cap
                 logger.warning(
-                    "long request clamped to long_new_cap=%d new tokens",
+                    "long request clamped to long_new_cap=%d new tokens "
+                    "(long_clamp_new_tokens=True)",
                     self.runtime.long_new_cap,
                 )
             if not self._effective_sampling(request).is_greedy:
@@ -906,7 +1103,11 @@ class InferenceEngine:
                     progressed = await self._admit()
                 progressed |= await self._advance_long()
                 if self._active:
-                    await asyncio.to_thread(self._decode_tick)
+                    await asyncio.to_thread(
+                        self._spec_decode_tick
+                        if self._drafter is not None
+                        else self._decode_tick
+                    )
                 elif not progressed and self._inflight is None:
                     self._wake.clear()
                     if (
@@ -1187,6 +1388,8 @@ class InferenceEngine:
                 continue
             self._active[request.slot] = request
             self._track_retirement(request)
+            if self._drafter is not None and request.history is not None:
+                self._drafter.admit(request.slot, request.prompt)
 
     async def _admit(self) -> bool:
         admitted = False
@@ -1710,18 +1913,11 @@ class InferenceEngine:
         self._k, self._v, self._last, self._lens, toks = (
             self._decode_jit(window, steps, sampled)(*args)
         )
-        with self._retire_lock:
-            self._decode_clock += steps
         for slot in self._active:
             self._host_lens[slot] += steps
         block = np.asarray(toks)  # [steps, B] — THE host sync per dispatch
         elapsed = time.perf_counter() - started
-        n_active = len(self._active)
-        self.stats.decode_dispatches += 1
-        self.stats.decode_time_s += elapsed
-        occupancy = n_active / self.runtime.max_batch_size
-        self.stats.occupancy_sum += occupancy
-        self.stats.occupancy_hist[min(3, int(occupancy * 4))] += 1
+        self._note_dispatch(elapsed, steps)
         if steps < self.runtime.decode_steps_per_dispatch:
             self.stats.short_dispatches += 1
         # fan tokens out with ONE event-loop marshal per dispatch: a
@@ -1764,6 +1960,108 @@ class InferenceEngine:
         if deliveries:
             self._loop.call_soon_threadsafe(_deliver_batch, deliveries)
 
+    def _note_dispatch(self, elapsed: float, clock_steps: int) -> None:
+        """Per-dispatch clock + stats shared by the plain decode tick and
+        the speculative verify tick — ONE copy of the occupancy/clock
+        accounting so the two modes cannot drift."""
+        with self._retire_lock:
+            self._decode_clock += clock_steps
+        self.stats.decode_dispatches += 1
+        self.stats.decode_time_s += elapsed
+        occupancy = len(self._active) / self.runtime.max_batch_size
+        self.stats.occupancy_sum += occupancy
+        self.stats.occupancy_hist[min(3, int(occupancy * 4))] += 1
+
+    def _spec_decode_tick(self) -> None:
+        """One speculative wave: draft up to k tokens per active request
+        (host-side n-gram lookup or the draft model), verify all of them
+        plus the next position in ONE target dispatch, emit each row's
+        accepted prefix + correction token.  Replaces ``_decode_tick``
+        when ``RuntimeConfig.speculative`` is set; everything downstream
+        (retirement authority, stop tokens, fan-out batching) is shared.
+        """
+        spec = self._spec
+        B = self.runtime.max_batch_size
+        active_mask = np.zeros((B,), bool)
+        max_len = 1
+        for slot in self._active:
+            active_mask[slot] = True
+            max_len = max(max_len, int(self._host_lens[slot]))
+        window = self._window_bucket(max_len)
+        # wave-width ceiling: k drafts + 1 correction, shrunk so no row's
+        # chunk can write past max_seq (a clamped dynamic_update_slice
+        # would slide BACKWARD over valid history — unlike the dense
+        # decode ring, where overshoot only ever lands beyond a retiring
+        # row's valid length)
+        cap = max(1, min(spec.k + 1, self.runtime.max_seq_len - max_len))
+        # draft FIRST, then size the wave to the longest actual proposal:
+        # ticks where the drafter finds nothing dispatch a 1-wide verify
+        # (a plain decode step), not a k+1-wide one
+        proposals: dict[int, list[int]] = {}
+        max_nd = 0
+        if cap > 1:
+            entries = [
+                (slot, request.history)
+                for slot, request in self._active.items()
+            ]
+            for (slot, _), proposal in zip(
+                entries, self._drafter.propose(entries)
+            ):
+                proposal = proposal[: cap - 1]
+                proposals[slot] = proposal
+                max_nd = max(max_nd, len(proposal))
+        S = min(cap, max_nd + 1)
+        drafts = np.zeros((B, S - 1), np.int32)
+        ndraft = np.zeros((B,), np.int32)
+        for slot, proposal in proposals.items():
+            drafts[slot, : len(proposal)] = proposal
+            ndraft[slot] = len(proposal)
+        sampled = any(
+            not self._effective_sampling(r).is_greedy
+            for r in self._active.values()
+        )
+        started = time.perf_counter()
+        args = [self.params, self._k, self._v]
+        if self._paged:
+            args.append(self._tables)
+        args += [
+            self._last,
+            self._lens,
+            jnp.asarray(active_mask),
+            jnp.asarray(drafts),
+            jnp.asarray(ndraft),
+            self._slot_keys,
+            self._temp,
+            self._top_k,
+            self._top_p,
+        ]
+        self._k, self._v, self._last, self._lens, out_toks, emitted = (
+            self._verify_jit(window, S, sampled)(*args)
+        )
+        out_toks = np.asarray(out_toks)  # [B, S] — THE host sync
+        emitted = np.asarray(emitted)
+        elapsed = time.perf_counter() - started
+        # clock: one verify forward ≈ one decode step of wall time; the
+        # heap horizon only drives the non-spec short-dispatch lever, so
+        # a coarse clock is fine here
+        self._note_dispatch(elapsed, 1)
+        deliveries: list[tuple[asyncio.Queue, list]] = []
+        for slot, request in list(self._active.items()):
+            count = int(emitted[slot])
+            self._host_lens[slot] += count
+            self.stats.spec_proposed += int(ndraft[slot])
+            self.stats.spec_accepted += count - 1
+            self.stats.spec_emitted += count
+            self.stats.spec_rows += 1
+            items: list = []
+            for token in out_toks[slot, :count].tolist():
+                if self._record_token(request, token, items):
+                    break
+            if items:
+                deliveries.append((request.out, items))
+        if deliveries:
+            self._loop.call_soon_threadsafe(_deliver_batch, deliveries)
+
     def _retire_slot(self, request: GenRequest) -> None:
         """Reclaim a short-lane request's slot + page reservation and drop
         the retire-heap's reference.  Bookkeeping runs BEFORE any _DONE
@@ -1771,6 +2069,8 @@ class InferenceEngine:
         slot is already free (no window where a finished request still
         occupies ``_active``)."""
         self._active.pop(request.slot, None)
+        if self._drafter is not None and request.slot != -1:
+            self._drafter.retire(request.slot)
         if self._paged:
             if self._prefix is not None and request.shared_pages:
                 # shared pages return to the CACHE (refcount), never to
@@ -1798,6 +2098,8 @@ class InferenceEngine:
         if not hit_stop:
             items.append(token)
             self.stats.decode_tokens += 1
+            if request.history is not None:  # speculation: drafter context
+                request.history.append(token)
         if long:
             # the long lane has no slot and its sequence room is the
             # statically-sized fresh cache, enforced by long_new_cap

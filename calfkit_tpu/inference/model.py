@@ -255,6 +255,7 @@ def forward(
     attn_window: int | None = None,  # static: attend only cache[..., :W, :]
     unroll: bool = False,  # static: python layer loop (the decode hot path)
     attn_impl: str = "xla",  # static: "xla" | "pallas" | "pallas_interpret"
+    insert_at: jax.Array | None = None,  # [B] explicit per-row write offset
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
     """Run the decoder over a token chunk, updating the cache functionally.
 
@@ -273,7 +274,11 @@ def forward(
     eps = config.norm_eps
     x = params["embed"][tokens]  # [B, S, D] gather
     cos, sin = rope_tables(positions, config.head_dim, config.rope_theta)
-    insert_at = seq_lens - tokens.shape[1]  # where this chunk lands per seq
+    if insert_at is None:
+        # default: the chunk is fully valid and ends at seq_lens.  An
+        # explicit insert_at serves RAGGED chunks (speculative draft
+        # catch-up: per-row valid lengths shorter than the padded width)
+        insert_at = seq_lens - tokens.shape[1]  # where this chunk lands
 
     layer_params = params["layers"]
     k_pages, v_pages = kv_cache  # [L, B, K, Smax, hd]
@@ -491,6 +496,189 @@ def logsumexp_merge(
     w1 = jnp.exp(m1 - m)
     w2 = jnp.exp(m2 - m)
     return (o1 * w1 + o2 * w2) / (z1 * w1 + z2 * w2)
+
+
+def _verify_step_with_ring(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # [B, S] fed tokens: [last, d_0, .., d_{S-2}]
+    base_lens: jax.Array,  # [B] kv length at dispatch start
+    ring_dtype: Any,
+    attn_source: Any,  # (i, q [B,S,H,hd], rk, rv, extra) -> [B, S, H, hd]
+    scan_xs: Any,
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """The shared speculative-VERIFY transformer body.
+
+    Structurally :func:`_decode_step_with_ring` generalized from one query
+    to S = k+1 queries per row: the whole drafted chunk runs as ONE forward
+    (this is the point — the full weight read is amortized over every
+    accepted token), its K/V lands densely in a chunk ring (slot j = the
+    token at position ``base_lens + j``), attention merges (main cache ⊕
+    causal chunk), and the caller consolidates the ring exactly like a
+    decode dispatch — so ragged acceptance needs NO physical rollback:
+    rejected slots simply sit beyond the advanced ``lens`` and the next
+    wave overwrites them.
+    """
+    eps = config.norm_eps
+    B, S = tokens.shape
+    positions = base_lens[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    x = params["embed"][tokens]
+    cos, sin = rope_tables(positions, config.head_dim, config.rope_theta)
+    ring_shape = (config.n_layers, S, B, config.n_kv_heads, config.head_dim)
+    ring_k = jnp.zeros(ring_shape, ring_dtype)
+    ring_v = jnp.zeros(ring_shape, ring_dtype)
+
+    def layer_body(carry, inputs):
+        x, ring_k, ring_v, i = carry
+        lp, extra = inputs
+        q, k, v = attn_qkv(x, lp, cos, sin, eps)
+        # [B, S, K, hd] -> ring layout [S, B, K, hd], written densely at
+        # layer i — same no-scatter scheme as the decode ring
+        slab = jnp.swapaxes(k, 0, 1).astype(ring_k.dtype)[None]
+        ring_k = lax.dynamic_update_slice(ring_k, slab, (i, 0, 0, 0, 0))
+        slab = jnp.swapaxes(v, 0, 1).astype(ring_v.dtype)[None]
+        ring_v = lax.dynamic_update_slice(ring_v, slab, (i, 0, 0, 0, 0))
+        attn = attn_source(
+            i,
+            q,
+            lax.dynamic_index_in_dim(ring_k, i, 0, keepdims=False),
+            lax.dynamic_index_in_dim(ring_v, i, 0, keepdims=False),
+            extra,
+        )
+        return (attn_out_mlp(x, attn, lp, eps), ring_k, ring_v, i + 1), None
+
+    (x, ring_k, ring_v, _), _ = lax.scan(
+        layer_body,
+        (x, ring_k, ring_v, jnp.int32(0)),
+        (params["layers"], scan_xs),
+    )
+    logits = lm_logits(x, params, eps)
+    return logits, (ring_k, ring_v)  # logits [B, S, V]
+
+
+def _verify_merged_attention(
+    q: jax.Array,  # [B, S, H, hd] the chunk's queries
+    k_cache: jax.Array,  # [B, K, W, hd] main cache window (read-only)
+    v_cache: jax.Array,
+    ring_k: jax.Array,  # [S, B, K, hd] this layer's chunk K
+    ring_v: jax.Array,
+    base_lens: jax.Array,  # [B]
+) -> jax.Array:
+    """Multi-query merged attention for the verify step (XLA path).
+
+    Source 1 is the main cache masked by ``base_lens`` (everything there
+    precedes every query).  Source 2 is the chunk itself with a causal
+    within-chunk mask (query j attends chunk slots 0..j — slot j IS its own
+    token).  Merged with the shared logsumexp law; one batched einsum pair
+    reads the window ONCE for all S queries (the per-token window read is
+    what speculation amortizes).
+    """
+    B, S, H, hd = q.shape
+    K = k_cache.shape[1]
+    G = H // K
+    scale = 1.0 / math.sqrt(hd)
+    qg = q.reshape(B, S, K, G, hd)
+
+    s1 = _einsum_f32("bskgh,bkwh->bkgsw", qg, k_cache) * scale
+    valid1 = jnp.arange(k_cache.shape[2])[None, :] < base_lens[:, None]
+    s1 = jnp.where(valid1[:, None, None, None, :], s1, -1e30)
+    m1 = jnp.max(s1, axis=-1, keepdims=True)
+    m1 = jnp.maximum(m1, -1e29)  # fresh rows stay finite
+    p1 = jnp.exp(s1 - m1).astype(k_cache.dtype)
+    z1 = jnp.sum(p1.astype(jnp.float32), axis=-1, keepdims=True)
+    o1 = _einsum_f32("bkgsw,bkwh->bkgsh", p1, v_cache)
+
+    s2 = _einsum_f32("bskgh,tbkh->bkgst", qg, ring_k) * scale
+    causal = (
+        jnp.arange(S, dtype=jnp.int32)[None, :]
+        <= jnp.arange(S, dtype=jnp.int32)[:, None]
+    )  # [S(query), S(chunk slot)]
+    s2 = jnp.where(causal[None, None, None, :, :], s2, -1e30)
+    m2 = jnp.max(s2, axis=-1, keepdims=True)
+    m2 = jnp.maximum(m2, -1e29)
+    p2 = jnp.exp(s2 - m2).astype(ring_k.dtype)
+    z2 = jnp.sum(p2.astype(jnp.float32), axis=-1, keepdims=True)
+    o2 = _einsum_f32("bkgst,tbkh->bkgsh", p2, ring_v)
+
+    out = logsumexp_merge((o1, m1, z1), (o2, m2, z2))  # [B, K, G, S, hd]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd).astype(q.dtype)
+
+
+def verify_step_ring(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # [B, S] fed tokens
+    kv_cache: tuple[jax.Array, jax.Array],  # window-sliced, READ-ONLY here
+    base_lens: jax.Array,  # [B]
+    attn_impl: str = "xla",
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """Speculative verify over the dense cache layout → (logits [B, S, V],
+    chunk ring [L, S, B, K, hd] ×2 for :func:`consolidate_ring`)."""
+    k_pages, v_pages = kv_cache
+    S = tokens.shape[1]
+
+    def attn_source(i, q, rk, rv, extra):
+        k_page, v_page = extra
+        if attn_impl.startswith("pallas"):
+            # host/interim fallback: the single-query merged kernel applied
+            # per chunk position — ring slot validity (0..t) IS the
+            # within-chunk causal mask, so t=j gives query j's semantics
+            # exactly.  A true multi-query kernel (the ragged-paged-
+            # attention direction, PAPERS.md arXiv:2604.15464) would read
+            # the window once instead of S times; this keeps the Pallas
+            # lane correct until that kernel lands.
+            from calfkit_tpu.inference.pallas_attention import (
+                verify_attention_pallas,
+            )
+
+            return verify_attention_pallas(
+                q, k_page, v_page, rk, rv, base_lens,
+                interpret=attn_impl == "pallas_interpret",
+            )
+        return _verify_merged_attention(q, k_page, v_page, rk, rv, base_lens)
+
+    return _verify_step_with_ring(
+        params, config, tokens, base_lens, k_pages.dtype, attn_source,
+        (k_pages, v_pages),
+    )
+
+
+def verify_step_ring_paged(
+    params: Params,
+    config: ModelConfig,
+    tokens: jax.Array,  # [B, S]
+    pool: tuple[jax.Array, jax.Array],  # [L, N, K, page, hd] READ-ONLY here
+    tables: jax.Array,  # [B, Pmax]
+    base_lens: jax.Array,  # [B]
+    wpages: int,  # static: window bucket in pages
+    attn_impl: str = "xla",
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array]]:
+    """Speculative verify reading KV through the block tables → (logits,
+    chunk ring for :func:`consolidate_ring_paged`)."""
+    pool_k, pool_v = pool
+
+    def attn_source(i, q, rk, rv, extra):
+        if attn_impl.startswith("pallas"):
+            from calfkit_tpu.inference.pallas_attention import (
+                verify_attention_paged_pallas,
+            )
+
+            return verify_attention_paged_pallas(
+                q, pool_k, pool_v, i, tables, rk, rv, base_lens,
+                wpages=wpages, interpret=attn_impl == "pallas_interpret",
+            )
+        kl = lax.dynamic_index_in_dim(pool_k, i, 0, keepdims=False)
+        vl = lax.dynamic_index_in_dim(pool_v, i, 0, keepdims=False)
+        return _verify_merged_attention(
+            q,
+            gather_window_paged(kl, tables, wpages),
+            gather_window_paged(vl, tables, wpages),
+            rk, rv, base_lens,
+        )
+
+    return _verify_step_with_ring(
+        params, config, tokens, base_lens, pool_k.dtype, attn_source, None
+    )
 
 
 def consolidate_ring(

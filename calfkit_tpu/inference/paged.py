@@ -22,9 +22,21 @@ Design decisions:
 - The allocator is plain host Python.  It is only touched from the engine's
   scheduler flow (admission on the event loop, retirement on the decode
   thread — never concurrently, same discipline as the slot free-list).
+
+Speculative decoding and pages: a verify wave writes k+1 chunk positions
+through the block tables, then acceptance advances each row's length by
+only ``accepted + 1`` — the rejected tail's K/V sits in the row's OWN
+reserved pages beyond its valid length and is overwritten by the next
+wave, so "rollback" is a length update, never a page operation.  Prefix-
+cache hashing stays consistent automatically: only FULL PAGES OF THE
+PROMPT are ever registered (``chain_hashes`` runs over the prompt alone),
+and the chunk's first write lands at ``lens >= prompt_len``, past every
+registered page — partially-accepted blocks are always private pages.
 """
 
 from __future__ import annotations
+
+from collections import OrderedDict
 
 import numpy as np
 
@@ -113,8 +125,6 @@ class PrefixCache:
     single-writer discipline as the allocator)."""
 
     def __init__(self) -> None:
-        from collections import OrderedDict
-
         self._entries: dict[bytes, int] = {}      # chain hash -> page
         self._hash_of: dict[int, bytes] = {}
         self._refs: dict[int, int] = {}            # live slot references

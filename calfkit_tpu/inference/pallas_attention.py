@@ -285,6 +285,71 @@ def merged_decode_attention_pallas(
 
 
 # --------------------------------------------------------------------------- #
+# speculative verify: k+1 queries per row against (main cache ⊕ chunk)
+# --------------------------------------------------------------------------- #
+
+
+def verify_attention_pallas(
+    q: jax.Array,  # [B, S, H, hd] the verify chunk's queries
+    k_cache: jax.Array,  # [B, K, W, hd] main-cache window
+    v_cache: jax.Array,
+    chunk_k: jax.Array,  # [S, B, K, hd] this layer's chunk K (ring layout)
+    chunk_v: jax.Array,
+    base_lens: jax.Array,  # [B]
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Multi-query verify attention on the Pallas lane (host fallback).
+
+    Decomposes the S-query verify into S single-query calls of the proven
+    decode kernel: the chunk plays the ring, and ring-slot validity
+    (``slot <= t``) at ``t = j`` IS query j's within-chunk causal mask, so
+    each call computes exactly one verify position's semantics.  Correct
+    everywhere (including interpret mode on CPU) at the cost of reading
+    the window S times; a true multi-query kernel — one window DMA
+    amortized over all k+1 queries, the "Ragged Paged Attention" shape —
+    is the follow-up once profiled on hardware.
+    """
+    S = q.shape[1]
+    outs = [
+        merged_decode_attention_pallas(
+            q[:, j : j + 1], k_cache, v_cache, chunk_k, chunk_v,
+            base_lens, jnp.int32(j), interpret=interpret,
+        )
+        for j in range(S)
+    ]
+    return jnp.concatenate(outs, axis=1)
+
+
+def verify_attention_paged_pallas(
+    q: jax.Array,  # [B, S, H, hd]
+    pool_k: jax.Array,  # [L, N, K, page, hd]
+    pool_v: jax.Array,
+    layer: jax.Array,  # scalar int32
+    tables: jax.Array,  # [B, Pmax]
+    chunk_k: jax.Array,  # [S, B, K, hd]
+    chunk_v: jax.Array,
+    base_lens: jax.Array,
+    *,
+    wpages: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged analog of :func:`verify_attention_pallas`: per chunk position,
+    the block-table kernel reads the main pages and the chunk folds in as
+    the ring — same decomposition, same follow-up kernel noted there."""
+    S = q.shape[1]
+    outs = [
+        merged_paged_decode_attention_pallas(
+            q[:, j : j + 1], pool_k, pool_v, layer, tables,
+            chunk_k, chunk_v, base_lens, jnp.int32(j),
+            wpages=wpages, interpret=interpret,
+        )
+        for j in range(S)
+    ]
+    return jnp.concatenate(outs, axis=1)
+
+
+# --------------------------------------------------------------------------- #
 # prefill: flash attention over the (chunk-updated) cache
 # --------------------------------------------------------------------------- #
 

@@ -1,0 +1,314 @@
+"""Speculative-decoding drafters (the proposal half of the scheme).
+
+The engine's spec tick is drafter-agnostic: each tick it asks the drafter
+for up to ``k`` candidate tokens per active request, then a single verify
+dispatch scores all k+1 positions against the paged/dense KV cache and
+accepts a (possibly empty) prefix per row — see
+``engine.InferenceEngine._spec_decode_tick`` and
+``sampler.spec_accept_slots``.  Drafters only PROPOSE; correctness never
+depends on them (a useless drafter just degrades to ~1 token/dispatch).
+
+Two implementations behind one protocol:
+
+- :class:`NgramDrafter` — prompt-lookup decoding: match the tail of the
+  generated sequence against the prompt + generated history and propose
+  the continuation of the most recent earlier occurrence.  Zero weights,
+  zero device work, pure host.  This is the agent-serving drafter: tool
+  schemas, quoted documents, and repeated instruction blocks make the
+  history highly self-similar, exactly where lookup hits.
+- :class:`DraftModelDrafter` — a second, smaller model proposes greedily.
+  Loaded through the SAME init/sharding path as the target
+  (``param_shardings``/``place_params``; pass real checkpoint params via
+  ``InferenceEngine(draft_params=...)`` using the existing loader).  It
+  keeps its own dense KV cache per slot and catches up on whatever the
+  target emitted since its last call — rejected speculation simply gets
+  overwritten by the next catch-up chunk (same garbage-beyond-length
+  tolerance as the main cache).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Protocol
+
+import numpy as np
+
+from calfkit_tpu.inference.config import ModelConfig, RuntimeConfig, SpecConfig
+
+logger = logging.getLogger(__name__)
+
+
+class Drafter(Protocol):
+    """What the engine's spec tick needs from a proposal source."""
+
+    k: int
+
+    def admit(self, slot: int, prompt: list[int]) -> None:
+        """A request was activated into ``slot``."""
+
+    def retire(self, slot: int) -> None:
+        """``slot``'s request retired (or was cancelled)."""
+
+    def propose(
+        self, requests: "list[tuple[int, list[int]]]"
+    ) -> "list[list[int]]":
+        """Per (slot, token history) entry: up to ``k`` draft tokens for
+        the positions after the history's final token.  Fewer (or zero)
+        proposals are fine — the verify wave pads and masks."""
+
+
+class NgramDrafter:
+    """Prompt-lookup drafting: propose the continuation of the most recent
+    earlier occurrence of the sequence tail.
+
+    Longest tails first (``ngram_max`` down to ``ngram_min``): a longer
+    match carries more context and is less likely to propose a spurious
+    continuation.  The search runs over the int32 byte view so the hot
+    path is C-speed ``bytes.rfind``, alignment-checked (a byte-level hit
+    must fall on a 4-byte token boundary to be a token-level hit).  The
+    byte view is kept INCREMENTALLY per slot (appended as history grows)
+    — rebuilding it from the token list each wave would be an O(history)
+    host cost per row per tick on the scheduler's latency path.
+    """
+
+    def __init__(self, spec: SpecConfig):
+        self.k = spec.k
+        self.ngram_max = max(1, spec.ngram_max)
+        self.ngram_min = max(1, min(spec.ngram_min, self.ngram_max))
+        self._bufs: dict[int, bytearray] = {}  # slot -> history byte view
+
+    def admit(self, slot: int, prompt: "list[int]") -> None:
+        self._bufs[slot] = bytearray()
+
+    def retire(self, slot: int) -> None:
+        self._bufs.pop(slot, None)
+
+    def _slot_bytes(self, slot: int, history: "list[int]") -> bytearray:
+        # returned WITHOUT copying: rfind/slicing work on bytearray, and a
+        # bytes(...) wrap here would reintroduce the O(history) per-tick
+        # cost the incremental buffer exists to avoid
+        buf = self._bufs.setdefault(slot, bytearray())
+        synced = len(buf) // 4
+        if synced > len(history):  # defensive: slot reused without admit()
+            buf.clear()
+            synced = 0
+        if synced < len(history):
+            buf += np.asarray(history[synced:], np.int32).tobytes()
+        return buf
+
+    def _lookup(self, buf: "bytearray", history: "list[int]") -> "list[int]":
+        L = len(history)
+        if L < 2:
+            return []
+        for n in range(min(self.ngram_max, L - 1), self.ngram_min - 1, -1):
+            tail = buf[(L - n) * 4 :]
+            # rightmost earlier occurrence, excluding the tail matching
+            # itself; byte hits must land on token boundaries
+            end = (L - 1) * 4  # candidate start strictly before L - n
+            while end >= n * 4:
+                hit = buf.rfind(tail, 0, end)
+                if hit < 0:
+                    break
+                if hit % 4 == 0:
+                    # the end bound forces start <= L-1, so at least one
+                    # continuation token always exists
+                    start = hit // 4 + n
+                    return history[start : start + self.k]
+                end = hit + len(tail) - 1
+        return []
+
+    def propose(
+        self, requests: "list[tuple[int, list[int]]]"
+    ) -> "list[list[int]]":
+        return [
+            self._lookup(self._slot_bytes(slot, history), history)
+            for slot, history in requests
+        ]
+
+
+class DraftModelDrafter:
+    """A second, smaller model drafting greedily from its own dense KV.
+
+    State contract: ``_dlen[slot]`` tokens of the request's history are in
+    the draft cache.  Each ``propose`` feeds the catch-up delta
+    (``history[_dlen:]`` — the tokens the target emitted since last time,
+    padded to a power-of-two bucket so compile count stays logarithmic),
+    then rolls ``k`` greedy steps.  Draft K/V written during speculation
+    sits beyond ``_dlen`` after the call and is overwritten by the next
+    catch-up — rejections cost nothing to roll back, mirroring the target
+    cache's scheme.
+    """
+
+    def __init__(
+        self,
+        spec: SpecConfig,
+        runtime: RuntimeConfig,
+        mesh: Any,
+        params: Any = None,
+        seed: int = 17,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from calfkit_tpu.inference import model as M
+        from calfkit_tpu.inference.sharding import (
+            cache_sharding,
+            param_shardings,
+            place_params,
+        )
+
+        assert spec.draft is not None
+        self.k = spec.k
+        self.config: ModelConfig = spec.draft
+        self._runtime = runtime
+        if params is None:
+            # correctness never depends on the draft, but RANDOM draft
+            # weights mean ~0 acceptance while still paying every draft
+            # forward — worse than speculation off.  Loud, not silent.
+            logger.warning(
+                "draft model %s initialized with RANDOM weights — pass "
+                "draft_params (engine) / draft_checkpoint (client) for a "
+                "real drafter; expect ~zero acceptance until then",
+                self.config.name,
+            )
+            params = M.init_params(self.config, jax.random.key(seed))
+        self.params = place_params(
+            params, param_shardings(self.config, mesh)
+        )
+        B, S = runtime.max_batch_size, runtime.max_seq_len
+        cfg = self.config
+        self._kc = jax.device_put(
+            jnp.zeros(
+                (cfg.n_layers, B, cfg.n_kv_heads, S, cfg.head_dim),
+                jnp.dtype(cfg.dtype),
+            ),
+            cache_sharding(cfg, mesh, B),
+        )
+        self._vc = jax.device_put(
+            jnp.zeros_like(self._kc), cache_sharding(cfg, mesh, B)
+        )
+        self._dlen = np.zeros((B,), np.int64)
+        self._jits: dict[int, Any] = {}
+
+    def admit(self, slot: int, prompt: "list[int]") -> None:
+        # lazy: the first propose's catch-up covers the whole prompt
+        self._dlen[slot] = 0
+
+    def retire(self, slot: int) -> None:
+        self._dlen[slot] = 0
+
+    def _propose_jit(self, width: int) -> Any:
+        """One compile per catch-up bucket: forward the [B, width] catch-up
+        chunk at per-row offsets, then k greedy single-token steps."""
+        fn = self._jits.get(width)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        from calfkit_tpu.inference import model as M
+
+        cfg = self.config
+        k_steps = self.k
+
+        def propose(params, kc, vc, catchup, base, cat_len):
+            B = base.shape[0]
+            pos = base[:, None] + jnp.arange(width, dtype=jnp.int32)[None, :]
+            seq_lens = base + cat_len
+            logits, (kc, vc) = M.forward(
+                params, cfg, catchup, pos, (kc, vc), seq_lens,
+                unroll=True, insert_at=base,
+            )
+            idx = jnp.clip(cat_len - 1, 0, width - 1)
+            last = jnp.take_along_axis(
+                logits, idx[:, None, None], axis=1
+            )[:, 0]
+            cur = jnp.argmax(last, axis=-1).astype(jnp.int32)
+            outs = [cur]
+            lens = seq_lens
+            for _ in range(k_steps - 1):
+                logits, (kc, vc) = M.forward(
+                    params, cfg, cur[:, None], lens[:, None], (kc, vc),
+                    lens + 1, unroll=True,
+                )
+                cur = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                outs.append(cur)
+                lens = lens + 1
+            return kc, vc, jnp.stack(outs, axis=1)  # [B, k]
+
+        fn = jax.jit(propose, donate_argnums=(1, 2))
+        self._jits[width] = fn
+        return fn
+
+    def propose(
+        self, requests: "list[tuple[int, list[int]]]"
+    ) -> "list[list[int]]":
+        import jax.numpy as jnp
+
+        if not requests:
+            return []
+        B = self._runtime.max_batch_size
+        S = self._runtime.max_seq_len
+        deltas = [
+            len(history) - int(self._dlen[slot]) for slot, history in requests
+        ]
+        width = 1
+        while width < max(max(deltas), 1):
+            width *= 2
+        # the catch-up bucket can never exceed the draft cache (a non-
+        # power-of-two max_seq_len would otherwise overflow it); a row
+        # whose delta still exceeds the clamped width feeds only its TAIL
+        # — proposals degrade, verified output never depends on them
+        width = min(width, S)
+        catchup = np.zeros((B, width), np.int32)
+        base = np.zeros((B,), np.int32)
+        cat_len = np.zeros((B,), np.int32)
+        live: list[tuple[int, int]] = []  # (slot, room) rows actually fed
+        for (slot, history), delta in zip(requests, deltas):
+            if delta <= 0:  # defensive: history never shrinks mid-request
+                continue
+            d = int(self._dlen[slot])
+            if delta > width:
+                d = len(history) - width
+                delta = width
+            elif d + width > S:
+                # the batch-wide width bucket would overhang this row's
+                # cache end and dynamic_update_slice CLAMPS the start
+                # backward — which would overwrite valid early positions
+                # with wrong-position K/V.  Re-feed from S - width
+                # instead: positions [d, dlen) rewrite identically,
+                # positions before d stay untouched, nothing clamps.
+                d = max(0, S - width)
+                delta = len(history) - d
+            catchup[slot, :delta] = history[d:]
+            base[slot] = d
+            cat_len[slot] = delta
+            self._dlen[slot] = len(history)
+            # a draft would write beyond the draft cache near the end of a
+            # sequence's life; cap proposals by the cache room instead
+            live.append((slot, S - len(history) - 1))
+        fn = self._propose_jit(width)
+        self._kc, self._vc, drafts = fn(
+            self.params, self._kc, self._vc,
+            jnp.asarray(catchup), jnp.asarray(base), jnp.asarray(cat_len),
+        )
+        drafts = np.asarray(drafts)
+        by_slot = {
+            slot: [int(t) for t in drafts[slot, : max(0, min(self.k, room))]]
+            for slot, room in live
+        }
+        return [by_slot.get(slot, []) for slot, _ in requests]
+
+
+def build_drafter(
+    spec: SpecConfig,
+    runtime: RuntimeConfig,
+    mesh: Any,
+    draft_params: Any = None,
+    seed: int = 17,
+) -> Drafter:
+    if spec.draft is not None:
+        return DraftModelDrafter(
+            spec, runtime, mesh, params=draft_params, seed=seed
+        )
+    return NgramDrafter(spec)

@@ -439,6 +439,10 @@ class TestConfig5Scale:
                 max_batch_size=B, max_seq_len=128, prefill_chunk=16,
                 decode_steps_per_dispatch=4, kv_layout="paged", page_size=16,
                 num_kv_pages=4 * B + 1, long_context=True, long_new_cap=8,
+                # the client's max_new_tokens=12 exceeds the lane cap: this
+                # serving path explicitly negotiates clamping (the engine
+                # faults by default rather than silently shrinking budgets)
+                long_clamp_new_tokens=True,
             ),
             max_new_tokens=12,
         )

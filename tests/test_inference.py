@@ -1114,9 +1114,32 @@ class TestLongContextLane:
                 pass
         await engine.stop()
 
-    async def test_long_max_new_clamped_to_cap(self):
+    async def test_long_max_new_over_cap_faults(self):
+        """A long request whose token budget exceeds long_new_cap FAULTS
+        with a typed error by default — the engine must not silently
+        rewrite the caller's budget (the pre-r6 clamp corrupted downstream
+        accounting that trusted max_new_tokens)."""
+        from calfkit_tpu.exceptions import InferenceError
+
         params = self._params()
         engine = self._long_engine(params, long_new_cap=8)
+        await engine.start()
+        prompt = [(i + 9) % CFG.vocab_size for i in range(70)]
+        with pytest.raises(InferenceError, match="long_new_cap"):
+            async for _ in engine.generate(prompt, max_new_tokens=1000):
+                pass
+        # the lane still serves a within-budget request afterwards
+        out = [t async for t in engine.generate(prompt, max_new_tokens=4)]
+        assert len(out) == 4
+        await engine.stop()
+
+    async def test_long_max_new_clamped_only_with_optin(self):
+        """long_clamp_new_tokens=True restores clamping as an explicit
+        negotiation (the old silent default)."""
+        params = self._params()
+        engine = self._long_engine(
+            params, long_new_cap=8, long_clamp_new_tokens=True
+        )
         await engine.start()
         prompt = [(i + 9) % CFG.vocab_size for i in range(70)]
         out = [t async for t in engine.generate(prompt, max_new_tokens=1000)]
