@@ -70,6 +70,12 @@ _LAZY: dict[str, str] = {
     "KafkaWireMesh": "calfkit_tpu.mesh",
     "ConnectionProfile": "calfkit_tpu.mesh",
     "WireSecurity": "calfkit_tpu.mesh",
+    # observability: tracing + metrics (dependency-free)
+    "TraceContext": "calfkit_tpu.observability",
+    "Tracer": "calfkit_tpu.observability",
+    "MetricsRegistry": "calfkit_tpu.observability",
+    "MetricsServer": "calfkit_tpu.observability",
+    "metrics_text": "calfkit_tpu.observability",
     # model clients (local TPU path + remote adapters)
     "JaxLocalModelClient": "calfkit_tpu.inference",
     "EchoModelClient": "calfkit_tpu.engine",

@@ -27,6 +27,8 @@ def _register() -> None:
         ("calfkit_tpu.cli.dev", "dev_group"),
         ("calfkit_tpu.cli.chat", "chat_command"),
         ("calfkit_tpu.cli.topics", "topics_group"),
+        ("calfkit_tpu.cli.obs", "trace_command"),
+        ("calfkit_tpu.cli.obs", "stats_command"),
     ):
         if find_spec(module_name) is None:
             continue

@@ -1,0 +1,183 @@
+"""``ck trace`` / ``ck stats`` — the observability operator surface.
+
+``ck trace <correlation-id>`` reads the compacted ``mesh.traces`` topic
+and prints the run's per-hop waterfall (trace_id equals the correlation
+id by client convention, so the id on any log line or client handle is
+the lookup key).  ``ck stats`` reads the ``mesh.engine_stats`` directory
+and prints a live table of every engine's serving metrics.
+
+Rendering is split into pure functions (``render_waterfall`` /
+``render_stats_table``) so tests cover the formatting without a mesh.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Iterable
+
+import click
+
+from calfkit_tpu import protocol
+from calfkit_tpu.cli._common import resolve_mesh_for_cli
+from calfkit_tpu.models.records import (
+    ControlPlaneRecord,
+    EngineStatsRecord,
+    SpanRecord,
+)
+
+_BAR_WIDTH = 32
+
+
+def _depth_of(span: SpanRecord, by_id: dict[str, SpanRecord]) -> int:
+    depth = 0
+    seen: set[str] = {span.span_id}
+    parent = span.parent_span_id
+    while parent and parent in by_id and parent not in seen:
+        seen.add(parent)
+        depth += 1
+        parent = by_id[parent].parent_span_id
+    return depth
+
+
+def render_waterfall(spans: "list[SpanRecord]") -> str:
+    """The per-hop waterfall: one line per span, bar positioned on the
+    trace's wall-clock window, indented by parent depth."""
+    if not spans:
+        return "no spans"
+    by_id = {s.span_id: s for s in spans}
+    t0 = min(s.start_s for s in spans)
+    t1 = max(s.start_s + s.duration_ms / 1000.0 for s in spans)
+    total_ms = max((t1 - t0) * 1000.0, 0.001)
+    lines = [
+        f"trace {spans[0].trace_id}  —  {len(spans)} spans, "
+        f"{total_ms:.1f} ms end-to-end"
+    ]
+    for span in sorted(spans, key=lambda s: (s.start_s, s.span_id)):
+        offset_ms = (span.start_s - t0) * 1000.0
+        left = int(offset_ms / total_ms * _BAR_WIDTH)
+        left = min(left, _BAR_WIDTH - 1)
+        width = max(
+            1,
+            int((offset_ms + span.duration_ms) / total_ms * _BAR_WIDTH) - left,
+        )
+        bar = " " * left + "#" * min(width, _BAR_WIDTH - left)
+        indent = "  " * _depth_of(span, by_id)
+        flag = "" if span.status == "ok" else f"  !{span.status}"
+        lines.append(
+            f"{offset_ms:9.1f}ms  [{bar:<{_BAR_WIDTH}}] "
+            f"{span.duration_ms:9.1f}ms  {indent}{span.name}"
+            f"  ({span.emitter or span.kind}){flag}"
+        )
+    return "\n".join(lines)
+
+
+def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
+    """The live engine table: one row per engine-backed node."""
+    rows = [
+        (
+            "NODE", "MODEL", "TOK/S", "OCC", "ACTIVE", "SLOTS",
+            "DECODED", "TTFT P50/P99 MS",
+        )
+    ]
+    for r in records:
+        lat = r.latency_ms or {}
+        ttft = (
+            f"{lat.get('ttft_p50', 0):.0f}/{lat.get('ttft_p99', 0):.0f}"
+            if lat else "-"
+        )
+        # prefer the per-heartbeat-interval rates: lifetime cumulative
+        # tok/s flattens toward the mean (an engine idle for an hour then
+        # bursting shows ~0 lifetime) — the window field exists for this
+        window = r.window or {}
+        tok_s = window.get("tokens_per_second", r.tokens_per_second)
+        occupancy = window.get("mean_occupancy", r.mean_occupancy)
+        rows.append(
+            (
+                r.node_id,
+                r.model_name,
+                f"{tok_s:.1f}",
+                f"{occupancy:.2f}",
+                str(r.active_requests),
+                f"{r.max_batch_size - r.free_slots}/{r.max_batch_size}"
+                if r.max_batch_size else "-",
+                str(r.decode_tokens),
+                ttft,
+            )
+        )
+    if len(rows) == 1:
+        return "no live engines (is a worker with a local model running?)"
+    widths = [max(len(row[i]) for row in rows) for i in range(len(rows[0]))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in rows
+    )
+
+
+def _parse_spans(items: dict[str, bytes], correlation_id: str) -> list[SpanRecord]:
+    spans: list[SpanRecord] = []
+    prefix = f"{correlation_id}/"
+    for key, value in items.items():
+        if not key.startswith(prefix):
+            continue
+        try:
+            spans.append(SpanRecord.from_wire(value))
+        except Exception:  # noqa: BLE001 - skip undecodable records, keep the rest
+            continue
+    return spans
+
+
+def _parse_engine_stats(items: dict[str, bytes]) -> list[EngineStatsRecord]:
+    out: list[EngineStatsRecord] = []
+    for value in items.values():
+        try:
+            wrapped = ControlPlaneRecord.from_wire(value)
+            out.append(EngineStatsRecord.model_validate(wrapped.record))
+        except Exception:  # noqa: BLE001
+            continue
+    return sorted(out, key=lambda r: r.node_id)
+
+
+@click.command("trace", help="print a run's per-hop trace waterfall")
+@click.argument("correlation_id")
+@click.option("--mesh", "mesh_url", default=None, help="mesh url (or $CALFKIT_MESH_URL)")
+@click.option("--timeout", default=15.0, show_default=True, help="catch-up timeout (s)")
+def trace_command(correlation_id: str, mesh_url: str | None, timeout: float) -> None:
+    async def main() -> None:
+        mesh = resolve_mesh_for_cli(mesh_url, hosts_worker=False)
+        await mesh.start()
+        try:
+            reader = mesh.table_reader(protocol.TRACES_TOPIC)
+            await reader.start(timeout=timeout)
+            await reader.barrier(timeout=timeout)
+            spans = _parse_spans(reader.items(), correlation_id)
+            await reader.stop()
+        finally:
+            await mesh.stop()
+        if not spans:
+            raise click.ClickException(
+                f"no spans for {correlation_id!r} on {protocol.TRACES_TOPIC} "
+                "(run too old for compaction, or tracing not flowing?)"
+            )
+        click.echo(render_waterfall(spans))
+
+    asyncio.run(main())
+
+
+@click.command("stats", help="print live engine serving metrics")
+@click.option("--mesh", "mesh_url", default=None, help="mesh url (or $CALFKIT_MESH_URL)")
+@click.option("--timeout", default=15.0, show_default=True, help="catch-up timeout (s)")
+def stats_command(mesh_url: str | None, timeout: float) -> None:
+    async def main() -> None:
+        mesh = resolve_mesh_for_cli(mesh_url, hosts_worker=False)
+        await mesh.start()
+        try:
+            reader = mesh.table_reader(protocol.ENGINE_STATS_TOPIC)
+            await reader.start(timeout=timeout)
+            await reader.barrier(timeout=timeout)
+            records = _parse_engine_stats(reader.items())
+            await reader.stop()
+        finally:
+            await mesh.stop()
+        click.echo(render_stats_table(records))
+
+    asyncio.run(main())

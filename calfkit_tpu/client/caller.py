@@ -59,6 +59,7 @@ class Client:
         self._owns_mesh = False  # connect() sets it for url-built transports
         self._start_lock: asyncio.Lock | None = None
         self._mesh_view: Any = None
+        self._span_tasks: set[asyncio.Task] = set()  # in-flight span exports
 
     # ------------------------------------------------------------- connect
     @classmethod
@@ -109,6 +110,13 @@ class Client:
 
     async def close(self) -> None:
         self._closed = True
+        pending = {t for t in self._span_tasks if not t.done()}
+        if pending:
+            # give in-flight fire-and-forget span exports a brief window
+            # to land before the mesh stops (the root span has no
+            # ring-to-topic fallback); stragglers are dropped, not awaited
+            with contextlib.suppress(Exception):
+                await asyncio.wait(pending, timeout=2.0)
         if self._subscription is not None:
             with contextlib.suppress(Exception):
                 await self._subscription.stop()
@@ -165,6 +173,8 @@ class Client:
         state: State,
         deps: dict[str, Any],
     ) -> None:
+        from calfkit_tpu.observability.trace import TRACER
+
         envelope = Envelope(
             context=SessionContext(state=state, deps=deps),
             workflow=WorkflowState(
@@ -180,19 +190,46 @@ class Client:
                 ]
             ),
         )
-        await self.mesh.publish(
-            target_topic,
-            envelope.to_wire(),
-            key=partition_key(task_id),
-            headers={
-                protocol.HDR_EMITTER: protocol.emitter_header("client", self.client_id),
-                protocol.HDR_KIND: "call",
-                protocol.HDR_WIRE: "envelope",
-                protocol.HDR_ROUTE: route,
-                protocol.HDR_TASK: task_id,
-                protocol.HDR_CORRELATION: correlation_id,
-            },
+        # the trace root: trace_id == correlation_id by convention, so
+        # `ck trace <correlation-id>` needs no id mapping
+        span = TRACER.start_span(
+            "client.dispatch",
+            trace_id=correlation_id,
+            kind="client",
+            emitter=protocol.emitter_header("client", self.client_id),
+            attrs={"target_topic": target_topic, "route": route},
         )
+        headers = {
+            protocol.HDR_EMITTER: protocol.emitter_header("client", self.client_id),
+            protocol.HDR_KIND: "call",
+            protocol.HDR_WIRE: "envelope",
+            protocol.HDR_ROUTE: route,
+            protocol.HDR_TASK: task_id,
+            protocol.HDR_CORRELATION: correlation_id,
+            **span.context.headers(),
+        }
+        try:
+            await self.mesh.publish(
+                target_topic,
+                envelope.to_wire(),
+                key=partition_key(task_id),
+                headers=headers,
+            )
+        except BaseException as exc:
+            span.end(
+                status="cancelled"
+                if isinstance(exc, asyncio.CancelledError)
+                else "error"
+            )
+            raise
+        record = span.end()
+        if record is not None:
+            # best-effort span export, FIRE-AND-FORGET (shared helper):
+            # an awaited publish here would add a full broker round-trip
+            # to every client call; close() drains stragglers briefly
+            from calfkit_tpu.observability.trace import publish_spans_soon
+
+            publish_spans_soon(self.mesh.publish, [record], self._span_tasks)
 
 
 class AgentGateway(Generic[OutputT]):

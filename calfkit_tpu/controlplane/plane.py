@@ -123,6 +123,7 @@ class ControlPlane:
                     protocol.AGENTS_TOPIC,
                     protocol.CAPABILITIES_TOPIC,
                     protocol.ENGINE_STATS_TOPIC,
+                    protocol.TRACES_TOPIC,
                 ],
                 compacted=True,
             )

@@ -277,21 +277,35 @@ class JaxLocalModelClient(ModelClient):
         if self._engine is not None:
             await self._engine.stop()
 
-    def stats_snapshot(self) -> dict:
+    def stats_snapshot(self, *, window: bool = False) -> dict:
         """Live serving metrics (for the control-plane engine-stats advert);
-        safe before start (zeros) — construction is intentionally cheap."""
+        safe before start (zeros) — construction is intentionally cheap.
+
+        ``window=True`` additionally reports per-interval rates since the
+        PREVIOUS window=True call (``EngineStats.snapshot_and_delta`` —
+        single-consumer by design: the heartbeat advert passes it; ad-hoc
+        pollers must not, or they steal the heartbeat's intervals)."""
         engine = self._engine
         if engine is None:
             # engine builds lazily on first request: report the CONFIGURED
-            # shape so directories aren't stuck showing 0/0 slots
+            # shape — with the SAME key set as the live branch (zeros for
+            # the counters) so control-plane consumers never KeyError on a
+            # cold engine
             from calfkit_tpu.inference.config import RuntimeConfig
 
             runtime = self._runtime or RuntimeConfig()  # mirror _build_engine
             return {
                 "model_name": self.model_name,
-                "max_batch_size": runtime.max_batch_size,
+                "platform": "",
+                "tokens_per_second": 0.0,
+                "mean_occupancy": 0.0,
+                "active_requests": 0,
                 "free_slots": runtime.max_batch_size,
+                "max_batch_size": runtime.max_batch_size,
                 "kv_layout": runtime.kv_layout,
+                "prefill_tokens": 0,
+                "decode_tokens": 0,
+                "decode_dispatches": 0,
             }
         import jax
 
@@ -310,6 +324,29 @@ class JaxLocalModelClient(ModelClient):
             "decode_tokens": stats.decode_tokens,
             "decode_dispatches": stats.decode_dispatches,
         }
+        try:
+            # latency percentiles ride the advert for free: the registry's
+            # fixed-bucket histograms already hold them.  Best-effort —
+            # metrics must never fault the heartbeat.
+            engine._sync_metric_counters()
+            m = engine.latency  # per-ENGINE histograms: node-attributable
+            snapshot["latency_ms"] = {
+                name: round(m[hist].percentile(q), 3)
+                for hist, label in (
+                    ("ttft_ms", "ttft"),
+                    ("inter_token_ms", "inter_token"),
+                    ("queue_wait_ms", "queue_wait"),
+                    ("prefill_ms", "prefill"),
+                )
+                for q, name in ((0.5, f"{label}_p50"), (0.99, f"{label}_p99"))
+            }
+            # per-interval rates since the previous heartbeat (the
+            # windowing story for occupancy_hist + counters) — consumed
+            # only when the single designated consumer asks
+            if window:
+                snapshot["window"] = engine.stats.snapshot_and_delta()[1]
+        except Exception:  # noqa: BLE001 - telemetry stays best-effort
+            pass
         if rt.speculative is not None:
             snapshot["speculative"] = {
                 "k": rt.speculative.k,
@@ -405,10 +442,35 @@ class JaxLocalModelClient(ModelClient):
             hits = [i for s in stops if (i := text.find(s)) != -1]
             return min(hits) if hits else -1
 
+        # trace spans: the node kernel (or any caller) that set the trace
+        # contextvar gets engine.generate with prefill/decode children;
+        # untraced callers pay one contextvar read
+        from calfkit_tpu.observability.trace import TRACER, current_context
+
+        trace_parent = current_context.get()
+        gen_span = prefill_span = decode_span = None
+        if trace_parent is not None:
+            gen_span = TRACER.start_span(
+                "engine.generate",
+                parent=trace_parent,
+                kind="engine",
+                emitter=f"engine/{self.model_name}",
+                attrs={
+                    "model": self.model_name,
+                    "prompt_tokens": len(prompt),
+                    "max_new_tokens": max_new,
+                },
+            )
+            prefill_span = TRACER.start_span(
+                "engine.prefill", parent=gen_span.context, kind="engine",
+                emitter=gen_span.emitter,
+            )
+
         started = time.perf_counter()
         generated: list[int] = []
         emitted = 0
         stopped_at = -1
+        ttft_ms = 0.0
         _EMIT_EVERY = 4  # re-decode cadence: bounds detokenize cost
         token_stream = self._engine.generate(
             prompt,
@@ -417,12 +479,23 @@ class JaxLocalModelClient(ModelClient):
             sampling=sampling,
             seed=settings.seed,
         )
+        stream_exc: BaseException | None = None
         try:
             async for token in token_stream:
                 generated.append(token)
-                # the first token is emitted immediately (it IS the TTFT
-                # moment — right after prefill); later ones batch on the
-                # re-decode cadence
+                if len(generated) == 1:
+                    # the first token IS the TTFT moment — right after
+                    # prefill; the decode phase starts here
+                    ttft_ms = (time.perf_counter() - started) * 1000.0
+                    if prefill_span is not None:
+                        prefill_span.end(ttft_ms=round(ttft_ms, 3))
+                        prefill_span = None
+                        decode_span = TRACER.start_span(
+                            "engine.decode", parent=gen_span.context,
+                            kind="engine", emitter=gen_span.emitter,
+                        )
+                # the first token is emitted immediately; later ones batch
+                # on the re-decode cadence
                 if len(generated) % _EMIT_EVERY and len(generated) != 1:
                     continue
                 # emit only the prefix that can't change: a trailing
@@ -436,10 +509,38 @@ class JaxLocalModelClient(ModelClient):
                 if len(text) > emitted:
                     yield TextDelta(text[emitted:])
                     emitted = len(text)
+        except BaseException as exc:
+            # captured locally, NOT via sys.exc_info() in the finally:
+            # exc_info also reports exceptions merely being HANDLED in an
+            # enclosing frame (this generator's frames resume inside the
+            # consumer's stack), which would mark clean streams as errors
+            stream_exc = exc
+            raise
         finally:
             # a break above abandons the stream; close NOW (not at GC) so
             # the engine reclaims the slot at its next tick
             await token_stream.aclose()
+            # span status tells the truth about HOW the stream ended: an
+            # in-flight exception (engine fault) is error, a consumer
+            # abandoning the generator is cancelled, a break/return is ok
+            status = (
+                None if stream_exc is None
+                else "cancelled"
+                if isinstance(stream_exc, (GeneratorExit, asyncio.CancelledError))
+                else "error"
+            )
+            if prefill_span is not None:  # zero tokens: no decode phase
+                prefill_span.end(status=status)
+            if decode_span is not None:
+                decode_span.end(
+                    status=status, generated_tokens=len(generated)
+                )
+            if gen_span is not None:
+                gen_span.end(
+                    status=status,
+                    generated_tokens=len(generated),
+                    ttft_ms=round(ttft_ms, 3),
+                )
         elapsed = time.perf_counter() - started
 
         full_text = tokenizer.decode(generated)

@@ -41,6 +41,11 @@ from jax import lax
 from calfkit_tpu.exceptions import InferenceError
 from calfkit_tpu.inference import model as M
 from calfkit_tpu.inference.config import ModelConfig, RuntimeConfig
+from calfkit_tpu.observability.metrics import (
+    INTER_TOKEN_BUCKETS_MS,
+    REGISTRY,
+    MetricsRegistry,
+)
 from calfkit_tpu.inference.sampler import (
     SamplingParams,
     sample_slots,
@@ -58,6 +63,88 @@ logger = logging.getLogger(__name__)
 _DONE = object()
 
 _ATTN_PROFILE_CACHE: "tuple[tuple, dict | None] | None" = None
+
+
+# process-wide active-request aggregation: the shared gauge must report
+# the SUM across live engines, not the last dispatching engine's count
+# (updated per dispatch; entries removed at engine stop / GC).  The lock
+# serializes insert/pop/sum across decode threads and the event loop —
+# an unguarded sum() during another engine's first insert would raise
+# "dictionary changed size during iteration" INTO the decode tick,
+# letting telemetry fault serving.
+_ACTIVE_BY_ENGINE: dict[int, int] = {}
+_ACTIVE_LOCK = threading.Lock()
+
+
+def _drop_engine_active(key: int) -> None:
+    """Remove one engine from the aggregation AND re-set the gauge —
+    shared by stop() and the GC finalizer, so an abandoned engine's last
+    count never stays pinned in the exposition."""
+    with _ACTIVE_LOCK:
+        if _ACTIVE_BY_ENGINE.pop(key, None) is None:
+            return
+        total = sum(_ACTIVE_BY_ENGINE.values())
+    REGISTRY.gauge("calfkit_engine_active_requests").set(total)
+
+
+def _engine_metrics(
+    registry: "MetricsRegistry | None" = None, *, histograms_only: bool = False
+) -> dict:
+    """The engine's latency instruments, get-or-create from ``registry``
+    (default: the process registry — many engines per process share one
+    instrument per metric for the /metrics exposition; each engine also
+    builds a private ``histograms_only`` set for per-node percentile
+    attribution — counters/gauges stay process-level, so a private copy
+    of them would just be dead zeros).  Everything observed here is PER
+    DISPATCH or PER ADMISSION, never per token: the decode hot path must
+    stay allocation-free."""
+    reg = registry if registry is not None else REGISTRY
+    out: dict = {
+        "queue_wait_ms": reg.histogram(
+            "calfkit_engine_queue_wait_ms",
+            "submit-to-prefill-start wait (ms)",
+        ),
+        "prefill_ms": reg.histogram(
+            "calfkit_engine_prefill_ms",
+            "prefill wave latency, admission to landing (ms)",
+        ),
+        "ttft_ms": reg.histogram(
+            "calfkit_engine_ttft_ms",
+            "time to first token: submit to first-token emission (ms)",
+        ),
+        "inter_token_ms": reg.histogram(
+            "calfkit_engine_inter_token_ms",
+            "per-sequence inter-token latency (dispatch wall / steps, ms)",
+            buckets=INTER_TOKEN_BUCKETS_MS,
+        ),
+        "decode_dispatch_ms": reg.histogram(
+            "calfkit_engine_decode_dispatch_ms",
+            "one decode/verify dispatch, enqueue to host sync (ms)",
+        ),
+    }
+    if histograms_only:
+        return out
+    out.update(
+        decode_tokens=reg.counter(
+            "calfkit_engine_decode_tokens_total", "decoded tokens emitted"
+        ),
+        prefill_tokens=reg.counter(
+            "calfkit_engine_prefill_tokens_total", "prompt tokens prefilled"
+        ),
+        spec_proposed=reg.counter(
+            "calfkit_engine_spec_proposed_total",
+            "speculative draft tokens offered to verify dispatches",
+        ),
+        spec_accepted=reg.counter(
+            "calfkit_engine_spec_accepted_total",
+            "speculative draft tokens accepted by verify dispatches",
+        ),
+        active_requests=reg.gauge(
+            "calfkit_engine_active_requests",
+            "requests holding a slot (summed across the process's engines)",
+        ),
+    )
+    return out
 
 
 def _host_feature_tag() -> str:
@@ -226,6 +313,59 @@ class EngineStats:
     spec_accepted: int = 0
     spec_emitted: int = 0  # tokens emitted by verify dispatches (device)
     spec_rows: int = 0  # Σ over verify dispatches of active rows
+    # snapshot_and_delta state: the previous window's counter values +
+    # timestamp.  Single-consumer by design (the heartbeat advert) — two
+    # delta readers would steal each other's intervals.
+    _window: Any = field(default=None, repr=False, compare=False)
+
+    _COUNTER_FIELDS = (
+        "prefill_tokens", "decode_tokens", "decode_dispatches",
+        "decode_time_s", "occupancy_sum", "short_dispatches",
+        "long_requests", "long_dispatches", "prefix_hits",
+        "prefix_reused_tokens", "spec_proposed", "spec_accepted",
+        "spec_emitted", "spec_rows",
+    )
+
+    def counters(self) -> dict:
+        """Every cumulative counter as a plain dict (occupancy_hist as a
+        copied list) — the windowing substrate."""
+        out: dict = {f: getattr(self, f) for f in self._COUNTER_FIELDS}
+        out["occupancy_hist"] = list(self.occupancy_hist)
+        return out
+
+    def snapshot_and_delta(self) -> "tuple[dict, dict]":
+        """(cumulative, per-interval delta) since the previous call.
+
+        The delta is what heartbeat adverts should report: per-interval
+        rates (``tokens_per_second`` over the interval, occupancy-hist
+        increments) instead of lifetime cumulative values that flatten
+        toward the mean as uptime grows.  The first call's delta covers
+        everything since engine construction."""
+        now = time.monotonic()
+        cur = self.counters()
+        prev, prev_t = self._window or (
+            {f: 0 for f in self._COUNTER_FIELDS} | {"occupancy_hist": [0, 0, 0, 0]},
+            None,
+        )
+        delta: dict = {
+            f: cur[f] - prev[f] for f in self._COUNTER_FIELDS
+        }
+        delta["occupancy_hist"] = [
+            a - b for a, b in zip(cur["occupancy_hist"], prev["occupancy_hist"])
+        ]
+        delta["interval_s"] = (
+            round(now - prev_t, 3) if prev_t is not None else None
+        )
+        dt = delta["decode_time_s"]
+        delta["tokens_per_second"] = (
+            round(delta["decode_tokens"] / dt, 1) if dt > 0 else 0.0
+        )
+        dd = delta["decode_dispatches"]
+        delta["mean_occupancy"] = (
+            round(delta["occupancy_sum"] / dd, 4) if dd else 0.0
+        )
+        self._window = (cur, now)
+        return cur, delta
 
     @property
     def tokens_per_second(self) -> float:
@@ -464,6 +604,26 @@ class InferenceEngine:
         self._task: asyncio.Task[None] | None = None
         self._running = False
         self.stats = EngineStats()
+        # latency telemetry: process-registry instruments + the sync
+        # cursors that turn cumulative stats into counter increments
+        self.metrics = _engine_metrics()
+        # per-ENGINE latency histograms: the advert's percentiles must
+        # attribute to THIS engine, not blend every engine in the process
+        # (the process-registry instruments above stay shared for the
+        # /metrics exposition; both are observed, each O(1))
+        self._own_registry = MetricsRegistry()
+        self.latency = _engine_metrics(self._own_registry, histograms_only=True)
+        self._counted = {
+            "decode_tokens": 0, "prefill_tokens": 0,
+            "spec_proposed": 0, "spec_accepted": 0,
+        }
+        self._counted_lock = threading.Lock()
+        # self-cleaning gauge aggregation: an engine abandoned without
+        # stop() must not pin its last active count into the process
+        # gauge (stop() also clears eagerly and re-sets the gauge)
+        import weakref
+
+        weakref.finalize(self, _drop_engine_active, id(self))
 
         self._decode_jits: dict[tuple, Any] = {}  # (window, steps, ...)
         self._prefill_jits: dict[tuple, Any] = {}
@@ -941,6 +1101,8 @@ class InferenceEngine:
                 self._task.cancel()
             self._task = None
         self._finish_all()
+        # a stopped engine must not pin a stale count in the process gauge
+        _drop_engine_active(id(self))
 
     def _finish_all(self) -> None:
         """Terminate every waiter: active slots AND still-queued requests
@@ -1474,6 +1636,11 @@ class InferenceEngine:
         request.prefill_ms = (time.perf_counter() - started) * 1000.0
         self.stats.prefill_tokens += n
         self.stats.long_requests += 1
+        self._observe("prefill_ms", request.prefill_ms)
+        ttft_ms = (time.perf_counter() - request.started_at) * 1000.0
+        self._observe("ttft_ms", ttft_ms)
+        # the long lane's wait is everything before its prefill started
+        self._observe("queue_wait_ms", max(0.0, ttft_ms - request.prefill_ms))
         if self._emit_long(request, first):
             return
         cfg = self.config
@@ -1698,11 +1865,19 @@ class InferenceEngine:
         whole wave.  The device-side last/lens scatter happens inside the
         prefill jit (``_finalize_wave_math``)."""
         deliveries: list[tuple[asyncio.Queue, list]] = []
+        self._observe("prefill_ms", elapsed_ms)
+        now = time.perf_counter()
         for r, request in enumerate(wave):
             if request.slot == -1:
                 continue
             request.prefill_ms = elapsed_ms
             self.stats.prefill_tokens += int(true_lens[r])
+            # per-request latency attribution: the wave lands the first
+            # token, so submit→now IS the TTFT; what precedes the prefill
+            # work is queue wait.  O(wave), never per token.
+            ttft_ms = (now - request.started_at) * 1000.0
+            self._observe("ttft_ms", ttft_ms)
+            self._observe("queue_wait_ms", max(0.0, ttft_ms - elapsed_ms))
             # the prompt occupies [0, true_len); decode inserts from true_len
             self._host_lens[request.slot] = int(true_lens[r])
             items: list = []
@@ -1960,10 +2135,18 @@ class InferenceEngine:
         if deliveries:
             self._loop.call_soon_threadsafe(_deliver_batch, deliveries)
 
-    def _note_dispatch(self, elapsed: float, clock_steps: int) -> None:
+    def _note_dispatch(
+        self, elapsed: float, clock_steps: int,
+        tokens_per_row: float | None = None,
+    ) -> None:
         """Per-dispatch clock + stats shared by the plain decode tick and
         the speculative verify tick — ONE copy of the occupancy/clock
-        accounting so the two modes cannot drift."""
+        accounting so the two modes cannot drift.
+
+        ``tokens_per_row`` is the latency denominator when it differs from
+        the clock: a verify dispatch advances the clock by 1 but emits
+        each row's accepted prefix, so its inter-token latency is wall
+        over MEAN EMITTED per row, not wall over 1."""
         with self._retire_lock:
             self._decode_clock += clock_steps
         self.stats.decode_dispatches += 1
@@ -1971,6 +2154,51 @@ class InferenceEngine:
         occupancy = len(self._active) / self.runtime.max_batch_size
         self.stats.occupancy_sum += occupancy
         self.stats.occupancy_hist[min(3, int(occupancy * 4))] += 1
+        # latency telemetry: TWO O(1) observes per dispatch — inter-token
+        # latency is dispatch wall over tokens-per-row, never a per-token
+        # loop (the hot-path allocation budget is zero)
+        denom = tokens_per_row if tokens_per_row else clock_steps
+        self._observe("decode_dispatch_ms", elapsed * 1000.0)
+        self._observe("inter_token_ms", elapsed * 1000.0 / max(1.0, denom))
+        self._update_active_gauge()
+        self._sync_metric_counters()
+
+    def _update_active_gauge(self) -> None:
+        """The process gauge sums across live engines (last-writer-wins
+        would let an idle engine zero out a busy one's count).  Called per
+        dispatch AND per retirement — without the retirement update an
+        idle engine would pin its final in-flight count forever.  The
+        running check sits INSIDE the lock so stop()'s pop (which runs
+        after _running flips) can never interleave between the check and
+        the insert and leave a stale re-inserted entry."""
+        with _ACTIVE_LOCK:
+            if not self._running:
+                return
+            _ACTIVE_BY_ENGINE[id(self)] = len(self._active)
+            total = sum(_ACTIVE_BY_ENGINE.values())
+        self.metrics["active_requests"].set(total)
+
+    def _observe(self, key: str, value: float) -> None:
+        """One latency observation, recorded twice (both O(1)): the
+        process-shared instrument feeds the /metrics exposition, the
+        per-engine one feeds this engine's advert percentiles."""
+        self.metrics[key].observe(value)
+        self.latency[key].observe(value)
+
+    def _sync_metric_counters(self) -> None:
+        """Fold cumulative stats into the process-registry counters as
+        increments (called per dispatch + at snapshot time; at most one
+        dispatch of lag, O(1) work).  Locked: the decode thread (via
+        _note_dispatch) and the event-loop heartbeat (via stats_snapshot)
+        both run this — an unlocked read-inc-write would double-count."""
+        m, counted, stats = self.metrics, self._counted, self.stats
+        with self._counted_lock:
+            for key in ("decode_tokens", "prefill_tokens", "spec_proposed",
+                        "spec_accepted"):
+                value = getattr(stats, key)
+                if value != counted[key]:
+                    m[key].inc(value - counted[key])
+                    counted[key] = value
 
     def _spec_decode_tick(self) -> None:
         """One speculative wave: draft up to k tokens per active request
@@ -2043,8 +2271,14 @@ class InferenceEngine:
         elapsed = time.perf_counter() - started
         # clock: one verify forward ≈ one decode step of wall time; the
         # heap horizon only drives the non-spec short-dispatch lever, so
-        # a coarse clock is fine here
-        self._note_dispatch(elapsed, 1)
+        # a coarse clock is fine here.  Inter-token latency, however, must
+        # divide by what each row actually EMITTED (accepted prefix +
+        # correction), or acceptance would inflate the reported latency.
+        n_active = len(self._active)
+        self._note_dispatch(
+            elapsed, 1,
+            tokens_per_row=float(emitted.sum()) / n_active if n_active else 1.0,
+        )
         deliveries: list[tuple[asyncio.Queue, list]] = []
         for slot, request in list(self._active.items()):
             count = int(emitted[slot])
@@ -2081,6 +2315,7 @@ class InferenceEngine:
         self._free.append(request.slot)
         request.slot = -1
         self._untrack_retirement(request)
+        self._update_active_gauge()
 
     def _record_token(
         self, request: GenRequest, token: int, items: list, *,

@@ -22,12 +22,59 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 import zlib
 from typing import Awaitable, Callable
 
 from calfkit_tpu.mesh.transport import Record
+from calfkit_tpu.observability.metrics import REGISTRY
+from calfkit_tpu.observability.trace import TRACER, TraceContext
 
 logger = logging.getLogger(__name__)
+
+# lane telemetry: how long records sit queued behind their key's lane
+# (the "where did the time go" gap between publish and handler start).
+# Buckets span sub-ms (healthy lanes) through tens of seconds (a stalled
+# lane is exactly what this metric exists to expose — capping at 1 s
+# would hide the pathology in +Inf)
+_LANE_WAIT_BUCKETS_MS = (
+    0.1, 0.5, 1.0, 5.0, 10.0, 50.0, 100.0, 500.0,
+    1000.0, 5000.0, 10000.0, 30000.0,
+)
+_QUEUE_WAIT = REGISTRY.histogram(
+    "calfkit_dispatch_queue_wait_ms",
+    "time a record spent queued in its key-ordered lane (ms)",
+    buckets=_LANE_WAIT_BUCKETS_MS,
+)
+_RECORDS = REGISTRY.counter(
+    "calfkit_dispatch_records_total", "records dispatched through lanes"
+)
+
+
+class _LaneTask(asyncio.Task):
+    """A lane worker task that records cancel() requests.
+
+    ``Task.cancelling()`` is 3.11+; on the image's 3.10 a lane cannot
+    otherwise distinguish "this task was cancelled" (stop(), asyncio.run
+    teardown, an enclosing scope — must terminate) from "the handler
+    raised CancelledError itself" (a fault the lane must survive).  The
+    flag emulates exactly the cancelling() signal: set by ANY cancel()
+    delivery, regardless of who called it."""
+
+    _cancel_requested = False
+
+    def cancel(self, msg: "str | None" = None) -> bool:
+        self._cancel_requested = True
+        return super().cancel(msg)
+
+
+def _task_cancel_requested(task: "asyncio.Task | None") -> bool:
+    if task is None:
+        return False
+    if getattr(task, "_cancel_requested", False):
+        return True
+    cancelling = getattr(task, "cancelling", None)  # 3.11+ native signal
+    return cancelling is not None and bool(cancelling())
 
 
 class _TripwireSemaphore(asyncio.Semaphore):
@@ -58,7 +105,9 @@ class KeyOrderedDispatcher:
         self._handler = handler
         self._lanes = max_workers
         self._name = name
-        self._queues: list[asyncio.Queue[Record | None]] = [
+        # queue items are (record, enqueue perf_counter) for queue-wait
+        # attribution; None is the drain sentinel
+        self._queues: list[asyncio.Queue[tuple[Record, float] | None]] = [
             asyncio.Queue() for _ in range(max_workers)
         ]
         self._permits = _TripwireSemaphore(2 * max_workers)
@@ -72,9 +121,10 @@ class KeyOrderedDispatcher:
         if self._started:
             return
         self._started = True
+        loop = asyncio.get_running_loop()
         self._workers = [
-            asyncio.get_running_loop().create_task(
-                self._serve_lane(i), name=f"{self._name}-lane-{i}"
+            _LaneTask(
+                self._serve_lane(i), loop=loop, name=f"{self._name}-lane-{i}"
             )
             for i in range(self._lanes)
         ]
@@ -84,12 +134,18 @@ class KeyOrderedDispatcher:
         ``drain_timeout`` so shutdown always terminates."""
         self._stopping = True
         drained = True
-        try:
+
+        async def acquire_all() -> None:
             # owning every permit proves no handler is still running
-            async with asyncio.timeout(drain_timeout):
-                for _ in range(2 * self._lanes):
-                    await self._permits.acquire()
-        except TimeoutError:
+            for _ in range(2 * self._lanes):
+                await self._permits.acquire()
+
+        try:
+            # wait_for, not asyncio.timeout: the image runs 3.10, where
+            # asyncio.timeout does not exist (stop() used to raise
+            # AttributeError here and rely on callers suppressing it)
+            await asyncio.wait_for(acquire_all(), drain_timeout)
+        except (TimeoutError, asyncio.TimeoutError):
             drained = False
             logger.warning(
                 "[%s] graceful drain timed out after %.1fs; cancelling in-flight handlers",
@@ -98,8 +154,8 @@ class KeyOrderedDispatcher:
             )
         for q in self._queues:
             q.put_nowait(None)
-        for w in self._workers:
-            if not drained:
+        if not drained:
+            for w in self._workers:
                 w.cancel()
         for w in self._workers:
             try:
@@ -129,24 +185,55 @@ class KeyOrderedDispatcher:
                 record.topic,
             )
         await self._permits.acquire()
-        self._queues[self.lane_of(record.key)].put_nowait(record)
+        self._queues[self.lane_of(record.key)].put_nowait(
+            (record, time.perf_counter())
+        )
 
     # -------------------------------------------------------------- lanes
     async def _serve_lane(self, lane: int) -> None:
         queue = self._queues[lane]
         while True:
-            record = await queue.get()
-            if record is None:
+            item = await queue.get()
+            if item is None:
                 return
+            record, enqueued = item
+            wait_ms = (time.perf_counter() - enqueued) * 1000.0
+            _QUEUE_WAIT.observe(wait_ms)
+            _RECORDS.inc()
+            # traced records get a dispatch span (parent: the emitting
+            # hop's span) covering HANDLER time, with the preceding lane
+            # wait carried as the queue_wait_ms attr; untraced records
+            # (heartbeats, control plane) pay only the two
+            # histogram/counter calls above
+            span = None
+            remote = TraceContext.from_headers(record.headers)
+            if remote is not None:
+                span = TRACER.start_span(
+                    "mesh.dispatch",
+                    parent=remote,
+                    kind="dispatch",
+                    emitter=self._name,
+                    attrs={
+                        "topic": record.topic,
+                        "lane": lane,
+                        "queue_wait_ms": round(wait_ms, 3),
+                    },
+                )
+            status = None
             try:
                 await self._handler(record)
             except asyncio.CancelledError:
-                task = asyncio.current_task()
-                if task is not None and task.cancelling():
-                    raise  # stop() is cancelling this worker
-                # handler-originated cancellation (e.g. it cancelled a child
-                # and let the error escape): a fault, not a shutdown — the
-                # lane must survive or its queued records leak permits
+                # was OUR task cancelled (stop(), asyncio.run teardown, an
+                # enclosing scope — terminate), or did the handler raise
+                # CancelledError itself (a fault the lane must survive)?
+                # _LaneTask records cancel() deliveries so this works on
+                # 3.10 too, where Task.cancelling() does not exist.
+                if _task_cancel_requested(asyncio.current_task()):
+                    if span is not None:
+                        span.end(status="cancelled")
+                        span = None
+                    raise
+                status = "error"
                 logger.exception(
                     "[%s] handler leaked CancelledError on %s (lane %d)",
                     self._name,
@@ -156,6 +243,7 @@ class KeyOrderedDispatcher:
             except BaseException:
                 # the handler owns its fault rail; anything escaping it is a
                 # floor-level bug — log loudly, never kill the lane
+                status = "error"
                 logger.exception(
                     "[%s] handler escaped its fault rail on %s (lane %d)",
                     self._name,
@@ -163,4 +251,6 @@ class KeyOrderedDispatcher:
                     lane,
                 )
             finally:
+                if span is not None:
+                    span.end(status=status)
                 self._permits.release()

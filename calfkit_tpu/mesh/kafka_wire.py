@@ -35,6 +35,7 @@ from dataclasses import dataclass
 from typing import Any, Awaitable, Callable, Mapping
 
 from calfkit_tpu.mesh.connection import DEFAULT_MAX_MESSAGE_BYTES
+from calfkit_tpu.protocol import header_map as protocol_header_map
 from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
 from calfkit_tpu.mesh.tables import TableReader, TableWriter
 from calfkit_tpu.mesh.transport import (
@@ -1613,10 +1614,11 @@ class _WireConsumer:
                     topic=topic,
                     key=key,
                     value=value or b"",
-                    headers={
-                        hk: hv.decode("utf-8", "replace")
-                        for hk, hv in headers
-                    },
+                    # the protocol.header_map contract: undecodable header
+                    # values are DROPPED, not replacement-char'd — a
+                    # garbage x-mesh-trace must degrade to untraced, not
+                    # mint a bogus trace id shared by every corrupt record
+                    headers=protocol_header_map(dict(headers)),
                     offset=off,
                     timestamp=ts_ms / 1000.0,
                 )

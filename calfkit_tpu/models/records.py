@@ -65,3 +65,39 @@ class EngineStatsRecord(BaseModel):
     decode_tokens: int = 0
     decode_dispatches: int = 0
     hbm_gb_in_use: float | None = None  # where the backend reports memory
+    # latency percentiles (ms) from the engine's fixed-bucket histograms:
+    # ttft_p50/p99, inter_token_p50/p99, queue_wait_p50/p99, prefill_p50/p99
+    latency_ms: dict[str, float] | None = None
+    # per-heartbeat-interval deltas (EngineStats.snapshot_and_delta), so
+    # directory readers see rates, not lifetime cumulative values
+    window: dict[str, Any] | None = None
+
+
+class SpanRecord(BaseModel):
+    """One finished trace span, published to the compacted ``mesh.traces``
+    topic (and kept in the process tracer's ring buffer as the zero-broker
+    fallback).  ``trace_id`` equals the run's correlation id by client
+    convention, so ``ck trace <correlation-id>`` needs no join."""
+
+    trace_id: str
+    span_id: str
+    parent_span_id: str | None = None
+    name: str = ""
+    # client | dispatch | agent | tool | consumer | toolbox | engine | internal
+    kind: str = "internal"
+    emitter: str = ""
+    start_s: float = 0.0  # wall clock (epoch seconds): waterfall alignment
+    duration_ms: float = 0.0
+    status: str = "ok"  # ok | error | cancelled
+    attrs: dict[str, Any] = Field(default_factory=dict)
+
+    def span_key(self) -> str:
+        """Compaction key: latest record per span survives."""
+        return f"{self.trace_id}/{self.span_id}"
+
+    def to_wire(self) -> bytes:
+        return self.model_dump_json().encode("utf-8")
+
+    @classmethod
+    def from_wire(cls, data: bytes | str) -> "SpanRecord":
+        return cls.model_validate_json(data)

@@ -17,6 +17,7 @@ redelivered hop cannot double-commit; in-flight ``tool_calls`` /
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Callable, Sequence
 
@@ -61,6 +62,8 @@ from calfkit_tpu.nodes.steps import (
 from calfkit_tpu.nodes.tool import ToolNodeDef, eager_tools
 from calfkit_tpu.peers.handoff import HANDOFF_TOOL, arbitrate_handoff
 from calfkit_tpu.peers.messaging import MESSAGE_AGENT_TOOL
+
+logger = logging.getLogger(__name__)
 
 Instructions = str | Callable[[NodeRunContext], str]
 ToolsSpec = Any  # ToolNodeDef list | ToolBinding list | selector with .resolve()
@@ -177,8 +180,14 @@ class BaseAgentNodeDef(BaseNodeDef):
         from calfkit_tpu.models.records import EngineStatsRecord
 
         try:
+            try:
+                # the heartbeat is THE designated consumer of the
+                # per-interval window (single-consumer delta semantics)
+                snapshot = snapshot_fn(window=True)
+            except TypeError:
+                snapshot = snapshot_fn()  # third-party snapshot: no kwarg
             return EngineStatsRecord(
-                node_id=self.node_id, **snapshot_fn()
+                node_id=self.node_id, **snapshot
             ).model_dump()
         except Exception:  # noqa: BLE001 - metrics must never fault serving
             logger.debug("engine stats snapshot failed", exc_info=True)
@@ -291,17 +300,54 @@ class BaseAgentNodeDef(BaseNodeDef):
         model: ModelClient = self.model
         if self.stream_tokens and ctx.root_topic:
             model = _TokenTap(self.model, self, ctx)
+        # the turn span: child of the hop span, parent of the engine's
+        # prefill/decode spans (propagated via the trace contextvar so the
+        # inference client needs no plumbing).  Untraced hops skip it.
+        from calfkit_tpu.observability.trace import TRACER, current_context
+
+        turn_span = None
+        turn_token = None
+        parent_ctx = current_context.get()
+        if parent_ctx is not None:
+            turn_span = TRACER.start_span(
+                "agent.turn",
+                parent=parent_ctx,
+                kind="agent",
+                emitter=self.emitter,
+                attrs={"model": self.model.model_name},
+            )
+            turn_token = current_context.set(turn_span.context)
         started = time.perf_counter()
-        outcome: TurnOutcome = await run_turn(
-            model,
-            messages,
-            tool_defs=[b.tool for b in bindings] + peer_defs,
-            output_type=self.output_type,
-            settings=self.model_settings,
-            author=self.name,
-            max_output_retries=self.max_output_retries,
-        )
+        try:
+            outcome: TurnOutcome = await run_turn(
+                model,
+                messages,
+                tool_defs=[b.tool for b in bindings] + peer_defs,
+                output_type=self.output_type,
+                settings=self.model_settings,
+                author=self.name,
+                max_output_retries=self.max_output_retries,
+            )
+        except BaseException as exc:
+            if turn_span is not None:
+                import asyncio as _asyncio
+
+                turn_span.end(
+                    status="cancelled"
+                    if isinstance(exc, _asyncio.CancelledError)
+                    else "error"
+                )
+                current_context.reset(turn_token)
+            raise
         elapsed_ms = (time.perf_counter() - started) * 1000.0
+        if turn_span is not None:
+            turn_span.end(
+                decode_ms=round(elapsed_ms, 3),
+                prompt_tokens=outcome.usage.input_tokens,
+                generated_tokens=outcome.usage.output_tokens,
+                tool_calls=len(outcome.tool_calls),
+            )
+            current_context.reset(turn_token)
         facts.append(
             InferenceFact(
                 model_name=self.model.model_name,

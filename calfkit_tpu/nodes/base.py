@@ -143,6 +143,11 @@ class NodeRunContext:
     # captured at stage 0: the run's step-stream destination survives the
     # frame unwind that a ReturnCall performs before flush time
     root_topic: str | None = None
+    # this hop's trace context (the HOP SPAN's id): forwarded in every
+    # outgoing record's headers so downstream hops parent to this hop
+    trace: Any = None  # TraceContext | None
+    # set by _publish_fault so the hop span can record error status
+    fault_error_type: str | None = None
 
     @property
     def state(self) -> State:
@@ -194,6 +199,7 @@ class BaseNodeDef(RegistryMixin):
         self.on_callee_error = list(on_callee_error)
         self.resources: dict[str, Any] = {}
         self._transport: MeshTransport | None = None
+        self._span_tasks: "set[Any]" = set()  # in-flight span exports
 
     # ------------------------------------------------------------ identity
     @property
@@ -285,6 +291,34 @@ class BaseNodeDef(RegistryMixin):
         )
         log_id = (correlation_id or task_id)[:8]
 
+        # ---- tracing: one HOP SPAN per traced delivery.  A missing trace
+        # header is legal (pre-trace emitters, external producers) — the
+        # hop simply runs untraced.  Everything here is fail-open.
+        from calfkit_tpu.observability import trace as _trace
+
+        hop_span = None
+        sink: list[Any] = []
+        sink_token = ctx_token = None
+        remote = _trace.TraceContext.from_headers(headers)
+        if remote is not None:
+            hop_span = _trace.TRACER.start_span(
+                f"{self.kind}.hop",
+                parent=remote,
+                kind=self.kind,
+                emitter=self.emitter,
+                attrs={
+                    "node": self.node_id,
+                    "topic": record.topic,
+                    "route": route,
+                    "delivery": kind,
+                },
+            )
+            ctx.trace = hop_span.context
+            ctx_token = _trace.current_context.set(hop_span.context)
+            # in-process children (the inference engine's spans) land in
+            # this hop's sink so they ride the same topic publish below
+            sink, sink_token = _trace.collect_spans()
+
         try:
             await self._execute(ctx)
         except MintedFault as minted:
@@ -316,8 +350,35 @@ class BaseNodeDef(RegistryMixin):
             if not recovered:
                 # a failed recovery must not swallow the original fault
                 await self._publish_fault(ctx, report)
+        except BaseException as exc:
+            # cancellation (lane force-cancel, loop teardown) and other
+            # non-Exception escapes: record the truth on the hop span NOW
+            # (end() is idempotent — the finally's end() becomes a no-op),
+            # then propagate.  Captured locally, not via sys.exc_info() in
+            # the finally, which also reports outer HANDLED exceptions.
+            if hop_span is not None:
+                import asyncio as _asyncio
+
+                hop_span.end(
+                    status="cancelled"
+                    if isinstance(exc, _asyncio.CancelledError)
+                    else "error"
+                )
+            raise
         finally:
             await self._flush_steps(ctx)
+            if hop_span is not None:
+                if ctx.fault_error_type is not None:
+                    hop_span.end(
+                        status="error", error_type=ctx.fault_error_type
+                    )
+                else:
+                    hop_span.end()
+                if ctx_token is not None:
+                    _trace.current_context.reset(ctx_token)
+                if sink_token is not None:
+                    _trace.release_spans(sink_token)
+                self._publish_spans_soon(sink)
 
     def _own_fault_type(self) -> str:
         return FaultTypes.NODE_ERROR
@@ -733,6 +794,7 @@ class BaseNodeDef(RegistryMixin):
 
     # ---------------------------------------------------------------- fault
     async def _publish_fault(self, ctx: NodeRunContext, report: ErrorReport) -> None:
+        ctx.fault_error_type = report.error_type  # hop span → status=error
         envelope = ctx.envelope
         if envelope.workflow.depth == 0:
             # no caller: the fault rail's floor
@@ -819,6 +881,9 @@ class BaseNodeDef(RegistryMixin):
             headers[protocol.HDR_CORRELATION] = ctx.correlation_id
         if error_type:
             headers[protocol.HDR_ERROR_TYPE] = error_type
+        if ctx.trace is not None:
+            # downstream hops parent to THIS hop's span
+            headers.update(ctx.trace.headers())
         await self.transport.publish(
             topic,
             envelope.to_wire(),
@@ -843,6 +908,26 @@ class BaseNodeDef(RegistryMixin):
                     self.node_id,
                     exc_info=True,
                 )
+
+    def _publish_spans_soon(self, records: "list[Any]") -> None:
+        """Export the hop's finished spans off the delivery critical path
+        (the dispatcher lane permit is still held here) via the shared
+        fire-and-forget helper; the tracer's ring buffer already holds
+        every record, so a failed publish degrades to in-process
+        visibility."""
+        if self._transport is None:
+            return
+        from calfkit_tpu.observability.trace import publish_spans_soon
+
+        publish_spans_soon(
+            self._transport.publish,
+            records,
+            self._span_tasks,
+            on_error=lambda exc: logger.debug(
+                "[%s] span publish failed (run unaffected): %s",
+                self.node_id, exc,
+            ),
+        )
 
     async def _flush_steps(self, ctx: NodeRunContext) -> None:
         if not ctx.ledger.has_steps:

@@ -1,0 +1,47 @@
+"""Observability: mesh-wide distributed tracing + engine latency telemetry.
+
+Three pieces (ISSUE 2 tentpole):
+
+- :mod:`~calfkit_tpu.observability.trace` — ``TraceContext`` propagation
+  over Kafka record headers, spans, the process tracer with its bounded
+  ring buffer (zero-broker fallback), and the ``mesh.traces`` export seam.
+- :mod:`~calfkit_tpu.observability.metrics` — the dependency-free
+  counter/gauge/histogram registry and Prometheus text exposition
+  (``metrics_text``).
+- :mod:`~calfkit_tpu.observability.http` — the optional asyncio
+  ``/metrics`` endpoint.
+
+Everything here is fail-open: telemetry errors never fault serving.
+"""
+
+from calfkit_tpu.observability.metrics import (
+    REGISTRY,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    metrics_text,
+)
+from calfkit_tpu.observability.trace import (
+    TRACER,
+    Span,
+    TraceContext,
+    Tracer,
+    current_context,
+)
+from calfkit_tpu.observability.http import MetricsServer
+
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "MetricsServer",
+    "metrics_text",
+    "TRACER",
+    "Span",
+    "TraceContext",
+    "Tracer",
+    "current_context",
+]

@@ -1,0 +1,105 @@
+"""A tiny asyncio HTTP endpoint for the metrics exposition.
+
+No aiohttp, no framework: ``asyncio.start_server`` + a minimal HTTP/1.0
+responder serving ``GET /metrics`` (Prometheus text v0) and ``GET
+/healthz``.  This is an OPTIONAL operator convenience — nothing in the
+serving path depends on it — so every failure mode closes the offending
+connection and keeps listening.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from calfkit_tpu.observability.metrics import MetricsRegistry, metrics_text
+
+logger = logging.getLogger(__name__)
+
+_MAX_REQUEST_BYTES = 8192
+
+
+class MetricsServer:
+    """``async with MetricsServer(port=9100): ...`` or start()/stop()."""
+
+    def __init__(
+        self,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        registry: MetricsRegistry | None = None,
+    ):
+        self.host = host
+        self.port = port  # 0 = OS-assigned; read back after start()
+        self._registry = registry
+        self._server: asyncio.Server | None = None
+
+    async def start(self) -> None:
+        if self._server is not None:
+            return
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        sockets = self._server.sockets or []
+        if sockets:
+            self.port = sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is None:
+            return
+        self._server.close()
+        try:
+            await self._server.wait_closed()
+        except Exception:  # noqa: BLE001
+            pass
+        self._server = None
+
+    async def __aenter__(self) -> "MetricsServer":
+        await self.start()
+        return self
+
+    async def __aexit__(self, *exc: object) -> None:
+        await self.stop()
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            request = await asyncio.wait_for(
+                reader.readline(), timeout=5.0
+            )
+            if len(request) > _MAX_REQUEST_BYTES:
+                raise ValueError("request line too long")
+            parts = request.decode("latin-1", "replace").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            # drain headers (bounded) so keep-alive clients see a clean close
+            drained = 0
+            while True:
+                line = await asyncio.wait_for(reader.readline(), timeout=5.0)
+                drained += len(line)
+                if line in (b"\r\n", b"\n", b"") or drained > _MAX_REQUEST_BYTES:
+                    break
+            if path.split("?", 1)[0] == "/metrics":
+                body = metrics_text(self._registry).encode("utf-8")
+                status, ctype = "200 OK", "text/plain; version=0.0.4"
+            elif path.split("?", 1)[0] == "/healthz":
+                body, status, ctype = b"ok\n", "200 OK", "text/plain"
+            else:
+                body, status, ctype = b"not found\n", "404 Not Found", "text/plain"
+            writer.write(
+                (
+                    f"HTTP/1.0 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode("latin-1")
+                + body
+            )
+            await writer.drain()
+        except Exception:  # noqa: BLE001 - a bad client never kills the server
+            logger.debug("metrics endpoint request failed", exc_info=True)
+        finally:
+            try:
+                writer.close()
+            except Exception:  # noqa: BLE001
+                pass

@@ -17,6 +17,10 @@ Every envelope-bearing record carries:
 - ``x-mesh-task``      — task id (uuid); equals the partition key's source
 - ``x-mesh-correlation`` — correlation id of the whole run (client-minted)
 - ``x-mesh-error-type`` — fault records only: the typed fault code
+- ``x-mesh-trace``     — distributed-trace id (client-minted, equals the
+                         correlation id by convention)
+- ``x-mesh-span``      — the EMITTING hop's span id; the receiving hop
+                         parents its own span to it
 
 Headers are advisory routing/telemetry metadata; the envelope body is always
 authoritative.  Consumers must tolerate missing headers (a ``None`` decode).
@@ -37,6 +41,8 @@ HDR_ROUTE: Final = "x-mesh-route"
 HDR_TASK: Final = "x-mesh-task"
 HDR_CORRELATION: Final = "x-mesh-correlation"
 HDR_ERROR_TYPE: Final = "x-mesh-error-type"
+HDR_TRACE: Final = "x-mesh-trace"
+HDR_SPAN: Final = "x-mesh-span"
 
 ALL_HEADERS: Final = (
     HDR_EMITTER,
@@ -46,6 +52,8 @@ ALL_HEADERS: Final = (
     HDR_TASK,
     HDR_CORRELATION,
     HDR_ERROR_TYPE,
+    HDR_TRACE,
+    HDR_SPAN,
 )
 
 # --------------------------------------------------------------------------- #
@@ -54,10 +62,10 @@ ALL_HEADERS: Final = (
 
 NodeKind = Literal["agent", "tool", "consumer", "toolbox", "client", "worker"]
 MessageKind = Literal["call", "return", "fault"]
-WireKind = Literal["envelope", "step"]
+WireKind = Literal["envelope", "step", "span"]
 
 MESSAGE_KINDS: Final = ("call", "return", "fault")
-WIRE_KINDS: Final = ("envelope", "step")
+WIRE_KINDS: Final = ("envelope", "step", "span")
 
 # --------------------------------------------------------------------------- #
 # decode helpers
@@ -188,6 +196,11 @@ def client_inbox_topic(client_id: str) -> str:
 AGENTS_TOPIC: Final = "mesh.agents"
 CAPABILITIES_TOPIC: Final = "mesh.capabilities"
 ENGINE_STATS_TOPIC: Final = "mesh.engine_stats"
+# compacted span stream (key = trace_id/span_id: compaction dedupes
+# re-emissions; spans are one-shot keys, so production clusters should
+# ALSO set time retention — cleanup.policy=compact,delete — to bound
+# total growth; see docs/observability.md)
+TRACES_TOPIC: Final = "mesh.traces"
 
 
 def fanout_state_topic(node_id: str) -> str:

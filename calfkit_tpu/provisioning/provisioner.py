@@ -91,6 +91,7 @@ def framework_topics_for_nodes(nodes: Iterable[BaseNodeDef]) -> list[str]:
         protocol.AGENTS_TOPIC,
         protocol.CAPABILITIES_TOPIC,
         protocol.ENGINE_STATS_TOPIC,
+        protocol.TRACES_TOPIC,
     }
     for node in nodes:
         topics.add(protocol.fanout_state_topic(node.node_id))

@@ -1,0 +1,111 @@
+"""CI lint: the decode hot path must stay free of per-token overhead.
+
+Parses ``calfkit_tpu/inference/engine.py`` and checks the dispatch-loop
+functions (the per-tick code that runs between device dispatches) for
+constructs the telemetry PR explicitly bans there (ISSUE 2):
+
+- ``time.time()`` — the wall clock syscall is slower than
+  ``time.perf_counter()`` and wrong for durations; latency attribution in
+  the dispatch loop must use perf_counter.
+- logging calls (``logger.*``, ``logging.*``, ``print``) — a log line per
+  dispatch (let alone per token) is an I/O stall on the serving path;
+  telemetry goes through the O(1) metrics instruments instead.
+
+Exit 0 when clean; exit 1 with a file:line listing otherwise.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+ENGINE = Path(__file__).resolve().parent.parent / (
+    "calfkit_tpu/inference/engine.py"
+)
+
+# the dispatch loop: every function that runs per decode tick (or inside
+# one) on the scheduler/decode threads
+HOT_FUNCTIONS = {
+    "_decode_tick",
+    "_spec_decode_tick",
+    "_note_dispatch",
+    "_observe",
+    "_update_active_gauge",
+    "_sync_metric_counters",
+    "_record_token",
+    "_retire_slot",
+    "_retirement_near",
+    "_retirement_bound",
+    "_deliver_batch",
+}
+
+BANNED_CALL_NAMES = {"print"}
+BANNED_ATTR_CALLS = {
+    ("time", "time"),  # wall clock on the hot path
+}
+BANNED_RECEIVERS = {"logger", "logging"}  # any logging call
+
+
+def _violations(tree: ast.AST) -> list[tuple[int, str]]:
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in HOT_FUNCTIONS:
+            continue
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = call.func
+            if isinstance(fn, ast.Name) and fn.id in BANNED_CALL_NAMES:
+                out.append((call.lineno, f"{node.name}: call to {fn.id}()"))
+            elif isinstance(fn, ast.Attribute) and isinstance(
+                fn.value, ast.Name
+            ):
+                pair = (fn.value.id, fn.attr)
+                if pair in BANNED_ATTR_CALLS:
+                    out.append(
+                        (call.lineno,
+                         f"{node.name}: {pair[0]}.{pair[1]}() (use "
+                         "time.perf_counter)")
+                    )
+                elif fn.value.id in BANNED_RECEIVERS:
+                    out.append(
+                        (call.lineno,
+                         f"{node.name}: {fn.value.id}.{fn.attr}() — no "
+                         "logging on the dispatch loop")
+                    )
+    return sorted(out)
+
+
+def main() -> int:
+    source = ENGINE.read_text()
+    tree = ast.parse(source, filename=str(ENGINE))
+    found = _violations(tree)
+    # the guarded function set must actually exist — a rename must break
+    # this lint loudly, not silently lint nothing
+    names = {
+        n.name
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    missing = {"_decode_tick", "_record_token", "_note_dispatch"} - names
+    if missing:
+        print(f"lint_hotpath: guarded functions missing from engine.py: "
+              f"{sorted(missing)} (update HOT_FUNCTIONS)")
+        return 1
+    if found:
+        for line, message in found:
+            print(f"{ENGINE}:{line}: {message}")
+        print(f"lint_hotpath: {len(found)} hot-path violation(s)")
+        return 1
+    print(
+        f"lint_hotpath: clean ({len(HOT_FUNCTIONS & names)} dispatch-loop "
+        "functions checked)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

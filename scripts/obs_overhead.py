@@ -1,0 +1,217 @@
+"""Observability-overhead microbench → OBS_OVERHEAD.json.
+
+SCHED_OVERHEAD-style host-stub measurement: the device is removed (decode
+and prefill jits replaced by shape-faithful instant stubs), so the tok/s
+measured is pure host-side scheduler + telemetry cost.  The workload runs
+with observability ON (the default: histograms observed per dispatch,
+tracer enabled) and OFF (``REGISTRY.set_enabled(False)`` +
+``TRACER.set_enabled(False)``).
+
+Acceptance bar (ISSUE 2): **< 2% decode throughput delta**.  Two
+estimators ship in the artifact:
+
+- ``implied_delta_pct`` (THE gated value): the per-dispatch
+  instrumentation bundle (exactly what ``_note_dispatch`` adds — two
+  histogram observes, a gauge set, the counter sync) timed directly over
+  many iterations, converted to a throughput delta against the measured
+  host cost per dispatch.  Deterministic at the sub-percent level.
+- ``ab_delta_pct`` (evidence, not gated): best-of-N tok/s with
+  observability on vs off.  On a shared-CPU container, individual runs
+  jitter ±15-25% — far above a 2% effect — so the A/B number is reported
+  for transparency but cannot gate (observed here: the sign flips
+  rep-to-rep).
+
+Prints one JSON line; ``--out PATH`` writes the committed artifact.
+Exits non-zero when the bar is violated.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from calfkit_tpu.inference.config import RuntimeConfig, preset  # noqa: E402
+from calfkit_tpu.inference.engine import InferenceEngine  # noqa: E402
+from calfkit_tpu.observability.metrics import REGISTRY  # noqa: E402
+from calfkit_tpu.observability.trace import TRACER  # noqa: E402
+
+BS = 64
+STEPS = 32
+NEW_TOKENS = 128
+REPS = 8
+DELTA_BAR_PCT = 2.0
+
+
+def _stub_jits(engine: InferenceEngine, bs: int) -> None:
+    """Shape-faithful instant stubs at the JIT boundary (the
+    scripts/sched_overhead.py discipline): all real host-side scheduler
+    AND telemetry work still runs and is what gets measured."""
+
+    def fake_decode(window: int, steps: int | None = None, sampled: bool = False):
+        steps = steps or engine.runtime.decode_steps_per_dispatch
+
+        def run(params, k, v, *rest):
+            toks = jnp.ones((steps, bs), jnp.int32)
+            if engine._paged:
+                tables, last, lens, *_ = rest
+            else:
+                last, lens, *_ = rest
+            return k, v, last, lens, toks
+
+        return run
+
+    def fake_prefill_jit(bucket: int, rows: int, sampled: bool = False):
+        def run(params, k, v, last, lens, tokens, slots, true_lens,
+                slot_keys, temp, top_k, top_p,
+                seeds, w_temp, w_top_k, w_top_p,
+                tables=None, page_rows=None, scatter_ids=None):
+            firsts = jnp.ones((rows,), jnp.int32)
+            return k, v, tables, last, lens, slot_keys, temp, top_k, top_p, firsts
+
+        return run
+
+    engine._decode_jit = fake_decode
+    engine._prefill_jit = fake_prefill_jit
+
+
+async def _one_rep() -> float:
+    """One full serve of 2*BS requests; returns decode tok/s (host wall)."""
+    config = preset("debug", max_seq_len=256)
+    runtime = RuntimeConfig(
+        max_batch_size=BS, max_seq_len=256, prefill_chunk=32,
+        decode_steps_per_dispatch=STEPS,
+    )
+    engine = InferenceEngine(config, runtime)
+    _stub_jits(engine, BS)
+    await engine.start()
+
+    async def one(i: int) -> int:
+        n = 0
+        async for _ in engine.generate(
+            list(range(1, 18)), max_new_tokens=NEW_TOKENS
+        ):
+            n += 1
+        return n
+
+    t0 = time.perf_counter()
+    counts = await asyncio.gather(*[one(i) for i in range(2 * BS)])
+    wall = time.perf_counter() - t0
+    tokens = engine.stats.decode_tokens
+    await engine.stop()
+    assert all(c == NEW_TOKENS for c in counts), "stub served wrong lengths"
+    return tokens / wall
+
+
+def _instrumentation_bundle_us(iters: int = 20000) -> float:
+    """Median-of-5 timing of one dispatch's instrumentation: exactly the
+    calls ``_note_dispatch`` adds — dual histogram observes (process +
+    per-engine registries), the gauge set, and the locked counter sync
+    against a drifting stats object."""
+    import threading
+
+    from calfkit_tpu.inference.engine import EngineStats, _engine_metrics
+    from calfkit_tpu.observability.metrics import MetricsRegistry
+
+    m = _engine_metrics()
+    own = _engine_metrics(MetricsRegistry())
+    stats = EngineStats()
+    counted = {"decode_tokens": 0, "prefill_tokens": 0,
+               "spec_proposed": 0, "spec_accepted": 0}
+    lock = threading.Lock()
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            stats.decode_tokens += BS * STEPS  # the sync always has work
+            for pair_key, value in (
+                ("decode_dispatch_ms", 18.0), ("inter_token_ms", 18.0 / STEPS)
+            ):
+                m[pair_key].observe(value)
+                own[pair_key].observe(value)
+            m["active_requests"].set(BS)
+            with lock:
+                for key in counted:
+                    value = getattr(stats, key)
+                    if value != counted[key]:
+                        m[key].inc(value - counted[key])
+                        counted[key] = value
+        samples.append((time.perf_counter() - t0) / iters * 1e6)
+    samples.sort()
+    return samples[2]
+
+
+async def run() -> dict:
+    # one discarded warmup rep: jit tracing / allocator warmup must not be
+    # billed to either mode
+    await _one_rep()
+    on_runs: list[float] = []
+    off_runs: list[float] = []
+    for rep in range(REPS):
+        order = (True, False) if rep % 2 == 0 else (False, True)
+        for mode_on in order:
+            REGISTRY.set_enabled(mode_on)
+            TRACER.set_enabled(mode_on)
+            (on_runs if mode_on else off_runs).append(await _one_rep())
+    REGISTRY.set_enabled(True)
+    TRACER.set_enabled(True)
+    best_on, best_off = max(on_runs), max(off_runs)
+    ab_delta_pct = (best_off - best_on) / best_off * 100.0
+
+    # the gated estimator: time EXACTLY the per-dispatch instrumentation
+    # bundle, convert to a throughput delta against the measured host
+    # cost of one dispatch (host-stub throughput is host-bound, so the
+    # added fraction of dispatch time IS the throughput delta)
+    bundle_us = _instrumentation_bundle_us()
+    tokens_per_dispatch = BS * STEPS
+    host_us_per_dispatch = tokens_per_dispatch / best_on * 1e6
+    implied_delta_pct = bundle_us / host_us_per_dispatch * 100.0
+    ok = implied_delta_pct < DELTA_BAR_PCT
+    return {
+        "metric": f"obs_overhead[host-stub bs={BS} steps={STEPS}]",
+        "value": round(implied_delta_pct, 4),
+        "unit": "pct_decode_throughput_delta_implied",
+        "bar_pct": DELTA_BAR_PCT,
+        "ok": ok,
+        "instrumentation_us_per_dispatch": round(bundle_us, 3),
+        "host_us_per_dispatch": round(host_us_per_dispatch, 1),
+        "tok_s_observability_on": round(best_on, 1),
+        "tok_s_observability_off": round(best_off, 1),
+        "ab_delta_pct_best_of": round(ab_delta_pct, 3),
+        "ab_note": (
+            "A/B wall-clock deltas on this container jitter far above the "
+            "2% bar (sign flips rep-to-rep); the implied delta from the "
+            "directly-timed instrumentation bundle is the gated value"
+        ),
+        "runs_on": [round(r, 1) for r in on_runs],
+        "runs_off": [round(r, 1) for r in off_runs],
+        "reps": REPS,
+        "new_tokens_per_request": NEW_TOKENS,
+        "requests": 2 * BS,
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None, help="also write JSON here")
+    ns = parser.parse_args()
+    result = asyncio.run(run())
+    line = json.dumps(result)
+    print(line)
+    if ns.out:
+        with open(ns.out, "w") as f:
+            f.write(line + "\n")
+    sys.exit(0 if result["ok"] else 1)

@@ -79,6 +79,50 @@ class TestRoundTripProperties:
         out = decode_record_batches(a + b)
         assert [v for *_, v, _h in out] == [b"1", b"2", b"3"]
 
+    def test_fuzzed_trace_headers_round_trip_or_degrade(self):
+        """ISSUE 2 satellite fuzz: trace headers with arbitrary byte
+        values always survive the codec byte-exactly, and the consumer
+        side (header_map + TraceContext.from_headers) either yields a
+        valid context or degrades to untraced — never raises."""
+        from calfkit_tpu import protocol
+        from calfkit_tpu.observability.trace import TraceContext
+
+        rng = random.Random(99)
+        for _ in range(200):
+            # mix of valid utf-8 ids, arbitrary bytes, empty values, and
+            # a missing span/trace header in some iterations
+            headers: list[tuple[str, bytes]] = []
+            if rng.random() < 0.8:
+                value = (
+                    rng.randbytes(rng.randint(0, 48))
+                    if rng.random() < 0.5
+                    else ("corr-%d" % rng.randint(0, 9999)).encode()
+                )
+                headers.append((protocol.HDR_TRACE, value))
+            if rng.random() < 0.8:
+                headers.append(
+                    (protocol.HDR_SPAN, rng.randbytes(rng.randint(0, 32)))
+                )
+            headers.extend(
+                (
+                    "".join(rng.choices("abcxyz-._", k=rng.randint(1, 12))),
+                    rng.randbytes(rng.randint(0, 64)),
+                )
+                for _ in range(rng.randint(0, 3))
+            )
+            blob = encode_record_batch(
+                [(rng.randbytes(4) or None, b"v", headers)],
+                rng.randint(0, 2**40),
+            )
+            [(_o, _t, _k, _v, decoded)] = decode_record_batches(blob)
+            assert decoded == headers  # codec is byte-exact
+            ctx = TraceContext.from_headers(protocol.header_map(dict(decoded)))
+            if ctx is not None:
+                assert ctx.trace_id  # never an empty trace id
+                # a context implies the trace header decoded as utf-8
+                raw = dict(decoded)[protocol.HDR_TRACE]
+                assert ctx.trace_id == raw.decode("utf-8")
+
 
 class TestCorruption:
     def test_truncation_at_every_boundary(self):

@@ -73,6 +73,50 @@ class TestWireCodec:
         assert [(k, v, h) for _o, _t, k, v, h in out] == records
         assert [o for o, *_ in out] == [0, 1, 2]
 
+    def test_trace_headers_round_trip_through_record_batch(self):
+        """ISSUE 2 satellite: the trace headers the observability layer
+        rides on survive encode/decode — wire header values come back as
+        BYTES and normalize through protocol.header_map, with missing
+        headers tolerated (a None decode, never a KeyError)."""
+        from calfkit_tpu import protocol
+        from calfkit_tpu.observability.trace import TraceContext
+
+        ctx = TraceContext(trace_id="corr-42", span_id="span-7")
+        wire_headers = [
+            (name, value.encode("utf-8"))
+            for name, value in (
+                ctx.headers() | {protocol.HDR_CORRELATION: "corr-42"}
+            ).items()
+        ]
+        blob = encode_record_batch([(b"k", b"v", wire_headers)], 1234)
+        [(_o, _t, _k, _v, decoded)] = decode_record_batches(blob)
+        # bytes-vs-str: raw wire values are bytes; header_map normalizes
+        assert all(isinstance(v, bytes) for _n, v in decoded)
+        normalized = protocol.header_map(dict(decoded))
+        back = TraceContext.from_headers(normalized)
+        assert back is not None
+        assert back.trace_id == "corr-42"
+        assert back.span_id == "span-7"
+        assert normalized[protocol.HDR_CORRELATION] == "corr-42"
+
+    def test_missing_and_undecodable_trace_headers_tolerated(self):
+        from calfkit_tpu import protocol
+        from calfkit_tpu.observability.trace import TraceContext
+
+        # no headers at all survives the round trip as an untraced record
+        blob = encode_record_batch([(b"k", b"v", [])], 1)
+        [(_o, _t, _k, _v, decoded)] = decode_record_batches(blob)
+        assert TraceContext.from_headers(protocol.header_map(dict(decoded))) is None
+        # an undecodable trace header value is DROPPED by header_map, so
+        # the record degrades to untraced instead of crashing the consumer
+        blob = encode_record_batch(
+            [(b"k", b"v", [(protocol.HDR_TRACE, b"\xff\xfe\xfd")])], 1
+        )
+        [(_o, _t, _k, _v, decoded)] = decode_record_batches(blob)
+        normalized = protocol.header_map(dict(decoded))
+        assert protocol.HDR_TRACE not in normalized
+        assert TraceContext.from_headers(normalized) is None
+
     def test_range_assign_splits_evenly(self):
         members = {"m-1": ["a"], "m-2": ["a"]}
         partitions = {"a": [0, 1, 2, 3, 4]}

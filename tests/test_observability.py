@@ -1,0 +1,393 @@
+"""Unit coverage for the observability subsystem (ISSUE 2): the metrics
+registry + Prometheus exposition, trace contexts/spans/ring buffer, the
+dispatcher lane telemetry, the stats-snapshot key-set contract, the
+EngineStats windowing story, the asyncio metrics endpoint, and the CLI
+renderers."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from calfkit_tpu import protocol
+from calfkit_tpu.models.records import EngineStatsRecord, SpanRecord
+from calfkit_tpu.observability.metrics import (
+    MetricsRegistry,
+    metrics_text,
+)
+from calfkit_tpu.observability.trace import (
+    TRACER,
+    TraceContext,
+    Tracer,
+    collect_spans,
+    current_context,
+    release_spans,
+)
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_roundtrip(self):
+        reg = MetricsRegistry()
+        c = reg.counter("jobs_total", "jobs")
+        c.inc()
+        c.inc(4)
+        c.inc(-3)  # counters are monotonic: dropped, not raised
+        g = reg.gauge("depth", "queue depth")
+        g.set(7)
+        assert c.value == 5
+        assert g.value == 7
+        text = reg.render()
+        assert "# TYPE jobs_total counter" in text
+        assert "jobs_total 5" in text
+        assert "# TYPE depth gauge" in text
+        assert "depth 7" in text
+
+    def test_get_or_create_shares_instruments(self):
+        reg = MetricsRegistry()
+        a = reg.counter("shared")
+        b = reg.counter("shared")
+        assert a is b
+        with pytest.raises(ValueError, match="already registered"):
+            reg.gauge("shared")
+
+    def test_histogram_buckets_and_exposition(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat_ms", "latency", buckets=(1.0, 10.0, 100.0))
+        for v in (0.5, 5.0, 5.0, 50.0, 5000.0):
+            h.observe(v)
+        assert h.count == 5
+        assert h.sum == pytest.approx(5060.5)
+        text = h.render()
+        # cumulative per-bucket counts + the +Inf catch-all
+        assert 'lat_ms_bucket{le="1"} 1' in text
+        assert 'lat_ms_bucket{le="10"} 3' in text
+        assert 'lat_ms_bucket{le="100"} 4' in text
+        assert 'lat_ms_bucket{le="+Inf"} 5' in text
+        assert "lat_ms_count 5" in text
+
+    def test_histogram_percentile_is_bucket_upper_bound(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("p_ms", buckets=(1.0, 10.0, 100.0))
+        assert h.percentile(0.99) == 0.0  # empty: defined, not a crash
+        for _ in range(99):
+            h.observe(5.0)
+        h.observe(5000.0)
+        assert h.percentile(0.5) == 10.0
+        assert h.percentile(0.99) == 10.0
+        assert h.percentile(1.0) == 100.0  # +Inf clamps to the last bound
+
+    def test_snapshot_and_delta_windows(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("w_ms", buckets=(10.0,))
+        c = reg.counter("w_total")
+        h.observe(5.0)
+        c.inc(3)
+        cum, delta = h.snapshot_and_delta()
+        assert cum["count"] == 1 and delta["count"] == 1
+        h.observe(50.0)
+        cum, delta = h.snapshot_and_delta()
+        assert cum["count"] == 2
+        assert delta["count"] == 1
+        assert delta["counts"] == [0, 1]
+        assert c.snapshot_and_delta() == (3, 3)
+        assert c.snapshot_and_delta() == (3, 0)
+
+    def test_disabled_registry_records_nothing(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("off_ms")
+        reg.set_enabled(False)
+        h.observe(5.0)
+        reg.counter("off_total").inc()
+        assert h.count == 0
+        assert reg.counter("off_total").value == 0
+        reg.set_enabled(True)
+        h.observe(5.0)
+        assert h.count == 1
+
+    def test_bad_values_never_raise(self):
+        reg = MetricsRegistry()
+        reg.histogram("bad_ms").observe("nan-soup")  # type: ignore[arg-type]
+        reg.counter("bad_total").inc("many")  # type: ignore[arg-type]
+        reg.gauge("bad_gauge").set(object())  # type: ignore[arg-type]
+
+    def test_metrics_text_process_registry(self):
+        # the process registry carries the engine/dispatch instruments:
+        # rendering must always be valid exposition, never raise
+        text = metrics_text()
+        assert isinstance(text, str)
+
+
+class TestTraceContext:
+    def test_header_round_trip(self):
+        ctx = TraceContext(trace_id="t1", span_id="s1")
+        headers = ctx.headers()
+        assert headers == {
+            protocol.HDR_TRACE: "t1", protocol.HDR_SPAN: "s1"
+        }
+        back = TraceContext.from_headers(headers)
+        assert back is not None
+        assert (back.trace_id, back.span_id) == ("t1", "s1")
+
+    def test_missing_headers_tolerated(self):
+        assert TraceContext.from_headers({}) is None
+        assert TraceContext.from_headers({protocol.HDR_SPAN: "s"}) is None
+        # trace without span: legal (root-emitted records)
+        ctx = TraceContext.from_headers({protocol.HDR_TRACE: "t"})
+        assert ctx is not None and ctx.span_id == ""
+
+    def test_bytes_header_values_via_header_map(self):
+        raw = {
+            protocol.HDR_TRACE: b"t-bytes",
+            protocol.HDR_SPAN: b"s-bytes",
+            "x-junk": b"\xff\xfe",  # undecodable: dropped by header_map
+        }
+        ctx = TraceContext.from_headers(protocol.header_map(raw))
+        assert ctx is not None
+        assert ctx.trace_id == "t-bytes" and ctx.span_id == "s-bytes"
+
+
+class TestTracer:
+    def test_span_parenting_and_ring(self):
+        tracer = Tracer()
+        root = tracer.start_span("root", trace_id="trace-A", kind="client")
+        child = tracer.start_span("child", parent=root.context, kind="agent")
+        grandchild = tracer.start_span("gc", parent=child.context)
+        grandchild.end()
+        child.end(status="error", error_type="boom")
+        root.end()
+        spans = tracer.finished("trace-A")
+        assert [s.name for s in spans] == ["gc", "child", "root"]
+        by_name = {s.name: s for s in spans}
+        assert by_name["child"].parent_span_id == root.context.span_id
+        assert by_name["gc"].parent_span_id == child.context.span_id
+        assert by_name["root"].parent_span_id is None
+        assert by_name["child"].status == "error"
+        assert by_name["child"].attrs["error_type"] == "boom"
+        assert by_name["root"].duration_ms >= 0.0
+
+    def test_end_is_idempotent(self):
+        tracer = Tracer()
+        span = tracer.start_span("once", trace_id="t")
+        assert span.end() is not None
+        assert span.end() is None
+        assert len(tracer.finished("t")) == 1
+
+    def test_ring_is_bounded(self):
+        tracer = Tracer(ring_size=4)
+        for i in range(10):
+            tracer.start_span(f"s{i}", trace_id="t").end()
+        names = [s.name for s in tracer.finished("t")]
+        assert names == ["s6", "s7", "s8", "s9"]
+
+    def test_sink_collects_spans_finished_under_it(self):
+        tracer = Tracer()
+        tracer.start_span("before", trace_id="t").end()
+        sink, token = collect_spans()
+        tracer.start_span("inside", trace_id="t").end()
+        release_spans(token)
+        tracer.start_span("after", trace_id="t").end()
+        assert [s.name for s in sink] == ["inside"]
+
+    def test_disabled_tracer_exports_nothing(self):
+        tracer = Tracer()
+        tracer.set_enabled(False)
+        tracer.start_span("ghost", trace_id="t").end()
+        assert tracer.finished("t") == []
+        tracer.set_enabled(True)
+
+    def test_span_record_wire_round_trip(self):
+        record = SpanRecord(
+            trace_id="t", span_id="s", name="n", kind="engine",
+            start_s=123.0, duration_ms=4.5, attrs={"x": 1},
+        )
+        back = SpanRecord.from_wire(record.to_wire())
+        assert back == record
+        assert record.span_key() == "t/s"
+
+
+class TestDispatcherTelemetry:
+    async def test_traced_record_gets_dispatch_span(self):
+        from calfkit_tpu.mesh.dispatch import KeyOrderedDispatcher
+        from calfkit_tpu.mesh.transport import Record
+
+        handled = []
+
+        async def handler(record):
+            handled.append(record.topic)
+
+        dispatcher = KeyOrderedDispatcher(handler, max_workers=2)
+        dispatcher.start()
+        ctx = TraceContext(trace_id="disp-trace", span_id="parent-span")
+        await dispatcher.submit(
+            Record(topic="traced", value=b"x", key=b"k", headers=ctx.headers())
+        )
+        await dispatcher.submit(
+            Record(topic="untraced", value=b"x", key=b"k")
+        )
+        await dispatcher.stop()
+        assert sorted(handled) == ["traced", "untraced"]
+        spans = TRACER.finished("disp-trace")
+        assert len(spans) == 1
+        assert spans[0].name == "mesh.dispatch"
+        assert spans[0].parent_span_id == "parent-span"
+        assert spans[0].attrs["topic"] == "traced"
+        assert "queue_wait_ms" in spans[0].attrs
+
+
+class TestStatsSnapshotContract:
+    def test_cold_snapshot_has_live_key_set(self):
+        """Satellite 1: a cold engine's snapshot carries the same keys as
+        the live branch (zeros), so control-plane consumers never KeyError."""
+        from calfkit_tpu.inference.client import JaxLocalModelClient
+        from calfkit_tpu.inference.config import RuntimeConfig
+
+        client = JaxLocalModelClient(
+            config="debug",
+            runtime=RuntimeConfig(max_batch_size=3, kv_layout="dense"),
+        )
+        cold = client.stats_snapshot()
+        expected = {
+            "model_name", "platform", "tokens_per_second", "mean_occupancy",
+            "active_requests", "free_slots", "max_batch_size", "kv_layout",
+            "prefill_tokens", "decode_tokens", "decode_dispatches",
+        }
+        assert expected <= set(cold)
+        assert cold["free_slots"] == 3
+        assert cold["decode_tokens"] == 0
+        # the record model accepts it without loss
+        record = EngineStatsRecord(node_id="agent.x", **cold)
+        assert record.max_batch_size == 3
+
+
+class TestEngineStatsWindowing:
+    def test_snapshot_and_delta_reports_interval_rates(self):
+        from calfkit_tpu.inference.engine import EngineStats
+
+        stats = EngineStats()
+        stats.decode_tokens = 100
+        stats.decode_time_s = 2.0
+        stats.decode_dispatches = 10
+        stats.occupancy_sum = 5.0
+        stats.occupancy_hist[3] = 10
+        cum, delta = stats.snapshot_and_delta()
+        assert cum["decode_tokens"] == 100
+        assert delta["decode_tokens"] == 100
+        assert delta["tokens_per_second"] == 50.0
+        assert delta["interval_s"] is None  # first window: since birth
+        stats.decode_tokens = 160
+        stats.decode_time_s = 2.5
+        stats.decode_dispatches = 12
+        stats.occupancy_sum = 6.5
+        stats.occupancy_hist[3] = 12
+        cum, delta = stats.snapshot_and_delta()
+        assert cum["decode_tokens"] == 160
+        assert delta["decode_tokens"] == 60
+        assert delta["tokens_per_second"] == 120.0  # 60 tok / 0.5 s
+        assert delta["occupancy_hist"] == [0, 0, 0, 2]
+        assert delta["mean_occupancy"] == 0.75
+        assert delta["interval_s"] is not None
+
+
+class TestMetricsServer:
+    async def test_serves_metrics_and_health(self):
+        from calfkit_tpu.observability.http import MetricsServer
+
+        reg = MetricsRegistry()
+        reg.counter("served_total", "requests served").inc(3)
+
+        async def get(port: int, path: str) -> tuple[str, str]:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(
+                f"GET {path} HTTP/1.0\r\nHost: x\r\n\r\n".encode()
+            )
+            await writer.drain()
+            raw = await reader.read(65536)
+            writer.close()
+            head, _, body = raw.decode().partition("\r\n\r\n")
+            return head.splitlines()[0], body
+
+        async with MetricsServer(port=0, registry=reg) as server:
+            status, body = await get(server.port, "/metrics")
+            assert status == "HTTP/1.0 200 OK"
+            assert "served_total 3" in body
+            status, body = await get(server.port, "/healthz")
+            assert status == "HTTP/1.0 200 OK" and body == "ok\n"
+            status, _ = await get(server.port, "/nope")
+            assert status == "HTTP/1.0 404 Not Found"
+
+
+class TestCliRenderers:
+    def _spans(self) -> list[SpanRecord]:
+        return [
+            SpanRecord(
+                trace_id="t", span_id="a", name="client.dispatch",
+                kind="client", emitter="client/c1", start_s=100.0,
+                duration_ms=10.0,
+            ),
+            SpanRecord(
+                trace_id="t", span_id="b", parent_span_id="a",
+                name="agent.hop", kind="agent", emitter="agent/planner",
+                start_s=100.002, duration_ms=400.0,
+            ),
+            SpanRecord(
+                trace_id="t", span_id="c", parent_span_id="b",
+                name="engine.generate", kind="engine",
+                emitter="engine/debug", start_s=100.01, duration_ms=350.0,
+                status="error",
+            ),
+        ]
+
+    def test_waterfall_orders_and_indents(self):
+        from calfkit_tpu.cli.obs import render_waterfall
+
+        out = render_waterfall(self._spans())
+        lines = out.splitlines()
+        assert "3 spans" in lines[0]
+        assert "client.dispatch" in lines[1]
+        assert "  agent.hop" in lines[2]  # depth 1
+        assert "    engine.generate" in lines[3]  # depth 2
+        assert "!error" in lines[3]
+        assert render_waterfall([]) == "no spans"
+
+    def test_waterfall_survives_orphan_parents(self):
+        from calfkit_tpu.cli.obs import render_waterfall
+
+        spans = [
+            SpanRecord(trace_id="t", span_id="x", parent_span_id="gone",
+                       name="orphan", start_s=1.0, duration_ms=1.0)
+        ]
+        assert "orphan" in render_waterfall(spans)
+
+    def test_stats_table(self):
+        from calfkit_tpu.cli.obs import render_stats_table
+
+        records = [
+            EngineStatsRecord(
+                node_id="agent.planner", model_name="debug",
+                tokens_per_second=1843.2, mean_occupancy=0.74,
+                active_requests=11, free_slots=5, max_batch_size=16,
+                decode_tokens=918230,
+                latency_ms={"ttft_p50": 250.0, "ttft_p99": 1000.0},
+            )
+        ]
+        out = render_stats_table(records)
+        assert "agent.planner" in out
+        assert "1843.2" in out
+        assert "11/16" in out
+        assert "250/1000" in out
+        assert "no live engines" in render_stats_table([])
+
+    def test_span_parsing_filters_and_tolerates_garbage(self):
+        from calfkit_tpu.cli.obs import _parse_spans
+
+        good = SpanRecord(trace_id="t", span_id="s", name="n")
+        items = {
+            "t/s": good.to_wire(),
+            "t/bad": b"not-json",
+            "other/s": SpanRecord(
+                trace_id="other", span_id="s", name="x"
+            ).to_wire(),
+        }
+        spans = _parse_spans(items, "t")
+        assert [s.name for s in spans] == ["n"]

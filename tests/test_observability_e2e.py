@@ -1,0 +1,214 @@
+"""ISSUE 2 acceptance: one run through the WHOLE traced pipeline.
+
+Drives client → agent → tool → the real local JAX engine over the
+in-memory mesh and asserts:
+
+- a single trace_id (== the correlation id) yields ≥ 4 parent-linked
+  spans covering dispatch, the agent turn, the tool call, and engine
+  prefill/decode;
+- the TTFT and inter-token histograms are non-empty in the
+  ``metrics_text()`` Prometheus output;
+- spans reached the compacted ``mesh.traces`` topic (the operator-surface
+  read path), and ``ck trace``'s renderer draws the waterfall from them.
+"""
+
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from calfkit_tpu import protocol
+from calfkit_tpu.client import Client
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models.messages import ToolCallOutput
+from calfkit_tpu.models.records import SpanRecord
+from calfkit_tpu.nodes import Agent, agent_tool
+from calfkit_tpu.observability.trace import TRACER
+from calfkit_tpu.worker import Worker
+
+
+@agent_tool
+def lookup_fact(topic: str) -> str:
+    """Look up a fact.
+
+    Args:
+        topic: What to look up.
+    """
+    return f"fact about {topic}"
+
+
+class _ScriptedToolParser:
+    """Stateful tool_call_parser: the first model turn becomes a tool
+    call, every later turn is a final text answer — turning the random
+    debug model into a deterministic agent→tool→agent script while the
+    REAL engine does the prefill/decode work being traced."""
+
+    def __init__(self) -> None:
+        self.turns = 0
+
+    def __call__(self, text: str):
+        self.turns += 1
+        if self.turns == 1:
+            return "", [
+                ToolCallOutput(
+                    tool_call_id="tc-1",
+                    tool_name="lookup_fact",
+                    args={"topic": "tracing"},
+                )
+            ]
+        return "final answer", []
+
+
+class TestTracedPipeline:
+    async def test_trace_spans_and_latency_histograms(self):
+        from calfkit_tpu.inference import JaxLocalModelClient
+        from calfkit_tpu.inference.config import RuntimeConfig, preset
+        from calfkit_tpu.observability.metrics import metrics_text
+
+        model = JaxLocalModelClient(
+            config=preset("debug", max_seq_len=1024),
+            runtime=RuntimeConfig(
+                max_batch_size=2, max_seq_len=1024, prefill_chunk=64,
+                decode_steps_per_dispatch=4,
+            ),
+            tool_call_parser=_ScriptedToolParser(),
+            max_new_tokens=8,
+        )
+        mesh = InMemoryMesh()
+        agent = Agent("traced", model=model, tools=[lookup_fact])
+        async with Worker([agent, lookup_fact], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            handle = await client.agent("traced").start("trace me", timeout=45)
+            trace_id = handle.correlation_id
+            result = await handle.result()
+            assert result.output == "final answer"
+            await client.close()
+
+            spans = TRACER.finished(trace_id)
+            by_name: dict[str, list[SpanRecord]] = {}
+            for span in spans:
+                by_name.setdefault(span.name, []).append(span)
+
+            # coverage: dispatch, agent turn, tool call, engine
+            # prefill/decode all traced under ONE trace id
+            assert "client.dispatch" in by_name
+            assert "mesh.dispatch" in by_name
+            assert "agent.turn" in by_name
+            assert "tool.hop" in by_name
+            assert "engine.generate" in by_name
+            assert "engine.prefill" in by_name
+            assert "engine.decode" in by_name
+            # two model turns (initial + after the tool result)
+            assert len(by_name["agent.turn"]) == 2
+            assert len(by_name["engine.generate"]) == 2
+
+            # parent linkage: ≥4 linked spans whose parents resolve
+            # within the trace
+            ids = {s.span_id for s in spans}
+            linked = [
+                s for s in spans
+                if s.parent_span_id and s.parent_span_id in ids
+            ]
+            assert len(linked) >= 4
+            # the chain is rooted at the client dispatch span
+            roots = [s for s in spans if not s.parent_span_id]
+            assert [r.name for r in roots] == ["client.dispatch"]
+            # engine spans hang off an agent turn which hangs off a hop
+            turn = by_name["agent.turn"][0]
+            gen = next(
+                s for s in by_name["engine.generate"]
+                if s.parent_span_id == turn.span_id
+            )
+            assert gen.attrs["generated_tokens"] > 0
+            prefill = next(
+                s for s in by_name["engine.prefill"]
+                if s.parent_span_id == gen.span_id
+            )
+            assert prefill.attrs["ttft_ms"] > 0
+
+            # latency histograms are non-empty in the Prometheus output
+            text = metrics_text()
+
+            def count_of(metric: str) -> int:
+                for line in text.splitlines():
+                    if line.startswith(f"{metric}_count "):
+                        return int(line.split()[-1])
+                raise AssertionError(f"{metric} missing from exposition")
+
+            assert count_of("calfkit_engine_ttft_ms") > 0
+            assert count_of("calfkit_engine_inter_token_ms") > 0
+            assert count_of("calfkit_engine_queue_wait_ms") > 0
+            assert count_of("calfkit_engine_prefill_ms") > 0
+
+            # the operator read path: spans reached the compacted topic
+            # and the CLI renderer draws the waterfall from them
+            from calfkit_tpu.cli.obs import _parse_spans, render_waterfall
+
+            reader = mesh.table_reader(protocol.TRACES_TOPIC)
+            await reader.start()
+            topic_spans = _parse_spans(reader.items(), trace_id)
+            await reader.stop()
+            topic_names = {s.name for s in topic_spans}
+            assert {
+                "client.dispatch", "agent.hop", "tool.hop",
+                "agent.turn", "engine.generate",
+            } <= topic_names
+            waterfall = render_waterfall(topic_spans)
+            assert "agent.turn" in waterfall
+            assert f"trace {trace_id}" in waterfall
+        await model.stop()
+
+    async def test_fault_marks_hop_span_error(self):
+        """A faulting tool's hop span records status=error with the typed
+        fault code — fail-open tracing still tells the truth."""
+
+        @agent_tool
+        def broken_tool(x: str) -> str:
+            """Always explodes.
+
+            Args:
+                x: Ignored.
+            """
+            raise RuntimeError("kaboom")
+
+        def scripted(messages, params):
+            from calfkit_tpu.models import ModelResponse, TextOutput
+
+            has_returns = any(
+                getattr(part, "kind", "") in ("tool_return", "retry")
+                for m in messages
+                for part in getattr(m, "parts", [])
+            )
+            if has_returns:
+                return ModelResponse(parts=[TextOutput(text="recovered")])
+            return ModelResponse(parts=[
+                ToolCallOutput(
+                    tool_call_id="bt-1", tool_name="broken_tool",
+                    args={"x": "y"},
+                )
+            ])
+
+        from calfkit_tpu.engine import FunctionModelClient
+        from calfkit_tpu.nodes.agent import surface_to_model
+
+        mesh = InMemoryMesh()
+        agent = Agent(
+            "fault_traced",
+            model=FunctionModelClient(scripted),
+            tools=[broken_tool],
+            on_tool_error=lambda marker, ctx, report: surface_to_model(
+                ctx, report
+            ),
+        )
+        async with Worker([agent, broken_tool], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            handle = await client.agent("fault_traced").start("go", timeout=30)
+            trace_id = handle.correlation_id
+            result = await handle.result()
+            assert result.output == "recovered"
+            await client.close()
+        spans = TRACER.finished(trace_id)
+        tool_hops = [s for s in spans if s.name == "tool.hop"]
+        assert tool_hops and tool_hops[0].status == "error"
+        assert tool_hops[0].attrs["error_type"]
