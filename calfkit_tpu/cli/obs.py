@@ -76,7 +76,7 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
     rows = [
         (
             "NODE", "MODEL", "TOK/S", "OCC", "ACTIVE", "SLOTS",
-            "DECODED", "TTFT P50/P99 MS",
+            "DECODED", "TTFT P50/P99 MS", "GAP P99 MS", "WASTE",
         )
     ]
     for r in records:
@@ -84,6 +84,16 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
         ttft = (
             f"{lat.get('ttft_p50', 0):.0f}/{lat.get('ttft_p99', 0):.0f}"
             if lat else "-"
+        )
+        # overlapped execution health: the p99 inter-dispatch device-idle
+        # bubble (should sit at ~0 with overlap on) and the pad tokens
+        # one-dispatch-late retirement discarded
+        gap = (
+            f"{lat.get('dispatch_gap_p99', 0):.2f}"
+            if "dispatch_gap_p99" in lat else "-"
+        )
+        waste = (
+            str(r.overlap_wasted_tokens) if r.overlap_dispatch else "off"
         )
         # prefer the per-heartbeat-interval rates: lifetime cumulative
         # tok/s flattens toward the mean (an engine idle for an hour then
@@ -102,6 +112,8 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
                 if r.max_batch_size else "-",
                 str(r.decode_tokens),
                 ttft,
+                gap,
+                waste,
             )
         )
     if len(rows) == 1:

@@ -306,6 +306,8 @@ class JaxLocalModelClient(ModelClient):
                 "prefill_tokens": 0,
                 "decode_tokens": 0,
                 "decode_dispatches": 0,
+                "overlap_dispatch": runtime.overlap_dispatch,
+                "overlap_wasted_tokens": 0,
             }
         import jax
 
@@ -323,6 +325,10 @@ class JaxLocalModelClient(ModelClient):
             "prefill_tokens": stats.prefill_tokens,
             "decode_tokens": stats.decode_tokens,
             "decode_dispatches": stats.decode_dispatches,
+            # overlapped execution: whether double-buffered dispatch is on,
+            # and the pad tokens one-dispatch-late retirement discarded
+            "overlap_dispatch": rt.overlap_dispatch,
+            "overlap_wasted_tokens": stats.overlap_wasted_tokens,
         }
         try:
             # latency percentiles ride the advert for free: the registry's
@@ -337,6 +343,7 @@ class JaxLocalModelClient(ModelClient):
                     ("inter_token_ms", "inter_token"),
                     ("queue_wait_ms", "queue_wait"),
                     ("prefill_ms", "prefill"),
+                    ("dispatch_gap_ms", "dispatch_gap"),
                 )
                 for q, name in ((0.5, f"{label}_p50"), (0.99, f"{label}_p99"))
             }

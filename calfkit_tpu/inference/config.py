@@ -141,6 +141,24 @@ class RuntimeConfig:
     # a SpecConfig turns every decode tick into draft-k + one batched
     # verify dispatch scoring k+1 positions per sequence (see SpecConfig)
     speculative: "SpecConfig | None" = None
+    # overlapped execution (double-buffered decode dispatch): launch decode
+    # dispatch N+1 BEFORE syncing dispatch N's token block, so host-side
+    # fan-out / stop scanning / admission prep run while the device is
+    # busy and the inter-dispatch device-idle bubble goes to ~zero.  Stop
+    # and generation-bound detection move onto the device as a per-row
+    # done mask; a row that retires mid-block rides exactly one extra
+    # in-flight dispatch (its pad tokens are discarded, its slot/pages
+    # free only after that dispatch lands — one-dispatch-late, never
+    # early).  False = the lockstep reference path (sync-then-fan-out),
+    # byte-identical token streams either way.
+    overlap_dispatch: bool = True
+    # device-side retirement needs each request's stop-token set as a
+    # fixed-shape row: the per-slot table holds this many entries.  A
+    # short-lane request with more stop tokens than this is rejected when
+    # device-side retirement is in use (overlap_dispatch or speculative);
+    # the lockstep host path (overlap_dispatch=False, no speculation)
+    # keeps scanning arbitrary-size sets on the host.
+    max_stop_tokens: int = 8
     # weight-only quantization: "int8" halves decode HBM traffic and fits
     # Llama-3-8B on one 16 GB chip; "int4" (packed nibbles, group-128
     # scales) halves the weight stream again (~4 GB for 8B — margin for

@@ -48,6 +48,7 @@ from calfkit_tpu.observability.metrics import (
 )
 from calfkit_tpu.inference.sampler import (
     SamplingParams,
+    retire_mask_slots,
     sample_slots,
     spec_accept_slots,
 )
@@ -121,6 +122,12 @@ def _engine_metrics(
             "calfkit_engine_decode_dispatch_ms",
             "one decode/verify dispatch, enqueue to host sync (ms)",
         ),
+        "dispatch_gap_ms": reg.histogram(
+            "calfkit_engine_dispatch_gap_ms",
+            "device-idle bubble: previous dispatch landing to next launch, "
+            "zero while a dispatch is already in flight (ms)",
+            buckets=INTER_TOKEN_BUCKETS_MS,
+        ),
     }
     if histograms_only:
         return out
@@ -138,6 +145,11 @@ def _engine_metrics(
         spec_accepted=reg.counter(
             "calfkit_engine_spec_accepted_total",
             "speculative draft tokens accepted by verify dispatches",
+        ),
+        overlap_wasted_tokens=reg.counter(
+            "calfkit_engine_overlap_wasted_tokens_total",
+            "pad tokens discarded by one-dispatch-late retirement "
+            "(overlapped execution)",
         ),
         active_requests=reg.gauge(
             "calfkit_engine_active_requests",
@@ -313,6 +325,11 @@ class EngineStats:
     spec_accepted: int = 0
     spec_emitted: int = 0  # tokens emitted by verify dispatches (device)
     spec_rows: int = 0  # Σ over verify dispatches of active rows
+    # overlapped execution: pad tokens discarded because their row retired
+    # (or cancelled) while the dispatch that generated them was already in
+    # flight — the price of one-dispatch-late retirement, bounded by
+    # retired rows x steps_per_dispatch
+    overlap_wasted_tokens: int = 0
     # snapshot_and_delta state: the previous window's counter values +
     # timestamp.  Single-consumer by design (the heartbeat advert) — two
     # delta readers would steal each other's intervals.
@@ -323,7 +340,7 @@ class EngineStats:
         "decode_time_s", "occupancy_sum", "short_dispatches",
         "long_requests", "long_dispatches", "prefix_hits",
         "prefix_reused_tokens", "spec_proposed", "spec_accepted",
-        "spec_emitted", "spec_rows",
+        "spec_emitted", "spec_rows", "overlap_wasted_tokens",
     )
 
     def counters(self) -> dict:
@@ -566,6 +583,29 @@ class InferenceEngine:
         self._last = jnp.zeros((B,), jnp.int32)
         self._lens = jnp.zeros((B,), jnp.int32)
         self._host_lens = np.zeros((B,), np.int64)  # host mirror for windows
+        if rt.max_stop_tokens < 1:
+            raise ValueError("max_stop_tokens must be >= 1")
+        # device-side retirement inputs (overlapped execution): each slot's
+        # stop tokens as a fixed-shape row (-1 padded) and the absolute
+        # cache length at which the row hits its hard generation bound —
+        # min(prompt + max_new - 1, max_seq - 2), so bound-steps-remaining
+        # is just hard_end - lens ON DEVICE (always exact, even for a
+        # dispatch launched before the previous one's tokens reached the
+        # host).  Written at activation, shipped per dispatch like the
+        # active mask.
+        self._stop_np = np.full((B, rt.max_stop_tokens), -1, np.int32)
+        self._hard_end = np.zeros((B,), np.int32)
+        # device copies of the two arrays above, re-uploaded only when an
+        # activation rewrites them — the launch path must not pay a
+        # host→device transfer per dispatch for admission-time constants
+        self._retire_dev: "tuple[Any, Any] | None" = None
+        self._done_zero = jnp.zeros((B,), jnp.bool_)
+        # the launched-but-not-landed decode dispatch (overlap mode only):
+        # device handles for its outputs, the slot->request snapshot it
+        # was launched with, and the slots whose resource frees are
+        # deferred to its landing
+        self._pend: "dict | None" = None
+        self._last_sync_t: "float | None" = None
         # per-slot sampling state: one decode dispatch serves mixed settings
         # (row-wise knobs are data, not jit specializations)
         self._slot_keys = jax.random.split(jax.random.key(seed + 2), B)
@@ -616,6 +656,7 @@ class InferenceEngine:
         self._counted = {
             "decode_tokens": 0, "prefill_tokens": 0,
             "spec_proposed": 0, "spec_accepted": 0,
+            "overlap_wasted_tokens": 0,
         }
         self._counted_lock = threading.Lock()
         # self-cleaning gauge aggregation: an engine abandoned without
@@ -689,11 +730,17 @@ class InferenceEngine:
         cfg = self.config
         attn_impl = self._resolved_attn_impl("decode")
 
-        def decode(params, k, v, last, lens, active, slot_keys, temp, top_k, top_p):
+        def decode(params, k, v, last, lens, active, done_prev,
+                   stop_table, hard_end, slot_keys, temp, top_k, top_p):
             # ring-buffer decode: the main cache is READ-ONLY during the
             # scan; fresh K/V goes to a dense ring, consolidated once below.
             # The attention window is sliced ONCE per dispatch (a loop
             # constant), so per-step reads cover only live prefixes.
+            # ``done_prev`` is the PREVIOUS dispatch's device-side done
+            # mask: under overlapped execution this dispatch launches
+            # before the host has seen the previous block, and a row that
+            # retired there must be frozen here by pure device dataflow.
+            active = active & jnp.logical_not(done_prev)
             B = last.shape[0]
             kw = k[:, :, :, :window]
             vw = v[:, :, :, :window]
@@ -730,7 +777,13 @@ class InferenceEngine:
             )
             k, v = M.consolidate_ring((k, v), ring, lens)
             new_lens = jnp.where(active, lens + steps, lens)
-            return k, v, last, new_lens, toks  # toks [steps, B]
+            # device-side retirement: classify the fresh block against each
+            # row's stop table and hard bound, so the NEXT dispatch can
+            # launch (consuming ``done``) before any host sync of this one
+            n_valid, done = retire_mask_slots(
+                toks.T, stop_table, hard_end - lens, active
+            )
+            return k, v, last, new_lens, toks, n_valid, done  # toks [steps, B]
 
         fn = jax.jit(decode, donate_argnums=(1, 2))
         self._decode_jits[(window, steps, sampled)] = fn
@@ -749,8 +802,12 @@ class InferenceEngine:
         cfg = self.config
         attn_impl = self._resolved_attn_impl("paged_decode")
 
-        def decode(params, k, v, tables, last, lens, active,
-                   slot_keys, temp, top_k, top_p):
+        def decode(params, k, v, tables, last, lens, active, done_prev,
+                   stop_table, hard_end, slot_keys, temp, top_k, top_p):
+            # rows that retired in the still-in-flight previous dispatch
+            # are frozen out here (and their consolidation writes routed
+            # to the trash page) by the device-side done-mask chain
+            active = active & jnp.logical_not(done_prev)
             B = last.shape[0]
             ring = (
                 jnp.zeros(
@@ -784,7 +841,10 @@ class InferenceEngine:
                 (k, v), ring, tables, lens, active
             )
             new_lens = jnp.where(active, lens + steps, lens)
-            return k2, v2, last, new_lens, toks
+            n_valid, done = retire_mask_slots(
+                toks.T, stop_table, hard_end - lens, active
+            )
+            return k2, v2, last, new_lens, toks, n_valid, done
 
         fn = jax.jit(decode, donate_argnums=(1, 2))
         self._decode_jits[(wpages, steps, sampled, "paged")] = fn
@@ -809,7 +869,7 @@ class InferenceEngine:
         attn_impl = self._resolved_attn_impl("decode")
 
         def verify(params, k, v, last, lens, active, drafts, ndraft,
-                   slot_keys, temp, top_k, top_p):
+                   stop_table, hard_end, slot_keys, temp, top_k, top_p):
             kw = k[:, :, :, :window]
             vw = v[:, :, :, :window]
             tokens = jnp.concatenate([last[:, None], drafts], axis=1)
@@ -828,7 +888,14 @@ class InferenceEngine:
                 jnp.take_along_axis(out_toks, idx[:, None], axis=1)[:, 0],
                 last,
             )
-            return k, v, new_last, lens + emitted, out_toks, emitted
+            n_valid, done = retire_mask_slots(
+                out_toks, stop_table, hard_end - lens, active,
+                emitted=emitted,
+            )
+            return (
+                k, v, new_last, lens + emitted, out_toks, emitted,
+                n_valid, done,
+            )
 
         fn = jax.jit(verify, donate_argnums=(1, 2))
         self._decode_jits[key] = fn
@@ -845,7 +912,8 @@ class InferenceEngine:
         attn_impl = self._resolved_attn_impl("paged_decode")
 
         def verify(params, k, v, tables, last, lens, active, drafts,
-                   ndraft, slot_keys, temp, top_k, top_p):
+                   ndraft, stop_table, hard_end, slot_keys, temp, top_k,
+                   top_p):
             tokens = jnp.concatenate([last[:, None], drafts], axis=1)
             logits, ring = M.verify_step_ring_paged(
                 params, cfg, tokens, (k, v), tables, lens,
@@ -868,7 +936,14 @@ class InferenceEngine:
                 jnp.take_along_axis(out_toks, idx[:, None], axis=1)[:, 0],
                 last,
             )
-            return k2, v2, new_last, lens + emitted, out_toks, emitted
+            n_valid, done = retire_mask_slots(
+                out_toks, stop_table, hard_end - lens, active,
+                emitted=emitted,
+            )
+            return (
+                k2, v2, new_last, lens + emitted, out_toks, emitted,
+                n_valid, done,
+            )
 
         fn = jax.jit(verify, donate_argnums=(1, 2))
         self._decode_jits[key] = fn
@@ -1107,6 +1182,11 @@ class InferenceEngine:
     def _finish_all(self) -> None:
         """Terminate every waiter: active slots AND still-queued requests
         (a queued request left without _DONE hangs its generate() forever)."""
+        if self._pend is not None:
+            # abandon the in-flight dispatch; its deferred frees must
+            # still run or the slots/pages leak into the next start()
+            self._free_deferred(self._pend)
+            self._pend = None
         for request in list(self._active.values()):
             request.out.put_nowait(_DONE)
         self._active.clear()
@@ -1209,6 +1289,19 @@ class InferenceEngine:
             finally:
                 await inner.aclose()
             return
+        if (
+            self.runtime.overlap_dispatch or self._spec is not None
+        ) and len(stop_tokens) > self.runtime.max_stop_tokens:
+            # device-side retirement scans a fixed-shape per-slot stop
+            # table; silently truncating the set would MISS stops — fault
+            raise InferenceError(
+                f"request has {len(stop_tokens)} stop tokens but device-side"
+                f" retirement caps the per-slot table at max_stop_tokens="
+                f"{self.runtime.max_stop_tokens}; raise "
+                "RuntimeConfig.max_stop_tokens (or set "
+                "overlap_dispatch=False with speculation off for the "
+                "host-side lockstep path)"
+            )
         if self._paged:
             # reject what the pool could NEVER serve — re-queueing it would
             # wait (and starve everything behind it) forever
@@ -1270,6 +1363,11 @@ class InferenceEngine:
                         if self._drafter is not None
                         else self._decode_tick
                     )
+                elif self._pend is not None:
+                    # every participant retired/cancelled while a dispatch
+                    # was still in flight: land it (discarding pad tokens)
+                    # so the deferred slot/page frees actually happen
+                    await asyncio.to_thread(self._drain_decode)
                 elif not progressed and self._inflight is None:
                     self._wake.clear()
                     if (
@@ -1550,6 +1648,18 @@ class InferenceEngine:
                 continue
             self._active[request.slot] = request
             self._track_retirement(request)
+            # device-side retirement inputs for the slot: stop-token row
+            # (-1 padded; the submit-time cap guarantees it fits whenever
+            # a device-authority path will read it) and hard-bound lens
+            row = self._stop_np[request.slot]
+            row[:] = -1
+            stops = sorted(request.stop_tokens)[: row.shape[0]]
+            row[: len(stops)] = stops
+            self._hard_end[request.slot] = min(
+                len(request.prompt) + request.max_new_tokens - 1,
+                self.runtime.max_seq_len - 2,
+            )
+            self._retire_dev = None  # device copies stale: re-upload at launch
             if self._drafter is not None and request.history is not None:
                 self._drafter.admit(request.slot, request.prompt)
 
@@ -1745,37 +1855,69 @@ class InferenceEngine:
         )
 
     def _long_decode_tick(self) -> None:
+        """One long-lane pass.  Overlap mode gives the sp lane the same
+        launch-next-then-sync-previous treatment as the short lane: the
+        dispatch enqueued this pass runs on the mesh while the previous
+        block's tokens fan out, so the lane's per-dispatch sync no longer
+        serializes host and device.  A stop token found in the landed
+        block abandons the already-launched follow-up (its steps count as
+        ``overlap_wasted_tokens``; the per-request fresh cache it wrote
+        is discarded with the state, so nothing shared is corrupted)."""
         from calfkit_tpu.inference.ring_attention import decode_sp_dispatch
 
         state = self._long
         request = state["request"]
-        steps = min(
-            self.runtime.decode_steps_per_dispatch,
-            state["cap"] - state["t"],
-        )
-        started = time.perf_counter()
-        toks, last, fresh = decode_sp_dispatch(
-            self.params, self.config, state["last"], state["prefix"],
-            jnp.asarray([state["prefix_len"]], jnp.int32),
-            state["fresh"], state["t"], self._sp_mesh(), steps,
-        )
-        block = np.asarray(toks)[0]  # host sync per dispatch
-        elapsed = time.perf_counter() - started
-        state["fresh"] = fresh
-        state["last"] = last
-        state["t"] += steps
+        pend = state.pop("pend", None)
+        launched: "dict | None" = None
+        if state["t"] < state["cap"]:
+            steps = min(
+                self.runtime.decode_steps_per_dispatch,
+                state["cap"] - state["t"],
+            )
+            started = time.perf_counter()
+            toks, last, fresh = decode_sp_dispatch(
+                self.params, self.config, state["last"], state["prefix"],
+                jnp.asarray([state["prefix_len"]], jnp.int32),
+                state["fresh"], state["t"], self._sp_mesh(), steps,
+            )
+            state["fresh"] = fresh
+            state["last"] = last
+            state["t"] += steps
+            launched = dict(toks=toks, steps=steps, started=started)
+        if self.runtime.overlap_dispatch:
+            # double-buffered: the block launched THIS pass lands next
+            # pass, with its follow-up already in flight
+            state["pend"] = launched
+            landing = pend
+        else:
+            landing = launched
+        if landing is None:
+            return  # first overlapped pass: launch only
+        block = self._sync_host(landing["toks"])[0]  # host sync per dispatch
+        now = time.perf_counter()
+        start = landing["started"]
+        last_sync = state.get("synced_at")
+        if last_sync is not None and last_sync > start:
+            start = last_sync  # exclusive wall (see _land_decode)
+        state["synced_at"] = now
         # NOT decode_dispatches: that counter is mean_occupancy's
         # denominator, and a long dispatch uses the whole mesh, not slots
         self.stats.long_dispatches += 1
-        self.stats.decode_time_s += elapsed
+        self.stats.decode_time_s += now - start
         done = False
         for token in block:
             done = self._emit_long(request, int(token))
             if done:
                 break
-        if done or state["t"] >= state["cap"]:
-            if not done:
-                self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)
+        inflight = state.get("pend")
+        if done:
+            if inflight is not None:
+                # one-dispatch-late retirement, long-lane edition: the
+                # pre-launched follow-up block is all pad now
+                self.stats.overlap_wasted_tokens += inflight["steps"]
+            self._long = None
+        elif state["t"] >= state["cap"] and inflight is None:
+            self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)
             self._long = None
 
     def _emit_long(self, request: GenRequest, token: int) -> bool:
@@ -2047,6 +2189,56 @@ class InferenceEngine:
             request.shared_pages = request.shared_pages + fresh
 
     def _decode_tick(self) -> None:
+        """One scheduler tick of the short decode lane.
+
+        Overlapped mode (``runtime.overlap_dispatch``, the default):
+        enqueue dispatch N+1 FIRST, then sync + fan out dispatch N — the
+        device computes N+1 while the host does N's bookkeeping, so the
+        inter-dispatch device-idle bubble collapses to the launch-enqueue
+        cost.  Lockstep mode is the reference oracle: launch, sync, fan
+        out, with the host as the retirement authority."""
+        if not self.runtime.overlap_dispatch:
+            self._decode_tick_lockstep()
+            return
+        pend = self._pend
+        if self._active:
+            self._launch_decode()
+        else:
+            self._pend = None
+        if pend is not None:
+            deliveries = self._land_decode(pend)
+            if not self._active:
+                # the landing retired every participant: the dispatch
+                # launched moments ago is all zombies.  Land it NOW,
+                # before any consumer can observe completion — a caller
+                # whose generate() returned must find slots/pages fully
+                # accounted (the lockstep invariant, kept under overlap)
+                self._drain_decode()
+            if deliveries:
+                self._loop.call_soon_threadsafe(_deliver_batch, deliveries)
+
+    def _drain_decode(self) -> None:
+        """Land an in-flight dispatch whose participants have all retired
+        or cancelled (nothing live left to launch for)."""
+        pend, self._pend = self._pend, None
+        if pend is not None:
+            deliveries = self._land_decode(pend)
+            if deliveries:
+                self._loop.call_soon_threadsafe(_deliver_batch, deliveries)
+
+    def _sync_host(self, arrays: Any) -> Any:
+        """THE designated device→host sync point of the dispatch loop —
+        scripts/lint_hotpath.py bans blocking syncs everywhere else in the
+        overlap-critical functions, so the double-buffering can't silently
+        regress to one-sync-per-launch."""
+        if isinstance(arrays, tuple):
+            return tuple(np.asarray(a) for a in arrays)
+        return np.asarray(arrays)
+
+    def _decode_args(self) -> "tuple[list, int, int, bool]":
+        """Assemble one decode dispatch's host-side inputs (shared by the
+        overlap launch and the lockstep tick): returns (args, window,
+        steps, sampled).  Pure host work — no device sync."""
         active_mask = np.zeros((self.runtime.max_batch_size,), bool)
         needed = 1
         for slot in self._active:
@@ -2054,7 +2246,7 @@ class InferenceEngine:
             needed = max(needed, self._host_lens[slot])
         # the ring covers in-dispatch growth; the window only needs to cover
         # what's already in the main cache
-        window = self._window_bucket(needed)
+        window = self._window_bucket(int(needed))
         # admissions waiting AND a retirement in reach? shorten the dispatch
         # so the freed slot (and the waiter's prefill) isn't gated behind a
         # full tick; under saturation with no retirement near, full ticks
@@ -2072,7 +2264,9 @@ class InferenceEngine:
             not self._effective_sampling(r).is_greedy
             for r in self._active.values()
         )
-        started = time.perf_counter()
+        prev = self._pend
+        done_prev = prev["done_dev"] if prev is not None else self._done_zero
+        stop_table, hard_end = self._retire_args()
         args = [self.params, self._k, self._v]
         if self._paged:
             args.append(self._tables)
@@ -2080,18 +2274,147 @@ class InferenceEngine:
             self._last,
             self._lens,
             jnp.asarray(active_mask),
+            done_prev,
+            stop_table,
+            hard_end,
             self._slot_keys,
             self._temp,
             self._top_k,
             self._top_p,
         ]
-        self._k, self._v, self._last, self._lens, toks = (
+        return args, window, steps, sampled
+
+    def _retire_args(self) -> "tuple[Any, Any]":
+        """Device copies of the per-slot stop table + hard-bound lens —
+        admission-time constants, re-uploaded only after an activation
+        rewrote them (the launch path pays no per-dispatch transfer)."""
+        if self._retire_dev is None:
+            self._retire_dev = (
+                jnp.asarray(self._stop_np), jnp.asarray(self._hard_end)
+            )
+        return self._retire_dev
+
+    def _observe_gap(self) -> None:
+        """The dispatch-gap bubble, observed immediately BEFORE each jit
+        enqueue (after args prep — the device is idle through that prep
+        too, so observing at tick entry would under-report): zero while a
+        dispatch is already in flight (the device never idled), else the
+        host-side span since the previous dispatch landed.  Reset across
+        idle periods — an empty engine waiting for work is not a bubble."""
+        if self._pend is not None:
+            self._observe("dispatch_gap_ms", 0.0)
+        elif self._last_sync_t is not None:
+            self._observe(
+                "dispatch_gap_ms",
+                (time.perf_counter() - self._last_sync_t) * 1000.0,
+            )
+
+    def _launch_decode(self) -> None:
+        """Enqueue the next decode dispatch — NO host sync.  The previous
+        dispatch's device-side done mask rides in as ``done_prev``, so a
+        row that retired in the still-in-flight block is frozen out of
+        this one by pure device dataflow (its slot and pages stay held
+        until that block lands: one-dispatch-late retirement)."""
+        args, window, steps, sampled = self._decode_args()
+        if steps < self.runtime.decode_steps_per_dispatch:
+            self.stats.short_dispatches += 1
+        self._observe_gap()
+        started = time.perf_counter()
+        (
+            self._k, self._v, self._last, self._lens, toks, n_valid, done,
+        ) = self._decode_jit(window, steps, sampled)(*args)
+        for slot in self._active:
+            self._host_lens[slot] += steps
+        self._pend = dict(
+            toks_dev=toks,
+            n_valid_dev=n_valid,
+            done_dev=done,
+            steps=steps,
+            started=started,
+            participants=list(self._active.items()),
+            slot_set=set(self._active.keys()),
+            deferred=[],
+        )
+
+    def _land_decode(self, pend: dict) -> "list[tuple[asyncio.Queue, list]]":
+        """Host side of a landed dispatch: ONE sync for the token block
+        plus the device-computed retirement arrays, then batched fan-out.
+        The device is the retirement authority here — ``n_valid`` bounds
+        each row's delivery, ``done`` retires it.  Rows whose requests
+        retired or cancelled while this dispatch was in flight are pad
+        columns: discarded (counted as ``overlap_wasted_tokens``), with
+        their deferred slot/page frees released now that nothing in
+        flight can touch them.  Returns the deliveries — the CALLER posts
+        them, possibly after draining an all-zombie follow-up, so a
+        consumer never observes completion before accounting settles."""
+        block, n_valid, done = self._sync_host(
+            (pend["toks_dev"], pend["n_valid_dev"], pend["done_dev"])
+        )
+        now = time.perf_counter()
+        # exclusive wall: the launch happened before the PREVIOUS sync
+        # returned, so clip to the span this dispatch alone occupied —
+        # decode_time_s must keep approximating device-busy time, not
+        # double-count the overlapped bookkeeping
+        start = pend["started"]
+        if self._last_sync_t is not None and self._last_sync_t > start:
+            start = self._last_sync_t
+        self._last_sync_t = now
+        steps = pend["steps"]
+        self._note_dispatch(now - start, steps, n_rows=len(pend["participants"]))
+        deliveries: list[tuple[asyncio.Queue, list]] = []
+        block_cols = np.ascontiguousarray(block.T)  # [B, steps]
+        wasted = 0
+        for slot, request in pend["participants"]:
+            if self._active.get(slot) is not request:
+                # one-dispatch-late retirement: the row retired (or its
+                # consumer cancelled) while this block was in flight — the
+                # whole column is pad, and nothing may reach its queue
+                wasted += steps
+                continue
+            count = int(n_valid[slot])
+            items: list = block_cols[slot][:count].tolist()
+            request.generated += count
+            self.stats.decode_tokens += count
+            if done[slot]:
+                self._retire_slot(request)
+                items.append(_DONE)
+            if items:
+                deliveries.append((request.out, items))
+        if wasted:
+            self.stats.overlap_wasted_tokens += wasted
+        self._free_deferred(pend)
+        if not self._active:
+            self._last_sync_t = None  # idle boundary, not a bubble
+        return deliveries
+
+    def _free_deferred(self, pend: dict) -> None:
+        """Release the slots/pages of requests that retired while ``pend``
+        was in flight.  Deferred to the landing so an in-flight dispatch
+        can never write through a freshly-reallocated page (and shared
+        prefix pages stay referenced while a dispatch still reads them)."""
+        for slot, shared in pend["deferred"]:
+            if self._prefix is not None and shared:
+                self._prefix.release(shared)
+            if self._paged:
+                self._page_alloc.free(slot)
+            self._free.append(slot)
+
+    def _decode_tick_lockstep(self) -> None:
+        """The lockstep reference path: launch, sync, fan out — with the
+        HOST as the retirement authority (arbitrary-size stop sets).  The
+        overlapped path must produce byte-identical token streams; keep
+        this oracle intact."""
+        args, window, steps, sampled = self._decode_args()
+        self._observe_gap()
+        started = time.perf_counter()
+        self._k, self._v, self._last, self._lens, toks, _n_valid, _done = (
             self._decode_jit(window, steps, sampled)(*args)
         )
         for slot in self._active:
             self._host_lens[slot] += steps
-        block = np.asarray(toks)  # [steps, B] — THE host sync per dispatch
+        block = self._sync_host(toks)  # [steps, B] — THE host sync per dispatch
         elapsed = time.perf_counter() - started
+        self._last_sync_t = time.perf_counter()
         self._note_dispatch(elapsed, steps)
         if steps < self.runtime.decode_steps_per_dispatch:
             self.stats.short_dispatches += 1
@@ -2132,12 +2455,15 @@ class InferenceEngine:
                     break
             if items:
                 deliveries.append((request.out, items))
+        if not self._active:
+            self._last_sync_t = None
         if deliveries:
             self._loop.call_soon_threadsafe(_deliver_batch, deliveries)
 
     def _note_dispatch(
         self, elapsed: float, clock_steps: int,
         tokens_per_row: float | None = None,
+        n_rows: int | None = None,
     ) -> None:
         """Per-dispatch clock + stats shared by the plain decode tick and
         the speculative verify tick — ONE copy of the occupancy/clock
@@ -2146,12 +2472,16 @@ class InferenceEngine:
         ``tokens_per_row`` is the latency denominator when it differs from
         the clock: a verify dispatch advances the clock by 1 but emits
         each row's accepted prefix, so its inter-token latency is wall
-        over MEAN EMITTED per row, not wall over 1."""
+        over MEAN EMITTED per row, not wall over 1.  ``n_rows`` pins the
+        occupancy numerator to the dispatch's actual participant count
+        (under overlap the landing runs after newer admissions changed
+        ``_active``)."""
         with self._retire_lock:
             self._decode_clock += clock_steps
         self.stats.decode_dispatches += 1
         self.stats.decode_time_s += elapsed
-        occupancy = len(self._active) / self.runtime.max_batch_size
+        rows = n_rows if n_rows is not None else len(self._active)
+        occupancy = rows / self.runtime.max_batch_size
         self.stats.occupancy_sum += occupancy
         self.stats.occupancy_hist[min(3, int(occupancy * 4))] += 1
         # latency telemetry: TWO O(1) observes per dispatch — inter-token
@@ -2194,7 +2524,7 @@ class InferenceEngine:
         m, counted, stats = self.metrics, self._counted, self.stats
         with self._counted_lock:
             for key in ("decode_tokens", "prefill_tokens", "spec_proposed",
-                        "spec_accepted"):
+                        "spec_accepted", "overlap_wasted_tokens"):
                 value = getattr(stats, key)
                 if value != counted[key]:
                     m[key].inc(value - counted[key])
@@ -2206,7 +2536,15 @@ class InferenceEngine:
         plus the next position in ONE target dispatch, emit each row's
         accepted prefix + correction token.  Replaces ``_decode_tick``
         when ``RuntimeConfig.speculative`` is set; everything downstream
-        (retirement authority, stop tokens, fan-out batching) is shared.
+        (fan-out batching, deferred frees) is shared.
+
+        Speculation stays LOCKSTEP even when ``overlap_dispatch`` is on:
+        the host-side drafter needs the landed tokens of dispatch N to
+        propose for N+1, so there is nothing correct to pre-launch.  The
+        per-row retirement authority still moves to the device (the
+        verify jit returns n_valid/done via the same
+        ``sampler.retire_mask_slots``), keeping one classification code
+        path across both modes.
         """
         spec = self._spec
         B = self.runtime.max_batch_size
@@ -2248,6 +2586,7 @@ class InferenceEngine:
             not self._effective_sampling(r).is_greedy
             for r in self._active.values()
         )
+        self._observe_gap()  # just before enqueue: drafting is prep too
         started = time.perf_counter()
         args = [self.params, self._k, self._v]
         if self._paged:
@@ -2258,17 +2597,21 @@ class InferenceEngine:
             jnp.asarray(active_mask),
             jnp.asarray(drafts),
             jnp.asarray(ndraft),
+            *self._retire_args(),
             self._slot_keys,
             self._temp,
             self._top_k,
             self._top_p,
         ]
-        self._k, self._v, self._last, self._lens, out_toks, emitted = (
-            self._verify_jit(window, S, sampled)(*args)
-        )
-        out_toks = np.asarray(out_toks)  # [B, S] — THE host sync
-        emitted = np.asarray(emitted)
+        (
+            self._k, self._v, self._last, self._lens, out_toks, emitted,
+            n_valid, done,
+        ) = self._verify_jit(window, S, sampled)(*args)
+        out_toks, emitted, n_valid, done = self._sync_host(
+            (out_toks, emitted, n_valid, done)
+        )  # [B, S] + retirement arrays — THE host sync
         elapsed = time.perf_counter() - started
+        self._last_sync_t = time.perf_counter()
         # clock: one verify forward ≈ one decode step of wall time; the
         # heap horizon only drives the non-spec short-dispatch lever, so
         # a coarse clock is fine here.  Inter-token latency, however, must
@@ -2287,12 +2630,22 @@ class InferenceEngine:
             self.stats.spec_accepted += count - 1
             self.stats.spec_emitted += count
             self.stats.spec_rows += 1
-            items: list = []
-            for token in out_toks[slot, :count].tolist():
-                if self._record_token(request, token, items):
-                    break
+            # device retirement authority: deliver the classified prefix,
+            # retire on the device-computed done flag (same math as
+            # _record_token's loop, computed once on device)
+            valid = int(n_valid[slot])
+            items: list = out_toks[slot, :valid].tolist()
+            if request.history is not None:
+                request.history.extend(items)
+            request.generated += valid
+            self.stats.decode_tokens += valid
+            if done[slot]:
+                self._retire_slot(request)
+                items.append(_DONE)
             if items:
                 deliveries.append((request.out, items))
+        if not self._active:
+            self._last_sync_t = None
         if deliveries:
             self._loop.call_soon_threadsafe(_deliver_batch, deliveries)
 
@@ -2301,10 +2654,25 @@ class InferenceEngine:
         the retire-heap's reference.  Bookkeeping runs BEFORE any _DONE
         signal reaches the consumer: once completion is observable, the
         slot is already free (no window where a finished request still
-        occupies ``_active``)."""
+        occupies ``_active``).
+
+        Overlap: when a launched-but-not-landed dispatch still covers this
+        slot, the RESOURCE frees (page reservation, shared-page refcounts,
+        the free-list slot) defer to that dispatch's landing — an in-flight
+        dispatch must never find its pages re-allocated under it, nor its
+        shared prefix pages evicted while it still reads them.  Everything
+        observable (``_active``, the retire heap, the gauge) updates now."""
         self._active.pop(request.slot, None)
         if self._drafter is not None and request.slot != -1:
             self._drafter.retire(request.slot)
+        pend = self._pend
+        if pend is not None and request.slot in pend["slot_set"]:
+            pend["deferred"].append((request.slot, request.shared_pages))
+            request.shared_pages = []
+            request.slot = -1
+            self._untrack_retirement(request)
+            self._update_active_gauge()
+            return
         if self._paged:
             if self._prefix is not None and request.shared_pages:
                 # shared pages return to the CACHE (refcount), never to

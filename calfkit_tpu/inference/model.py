@@ -690,7 +690,11 @@ def consolidate_ring(
     contiguous chunks, once per dispatch (amortizing what a per-step scatter
     would pay 'steps' times).  Rows whose requests already retired write
     garbage BEYOND their valid length — harmless, masked by seq_lens and
-    overwritten by the next prefill on that slot."""
+    overwritten by the next prefill on that slot.  Under overlapped
+    execution a row that retired in the still-in-flight previous dispatch
+    arrives here FROZEN (the engine's done-mask chain stops its ``lens``
+    advancing), so its garbage writes repeat at one fixed in-row offset —
+    the same beyond-valid-length law, never another row's data."""
     k_pages, v_pages = kv_cache
     ring_k, ring_v = ring
 
@@ -827,7 +831,12 @@ def consolidate_ring_paged(
     trash page): a retired slot's pages may already belong to a NEW request,
     so letting its stale row write through its old table entries would
     corrupt a neighbor — the dense layout tolerated garbage-beyond-length,
-    the paged layout must not.
+    the paged layout must not.  Overlapped execution leans on the same
+    redirect: a row that retired inside the previous, still-in-flight
+    dispatch reaches this one masked inactive (device-side done chain),
+    so its writes land in the trash page even though the host hasn't
+    freed its pages yet (one-dispatch-late retirement frees them only
+    after this dispatch lands).
     """
     pool_k, pool_v = pool
     ring_k, ring_v = ring

@@ -14,6 +14,12 @@ k drafted tokens per row against the verify dispatch's k+1 logit rows:
 exact greedy match for greedy rows, rejection sampling (point-mass
 proposals) for sampled rows, both against the SAME filtered target
 distribution :func:`filtered_logits` defines.
+
+Overlapped execution adds :func:`retire_mask_slots` — device-side
+stop-token and generation-bound classification of a freshly generated
+token block, so the engine can launch the NEXT decode dispatch before the
+host ever sees this one's tokens (the done mask feeds the next dispatch's
+row masking without a host round-trip).
 """
 
 from __future__ import annotations
@@ -112,6 +118,49 @@ def sample_slots(
         lambda k, row: jax.random.categorical(k, row, axis=-1)
     )(keys, filtered).astype(jnp.int32)
     return jnp.where(temperature > 0.0, drawn, greedy)
+
+
+def retire_mask_slots(
+    toks: jax.Array,  # [B, S] the dispatch's generated tokens, row-major
+    stop_table: jax.Array,  # [B, n_stop] i32 per-row stop tokens, -1 padded
+    bound: jax.Array,  # [B] i32 steps until the row's hard bound (pre-dispatch)
+    active: jax.Array,  # [B] bool rows that actually participated
+    emitted: "jax.Array | None" = None,  # [B] valid tokens per row (None → S)
+) -> tuple[jax.Array, jax.Array]:
+    """Per-row retirement classification → (n_valid [B] i32, done [B] bool).
+
+    THE device-side mirror of the engine's host retirement authority
+    (``_record_token``): walk each row's token block, deliver tokens up to
+    the first stop token (exclusive) or the hard generation bound
+    (max_new_tokens / sequence room), whichever comes first.  ``n_valid``
+    is how many of the row's tokens the host should deliver; ``done`` is
+    whether the row retired inside this block.
+
+    Computing this ON DEVICE is what makes double-buffered dispatch safe:
+    the done mask of dispatch N feeds dispatch N+1's row masking as plain
+    device dataflow, so N+1 can launch before any host sync of N — a
+    retiring row is frozen out of N+1 without the host in the loop.
+
+    ``emitted`` ragged-limits the scan for speculative verify blocks
+    (positions past a row's emitted count are padding, and padding zeros
+    must never match a stop token).  Inactive rows report (0, False): a
+    done mask must never leak onto a slot the host has since re-admitted.
+    """
+    B, S = toks.shape
+    limit = (
+        jnp.full((B,), S, jnp.int32) if emitted is None
+        else emitted.astype(jnp.int32)
+    )
+    pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+    within = pos < limit[:, None]
+    is_stop = (toks[:, :, None] == stop_table[:, None, :]).any(-1) & within
+    stop_any = is_stop.any(axis=1)
+    first_stop = jnp.argmax(is_stop, axis=1).astype(jnp.int32)
+    n_before = jnp.where(stop_any, first_stop, limit)
+    bound = jnp.maximum(bound, 0)
+    n_valid = jnp.minimum(n_before, bound)
+    done = stop_any | (bound <= limit)
+    return jnp.where(active, n_valid, 0), done & active
 
 
 def spec_accept_slots(

@@ -24,6 +24,18 @@ Two implementations behind one protocol:
   target emitted since its last call — rejected speculation simply gets
   overwritten by the next catch-up chunk (same garbage-beyond-length
   tolerance as the main cache).
+
+Interplay with overlapped execution (``RuntimeConfig.overlap_dispatch``):
+the spec tick stays LOCKSTEP.  Both drafters propose from the landed
+token history, so there is nothing correct to pre-launch before the
+previous verify dispatch syncs — pre-launching with a stale history
+would draft continuations of a position the device has already moved
+past, collapsing acceptance to ~0 while still paying the k+1-wide
+dispatch.  What speculation does share with the overlap scheme is the
+device-side retirement mask: the verify jit returns per-row
+``(n_valid, done)`` via the same ``sampler.retire_mask_slots``, so stop
+tokens and generation bounds are classified once, on device, in both
+modes.
 """
 
 from __future__ import annotations

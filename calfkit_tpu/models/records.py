@@ -64,6 +64,12 @@ class EngineStatsRecord(BaseModel):
     prefill_tokens: int = 0
     decode_tokens: int = 0
     decode_dispatches: int = 0
+    # overlapped execution: double-buffered dispatch enabled, and pad
+    # tokens discarded by one-dispatch-late retirement (the overlap tax).
+    # Default False so a record from a pre-overlap engine (key absent)
+    # reads as off/unknown, not as overlapped-with-zero-waste
+    overlap_dispatch: bool = False
+    overlap_wasted_tokens: int = 0
     hbm_gb_in_use: float | None = None  # where the backend reports memory
     # latency percentiles (ms) from the engine's fixed-bucket histograms:
     # ttft_p50/p99, inter_token_p50/p99, queue_wait_p50/p99, prefill_p50/p99

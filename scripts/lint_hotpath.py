@@ -10,6 +10,13 @@ constructs the telemetry PR explicitly bans there (ISSUE 2):
 - logging calls (``logger.*``, ``logging.*``, ``print``) — a log line per
   dispatch (let alone per token) is an I/O stall on the serving path;
   telemetry goes through the O(1) metrics instruments instead.
+- blocking device→host syncs (``np.asarray``/``np.array``/
+  ``jax.device_get``/``.block_until_ready()``/``.item()`` on device
+  arrays) anywhere in the OVERLAP-critical functions except the single
+  designated sync point ``_sync_host`` (ISSUE 3): double-buffered
+  dispatch only reclaims the inter-dispatch bubble if the launch path
+  never stalls on the device, and a stray ``np.asarray`` silently turns
+  overlap back into lockstep.  ``jnp.asarray`` (host→device) stays legal.
 
 Exit 0 when clean; exit 1 with a file:line listing otherwise.
 """
@@ -28,7 +35,16 @@ ENGINE = Path(__file__).resolve().parent.parent / (
 # one) on the scheduler/decode threads
 HOT_FUNCTIONS = {
     "_decode_tick",
+    "_decode_tick_lockstep",
+    "_launch_decode",
+    "_land_decode",
+    "_drain_decode",
+    "_decode_args",
+    "_retire_args",
+    "_free_deferred",
+    "_observe_gap",
     "_spec_decode_tick",
+    "_long_decode_tick",
     "_note_dispatch",
     "_observe",
     "_update_active_gauge",
@@ -40,11 +56,40 @@ HOT_FUNCTIONS = {
     "_deliver_batch",
 }
 
+# pure host-side metric/heap helpers: never handed a device array, so the
+# blocking-sync ban would be noise there.  Everything ELSE in the dispatch
+# loop is overlap-critical — a blocking device→host sync reopens the
+# serialization bubble the double buffering exists to close.  Deriving the
+# overlap set by subtraction (instead of a second hand-maintained list)
+# means a future dispatch-loop function added to HOT_FUNCTIONS gets the
+# sync ban automatically.  The single legal sync point is ``_sync_host``
+# (checked to exist below).
+METRIC_HELPERS = {
+    "_observe",
+    "_update_active_gauge",
+    "_sync_metric_counters",
+    "_retirement_near",
+    "_retirement_bound",
+}
+OVERLAP_FUNCTIONS = HOT_FUNCTIONS - METRIC_HELPERS
+
 BANNED_CALL_NAMES = {"print"}
 BANNED_ATTR_CALLS = {
     ("time", "time"),  # wall clock on the hot path
 }
 BANNED_RECEIVERS = {"logger", "logging"}  # any logging call
+
+# blocking device→host syncs, banned in OVERLAP_FUNCTIONS (jnp.asarray is
+# host→device and stays legal; the host-side numpy constructors np.zeros/
+# np.full/np.ascontiguousarray never block on the device)
+BANNED_SYNC_ATTRS = {
+    ("np", "asarray"),
+    ("np", "array"),
+    ("numpy", "asarray"),
+    ("numpy", "array"),
+    ("jax", "device_get"),
+}
+BANNED_SYNC_METHODS = {"block_until_ready", "item"}  # any receiver
 
 
 def _violations(tree: ast.AST) -> list[tuple[int, str]]:
@@ -54,15 +99,22 @@ def _violations(tree: ast.AST) -> list[tuple[int, str]]:
             continue
         if node.name not in HOT_FUNCTIONS:
             continue
+        overlap = node.name in OVERLAP_FUNCTIONS
         for call in ast.walk(node):
             if not isinstance(call, ast.Call):
                 continue
             fn = call.func
             if isinstance(fn, ast.Name) and fn.id in BANNED_CALL_NAMES:
                 out.append((call.lineno, f"{node.name}: call to {fn.id}()"))
-            elif isinstance(fn, ast.Attribute) and isinstance(
-                fn.value, ast.Name
-            ):
+            elif isinstance(fn, ast.Attribute):
+                if overlap and fn.attr in BANNED_SYNC_METHODS:
+                    out.append(
+                        (call.lineno,
+                         f"{node.name}: .{fn.attr}() — blocking device "
+                         "sync outside _sync_host")
+                    )
+                if not isinstance(fn.value, ast.Name):
+                    continue
                 pair = (fn.value.id, fn.attr)
                 if pair in BANNED_ATTR_CALLS:
                     out.append(
@@ -75,6 +127,13 @@ def _violations(tree: ast.AST) -> list[tuple[int, str]]:
                         (call.lineno,
                          f"{node.name}: {fn.value.id}.{fn.attr}() — no "
                          "logging on the dispatch loop")
+                    )
+                elif overlap and pair in BANNED_SYNC_ATTRS:
+                    out.append(
+                        (call.lineno,
+                         f"{node.name}: {pair[0]}.{pair[1]}() — blocking "
+                         "host sync outside the designated _sync_host "
+                         "point")
                     )
     return sorted(out)
 
@@ -90,7 +149,10 @@ def main() -> int:
         for n in ast.walk(tree)
         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
     }
-    missing = {"_decode_tick", "_record_token", "_note_dispatch"} - names
+    missing = {
+        "_decode_tick", "_record_token", "_note_dispatch",
+        "_launch_decode", "_land_decode", "_sync_host",
+    } - names
     if missing:
         print(f"lint_hotpath: guarded functions missing from engine.py: "
               f"{sorted(missing)} (update HOT_FUNCTIONS)")

@@ -46,6 +46,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from calfkit_tpu.inference.config import RuntimeConfig, preset  # noqa: E402
 from calfkit_tpu.inference.engine import InferenceEngine  # noqa: E402
+from scripts._stub_common import (  # noqa: E402
+    stub_prefill_lens,
+    stub_retire_block,
+)
 from calfkit_tpu.observability.metrics import REGISTRY  # noqa: E402
 from calfkit_tpu.observability.trace import TRACER  # noqa: E402
 
@@ -67,10 +71,15 @@ def _stub_jits(engine: InferenceEngine, bs: int) -> None:
         def run(params, k, v, *rest):
             toks = jnp.ones((steps, bs), jnp.int32)
             if engine._paged:
-                tables, last, lens, *_ = rest
+                tables, last, lens, active, done_prev, _stop, hard_end, *_ = rest
             else:
-                last, lens, *_ = rest
-            return k, v, last, lens, toks
+                last, lens, active, done_prev, _stop, hard_end, *_ = rest
+            # mirror the device-retirement contract (the engine retires on
+            # the stub's verdict)
+            _act, n_valid, done, new_lens = stub_retire_block(
+                active, done_prev, lens, hard_end, steps
+            )
+            return k, v, last, new_lens, toks, n_valid, done
 
         return run
 
@@ -80,6 +89,7 @@ def _stub_jits(engine: InferenceEngine, bs: int) -> None:
                 seeds, w_temp, w_top_k, w_top_p,
                 tables=None, page_rows=None, scatter_ids=None):
             firsts = jnp.ones((rows,), jnp.int32)
+            lens = stub_prefill_lens(lens, slots, true_lens)
             return k, v, tables, last, lens, slot_keys, temp, top_k, top_p, firsts
 
         return run

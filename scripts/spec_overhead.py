@@ -48,6 +48,7 @@ from calfkit_tpu.inference.config import (  # noqa: E402
     preset,
 )
 from calfkit_tpu.inference.engine import InferenceEngine  # noqa: E402
+from scripts._stub_common import stub_retire_emitted  # noqa: E402
 
 K_SPEC = 4
 NEW_TOKENS = 64
@@ -103,14 +104,15 @@ def _stub_jits(engine: InferenceEngine, bs: int, rule) -> None:
     def fake_verify_jit(window: int, S: int, sampled: bool = False):
         def run(params, k, v, *rest):
             if engine._paged:
-                tables, last, lens, active, drafts, ndraft, *_ = rest
+                tables, last, lens, active, drafts, ndraft, _stop, hard_end, *_ = rest
             else:
-                last, lens, active, drafts, ndraft, *_ = rest
+                last, lens, active, drafts, ndraft, _stop, hard_end, *_ = rest
             last_np = np.asarray(last)
             lens_np = np.asarray(lens)
             act = np.asarray(active)
             dr = np.asarray(drafts)
             nd = np.asarray(ndraft)
+            hard = np.asarray(hard_end)
             B = last_np.shape[0]
             out = np.zeros((B, S), np.int32)
             emitted = np.zeros((B,), np.int32)
@@ -134,8 +136,13 @@ def _stub_jits(engine: InferenceEngine, bs: int, rule) -> None:
                 emitted[b] = accepted + 1
                 new_last[b] = out[b, accepted]
                 new_lens[b] += emitted[b]
+            # the device-side retirement contract (no stop tokens in this
+            # bench): deliver up to the hard bound, done when the block
+            # reaches it — the engine's spec tick retires on THIS verdict
+            n_valid, done = stub_retire_emitted(act, lens_np, hard, emitted)
             return (k, v, jnp.asarray(new_last), jnp.asarray(new_lens),
-                    jnp.asarray(out), jnp.asarray(emitted))
+                    jnp.asarray(out), jnp.asarray(emitted),
+                    jnp.asarray(n_valid), jnp.asarray(done))
 
         return run
 
