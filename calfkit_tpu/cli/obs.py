@@ -1,18 +1,25 @@
-"""``ck trace`` / ``ck stats`` — the observability operator surface.
+"""``ck trace`` / ``ck stats`` / ``ck timeline`` — the operator surface.
 
 ``ck trace <correlation-id>`` reads the compacted ``mesh.traces`` topic
 and prints the run's per-hop waterfall (trace_id equals the correlation
 id by client convention, so the id on any log line or client handle is
 the lookup key).  ``ck stats`` reads the ``mesh.engine_stats`` directory
 and prints a live table of every engine's serving metrics.
+``ck timeline <correlation-id>`` reconstructs one request's scheduler
+lifecycle — admission → waves → spec/overlap dispatches → retirement →
+frees — from an engine flight-recorder dump (same correlation id as the
+trace, so a fault report's id works for both commands).
 
 Rendering is split into pure functions (``render_waterfall`` /
-``render_stats_table``) so tests cover the formatting without a mesh.
+``render_stats_table`` / ``render_timeline``) so tests cover the
+formatting without a mesh.
 """
 
 from __future__ import annotations
 
 import asyncio
+import glob
+import os
 from typing import Iterable
 
 import click
@@ -77,6 +84,7 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
         (
             "NODE", "MODEL", "TOK/S", "OCC", "ACTIVE", "SLOTS",
             "DECODED", "TTFT P50/P99 MS", "GAP P99 MS", "WASTE",
+            "FREC APP/DROP",
         )
     ]
     for r in records:
@@ -95,6 +103,11 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
         waste = (
             str(r.overlap_wasted_tokens) if r.overlap_dispatch else "off"
         )
+        # flight-recorder ring accounting: a growing DROP count means the
+        # ring is overwriting history faster than anyone dumps it — raise
+        # RuntimeConfig.flightrec_events if postmortems come up short
+        fr = r.flightrec
+        frec = f"{fr.get('appended', 0)}/{fr.get('dropped', 0)}" if fr else "-"
         # prefer the per-heartbeat-interval rates: lifetime cumulative
         # tok/s flattens toward the mean (an engine idle for an hour then
         # bursting shows ~0 lifetime) — the window field exists for this
@@ -114,6 +127,7 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
                 ttft,
                 gap,
                 waste,
+                frec,
             )
         )
     if len(rows) == 1:
@@ -193,3 +207,88 @@ def stats_command(mesh_url: str | None, timeout: float) -> None:
         click.echo(render_stats_table(records))
 
     asyncio.run(main())
+
+
+# --------------------------------------------------------------- timeline
+def render_timeline(events: "list[dict]", correlation_id: str) -> str:
+    """One request's flight-recorder lifecycle, one line per event:
+    relative time since the first event, the event name, its decoded int
+    payload (labels from ``flightrec.ARG_LABELS``), and a ``(batch)``
+    marker on wave/dispatch events borrowed from the request's active
+    window (they covered its slot but carry no correlation id)."""
+    from calfkit_tpu.observability.flightrec import ARG_LABELS
+
+    if not events:
+        return "no events"
+    t0 = min(e.get("t_s", 0.0) for e in events)
+    span_ms = (max(e.get("t_s", 0.0) for e in events) - t0) * 1000.0
+    slot = next((e["slot"] for e in events if e.get("slot", -1) >= 0), -1)
+    lines = [
+        f"timeline {correlation_id}  —  {len(events)} events"
+        + (f", slot {slot}" if slot >= 0 else "")
+        + f", {span_ms:.1f} ms first→last"
+    ]
+    for e in events:
+        offset_ms = (e.get("t_s", t0) - t0) * 1000.0
+        name = e.get("event", "?")
+        labels = ARG_LABELS.get(name, ("a", "b"))
+        payload = "  ".join(
+            f"{label}={e.get(key, 0)}"
+            for label, key in zip(labels, ("a", "b"))
+            if label
+        )
+        note = e.get("note")
+        if note:
+            payload = (payload + "  " if payload else "") + f"note={note}"
+        marker = "" if e.get("corr") == correlation_id else "  (batch)"
+        lines.append(
+            f"{offset_ms:+11.3f}ms  {name:<16}"
+            + (f" {payload}" if payload else "")
+            + marker
+        )
+    return "\n".join(lines)
+
+
+def _newest_dump(directory: str) -> str | None:
+    paths = glob.glob(os.path.join(directory, "*.jsonl"))
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+@click.command(
+    "timeline",
+    help="reconstruct one request's scheduler lifecycle from a "
+    "flight-recorder dump",
+)
+@click.argument("correlation_id")
+@click.option(
+    "--dump",
+    "dump_path",
+    default=None,
+    help="dump file (default: newest *.jsonl in $CALFKIT_FLIGHTREC_DIR / "
+    "the fault-dump directory)",
+)
+def timeline_command(correlation_id: str, dump_path: str | None) -> None:
+    from calfkit_tpu.observability import flightrec
+
+    if dump_path is None:
+        directory = flightrec.default_dump_dir()
+        dump_path = _newest_dump(directory)
+        if dump_path is None:
+            raise click.ClickException(
+                f"no flight-recorder dumps in {directory!r} — trigger one "
+                "with SIGUSR2, GET /flightrec, or pass --dump PATH"
+            )
+        click.echo(f"reading {dump_path}", err=True)
+    try:
+        with open(dump_path) as f:
+            events = flightrec.parse_dump(f)
+    except OSError as exc:
+        raise click.ClickException(f"cannot read dump: {exc}") from exc
+    selected = flightrec.timeline_events(events, correlation_id)
+    if not selected:
+        raise click.ClickException(
+            f"no events for {correlation_id!r} in {dump_path} "
+            "(wrong dump, or the ring overwrote this request — see the "
+            "FREC APP/DROP column of `ck stats`)"
+        )
+    click.echo(render_timeline(selected, correlation_id))

@@ -15,14 +15,44 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Any
 
 from calfkit_tpu.mesh.transport import MeshTransport
 from calfkit_tpu.models.records import ControlPlaneRecord, ControlPlaneStamp
+from calfkit_tpu.observability.metrics import REGISTRY
 from calfkit_tpu.controlplane.config import ControlPlaneConfig
 
 logger = logging.getLogger(__name__)
+
+# a REAL staleness signal (ISSUE 4 satellite): computed at scrape time
+# from the last successful publish, so a wedged heartbeat loop shows a
+# climbing number instead of a frozen last-write.  Directory readers see
+# staleness per node via ControlPlaneStamp.heartbeat_at; this gauge is
+# the LOCAL view — "is MY publisher still getting beats out?" — which is
+# what a node-level alert needs when the broker (and thus the directory)
+# is the thing that broke.
+_HB_STALENESS = REGISTRY.gauge(
+    "calfkit_heartbeat_staleness_s",
+    "seconds since this process's last successful control-plane "
+    "heartbeat publish (scrape-time computed)",
+)
+
+
+def _bind_staleness(publisher: "ControlPlanePublisher") -> None:
+    """Point the gauge at ``publisher`` without pinning it alive: a
+    collected (or stopped) publisher reads as 0 rather than climbing
+    forever on a process that deliberately shut its control plane."""
+    ref = weakref.ref(publisher)
+
+    def staleness() -> float:
+        p = ref()
+        if p is None or p._last_beat_at is None:
+            return 0.0
+        return max(0.0, time.monotonic() - p._last_beat_at)
+
+    _HB_STALENESS.set_fn(staleness)
 
 
 @dataclass(frozen=True)
@@ -67,6 +97,7 @@ class ControlPlanePublisher:
         }
         self._task: asyncio.Task[None] | None = None
         self._started_at = time.time()
+        self._last_beat_at: float | None = None  # monotonic; None pre-start
 
     def _record(self, advert: Advert) -> ControlPlaneRecord:
         return ControlPlaneRecord(
@@ -89,6 +120,8 @@ class ControlPlanePublisher:
             await self._writers[advert.topic].put(
                 advert.key, self._record(advert).to_wire()
             )
+        self._last_beat_at = time.monotonic()
+        _bind_staleness(self)
         self._task = asyncio.get_running_loop().create_task(
             self._beat(), name="control-plane-heartbeat"
         )
@@ -96,17 +129,23 @@ class ControlPlanePublisher:
     async def _beat(self) -> None:
         while True:
             await asyncio.sleep(self._config.heartbeat_interval)
+            beat_ok = bool(self._adverts)
             for advert in self._adverts:
                 try:
                     await self._writers[advert.topic].put(
                         advert.key, self._record(advert).to_wire()
                     )
                 except Exception:  # noqa: BLE001 - per-tick resilience
+                    beat_ok = False
                     logger.warning(
                         "heartbeat publish failed for %s (retrying next tick)",
                         advert.key,
                         exc_info=True,
                     )
+            if beat_ok:
+                # only a fully-successful tick resets staleness: a tick
+                # where any advert failed leaves the gauge climbing
+                self._last_beat_at = time.monotonic()
 
     async def stop(self) -> None:
         # cancel BEFORE tombstoning: no tick may resurrect a record
@@ -122,3 +161,7 @@ class ControlPlanePublisher:
                 await self._writers[advert.topic].tombstone(advert.key)
             except Exception:  # noqa: BLE001
                 logger.warning("tombstone failed for %s", advert.key, exc_info=True)
+        # a DELIBERATELY stopped publisher must read as 0 staleness, not
+        # climb forever: the publisher object may stay referenced (the
+        # control plane holds it), so the weakref alone doesn't cover this
+        self._last_beat_at = None

@@ -277,6 +277,18 @@ class JaxLocalModelClient(ModelClient):
         if self._engine is not None:
             await self._engine.stop()
 
+    def ready(self) -> "tuple[bool, str]":
+        """Readiness probe for ``MetricsServer.set_readiness``: True only
+        once the engine is BUILT (weights placed) and its scheduler task
+        is running — distinct from liveness (``/healthz``), which is true
+        from process start.  Cheap enough to call per scrape."""
+        engine = self._engine
+        if engine is None:
+            return False, "engine not built (weights not loaded)"
+        if not getattr(engine, "_running", False):
+            return False, "engine not started"
+        return True, "engine running"
+
     def stats_snapshot(self, *, window: bool = False) -> dict:
         """Live serving metrics (for the control-plane engine-stats advert);
         safe before start (zeros) — construction is intentionally cheap.
@@ -308,6 +320,7 @@ class JaxLocalModelClient(ModelClient):
                 "decode_dispatches": 0,
                 "overlap_dispatch": runtime.overlap_dispatch,
                 "overlap_wasted_tokens": 0,
+                "flightrec": {"appended": 0, "dropped": 0, "dumped": 0},
             }
         import jax
 
@@ -329,6 +342,9 @@ class JaxLocalModelClient(ModelClient):
             # and the pad tokens one-dispatch-late retirement discarded
             "overlap_dispatch": rt.overlap_dispatch,
             "overlap_wasted_tokens": stats.overlap_wasted_tokens,
+            # flight-recorder ring accounting: overflow (dropped) must be
+            # an observable signal, never silent truncation
+            "flightrec": engine._journal.counts(),
         }
         try:
             # latency percentiles ride the advert for free: the registry's
@@ -485,6 +501,9 @@ class JaxLocalModelClient(ModelClient):
             stop_tokens=frozenset({tokenizer.eos_id}),
             sampling=sampling,
             seed=settings.seed,
+            # the flight recorder joins on the same id the trace does, so
+            # ``ck timeline <correlation-id>`` works from any log line
+            corr=trace_parent.trace_id if trace_parent is not None else None,
         )
         stream_exc: BaseException | None = None
         try:

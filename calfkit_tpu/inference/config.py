@@ -159,6 +159,13 @@ class RuntimeConfig:
     # the lockstep host path (overlap_dispatch=False, no speculation)
     # keeps scanning arbitrary-size sets on the host.
     max_stop_tokens: int = 8
+    # flight recorder: capacity (events) of the engine's in-memory ring
+    # journal of scheduler events (admission, waves, page alloc/free,
+    # spec/overlap dispatches, retirement, faults).  Rounds up to a power
+    # of two; dumps to JSONL on engine fault / SIGUSR2 / the /flightrec
+    # endpoint; appends are O(1) lock-free (< the 2% telemetry bar, see
+    # OBS_OVERHEAD.json).  0 disables recording entirely.
+    flightrec_events: int = 4096
     # weight-only quantization: "int8" halves decode HBM traffic and fits
     # Llama-3-8B on one 16 GB chip; "int4" (packed nibbles, group-128
     # scales) halves the weight stream again (~4 GB for 8B — margin for

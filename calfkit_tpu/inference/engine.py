@@ -41,6 +41,7 @@ from jax import lax
 from calfkit_tpu.exceptions import InferenceError
 from calfkit_tpu.inference import model as M
 from calfkit_tpu.inference.config import ModelConfig, RuntimeConfig
+from calfkit_tpu.observability import flightrec
 from calfkit_tpu.observability.metrics import (
     INTER_TOKEN_BUCKETS_MS,
     REGISTRY,
@@ -294,6 +295,12 @@ class GenRequest:
     generated: int = 0
     prefill_ms: float = 0.0
     cancelled: bool = False
+    # the request's trace/correlation id (the tracing layer's trace_id —
+    # client-minted equal to the correlation id), attached to every
+    # flight-recorder event so ``ck timeline <correlation-id>`` can
+    # reconstruct this request's lifecycle from a dump.  Precomputed
+    # string: journal appends never format.
+    corr: "str | None" = None
     started_at: float = field(default_factory=time.perf_counter)
     # the request's live _retire_heap entry ([bound, seq, request] list);
     # cleared at retirement so the heap stops pinning this object's
@@ -644,6 +651,15 @@ class InferenceEngine:
         self._task: asyncio.Task[None] | None = None
         self._running = False
         self.stats = EngineStats()
+        # flight recorder: the ring journal every scheduler decision point
+        # appends to (admission, waves, page alloc/free, spec/overlap
+        # dispatches, deferred retirement, faults).  Appends are O(1)
+        # lock-free; the ring dumps to JSONL on engine fault, SIGUSR2, or
+        # the /flightrec endpoint.  flightrec_events=0 makes append a
+        # single attribute check.
+        self._journal = flightrec.FlightRecorder(
+            rt.flightrec_events, label=config.name
+        )
         # latency telemetry: process-registry instruments + the sync
         # cursors that turn cumulative stats into counter increments
         self.metrics = _engine_metrics()
@@ -1164,6 +1180,9 @@ class InferenceEngine:
             return
         self._running = True
         self._loop = asyncio.get_running_loop()
+        # SIGUSR2 dumps every live journal (best-effort: non-main-thread
+        # or signal-less platforms simply skip; recording still works)
+        flightrec.install_sigusr2()
         self._task = self._loop.create_task(self._serve(), name="inference-engine")
 
     async def stop(self) -> None:
@@ -1217,6 +1236,7 @@ class InferenceEngine:
         stop_tokens: frozenset[int] = frozenset(),
         sampling: SamplingParams | None = None,
         seed: int | None = None,
+        corr: str | None = None,
     ) -> AsyncIterator[int]:
         """Submit a prompt; yields generated token ids as they decode.
 
@@ -1224,6 +1244,8 @@ class InferenceEngine:
         only — requests with different settings share decode dispatches
         (row-wise sampling state).  Abandoning the iterator cancels the
         request: its slot is reclaimed at the next scheduler tick.
+        ``corr`` tags the request's flight-recorder events with its
+        trace/correlation id (``ck timeline``'s join key).
         """
         if not self._running:
             raise InferenceError("engine not started")
@@ -1246,6 +1268,10 @@ class InferenceEngine:
             stop_tokens=stop_tokens,
             sampling=sampling,
             seed=seed,
+            corr=corr,
+        )
+        self._journal.append(
+            flightrec.EV_SUBMIT, corr, -1, len(request.prompt), max_new_tokens
         )
         if self._drafter is not None and not long_lane:
             # drafters read prompt + emitted history (the long lane decodes
@@ -1375,9 +1401,21 @@ class InferenceEngine:
                         and not self._long_pending and self._long is None
                     ):
                         await self._wake.wait()
-        except Exception:  # noqa: BLE001
+        except Exception as exc:  # noqa: BLE001
             logger.exception("inference engine scheduler crashed")
             self._running = False
+            # fault postmortem: the ring holds the exact decision sequence
+            # that led here — dump it next to the traceback.  Strictly
+            # fail-open: a broken journal writer must never mask the
+            # original fault or block the teardown below.
+            try:
+                self._journal.append(
+                    flightrec.EV_FAULT, None, -1, 0, 0, repr(exc)
+                )
+                path = self._journal.dump(reason="fault")
+                logger.error("flight-recorder fault dump: %s", path)
+            except Exception:  # noqa: BLE001
+                logger.exception("flight-recorder fault dump failed")
             self._finish_all()
 
     def _reap_cancelled(self) -> None:
@@ -1405,12 +1443,18 @@ class InferenceEngine:
             r.cancelled for r in self._inflight["wave"]
         ):
             for request in self._inflight["wave"]:
+                self._journal.append(
+                    flightrec.EV_CANCEL, request.corr, request.slot
+                )
                 if request.slot != -1:
                     self._retire_slot(request)
                 request.out.put_nowait(_DONE)
             self._inflight = None
         for request in list(self._active.values()):
             if request.cancelled:
+                self._journal.append(
+                    flightrec.EV_CANCEL, request.corr, request.slot
+                )
                 self._retire_slot(request)
                 request.out.put_nowait(_DONE)
         if any(r.cancelled for r in self._carry):
@@ -1520,6 +1564,10 @@ class InferenceEngine:
         be served this pass (alloc failure / wave trim) — re-admission
         replans from scratch."""
         if self._prefix is not None and request.shared_pages:
+            self._journal.append(
+                flightrec.EV_PREFIX_REL, request.corr, request.slot,
+                len(request.shared_pages),
+            )
             self._prefix.release(request.shared_pages)
         request.reuse_len = 0
         request.shared_pages = []
@@ -1527,7 +1575,13 @@ class InferenceEngine:
     def _alloc_with_eviction(self, slot: int, n: int) -> "list[int] | None":
         pages = self._page_alloc.alloc(slot, n)
         if pages is None and self._prefix is not None:
-            # idle cache entries are reclaimable capacity, not a leak
+            # idle cache entries are reclaimable capacity, not a leak;
+            # the journal records the SHORTFALL (what evict is asked to
+            # reclaim), not the whole allocation request
+            self._journal.append(
+                flightrec.EV_PAGE_EVICT, None, slot,
+                n - self._page_alloc.free_pages,
+            )
             self._prefix.evict(
                 n - self._page_alloc.free_pages, self._page_alloc
             )
@@ -1560,6 +1614,10 @@ class InferenceEngine:
             # must never reclaim pages an earlier-planned member still
             # needs (acquired pages are not evictable)
             self._prefix.acquire(wave[0].shared_pages)
+            self._journal.append(
+                flightrec.EV_PREFIX_ACQ, wave[0].corr, -1,
+                len(wave[0].shared_pages),
+            )
         while (
             len(wave) < len(self._free)
             and len(wave) < self.runtime.max_prefill_wave
@@ -1580,6 +1638,10 @@ class InferenceEngine:
                     : head_reuse // self.runtime.page_size
                 ]
                 self._prefix.acquire(peeked.shared_pages)
+                self._journal.append(
+                    flightrec.EV_PREFIX_ACQ, peeked.corr, -1,
+                    len(peeked.shared_pages),
+                )
             wave.append(self._next_pending())
         # wave sizes are power-of-two so each prefill bucket compiles at
         # most log2(max_prefill_wave)+1 jit variants (R in 1,2,4,...)
@@ -1612,6 +1674,10 @@ class InferenceEngine:
                     break
                 request.slot = slot
                 request.pages = shared + pages
+                self._journal.append(
+                    flightrec.EV_PAGE_ALLOC, request.corr, slot,
+                    len(request.pages), len(shared),
+                )
                 granted.append(request)
             wave = granted
             if not wave:
@@ -1621,6 +1687,9 @@ class InferenceEngine:
             while keep * 2 <= len(wave):
                 keep *= 2
             for request in wave[keep:]:
+                self._journal.append(
+                    flightrec.EV_PAGE_FREE, request.corr, request.slot
+                )
                 self._page_alloc.free(request.slot)
                 self._free.append(request.slot)
                 request.slot = -1
@@ -1631,6 +1700,9 @@ class InferenceEngine:
         else:
             for request in wave:
                 request.slot = self._free.pop()
+        self._journal.append(
+            flightrec.EV_WAVE_FORM, None, -1, len(wave), wave_bucket
+        )
         return wave, wave_bucket
 
     def _activate_wave(self, wave: list[GenRequest]) -> None:
@@ -1647,6 +1719,10 @@ class InferenceEngine:
                 request.out.put_nowait(_DONE)
                 continue
             self._active[request.slot] = request
+            self._journal.append(
+                flightrec.EV_ADMIT, request.corr, request.slot,
+                len(request.prompt), request.reuse_len,
+            )
             self._track_retirement(request)
             # device-side retirement inputs for the slot: stop-token row
             # (-1 padded; the submit-time cap guarantees it fits whenever
@@ -1718,6 +1794,9 @@ class InferenceEngine:
             break
         if request is None:
             return False
+        self._journal.append(
+            flightrec.EV_ADMIT_LONG, request.corr, -1, len(request.prompt)
+        )
         if self.runtime.chunked_prefill:
             # resumable: one chunk per scheduler pass, short decode ticks
             # run between chunks (same latency bound as the short lane)
@@ -2008,6 +2087,9 @@ class InferenceEngine:
         prefill jit (``_finalize_wave_math``)."""
         deliveries: list[tuple[asyncio.Queue, list]] = []
         self._observe("prefill_ms", elapsed_ms)
+        self._journal.append(
+            flightrec.EV_WAVE_LAND, None, -1, len(wave), int(elapsed_ms)
+        )
         now = time.perf_counter()
         for r, request in enumerate(wave):
             if request.slot == -1:
@@ -2128,6 +2210,9 @@ class InferenceEngine:
         )
         inf["scratch"] = (sk, sv)
         inf["idx"] = idx + 1
+        self._journal.append(
+            flightrec.EV_PREFILL_CHUNK, None, -1, inf["idx"], inf["n_chunks"]
+        )
         if inf["idx"] < inf["n_chunks"]:
             return False
         # last chunk done: land the wave
@@ -2319,6 +2404,9 @@ class InferenceEngine:
         if steps < self.runtime.decode_steps_per_dispatch:
             self.stats.short_dispatches += 1
         self._observe_gap()
+        self._journal.append(
+            flightrec.EV_DISPATCH_LAUNCH, None, -1, steps, len(self._active)
+        )
         started = time.perf_counter()
         (
             self._k, self._v, self._last, self._lens, toks, n_valid, done,
@@ -2382,6 +2470,9 @@ class InferenceEngine:
                 deliveries.append((request.out, items))
         if wasted:
             self.stats.overlap_wasted_tokens += wasted
+        self._journal.append(
+            flightrec.EV_DISPATCH_LAND, None, -1, steps, wasted
+        )
         self._free_deferred(pend)
         if not self._active:
             self._last_sync_t = None  # idle boundary, not a bubble
@@ -2394,10 +2485,15 @@ class InferenceEngine:
         prefix pages stay referenced while a dispatch still reads them)."""
         for slot, shared in pend["deferred"]:
             if self._prefix is not None and shared:
+                self._journal.append(
+                    flightrec.EV_PREFIX_REL, None, slot, len(shared)
+                )
                 self._prefix.release(shared)
             if self._paged:
+                self._journal.append(flightrec.EV_PAGE_FREE, None, slot)
                 self._page_alloc.free(slot)
             self._free.append(slot)
+            self._journal.append(flightrec.EV_SLOT_FREE, None, slot)
 
     def _decode_tick_lockstep(self) -> None:
         """The lockstep reference path: launch, sync, fan out — with the
@@ -2406,6 +2502,9 @@ class InferenceEngine:
         this oracle intact."""
         args, window, steps, sampled = self._decode_args()
         self._observe_gap()
+        self._journal.append(
+            flightrec.EV_DISPATCH_LAUNCH, None, -1, steps, len(self._active)
+        )
         started = time.perf_counter()
         self._k, self._v, self._last, self._lens, toks, _n_valid, _done = (
             self._decode_jit(window, steps, sampled)(*args)
@@ -2416,6 +2515,7 @@ class InferenceEngine:
         elapsed = time.perf_counter() - started
         self._last_sync_t = time.perf_counter()
         self._note_dispatch(elapsed, steps)
+        self._journal.append(flightrec.EV_DISPATCH_LAND, None, -1, steps, 0)
         if steps < self.runtime.decode_steps_per_dispatch:
             self.stats.short_dispatches += 1
         # fan tokens out with ONE event-loop marshal per dispatch: a
@@ -2587,6 +2687,9 @@ class InferenceEngine:
             for r in self._active.values()
         )
         self._observe_gap()  # just before enqueue: drafting is prep too
+        self._journal.append(
+            flightrec.EV_DISPATCH_LAUNCH, None, -1, S, len(self._active)
+        )
         started = time.perf_counter()
         args = [self.params, self._k, self._v]
         if self._paged:
@@ -2621,6 +2724,12 @@ class InferenceEngine:
         self._note_dispatch(
             elapsed, 1,
             tokens_per_row=float(emitted.sum()) / n_active if n_active else 1.0,
+        )
+        # spec stays lockstep, so the verify sync IS the landing: one
+        # event carries the wave's draft offer vs what actually emitted
+        self._journal.append(
+            flightrec.EV_SPEC_TICK, None, -1, int(ndraft.sum()),
+            int(emitted.sum()),
         )
         deliveries: list[tuple[asyncio.Queue, list]] = []
         for slot, request in list(self._active.items()):
@@ -2667,20 +2776,41 @@ class InferenceEngine:
             self._drafter.retire(request.slot)
         pend = self._pend
         if pend is not None and request.slot in pend["slot_set"]:
+            # one-dispatch-late retirement: observable state updates now,
+            # resource frees ride to the in-flight dispatch's landing —
+            # the journal records BOTH moments (RETIRE_DEFER here, the
+            # slot/page frees in _free_deferred)
+            self._journal.append(
+                flightrec.EV_RETIRE_DEFER, request.corr, request.slot,
+                request.generated,
+            )
             pend["deferred"].append((request.slot, request.shared_pages))
             request.shared_pages = []
             request.slot = -1
             self._untrack_retirement(request)
             self._update_active_gauge()
             return
+        self._journal.append(
+            flightrec.EV_RETIRE, request.corr, request.slot, request.generated
+        )
         if self._paged:
             if self._prefix is not None and request.shared_pages:
                 # shared pages return to the CACHE (refcount), never to
                 # the free list while other readers may hold them
+                self._journal.append(
+                    flightrec.EV_PREFIX_REL, request.corr, request.slot,
+                    len(request.shared_pages),
+                )
                 self._prefix.release(request.shared_pages)
                 request.shared_pages = []
+            self._journal.append(
+                flightrec.EV_PAGE_FREE, request.corr, request.slot
+            )
             self._page_alloc.free(request.slot)
         self._free.append(request.slot)
+        self._journal.append(
+            flightrec.EV_SLOT_FREE, request.corr, request.slot
+        )
         request.slot = -1
         self._untrack_retirement(request)
         self._update_active_gauge()
@@ -2707,6 +2837,12 @@ class InferenceEngine:
             # the long lane has no slot and its sequence room is the
             # statically-sized fresh cache, enforced by long_new_cap
             done = hit_stop or request.generated >= request.max_new_tokens
+            if done:
+                # the short lane's RETIRE rides _retire_slot; the long
+                # lane holds no slot, so its retirement is recorded here
+                self._journal.append(
+                    flightrec.EV_RETIRE, request.corr, -1, request.generated
+                )
         else:
             # exhaustion == the retire heap's bound formula reaching zero
             # (one authority: heap prediction and actual retirement agree)

@@ -22,7 +22,9 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
+import weakref
 import zlib
 from typing import Awaitable, Callable
 
@@ -49,6 +51,57 @@ _QUEUE_WAIT = REGISTRY.histogram(
 _RECORDS = REGISTRY.counter(
     "calfkit_dispatch_records_total", "records dispatched through lanes"
 )
+
+# saturation signals (ISSUE 4 satellite): the queue-wait histogram only
+# shows trouble AFTER records have waited — depth and in-flight gauges
+# show the build-up while it happens.  Our exposition has no labels, so
+# per-lane depth is surfaced as (total, deepest-single-lane): the max
+# gauge is exactly the "one stalled key serializes its lane" pathology a
+# per-lane breakdown exists to catch.  Values aggregate across every live
+# dispatcher in the process (one per node), mirroring the engine's
+# active-request gauge: last-writer-wins would let an idle node's
+# dispatcher zero out a saturated one.
+_QUEUE_DEPTH = REGISTRY.gauge(
+    "calfkit_dispatch_queue_depth",
+    "records queued in key-ordered lanes (summed over lanes + dispatchers)",
+)
+_LANE_DEPTH_MAX = REGISTRY.gauge(
+    "calfkit_dispatch_lane_depth_max",
+    "deepest single key-ordered lane across the process's dispatchers",
+)
+_IN_FLIGHT = REGISTRY.gauge(
+    "calfkit_dispatch_records_in_flight",
+    "records submitted but not yet finished (queued + in handlers)",
+)
+_DEPTH_LOCK = threading.Lock()
+_DEPTH_BY_DISPATCHER: "dict[int, tuple[int, int, int]]" = {}
+
+
+def _publish_depth(key: int, total: int, deepest: int, in_flight: int) -> None:
+    with _DEPTH_LOCK:
+        _DEPTH_BY_DISPATCHER[key] = (total, deepest, in_flight)
+        totals = _DEPTH_BY_DISPATCHER.values()
+        depth = sum(t for t, _, _ in totals)
+        max_lane = max((d for _, d, _ in totals), default=0)
+        flight = sum(f for _, _, f in totals)
+    _QUEUE_DEPTH.set(depth)
+    _LANE_DEPTH_MAX.set(max_lane)
+    _IN_FLIGHT.set(flight)
+
+
+def _drop_depth(key: int) -> None:
+    """Remove a stopped/abandoned dispatcher from the aggregation and
+    re-set the gauges, so its final counts never pin the exposition."""
+    with _DEPTH_LOCK:
+        if _DEPTH_BY_DISPATCHER.pop(key, None) is None:
+            return
+        totals = _DEPTH_BY_DISPATCHER.values()
+        depth = sum(t for t, _, _ in totals)
+        max_lane = max((d for _, d, _ in totals), default=0)
+        flight = sum(f for _, _, f in totals)
+    _QUEUE_DEPTH.set(depth)
+    _LANE_DEPTH_MAX.set(max_lane)
+    _IN_FLIGHT.set(flight)
 
 
 class _LaneTask(asyncio.Task):
@@ -115,6 +168,21 @@ class KeyOrderedDispatcher:
         self._started = False
         self._stopping = False
         self._warned_keyless = False
+        # a dispatcher abandoned without stop() must not pin its last
+        # depth/in-flight counts into the process gauges
+        weakref.finalize(self, _drop_depth, id(self))
+
+    def _update_depth_gauges(self) -> None:
+        """Recompute this dispatcher's saturation signals (O(lanes)) and
+        fold them into the process gauges.  Called per submit and per lane
+        dequeue/finish — the gauges track the live build-up, not a poll."""
+        depths = [q.qsize() for q in self._queues]
+        _publish_depth(
+            id(self),
+            sum(depths),
+            max(depths, default=0),
+            2 * self._lanes - self._permits._value,
+        )
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
@@ -164,6 +232,7 @@ class KeyOrderedDispatcher:
                 w.cancel()
         self._workers = []
         self._started = False
+        _drop_depth(id(self))
 
     # -------------------------------------------------------------- intake
     def lane_of(self, key: bytes | None) -> int:
@@ -188,6 +257,7 @@ class KeyOrderedDispatcher:
         self._queues[self.lane_of(record.key)].put_nowait(
             (record, time.perf_counter())
         )
+        self._update_depth_gauges()
 
     # -------------------------------------------------------------- lanes
     async def _serve_lane(self, lane: int) -> None:
@@ -200,6 +270,7 @@ class KeyOrderedDispatcher:
             wait_ms = (time.perf_counter() - enqueued) * 1000.0
             _QUEUE_WAIT.observe(wait_ms)
             _RECORDS.inc()
+            self._update_depth_gauges()  # dequeued: depth down, in-flight holds
             # traced records get a dispatch span (parent: the emitting
             # hop's span) covering HANDLER time, with the preceding lane
             # wait carried as the queue_wait_ms attr; untraced records
@@ -254,3 +325,4 @@ class KeyOrderedDispatcher:
                 if span is not None:
                     span.end(status=status)
                 self._permits.release()
+                self._update_depth_gauges()  # handler done: in-flight down

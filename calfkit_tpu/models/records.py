@@ -70,6 +70,9 @@ class EngineStatsRecord(BaseModel):
     # reads as off/unknown, not as overlapped-with-zero-waste
     overlap_dispatch: bool = False
     overlap_wasted_tokens: int = 0
+    # flight-recorder ring accounting ({"appended", "dropped", "dumped"}):
+    # None for records from engines predating the journal
+    flightrec: dict[str, int] | None = None
     hbm_gb_in_use: float | None = None  # where the backend reports memory
     # latency percentiles (ms) from the engine's fixed-bucket histograms:
     # ttft_p50/p99, inter_token_p50/p99, queue_wait_p50/p99, prefill_p50/p99

@@ -1,6 +1,6 @@
-"""Observability: mesh-wide distributed tracing + engine latency telemetry.
+"""Observability: tracing, metrics, and the engine flight recorder.
 
-Three pieces (ISSUE 2 tentpole):
+Four pieces:
 
 - :mod:`~calfkit_tpu.observability.trace` — ``TraceContext`` propagation
   over Kafka record headers, spans, the process tracer with its bounded
@@ -8,8 +8,13 @@ Three pieces (ISSUE 2 tentpole):
 - :mod:`~calfkit_tpu.observability.metrics` — the dependency-free
   counter/gauge/histogram registry and Prometheus text exposition
   (``metrics_text``).
-- :mod:`~calfkit_tpu.observability.http` — the optional asyncio
-  ``/metrics`` endpoint.
+- :mod:`~calfkit_tpu.observability.flightrec` — the engine flight
+  recorder: a bounded ring journal of scheduler events, dumped to JSONL
+  on engine fault / SIGUSR2 / ``GET /flightrec`` and reconstructed per
+  request by ``ck timeline``.
+- :mod:`~calfkit_tpu.observability.http` — the optional asyncio endpoint:
+  ``/metrics``, ``/healthz`` (liveness), ``/readyz`` (readiness probe),
+  ``/flightrec``.
 
 Everything here is fail-open: telemetry errors never fault serving.
 """
@@ -29,9 +34,11 @@ from calfkit_tpu.observability.trace import (
     Tracer,
     current_context,
 )
+from calfkit_tpu.observability.flightrec import FlightRecorder
 from calfkit_tpu.observability.http import MetricsServer
 
 __all__ = [
+    "FlightRecorder",
     "REGISTRY",
     "Counter",
     "Gauge",

@@ -130,6 +130,7 @@ class Gauge(_Instrument):
     def __init__(self, name: str, help: str, registry: "MetricsRegistry"):
         super().__init__(name, help, registry)
         self._value = 0.0
+        self._fn: "object | None" = None
 
     def set(self, v: float) -> None:
         if not self._on:
@@ -139,12 +140,28 @@ class Gauge(_Instrument):
         except (TypeError, ValueError):
             return
 
+    def set_fn(self, fn: "object | None") -> None:
+        """Computed gauge: ``value``/``render`` call ``fn()`` at SCRAPE
+        time instead of reporting the last ``set()``.  This is for
+        staleness-style signals ("seconds since the last heartbeat
+        publish") where a value written at event time is always 0 and the
+        interesting number only exists when somebody reads it.  ``fn``
+        must be cheap and never block; errors fall back to the last
+        ``set()`` value.  Pass None to clear."""
+        self._fn = fn
+
     @property
     def value(self) -> float:
+        fn = self._fn
+        if fn is not None:
+            try:
+                return float(fn())  # type: ignore[operator]
+            except Exception:  # noqa: BLE001 - a broken fn reads as the last set
+                pass
         return self._value
 
     def render(self) -> str:
-        return f"{self._head()}{self.name} {_fmt(self._value)}\n"
+        return f"{self._head()}{self.name} {_fmt(self.value)}\n"
 
 
 class Histogram(_Instrument):

@@ -116,6 +116,15 @@ class Worker(LifecycleHookMixin):
             raise
         self._state = "serving"
 
+    def ready(self) -> "tuple[bool, str]":
+        """Readiness probe for ``MetricsServer.set_readiness``: True once
+        boot finished — subscriptions registered, dispatch lanes running,
+        control plane advertised.  Distinct from liveness: a worker mid-boot
+        (or one that failed boot) is alive but must not receive traffic."""
+        if self._state != "serving":
+            return False, f"worker is {self._state}, not serving"
+        return True, "serving"
+
     async def _boot(self) -> None:
         await self._run_hooks(self._on_startup, phase="on_startup")
         await self._enter_resources(self.resources)

@@ -17,6 +17,14 @@ constructs the telemetry PR explicitly bans there (ISSUE 2):
   dispatch only reclaims the inter-dispatch bubble if the launch path
   never stalls on the device, and a stray ``np.asarray`` silently turns
   overlap back into lockstep.  ``jnp.asarray`` (host→device) stays legal.
+- flight-recorder appends (ISSUE 4): EVERY ``*._journal.append(...)``
+  call site in engine.py — hot function or not — must pass precomputed
+  values only: no f-strings, no ``%``/``.format`` formatting, no
+  dict/set/comprehension construction in the arguments.  The same bans
+  (plus logging and ``time.time``) apply to the body of
+  ``FlightRecorder.append`` itself in observability/flightrec.py: the
+  journal's O(1)-per-event promise is the whole reason it may stay on
+  in production.
 
 Exit 0 when clean; exit 1 with a file:line listing otherwise.
 """
@@ -29,6 +37,9 @@ from pathlib import Path
 
 ENGINE = Path(__file__).resolve().parent.parent / (
     "calfkit_tpu/inference/engine.py"
+)
+FLIGHTREC = Path(__file__).resolve().parent.parent / (
+    "calfkit_tpu/observability/flightrec.py"
 )
 
 # the dispatch loop: every function that runs per decode tick (or inside
@@ -138,10 +149,114 @@ def _violations(tree: ast.AST) -> list[tuple[int, str]]:
     return sorted(out)
 
 
+def _is_journal_append(call: ast.Call) -> bool:
+    """``<anything>._journal.append(...)``."""
+    fn = call.func
+    return (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "append"
+        and isinstance(fn.value, ast.Attribute)
+        and fn.value.attr == "_journal"
+    )
+
+
+def _formatting_violations(
+    root: ast.AST, where: str
+) -> "list[tuple[int, str]]":
+    """The allocation/formatting bans shared by journal-append call sites
+    and the append body: f-strings, %%-on-a-literal, ``.format()``, and
+    dict/set/comprehension construction."""
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(root):
+        if isinstance(node, ast.JoinedStr):
+            out.append((node.lineno, f"{where}: f-string"))
+        elif isinstance(node, (ast.Dict, ast.DictComp, ast.SetComp,
+                               ast.ListComp, ast.GeneratorExp)):
+            out.append(
+                (node.lineno,
+                 f"{where}: {type(node).__name__} construction")
+            )
+        elif (
+            isinstance(node, ast.BinOp)
+            and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)
+        ):
+            out.append((node.lineno, f"{where}: %-formatting"))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "format"
+        ):
+            out.append((node.lineno, f"{where}: .format() call"))
+    return out
+
+
+def _journal_site_violations(tree: ast.AST) -> "list[tuple[int, str]]":
+    """Every journal-append call site in engine.py, in ANY function (the
+    event-loop admission path must stay as dict-churn-free as the decode
+    thread — the journal is on by default in production)."""
+    out: list[tuple[int, str]] = []
+    for call in ast.walk(tree):
+        if isinstance(call, ast.Call) and _is_journal_append(call):
+            for arg in [*call.args, *call.keywords]:
+                out.extend(
+                    _formatting_violations(arg, "journal append site")
+                )
+    return out
+
+
+def _append_body_violations(tree: ast.AST) -> "list[tuple[int, str]]":
+    """The FlightRecorder.append body itself: the O(1) lock-free promise.
+    Returns a sentinel violation when the method cannot be found — a
+    rename must break this lint loudly, not silently lint nothing."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "FlightRecorder":
+            for fn in node.body:
+                if (
+                    isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and fn.name == "append"
+                ):
+                    out = _formatting_violations(fn, "FlightRecorder.append")
+                    for call in ast.walk(fn):
+                        if not isinstance(call, ast.Call):
+                            continue
+                        f = call.func
+                        if isinstance(f, ast.Name) and f.id in BANNED_CALL_NAMES:
+                            out.append(
+                                (call.lineno,
+                                 f"FlightRecorder.append: {f.id}()")
+                            )
+                        elif isinstance(f, ast.Attribute) and isinstance(
+                            f.value, ast.Name
+                        ):
+                            pair = (f.value.id, f.attr)
+                            if pair in BANNED_ATTR_CALLS:
+                                out.append(
+                                    (call.lineno,
+                                     "FlightRecorder.append: time.time()")
+                                )
+                            elif f.value.id in BANNED_RECEIVERS:
+                                out.append(
+                                    (call.lineno,
+                                     f"FlightRecorder.append: "
+                                     f"{f.value.id}.{f.attr}() — no logging")
+                                )
+                    return out
+    return [(0, "FlightRecorder.append not found in flightrec.py "
+               "(update lint_hotpath)")]
+
+
 def main() -> int:
     source = ENGINE.read_text()
     tree = ast.parse(source, filename=str(ENGINE))
     found = _violations(tree)
+    found += _journal_site_violations(tree)
+    fr_tree = ast.parse(FLIGHTREC.read_text(), filename=str(FLIGHTREC))
+    fr_found = _append_body_violations(fr_tree)
+    if fr_found:
+        for line, message in sorted(fr_found):
+            print(f"{FLIGHTREC}:{line}: {message}")
     # the guarded function set must actually exist — a rename must break
     # this lint loudly, not silently lint nothing
     names = {
@@ -157,14 +272,21 @@ def main() -> int:
         print(f"lint_hotpath: guarded functions missing from engine.py: "
               f"{sorted(missing)} (update HOT_FUNCTIONS)")
         return 1
-    if found:
-        for line, message in found:
+    if found or fr_found:
+        for line, message in sorted(found):
             print(f"{ENGINE}:{line}: {message}")
-        print(f"lint_hotpath: {len(found)} hot-path violation(s)")
+        print(
+            f"lint_hotpath: {len(found) + len(fr_found)} hot-path "
+            "violation(s)"
+        )
         return 1
+    journal_sites = sum(
+        isinstance(c, ast.Call) and _is_journal_append(c)
+        for c in ast.walk(tree)
+    )
     print(
         f"lint_hotpath: clean ({len(HOT_FUNCTIONS & names)} dispatch-loop "
-        "functions checked)"
+        f"functions, {journal_sites} journal-append sites checked)"
     )
     return 0
 

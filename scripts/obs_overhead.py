@@ -7,19 +7,25 @@ with observability ON (the default: histograms observed per dispatch,
 tracer enabled) and OFF (``REGISTRY.set_enabled(False)`` +
 ``TRACER.set_enabled(False)``).
 
-Acceptance bar (ISSUE 2): **< 2% decode throughput delta**.  Two
-estimators ship in the artifact:
+Acceptance bar (ISSUE 2, extended by ISSUE 4): **< 2% decode throughput
+delta**, now covering the flight recorder too.  Estimators in the
+artifact:
 
 - ``implied_delta_pct`` (THE gated value): the per-dispatch
   instrumentation bundle (exactly what ``_note_dispatch`` adds — two
-  histogram observes, a gauge set, the counter sync) timed directly over
-  many iterations, converted to a throughput delta against the measured
-  host cost per dispatch.  Deterministic at the sub-percent level.
-- ``ab_delta_pct`` (evidence, not gated): best-of-N tok/s with
-  observability on vs off.  On a shared-CPU container, individual runs
-  jitter ±15-25% — far above a 2% effect — so the A/B number is reported
-  for transparency but cannot gate (observed here: the sign flips
-  rep-to-rep).
+  histogram observes, a gauge set, the counter sync) PLUS the dispatch
+  loop's flight-recorder appends (launch + land), each timed directly
+  over many iterations, converted to a throughput delta against the
+  measured host cost per dispatch.  Deterministic at the sub-percent
+  level.
+- ``journal_implied_delta_pct``: the flight-recorder share alone
+  (measured appends-per-dispatch × directly-timed append cost).
+- ``ab_delta_pct`` / ``journal_ab_delta_pct`` (evidence, not gated):
+  best-of-N tok/s with observability on vs off, and with the journal on
+  (``flightrec_events`` default) vs off (0).  On a shared-CPU container,
+  individual runs jitter ±15-25% — far above a 2% effect — so the A/B
+  numbers are reported for transparency but cannot gate (observed here:
+  the sign flips rep-to-rep).
 
 Prints one JSON line; ``--out PATH`` writes the committed artifact.
 Exits non-zero when the bar is violated.
@@ -98,12 +104,14 @@ def _stub_jits(engine: InferenceEngine, bs: int) -> None:
     engine._prefill_jit = fake_prefill_jit
 
 
-async def _one_rep() -> float:
-    """One full serve of 2*BS requests; returns decode tok/s (host wall)."""
+async def _one_rep(flightrec_events: int = 4096) -> dict:
+    """One full serve of 2*BS requests; returns decode tok/s (host wall)
+    plus the flight-recorder's append count and the dispatch count (the
+    measured appends-per-dispatch feeds the implied journal estimator)."""
     config = preset("debug", max_seq_len=256)
     runtime = RuntimeConfig(
         max_batch_size=BS, max_seq_len=256, prefill_chunk=32,
-        decode_steps_per_dispatch=STEPS,
+        decode_steps_per_dispatch=STEPS, flightrec_events=flightrec_events,
     )
     engine = InferenceEngine(config, runtime)
     _stub_jits(engine, BS)
@@ -121,9 +129,15 @@ async def _one_rep() -> float:
     counts = await asyncio.gather(*[one(i) for i in range(2 * BS)])
     wall = time.perf_counter() - t0
     tokens = engine.stats.decode_tokens
+    appended = engine._journal.counts()["appended"]
+    dispatches = engine.stats.decode_dispatches
     await engine.stop()
     assert all(c == NEW_TOKENS for c in counts), "stub served wrong lengths"
-    return tokens / wall
+    return {
+        "tok_s": tokens / wall,
+        "appended": appended,
+        "dispatches": dispatches,
+    }
 
 
 def _instrumentation_bundle_us(iters: int = 20000) -> float:
@@ -164,31 +178,73 @@ def _instrumentation_bundle_us(iters: int = 20000) -> float:
     return samples[2]
 
 
+def _journal_append_us(iters: int = 100000) -> float:
+    """Median-of-5 timing of one flight-recorder append — the exact call
+    the dispatch loop's launch/land sites pay."""
+    from calfkit_tpu.observability.flightrec import (
+        EV_DISPATCH_LAUNCH,
+        FlightRecorder,
+    )
+
+    journal = FlightRecorder(4096)
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            journal.append(EV_DISPATCH_LAUNCH, None, -1, STEPS, BS)
+        samples.append((time.perf_counter() - t0) / iters * 1e6)
+    samples.sort()
+    return samples[2]
+
+
 async def run() -> dict:
     # one discarded warmup rep: jit tracing / allocator warmup must not be
     # billed to either mode
     await _one_rep()
     on_runs: list[float] = []
     off_runs: list[float] = []
+    appends_per_dispatch = 0.0
     for rep in range(REPS):
         order = (True, False) if rep % 2 == 0 else (False, True)
         for mode_on in order:
             REGISTRY.set_enabled(mode_on)
             TRACER.set_enabled(mode_on)
-            (on_runs if mode_on else off_runs).append(await _one_rep())
+            result = await _one_rep()
+            (on_runs if mode_on else off_runs).append(result["tok_s"])
+            if result["dispatches"]:
+                # the journal records regardless of the registry switch:
+                # any rep measures the real appends-per-dispatch ratio
+                appends_per_dispatch = (
+                    result["appended"] / result["dispatches"]
+                )
     REGISTRY.set_enabled(True)
     TRACER.set_enabled(True)
+    # flight-recorder A/B (ISSUE 4): journal on (default ring) vs off
+    # (flightrec_events=0), observability on in both — same jitter caveat
+    # as the registry A/B, reported as evidence only
+    journal_off_runs = [
+        (await _one_rep(flightrec_events=0))["tok_s"]
+        for _ in range(max(2, REPS // 2))
+    ]
     best_on, best_off = max(on_runs), max(off_runs)
+    best_journal_off = max(journal_off_runs)
     ab_delta_pct = (best_off - best_on) / best_off * 100.0
+    journal_ab_delta_pct = (
+        (best_journal_off - best_on) / best_journal_off * 100.0
+    )
 
     # the gated estimator: time EXACTLY the per-dispatch instrumentation
-    # bundle, convert to a throughput delta against the measured host
-    # cost of one dispatch (host-stub throughput is host-bound, so the
-    # added fraction of dispatch time IS the throughput delta)
+    # bundle + the journal's measured appends-per-dispatch, convert to a
+    # throughput delta against the measured host cost of one dispatch
+    # (host-stub throughput is host-bound, so the added fraction of
+    # dispatch time IS the throughput delta)
     bundle_us = _instrumentation_bundle_us()
+    append_us = _journal_append_us()
+    journal_us = append_us * appends_per_dispatch
     tokens_per_dispatch = BS * STEPS
     host_us_per_dispatch = tokens_per_dispatch / best_on * 1e6
-    implied_delta_pct = bundle_us / host_us_per_dispatch * 100.0
+    journal_implied_delta_pct = journal_us / host_us_per_dispatch * 100.0
+    implied_delta_pct = (bundle_us + journal_us) / host_us_per_dispatch * 100.0
     ok = implied_delta_pct < DELTA_BAR_PCT
     return {
         "metric": f"obs_overhead[host-stub bs={BS} steps={STEPS}]",
@@ -197,17 +253,25 @@ async def run() -> dict:
         "bar_pct": DELTA_BAR_PCT,
         "ok": ok,
         "instrumentation_us_per_dispatch": round(bundle_us, 3),
+        "journal_append_us": round(append_us, 4),
+        "journal_appends_per_dispatch": round(appends_per_dispatch, 3),
+        "journal_us_per_dispatch": round(journal_us, 3),
+        "journal_implied_delta_pct": round(journal_implied_delta_pct, 4),
         "host_us_per_dispatch": round(host_us_per_dispatch, 1),
         "tok_s_observability_on": round(best_on, 1),
         "tok_s_observability_off": round(best_off, 1),
+        "tok_s_journal_off": round(best_journal_off, 1),
         "ab_delta_pct_best_of": round(ab_delta_pct, 3),
+        "journal_ab_delta_pct_best_of": round(journal_ab_delta_pct, 3),
         "ab_note": (
             "A/B wall-clock deltas on this container jitter far above the "
             "2% bar (sign flips rep-to-rep); the implied delta from the "
-            "directly-timed instrumentation bundle is the gated value"
+            "directly-timed instrumentation bundle + journal appends is "
+            "the gated value"
         ),
         "runs_on": [round(r, 1) for r in on_runs],
         "runs_off": [round(r, 1) for r in off_runs],
+        "runs_journal_off": [round(r, 1) for r in journal_off_runs],
         "reps": REPS,
         "new_tokens_per_request": NEW_TOKENS,
         "requests": 2 * BS,

@@ -7,6 +7,7 @@ renderers."""
 from __future__ import annotations
 
 import asyncio
+import json
 
 import pytest
 
@@ -289,6 +290,92 @@ class TestEngineStatsWindowing:
         assert delta["interval_s"] is not None
 
 
+class TestGaugeSetFn:
+    def test_computed_gauge_reads_fn_at_scrape(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("staleness_s")
+        g.set(1.0)
+        ticks = iter((5.0, 7.0))
+        g.set_fn(lambda: next(ticks))
+        assert g.value == 5.0
+        assert "staleness_s 7" in g.render()
+        g.set_fn(None)
+        assert g.value == 1.0  # back to the last set()
+
+    def test_broken_fn_falls_back_to_last_set(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("flaky")
+        g.set(3.0)
+        g.set_fn(lambda: 1 / 0)
+        assert g.value == 3.0
+        assert "flaky 3" in g.render()
+
+
+class TestDispatcherDepthGauges:
+    async def test_depth_and_in_flight_track_saturation(self):
+        from calfkit_tpu.mesh.dispatch import (
+            _IN_FLIGHT,
+            _LANE_DEPTH_MAX,
+            _QUEUE_DEPTH,
+            KeyOrderedDispatcher,
+        )
+        from calfkit_tpu.mesh.transport import Record
+
+        gate = asyncio.Event()
+
+        async def handler(record):
+            await gate.wait()
+
+        dispatcher = KeyOrderedDispatcher(handler, max_workers=2)
+        dispatcher.start()
+        # one key → one lane: records serialize behind the blocked handler
+        for i in range(3):
+            await dispatcher.submit(
+                Record(topic="t", value=b"x", key=b"same-key")
+            )
+        await asyncio.sleep(0.05)  # lane picked up the first record
+        assert _IN_FLIGHT.value == 3
+        # 1 in the handler, 2 still queued in its lane
+        assert _QUEUE_DEPTH.value == 2
+        assert _LANE_DEPTH_MAX.value == 2
+        gate.set()
+        await dispatcher.stop()
+        # a stopped dispatcher never pins its counts into the exposition
+        assert _IN_FLIGHT.value == 0
+        assert _QUEUE_DEPTH.value == 0
+        assert _LANE_DEPTH_MAX.value == 0
+
+
+class TestHeartbeatStaleness:
+    async def test_staleness_climbs_from_last_publish(self):
+        from calfkit_tpu.controlplane.config import ControlPlaneConfig
+        from calfkit_tpu.controlplane.publisher import (
+            _HB_STALENESS,
+            Advert,
+            ControlPlanePublisher,
+        )
+        from calfkit_tpu.mesh import InMemoryMesh
+
+        mesh = InMemoryMesh()
+        await mesh.start()
+        publisher = ControlPlanePublisher(
+            mesh,
+            [Advert(topic="mesh.agents", node_name="a", node_kind="agent",
+                    instance_id="i1", payload={"name": "a"})],
+            ControlPlaneConfig(heartbeat_interval=30.0),
+        )
+        try:
+            await publisher.start()
+            # scrape-time computed: grows with wall time since the beat
+            first = _HB_STALENESS.value
+            assert 0.0 <= first < 5.0
+            await asyncio.sleep(0.05)
+            assert _HB_STALENESS.value > first
+        finally:
+            await publisher.stop()
+            await mesh.stop()
+
+
 class TestMetricsServer:
     async def test_serves_metrics_and_health(self):
         from calfkit_tpu.observability.http import MetricsServer
@@ -315,6 +402,106 @@ class TestMetricsServer:
             assert status == "HTTP/1.0 200 OK" and body == "ok\n"
             status, _ = await get(server.port, "/nope")
             assert status == "HTTP/1.0 404 Not Found"
+
+    async def test_healthz_is_liveness_readyz_is_readiness(self):
+        """Satellite: /healthz stays 200 unconditionally (liveness); the
+        readiness question moves to /readyz, which is 503 until a
+        registered probe says the node can actually serve."""
+        from calfkit_tpu.observability.http import MetricsServer
+
+        async def get(port: int, path: str) -> tuple[str, str]:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            await writer.drain()
+            raw = await reader.read(65536)
+            writer.close()
+            head, _, body = raw.decode().partition("\r\n\r\n")
+            return head.splitlines()[0], body
+
+        ready = {"ok": False}
+        async with MetricsServer(port=0) as server:
+            # no probe registered: alive, but never "ready by default"
+            status, body = await get(server.port, "/healthz")
+            assert status == "HTTP/1.0 200 OK"
+            status, body = await get(server.port, "/readyz")
+            assert status == "HTTP/1.0 503 Service Unavailable"
+            assert "no readiness probe" in body
+
+            server.set_readiness(
+                lambda: (ready["ok"], "engine weights + dispatch lanes")
+            )
+            status, body = await get(server.port, "/readyz")
+            assert status == "HTTP/1.0 503 Service Unavailable"
+            assert "engine weights" in body
+            status, _ = await get(server.port, "/healthz")
+            assert status == "HTTP/1.0 200 OK"  # liveness unaffected
+
+            ready["ok"] = True
+            status, body = await get(server.port, "/readyz")
+            assert status == "HTTP/1.0 200 OK"
+            assert body.startswith("ready")
+
+            # a probe that raises reads as unready, never as a 500
+            server.set_readiness(lambda: 1 / 0)
+            status, body = await get(server.port, "/readyz")
+            assert status == "HTTP/1.0 503 Service Unavailable"
+            assert "probe error" in body
+
+    async def test_flightrec_endpoint_dumps_registered_journals(self):
+        from calfkit_tpu.observability.flightrec import (
+            EV_SUBMIT,
+            FlightRecorder,
+        )
+        from calfkit_tpu.observability.http import MetricsServer
+
+        journal = FlightRecorder(8, label="http-test")
+        journal.append(EV_SUBMIT, "req-http", -1, 5, 6)
+
+        async def get(port: int, path: str) -> tuple[str, str]:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            await writer.drain()
+            raw = await reader.read(262144)
+            writer.close()
+            head, _, body = raw.decode().partition("\r\n\r\n")
+            return head.splitlines()[0], body
+
+        async with MetricsServer(port=0) as server:
+            status, body = await get(server.port, "/flightrec")
+        assert status == "HTTP/1.0 200 OK"
+        ours = [
+            json.loads(line)
+            for line in body.splitlines()
+            if "req-http" in line or '"label": "http-test"' in line
+        ]
+        assert any(o.get("corr") == "req-http" for o in ours)
+        assert journal.counts()["dumped"] == 1
+
+
+class TestReadinessProbes:
+    def test_model_client_ready_tracks_engine_lifecycle(self):
+        from calfkit_tpu.inference.client import JaxLocalModelClient
+
+        client = JaxLocalModelClient(config="debug")
+        ok, reason = client.ready()
+        assert not ok and "not built" in reason
+
+    async def test_worker_ready_tracks_serving_state(self):
+        from calfkit_tpu.engine.testing import EchoModelClient
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        agent = Agent("probe", model=EchoModelClient())
+        worker = Worker([agent], mesh=InMemoryMesh(), owns_transport=True)
+        ok, reason = worker.ready()
+        assert not ok and "new" in reason
+        await worker.start()
+        try:
+            assert worker.ready() == (True, "serving")
+        finally:
+            await worker.stop()
+        assert worker.ready()[0] is False
 
 
 class TestCliRenderers:
@@ -369,6 +556,7 @@ class TestCliRenderers:
                 active_requests=11, free_slots=5, max_batch_size=16,
                 decode_tokens=918230,
                 latency_ms={"ttft_p50": 250.0, "ttft_p99": 1000.0},
+                flightrec={"appended": 5000, "dropped": 904, "dumped": 1},
             )
         ]
         out = render_stats_table(records)
@@ -376,7 +564,13 @@ class TestCliRenderers:
         assert "1843.2" in out
         assert "11/16" in out
         assert "250/1000" in out
+        # ring overflow is observable, not silent: appended/dropped column
+        assert "FREC APP/DROP" in out
+        assert "5000/904" in out
         assert "no live engines" in render_stats_table([])
+        # a pre-flightrec record renders "-", not a KeyError
+        records[0] = records[0].model_copy(update={"flightrec": None})
+        assert "5000/904" not in render_stats_table(records)
 
     def test_span_parsing_filters_and_tolerates_garbage(self):
         from calfkit_tpu.cli.obs import _parse_spans
