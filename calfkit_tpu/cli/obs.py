@@ -84,6 +84,7 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
         (
             "NODE", "MODEL", "TOK/S", "OCC", "ACTIVE", "SLOTS",
             "DECODED", "TTFT P50/P99 MS", "GAP P99 MS", "WASTE",
+            "SHED", "EXPIRED", "CANCELS",
             "FREC APP/DROP",
         )
     ]
@@ -108,6 +109,16 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
         # RuntimeConfig.flightrec_events if postmortems come up short
         fr = r.flightrec
         frec = f"{fr.get('appended', 0)}/{fr.get('dropped', 0)}" if fr else "-"
+        # overload-protection health: admission sheds (bounded queues are
+        # DOING THEIR JOB — a growing SHED under load beats silent
+        # queue-wait growth), deadline expiries, and reaped cancels with
+        # the mesh-propagated subset in parentheses
+        shed = str(r.shed_requests) if r.max_pending else "off"
+        cancels = (
+            f"{r.cancelled_requests}({r.cancel_propagated})"
+            if r.cancel_propagated
+            else str(r.cancelled_requests)
+        )
         # prefer the per-heartbeat-interval rates: lifetime cumulative
         # tok/s flattens toward the mean (an engine idle for an hour then
         # bursting shows ~0 lifetime) — the window field exists for this
@@ -127,6 +138,9 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
                 ttft,
                 gap,
                 waste,
+                shed,
+                str(r.expired_requests),
+                cancels,
                 frec,
             )
         )

@@ -14,11 +14,17 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import random
 import uuid
-from typing import Any, Generic, TypeVar
+from dataclasses import dataclass
+from typing import Any, Callable, Generic, TypeVar
 
-from calfkit_tpu import protocol
-from calfkit_tpu.exceptions import ClientClosedError
+from calfkit_tpu import cancellation, protocol
+from calfkit_tpu.exceptions import (
+    RETRIABLE_FAULT_TYPES,
+    ClientClosedError,
+    NodeFaultError,
+)
 from calfkit_tpu.keying import partition_key
 from calfkit_tpu.mesh.transport import MeshTransport, Subscription
 from calfkit_tpu.models.messages import ModelMessage
@@ -40,6 +46,43 @@ OutputT = TypeVar("OutputT")
 DEFAULT_TIMEOUT = 60.0
 
 
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Caller-side bounded retry with jittered exponential backoff
+    (ISSUE 5) — applied by :meth:`AgentGateway.execute` to faults whose
+    ``error_type`` is in :data:`RETRIABLE_FAULT_TYPES` (overload, drain,
+    transient capability loss) and NOTHING else: a deadline fault means
+    the budget is spent, a node error means the same call would fail the
+    same way.
+
+    Delays follow ``base_delay * multiplier**attempt`` capped at
+    ``max_delay``, each multiplied by a jitter factor drawn uniformly
+    from ``[1 - jitter, 1]``.  ``rng`` is a zero-arg callable returning
+    a float in ``[0, 1)`` (default :func:`random.random`); pass e.g.
+    ``random.Random(0).random`` for fully deterministic backoff (the
+    chaos harness does)."""
+
+    attempts: int = 3  # total tries (1 = no retry)
+    base_delay: float = 0.05
+    max_delay: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5  # fraction of the delay the jitter may remove
+    rng: "Callable[[], float] | None" = None
+
+    def delay(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (0-based)."""
+        raw = min(self.base_delay * self.multiplier**attempt, self.max_delay)
+        draw = (self.rng or random.random)()
+        return raw * (1.0 - self.jitter * draw)
+
+    @staticmethod
+    def retriable(exc: BaseException) -> bool:
+        return (
+            isinstance(exc, NodeFaultError)
+            and exc.report.error_type in RETRIABLE_FAULT_TYPES
+        )
+
+
 class Client:
     def __init__(
         self,
@@ -47,11 +90,16 @@ class Client:
         *,
         client_id: str | None = None,
         default_timeout: float = DEFAULT_TIMEOUT,
+        retry: "RetryPolicy | None" = None,
     ):
         self.mesh = mesh
         self.client_id = client_id or uuid.uuid4().hex[:12]
         self.inbox_topic = protocol.client_inbox_topic(self.client_id)
         self.default_timeout = default_timeout
+        # opt-in bounded retry for execute(): None = single attempt (the
+        # pre-ISSUE-5 behavior; retries change at-most-once semantics for
+        # non-idempotent agents, so the caller must choose them)
+        self.retry = retry
         self._hub = Hub()
         self._subscription: Subscription | None = None
         self._started = False
@@ -60,6 +108,10 @@ class Client:
         self._start_lock: asyncio.Lock | None = None
         self._mesh_view: Any = None
         self._span_tasks: set[asyncio.Task] = set()  # in-flight span exports
+        # in-flight fire-and-forget cancel publishes (hub._cancel_soon):
+        # close() drains these too, or a caller exiting right after a
+        # ClientTimeoutError would silently drop the mesh cancel
+        self._cancel_tasks: set[asyncio.Task] = set()
 
     # ------------------------------------------------------------- connect
     @classmethod
@@ -69,6 +121,7 @@ class Client:
         *,
         client_id: str | None = None,
         default_timeout: float = DEFAULT_TIMEOUT,
+        retry: "RetryPolicy | None" = None,
     ) -> "Client":
         """Lazy constructor: performs no I/O (reference: caller.py:102).
 
@@ -81,7 +134,8 @@ class Client:
 
         transport, owned = resolve_mesh(mesh, allow_memory=False)
         client = cls(
-            transport, client_id=client_id, default_timeout=default_timeout
+            transport, client_id=client_id, default_timeout=default_timeout,
+            retry=retry,
         )
         client._owns_mesh = owned
         return client
@@ -110,11 +164,17 @@ class Client:
 
     async def close(self) -> None:
         self._closed = True
-        pending = {t for t in self._span_tasks if not t.done()}
+        pending = {
+            t
+            for t in (*self._span_tasks, *self._cancel_tasks)
+            if not t.done()
+        }
         if pending:
-            # give in-flight fire-and-forget span exports a brief window
-            # to land before the mesh stops (the root span has no
-            # ring-to-topic fallback); stragglers are dropped, not awaited
+            # give in-flight fire-and-forget span exports and cancel
+            # publishes a brief window to land before the mesh stops (the
+            # root span has no ring-to-topic fallback; a dropped cancel
+            # leaves downstream engines decoding for a dead caller);
+            # stragglers are dropped, not awaited
             with contextlib.suppress(Exception):
                 await asyncio.wait(pending, timeout=2.0)
         if self._subscription is not None:
@@ -162,6 +222,26 @@ class Client:
         return stream
 
     # ------------------------------------------------------------ internal
+    async def _publish_cancel(
+        self, target_topic: str, correlation_id: str, task_id: str
+    ) -> None:
+        """Publish the run's ``cancel`` record (ISSUE 5): pure headers, no
+        body, keyed like the call so it rides the same ordered lane.  Any
+        node on the target topic fans it out to in-process cancellation
+        targets (engines) — a timed-out caller stops burning TPU
+        dispatches instead of merely stopping to listen."""
+        headers = {
+            protocol.HDR_EMITTER: protocol.emitter_header(
+                "client", self.client_id
+            ),
+            protocol.HDR_KIND: "cancel",
+            protocol.HDR_TASK: task_id,
+            protocol.HDR_CORRELATION: correlation_id,
+        }
+        await self.mesh.publish(
+            target_topic, b"", key=partition_key(task_id), headers=headers
+        )
+
     async def _publish_call(
         self,
         target_topic: str,
@@ -172,6 +252,7 @@ class Client:
         task_id: str,
         state: State,
         deps: dict[str, Any],
+        deadline: float | None = None,
     ) -> None:
         from calfkit_tpu.observability.trace import TRACER
 
@@ -208,6 +289,10 @@ class Client:
             protocol.HDR_CORRELATION: correlation_id,
             **span.context.headers(),
         }
+        if deadline is not None:
+            # the mesh deadline: minted once from the caller's timeout,
+            # forwarded absolute by every hop (protocol.HDR_DEADLINE)
+            headers[protocol.HDR_DEADLINE] = protocol.format_deadline(deadline)
         try:
             await self.mesh.publish(
                 target_topic,
@@ -261,17 +346,38 @@ class AgentGateway(Generic[OutputT]):
         route: str = "run",
         timeout: float | None = None,
     ) -> InvocationHandle[OutputT]:
-        """Begin a run; returns a handle (reference: gateway.py:70)."""
+        """Begin a run; returns a handle (reference: gateway.py:70).
+
+        The effective timeout also mints the run's ``x-mesh-deadline``
+        (absolute epoch), and the handle carries a cancel hook: a timeout
+        (or an explicit ``handle.cancel()``) publishes a mesh ``cancel``
+        record so downstream engines abandon the run's work."""
         client = self._client
         await client._ensure_started()
         correlation_id = new_id()
         task_id = new_id()
+        effective_timeout = (
+            timeout if timeout is not None else client.default_timeout
+        )
+        deadline = (
+            cancellation.wall_clock() + effective_timeout
+            if effective_timeout is not None
+            else None
+        )
+
+        async def publish_cancel() -> None:
+            await client._publish_cancel(
+                self.input_topic, correlation_id, task_id
+            )
+
         # register BEFORE publish: the reply cannot beat the handle
         channel = client._hub.track(correlation_id, task_id)
         handle: InvocationHandle[OutputT] = InvocationHandle(
             channel,
             self.output_type,
-            default_timeout=timeout if timeout is not None else client.default_timeout,
+            default_timeout=effective_timeout,
+            on_abandon=publish_cancel,
+            task_registry=client._cancel_tasks,
         )
         await client._publish_call(
             self.input_topic,
@@ -281,6 +387,7 @@ class AgentGateway(Generic[OutputT]):
             task_id=task_id,
             state=self._build_state(message_history),
             deps=deps or {},
+            deadline=deadline,
         )
         return handle
 
@@ -307,12 +414,31 @@ class AgentGateway(Generic[OutputT]):
         deps: dict[str, Any] | None = None,
         route: str = "run",
         timeout: float | None = None,
+        retry: "RetryPolicy | None" = None,
     ) -> InvocationResult[OutputT]:
-        handle = await self.start(
-            prompt,
-            message_history=message_history,
-            deps=deps,
-            route=route,
-            timeout=timeout,
-        )
-        return await handle.result()
+        """Run to a typed result.  With a :class:`RetryPolicy` (here or on
+        the client), faults typed retriable — overload sheds, draining
+        workers — are retried with jittered exponential backoff; each
+        retry is a FRESH run (new correlation id, new deadline).  Timeouts
+        and non-retriable faults surface immediately."""
+        policy = retry if retry is not None else self._client.retry
+        attempts = policy.attempts if policy is not None else 1
+        last: BaseException | None = None
+        for attempt in range(max(1, attempts)):
+            if attempt:
+                await asyncio.sleep(policy.delay(attempt - 1))
+            try:
+                handle = await self.start(
+                    prompt,
+                    message_history=message_history,
+                    deps=deps,
+                    route=route,
+                    timeout=timeout,
+                )
+                return await handle.result()
+            except NodeFaultError as exc:
+                if policy is None or not RetryPolicy.retriable(exc):
+                    raise
+                last = exc
+        assert last is not None
+        raise last

@@ -82,10 +82,16 @@ class InvocationHandle(Generic[OutputT]):
         output_type: type[OutputT],
         *,
         default_timeout: float | None = None,
+        on_abandon: Any = None,  # async callable: publish the mesh cancel
+        task_registry: "set | None" = None,  # client-owned: close() drains it
     ):
         self._channel = channel
         self._output_type = output_type
         self._default_timeout = default_timeout
+        self._on_abandon = on_abandon
+        self._cancelled = False
+        self._cancel_task: "asyncio.Task | None" = None
+        self._task_registry = task_registry
 
     @property
     def correlation_id(self) -> str:
@@ -95,14 +101,60 @@ class InvocationHandle(Generic[OutputT]):
     def task_id(self) -> str:
         return self._channel.task_id
 
+    # the cancel publish runs as a background task off the timeout rail
+    # (_cancel_soon) but still must not linger forever: an unreachable
+    # broker is the LIKELY state when a timeout fires — the publish could
+    # otherwise block on reconnection indefinitely
+    _CANCEL_PUBLISH_TIMEOUT = 5.0
+
+    async def cancel(self) -> None:
+        """Publish the run's mesh ``cancel`` record (idempotent,
+        best-effort, time-bounded): downstream engines abandon in-flight
+        work for this correlation id instead of decoding for a caller
+        that left.  Called automatically when ``result()``/``stream()``
+        time out; call it yourself when abandoning a run for any other
+        reason."""
+        if self._cancelled or self._on_abandon is None:
+            return
+        self._cancelled = True
+        try:
+            await asyncio.wait_for(
+                self._on_abandon(), self._CANCEL_PUBLISH_TIMEOUT
+            )
+        except Exception:  # noqa: BLE001 - cancel is advisory, never masks
+            logger.debug(
+                "cancel publish failed for %s", self.correlation_id[:8],
+                exc_info=True,
+            )
+
+    def _cancel_soon(self) -> None:
+        """Queue the advisory cancel publish OFF the timeout rail: the
+        ``ClientTimeoutError`` must surface the moment the caller's
+        budget expires, not up to ``_CANCEL_PUBLISH_TIMEOUT`` later when
+        the broker is unreachable (the likely state when a timeout
+        fires).  The task is retained on the handle — and registered with
+        the client so ``Client.close()`` gives it a bounded window to
+        land before the mesh stops; ``cancel()`` stays awaitable for
+        callers who want publish confirmation."""
+        if self._cancelled or self._on_abandon is None:
+            return
+        task = asyncio.get_running_loop().create_task(self.cancel())
+        self._cancel_task = task
+        if self._task_registry is not None:
+            self._task_registry.add(task)
+            task.add_done_callback(self._task_registry.discard)
+
     async def result(self, timeout: float | None = None) -> InvocationResult[OutputT]:
-        """Await the terminal reply; faults raise :class:`NodeFaultError`."""
+        """Await the terminal reply; faults raise :class:`NodeFaultError`.
+        A timeout publishes the run's mesh cancel before raising — the
+        timeout is no longer purely local (ISSUE 5)."""
         timeout = timeout if timeout is not None else self._default_timeout
         try:
             terminal = await asyncio.wait_for(
                 asyncio.shield(self._channel.terminal), timeout
             )
         except asyncio.TimeoutError:
+            self._cancel_soon()
             raise ClientTimeoutError(
                 f"run {self.correlation_id[:8]} produced no terminal reply "
                 f"within {timeout}s"
@@ -128,6 +180,7 @@ class InvocationHandle(Generic[OutputT]):
             if deadline is not None:
                 remaining = deadline - loop.time()
                 if remaining <= 0:
+                    self._cancel_soon()
                     raise ClientTimeoutError(
                         f"run {self.correlation_id[:8]} stream timed out"
                     )
@@ -143,6 +196,7 @@ class InvocationHandle(Generic[OutputT]):
                 raise
             if not done:
                 step_task.cancel()
+                self._cancel_soon()
                 raise ClientTimeoutError(
                     f"run {self.correlation_id[:8]} stream timed out"
                 )

@@ -23,7 +23,7 @@ from calfkit_tpu.engine.model_client import (
 )
 from calfkit_tpu.engine.schema import output_tool_def
 from calfkit_tpu.models.capability import ToolDef
-from calfkit_tpu.exceptions import NodeFaultError
+from calfkit_tpu.exceptions import NodeFaultError, error_type_for
 from calfkit_tpu.models.error_report import ErrorReport, FaultTypes, safe_str
 from calfkit_tpu.models.messages import (
     ModelMessage,
@@ -148,13 +148,17 @@ async def run_turn(
         except Exception as exc:
             # a backend failure is a MODEL fault, not a generic node error:
             # the typed report lets callers/seams match on mesh.model_error
-            # (context-window overflows keep their own narrower type).
+            # (context-window overflows keep their own narrower type, and
+            # exceptions in the authoritative x-mesh-error-type table —
+            # EngineOverloadedError above all — keep THEIR code: an engine
+            # shed crossing this wrap as mesh.model_error would hide a
+            # retriable overload as a model bug).
             # safe_str: a hostile __str__ must not defeat the typed mint.
             message = safe_str(exc)
             error_type = (
                 FaultTypes.CONTEXT_WINDOW_EXCEEDED
                 if _is_context_overflow(exc, message)
-                else FaultTypes.MODEL_ERROR
+                else error_type_for(exc) or FaultTypes.MODEL_ERROR
             )
             raise NodeFaultError(
                 ErrorReport.build_safe(

@@ -1,12 +1,45 @@
-"""Public exception types (reference: calfkit/exceptions.py:1-233)."""
+"""Public exception types (reference: calfkit/exceptions.py:1-233) and the
+authoritative ``x-mesh-error-type`` ↔ exception-class table.
+
+The table (ISSUE 5 satellite) is the single place the wire fault vocabulary
+and the Python exception surface meet: the fault publisher in
+:mod:`calfkit_tpu.nodes.base` uses :func:`error_type_for` to give a typed
+exception a typed fault code (instead of harvesting it as a generic
+``mesh.node_error``), and the caller-side classifier uses
+:data:`RETRIABLE_FAULT_TYPES` / :func:`exception_for` to decide whether a
+fault is worth a backoff-retry and which local type represents it.
+"""
 
 from __future__ import annotations
 
 from typing import TYPE_CHECKING
 
+from calfkit_tpu.models.error_report import FaultTypes
+
 if TYPE_CHECKING:
     from calfkit_tpu.models.error_report import ErrorReport
     from calfkit_tpu.models.session_context import Envelope
+
+__all__ = [
+    "CalfkitError",
+    "NodeFaultError",
+    "ClientTimeoutError",
+    "ClientClosedError",
+    "DeserializationError",
+    "MeshUnavailableError",
+    "RegistryConfigError",
+    "SeamContractError",
+    "LifecycleConfigError",
+    "ProvisioningError",
+    "InferenceError",
+    "EngineOverloadedError",
+    "DeadlineExceededError",
+    "RunCancelledError",
+    "FAULT_TYPE_BY_EXCEPTION",
+    "RETRIABLE_FAULT_TYPES",
+    "error_type_for",
+    "exception_for",
+]
 
 
 class CalfkitError(Exception):
@@ -66,3 +99,107 @@ class ProvisioningError(CalfkitError):
 
 class InferenceError(CalfkitError):
     """Local inference backend failure."""
+
+
+class EngineOverloadedError(CalfkitError):
+    """Bounded admission shed this request (ISSUE 5).
+
+    Raised AT SUBMIT when an engine lane's queue is at
+    ``RuntimeConfig.max_pending`` (or when a stalled consumer tripped the
+    ``max_out_blocks`` delivery bound, or a draining worker refused the
+    call).  Typed and retriable by contract: the caller may back off and
+    retry the same call against the same or another engine — nothing was
+    partially executed.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        lane: str = "short",
+        pending: int = 0,
+        limit: int = 0,
+    ):
+        self.lane = lane
+        self.pending = pending
+        self.limit = limit
+        super().__init__(message)
+
+
+class DeadlineExceededError(CalfkitError, TimeoutError):
+    """The request's absolute deadline (``x-mesh-deadline``) passed.
+
+    Minted wherever the expiry is first observed — engine admission, the
+    queued-request reaper, or a mesh hop receiving an already-expired
+    call.  NOT retriable: the caller's budget is spent, retrying would
+    burn capacity for an answer nobody is waiting for.
+    """
+
+
+class RunCancelledError(CalfkitError):
+    """The run's caller published a mesh ``cancel`` before this call
+    started executing — the admission gate hit the correlation id's
+    tombstone (see :func:`calfkit_tpu.cancellation.was_cancelled`) and
+    refused to execute for a caller that already left.  NOT retriable:
+    the cancel was deliberate.
+    """
+
+
+# --------------------------------------------------------------------------- #
+# the authoritative x-mesh-error-type ↔ exception-class table
+# --------------------------------------------------------------------------- #
+# One direction is a plain dict; subclass lookups go through
+# error_type_for's MRO walk so e.g. a subclass of EngineOverloadedError
+# still classifies as mesh.overloaded.  NodeFaultError is deliberately
+# absent: it CARRIES a report with its own error_type rather than mapping
+# to one.
+
+FAULT_TYPE_BY_EXCEPTION: dict[type[BaseException], str] = {
+    EngineOverloadedError: FaultTypes.OVERLOADED,
+    DeadlineExceededError: FaultTypes.DEADLINE_EXCEEDED,
+    RunCancelledError: FaultTypes.CANCELLED,
+    ClientTimeoutError: FaultTypes.TIMEOUT,
+    DeserializationError: FaultTypes.DESERIALIZATION_ERROR,
+    InferenceError: FaultTypes.MODEL_ERROR,
+    MeshUnavailableError: FaultTypes.CAPABILITY_UNAVAILABLE,
+    ProvisioningError: FaultTypes.LIFECYCLE_ERROR,
+    LifecycleConfigError: FaultTypes.LIFECYCLE_ERROR,
+}
+
+# faults a caller may retry with backoff: the work was refused whole
+# (shed, drain, transport hiccup), never half-done.  Deadline faults are
+# deliberately NOT here — the budget is gone; timeouts are here because a
+# TRANSIENT downstream timeout (not the caller's own deadline) can succeed
+# on a less-loaded instance.
+RETRIABLE_FAULT_TYPES: frozenset[str] = frozenset(
+    {
+        FaultTypes.OVERLOADED,
+        FaultTypes.TIMEOUT,
+        FaultTypes.CAPABILITY_UNAVAILABLE,
+    }
+)
+
+# reverse direction, first-writer-wins where two exceptions share a code
+# (the dict above lists the canonical class first per code)
+_EXCEPTION_BY_FAULT_TYPE: dict[str, type[BaseException]] = {}
+for _exc_type, _code in FAULT_TYPE_BY_EXCEPTION.items():
+    _EXCEPTION_BY_FAULT_TYPE.setdefault(_code, _exc_type)
+
+
+def error_type_for(exc: BaseException) -> "str | None":
+    """The ``x-mesh-error-type`` code for an exception, honoring subclass
+    relationships; ``None`` when the exception has no typed code (the
+    fault publisher then falls back to its own generic code)."""
+    for klass in type(exc).__mro__:
+        code = FAULT_TYPE_BY_EXCEPTION.get(klass)
+        if code is not None:
+            return code
+    return None
+
+
+def exception_for(error_type: "str | None") -> "type[BaseException] | None":
+    """The canonical local exception class for a wire fault code;
+    ``None`` for unknown/untyped codes."""
+    if not error_type:
+        return None
+    return _EXCEPTION_BY_FAULT_TYPE.get(error_type)

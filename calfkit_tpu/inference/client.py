@@ -320,6 +320,13 @@ class JaxLocalModelClient(ModelClient):
                 "decode_dispatches": 0,
                 "overlap_dispatch": runtime.overlap_dispatch,
                 "overlap_wasted_tokens": 0,
+                # overload protection: same key set as the live branch
+                "max_pending": runtime.max_pending,
+                "shed_requests": 0,
+                "expired_requests": 0,
+                "cancelled_requests": 0,
+                "cancel_propagated": 0,
+                "delivery_stalled": 0,
                 "flightrec": {"appended": 0, "dropped": 0, "dumped": 0},
             }
         import jax
@@ -342,6 +349,15 @@ class JaxLocalModelClient(ModelClient):
             # and the pad tokens one-dispatch-late retirement discarded
             "overlap_dispatch": rt.overlap_dispatch,
             "overlap_wasted_tokens": stats.overlap_wasted_tokens,
+            # overload protection (ISSUE 5): admission sheds, deadline
+            # expiries, reaped consumer cancels (mesh-propagated subset),
+            # and max_out_blocks stall-cancels
+            "max_pending": rt.max_pending,
+            "shed_requests": stats.shed_requests,
+            "expired_requests": stats.expired_requests,
+            "cancelled_requests": stats.cancelled_requests,
+            "cancel_propagated": stats.cancel_propagated,
+            "delivery_stalled": stats.delivery_stalled,
             # flight-recorder ring accounting: overflow (dropped) must be
             # an observable signal, never silent truncation
             "flightrec": engine._journal.counts(),
@@ -495,6 +511,12 @@ class JaxLocalModelClient(ModelClient):
         stopped_at = -1
         ttft_ms = 0.0
         _EMIT_EVERY = 4  # re-decode cadence: bounds detokenize cost
+        # the delivery's mesh deadline rides the same contextvar channel as
+        # the trace: the node kernel set it from x-mesh-deadline, so the
+        # engine enforces the caller's ABSOLUTE budget (reject expired at
+        # admission, reap on expiry) with no per-layer arithmetic
+        from calfkit_tpu.cancellation import current_deadline
+
         token_stream = self._engine.generate(
             prompt,
             max_new_tokens=max_new,
@@ -504,6 +526,7 @@ class JaxLocalModelClient(ModelClient):
             # the flight recorder joins on the same id the trace does, so
             # ``ck timeline <correlation-id>`` works from any log line
             corr=trace_parent.trace_id if trace_parent is not None else None,
+            deadline=current_deadline.get(),
         )
         stream_exc: BaseException | None = None
         try:

@@ -159,6 +159,19 @@ class RuntimeConfig:
     # the lockstep host path (overlap_dispatch=False, no speculation)
     # keeps scanning arbitrary-size sets on the host.
     max_stop_tokens: int = 8
+    # overload protection (ISSUE 5): per-lane bound on QUEUED (not yet
+    # admitted) requests — at the bound, generate() sheds the submit with
+    # a typed EngineOverloadedError instead of letting queue wait grow
+    # silently.  Applied per lane (short `_pending`+carry, long
+    # `_long_pending`).  0 = unbounded (the pre-ISSUE-5 behavior).
+    max_pending: int = 0
+    # per-request token-delivery bound: a consumer that stops draining its
+    # stream accumulates whole dispatch-blocks in GenRequest.out forever —
+    # past this many undrained queue items the scheduler stall-cancels the
+    # request through the ordinary cancellation path (delivery_stalled
+    # counter; the consumer sees a typed EngineOverloadedError when it
+    # finally resumes).  0 = unbounded.
+    max_out_blocks: int = 0
     # flight recorder: capacity (events) of the engine's in-memory ring
     # journal of scheduler events (admission, waves, page alloc/free,
     # spec/overlap dispatches, retirement, faults).  Rounds up to a power

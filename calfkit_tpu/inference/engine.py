@@ -38,7 +38,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from calfkit_tpu.exceptions import InferenceError
+from calfkit_tpu import cancellation
+from calfkit_tpu.exceptions import (
+    DeadlineExceededError,
+    EngineOverloadedError,
+    InferenceError,
+)
 from calfkit_tpu.inference import model as M
 from calfkit_tpu.inference.config import ModelConfig, RuntimeConfig
 from calfkit_tpu.observability import flightrec
@@ -284,6 +289,10 @@ class GenRequest:
     # draft model catches its KV up from it.  None when speculation is off
     # (the non-spec hot path never pays the append).
     history: "list[int] | None" = None
+    # unbounded-ok: delivery growth is bounded by the max_out_blocks
+    # stall-cancel in the scheduler (_check_stalls), not by queue maxsize —
+    # a maxsize put_nowait would drop tokens mid-stream instead of reaping
+    # the stalled consumer whole
     out: asyncio.Queue = field(default_factory=asyncio.Queue)
     pages: list[int] = field(default_factory=list)  # paged-KV reservation
     # prefix caching: reused token count, the shared (cache-owned) page
@@ -295,6 +304,20 @@ class GenRequest:
     generated: int = 0
     prefill_ms: float = 0.0
     cancelled: bool = False
+    # deadline-aware overload protection (ISSUE 5): the request's absolute
+    # wall-clock deadline (epoch seconds via cancellation.wall_clock) —
+    # None = undeadlined.  ``expired`` marks a deadline-driven cancel so
+    # the consumer's _consume raises a typed DeadlineExceededError instead
+    # of ending the stream silently; ``stalled`` marks a max_out_blocks
+    # stall-cancel the same way (typed EngineOverloadedError on resume).
+    deadline: "float | None" = None
+    expired: bool = False
+    stalled: bool = False
+    # back-pointer into _deadline_heap so a FINISHED request's entry can
+    # be nulled immediately (_drop_deadline) instead of strongly holding
+    # the prompt/history/queue until the deadline lazily pops — minutes
+    # of dead memory per request under sustained load otherwise
+    deadline_entry: "list | None" = None
     # the request's trace/correlation id (the tracing layer's trace_id —
     # client-minted equal to the correlation id), attached to every
     # flight-recorder event so ``ck timeline <correlation-id>`` can
@@ -337,6 +360,17 @@ class EngineStats:
     # flight — the price of one-dispatch-late retirement, bounded by
     # retired rows x steps_per_dispatch
     overlap_wasted_tokens: int = 0
+    # overload protection (ISSUE 5): requests refused at submit by the
+    # max_pending bound; requests whose deadline passed (at submit, in
+    # queue, or while active); consumer-cancelled requests actually
+    # reaped; cancels that arrived via the mesh `cancel` record
+    # (cancel_correlation) — a subset of cancelled_requests; and requests
+    # stall-cancelled by the max_out_blocks delivery bound
+    shed_requests: int = 0
+    expired_requests: int = 0
+    cancelled_requests: int = 0
+    cancel_propagated: int = 0
+    delivery_stalled: int = 0
     # snapshot_and_delta state: the previous window's counter values +
     # timestamp.  Single-consumer by design (the heartbeat advert) — two
     # delta readers would steal each other's intervals.
@@ -348,6 +382,8 @@ class EngineStats:
         "long_requests", "long_dispatches", "prefix_hits",
         "prefix_reused_tokens", "spec_proposed", "spec_accepted",
         "spec_emitted", "spec_rows", "overlap_wasted_tokens",
+        "shed_requests", "expired_requests", "cancelled_requests",
+        "cancel_propagated", "delivery_stalled",
     )
 
     def counters(self) -> dict:
@@ -639,10 +675,36 @@ class InferenceEngine:
         self._retire_stale = 0
         self._decode_clock = 0
         self._cancel_dirty = False  # at least one .cancelled flag is set
+        # mesh cancels whose candidate snapshot lost the race with the
+        # decode thread (see cancel_correlation): re-matched on the next
+        # scheduler pass, where nothing mutates the queues concurrently
+        self._deferred_cancels: set[str] = set()
+        # deadline enforcement: min-heap of [deadline_epoch, seq, request]
+        # peeked once per scheduler pass (O(1) when nothing expired; pops
+        # only on actual expiry).  Event-loop-only — submit and reap both
+        # run there, so no lock.  Finished requests' entries pop lazily
+        # (liveness re-checked at pop time).
+        self._deadline_heap: list[list] = []
+        self._deadline_seq = itertools.count()
+        # chaos seam (tests/_chaos.py): when set, called with a point name
+        # ("tick" per scheduler pass, "dispatch" per decode tick) — an
+        # exception it raises crosses the dispatch loop like any real
+        # engine fault (journal dump + teardown)
+        self._chaos: Any = None
         self._inflight: dict | None = None  # chunked-prefill wave in flight
+        # requests whose (non-chunked) admission prefill is running in
+        # to_thread: otherwise they live only in a local during the JIT
+        # compile + prefill — exactly when an early cancel or deadline
+        # check most needs to see them.  Flags set here are honored at
+        # activation (_activate_wave sheds cancelled corpses).
+        self._admitting: list[GenRequest] = []
         self._carry: list[GenRequest] = []  # wave-trimmed, ahead of the queue
+        # unbounded-ok: growth is bounded by the max_pending admission shed
+        # in generate() (_shed_if_full), typed rejection instead of maxlen
+        # silently evicting queued callers
         self._pending: deque[GenRequest] = deque()
         # long-context lane (sequence-parallel; one request at a time)
+        # unbounded-ok: bounded by the same max_pending shed (long lane)
         self._long_pending: deque[GenRequest] = deque()
         self._long: dict | None = None  # active long request's device state
         self._long_inflight: dict | None = None  # chunked long prefill
@@ -660,6 +722,9 @@ class InferenceEngine:
         self._journal = flightrec.FlightRecorder(
             rt.flightrec_events, label=config.name
         )
+        # mesh cancel fan-out: a `cancel` record arriving at any node in
+        # the process reaches this engine's request abandonment
+        cancellation.register_cancel_target(self)
         # latency telemetry: process-registry instruments + the sync
         # cursors that turn cumulative stats into counter increments
         self.metrics = _engine_metrics()
@@ -1237,6 +1302,7 @@ class InferenceEngine:
         sampling: SamplingParams | None = None,
         seed: int | None = None,
         corr: str | None = None,
+        deadline: float | None = None,
     ) -> AsyncIterator[int]:
         """Submit a prompt; yields generated token ids as they decode.
 
@@ -1246,9 +1312,30 @@ class InferenceEngine:
         request: its slot is reclaimed at the next scheduler tick.
         ``corr`` tags the request's flight-recorder events with its
         trace/correlation id (``ck timeline``'s join key).
+
+        ``deadline`` is the request's ABSOLUTE wall-clock deadline (epoch
+        seconds on :func:`calfkit_tpu.cancellation.wall_clock`): an
+        already-expired submit raises :class:`DeadlineExceededError`
+        immediately, and a queued or active request whose deadline passes
+        is reaped through the cancellation path (the stream then raises
+        the same typed error).  With ``RuntimeConfig.max_pending`` set, a
+        submit that finds its lane's queue full is SHED with a typed
+        :class:`EngineOverloadedError` — O(1), before any device work.
         """
         if not self._running:
             raise InferenceError("engine not started")
+        if deadline is not None:
+            overdue = cancellation.wall_clock() - deadline
+            if overdue >= 0:
+                # expired on arrival: record the fault fast — admitting it
+                # would burn prefill + decode dispatches for a dead caller
+                self.stats.expired_requests += 1
+                self._journal.append(
+                    flightrec.EV_EXPIRE, corr, -1, int(overdue * 1000)
+                )
+                raise DeadlineExceededError(
+                    f"request expired {overdue:.3f}s before admission"
+                )
         long_lane = len(prompt) >= self.runtime.max_seq_len
         if long_lane and not self.runtime.long_context:
             raise InferenceError(
@@ -1269,6 +1356,7 @@ class InferenceEngine:
             sampling=sampling,
             seed=seed,
             corr=corr,
+            deadline=deadline,
         )
         self._journal.append(
             flightrec.EV_SUBMIT, corr, -1, len(request.prompt), max_new_tokens
@@ -1306,7 +1394,9 @@ class InferenceEngine:
                     "long-context lane decodes greedily; sampling settings "
                     "are ignored for this request"
                 )
+            self._shed_if_full("long", len(self._long_pending), request)
             self._long_pending.append(request)
+            self._submit_deadline(request)
             self._wake.set()
             inner = self._consume(request)
             try:
@@ -1338,7 +1428,11 @@ class InferenceEngine:
                     f"request needs {reserve} KV pages but the pool only has "
                     f"{usable}; lower max_new_tokens or raise num_kv_pages"
                 )
+        self._shed_if_full(
+            "short", len(self._pending) + len(self._carry), request
+        )
         self._pending.append(request)
+        self._submit_deadline(request)
         self._wake.set()
         inner = self._consume(request)
         try:
@@ -1349,6 +1443,193 @@ class InferenceEngine:
             # asyncgen finalizer gets around to collecting the inner one
             await inner.aclose()
 
+    # ------------------------------------------------- overload protection
+    def _shed_if_full(
+        self, lane: str, pending: int, request: GenRequest
+    ) -> None:
+        """Bounded admission (ISSUE 5): refuse the submit with a typed,
+        retriable error when the lane's queue is at ``max_pending`` —
+        O(1), before any device work, so saturation is a fast rejection
+        instead of silent queue-wait growth."""
+        limit = self.runtime.max_pending
+        if not limit or pending < limit:
+            return
+        self.stats.shed_requests += 1
+        self._journal.append(
+            flightrec.EV_SHED, request.corr, -1, pending, limit
+        )
+        raise EngineOverloadedError(
+            f"{lane} lane has {pending} queued requests (max_pending="
+            f"{limit}); retry with backoff or add capacity",
+            lane=lane, pending=pending, limit=limit,
+        )
+
+    def _submit_deadline(self, request: GenRequest) -> None:
+        """Register a deadlined request for the scheduler's expiry reap."""
+        if request.deadline is None:
+            return
+        entry = [request.deadline, next(self._deadline_seq), request]
+        request.deadline_entry = entry
+        heapq.heappush(self._deadline_heap, entry)
+
+    def _drop_deadline(self, request: GenRequest) -> None:
+        """A finished request must not linger in the deadline heap until
+        its deadline lazily pops: null the entry's request slot so the
+        heap holds no strong reference to the dead prompt/history."""
+        entry = request.deadline_entry
+        if entry is not None:
+            entry[2] = None
+            request.deadline_entry = None
+
+    def _request_live(self, request: GenRequest) -> bool:
+        """Is this request still queued or holding engine resources?
+        (Identity scan — only runs when a deadline actually expired.)"""
+        if request.slot != -1:
+            return True
+        if self._long is not None and self._long["request"] is request:
+            return True
+        if (
+            self._long_inflight is not None
+            and self._long_inflight["request"] is request
+        ):
+            return True
+        return any(
+            r is request
+            for r in (
+                *self._carry, *self._pending, *self._long_pending,
+                *self._admitting,
+            )
+        )
+
+    def _check_deadlines(self) -> None:
+        """Reap queued AND active requests whose deadline passed, through
+        the ordinary cancellation path (so overlap's one-dispatch-late
+        retirement semantics hold unchanged).  O(1) per scheduler pass
+        when nothing expired: one heap peek."""
+        heap = self._deadline_heap
+        if not heap:
+            return
+        now = cancellation.wall_clock()
+        if heap[0][0] > now:
+            return
+        while heap and heap[0][0] <= now:
+            _, _, request = heapq.heappop(heap)
+            if (
+                request is None  # finished: _drop_deadline nulled the entry
+                or request.cancelled
+                or not self._request_live(request)
+            ):
+                continue  # finished or already being reaped: lazy entry
+            request.expired = True
+            request.cancelled = True
+            self._cancel_dirty = True
+            self.stats.expired_requests += 1
+            self._journal.append(
+                flightrec.EV_EXPIRE, request.corr, request.slot,
+                int((now - request.deadline) * 1000),
+            )
+
+    def _check_stalls(self) -> None:
+        """Bound per-request token delivery: a consumer that stopped
+        draining its stream (``max_out_blocks`` undrained queue items)
+        is stall-cancelled through the ordinary cancellation path — its
+        accumulated blocks free with the request instead of growing
+        forever."""
+        bound = self.runtime.max_out_blocks
+        if not bound:
+            return
+        stalled = [
+            r for r in self._active.values()
+            if not r.cancelled and r.out.qsize() > bound
+        ]
+        if self._long is not None:
+            r = self._long["request"]
+            if not r.cancelled and r.out.qsize() > bound:
+                stalled.append(r)
+        for request in stalled:
+            request.stalled = True
+            request.cancelled = True
+            self._cancel_dirty = True
+            self.stats.delivery_stalled += 1
+            self._journal.append(
+                flightrec.EV_CANCEL, request.corr, request.slot,
+                request.out.qsize(),
+            )
+
+    def _note_cancel(self, request: GenRequest) -> None:
+        """One cancelled request drained from any lane or queue: the
+        journal line + counter.  Expiry- and stall-driven cancels were
+        already recorded (EV_EXPIRE at the deadline reap, EV_CANCEL at
+        the stall flag) and have their own counters — they ride the same
+        drain but must not double-count as consumer cancels."""
+        self._drop_deadline(request)
+        if request.expired or request.stalled:
+            return
+        self._journal.append(flightrec.EV_CANCEL, request.corr, request.slot)
+        self.stats.cancelled_requests += 1
+
+    def cancel_correlation(self, corr: str) -> int:
+        """Abandon every request tagged ``corr`` — the mesh ``cancel``
+        record's fan-out target (see :mod:`calfkit_tpu.cancellation`; the
+        engine registers itself at construction).  Event-loop context;
+        returns how many requests were newly flagged.  The scheduler's
+        next pass reaps them through the ordinary cancellation path.
+
+        The decode thread concurrently retires slots out of ``_active``
+        (flag-only protocol: every other reader runs on the serve loop,
+        never alongside the decode tick — this is the one foreign-task
+        scan), so the snapshot retries around a mid-iteration resize and,
+        if the race persists, defers the match to the scheduler pass
+        rather than ever dropping the cancel."""
+        if not corr:
+            return 0
+        for _ in range(4):
+            try:
+                candidates: list[GenRequest] = [
+                    *self._active.values(), *self._carry, *self._pending,
+                    *self._long_pending, *self._admitting,
+                ]
+                break
+            except RuntimeError:
+                continue
+        else:
+            self._deferred_cancels.add(corr)
+            self._wake.set()
+            return 0
+        if self._inflight is not None:
+            candidates += self._inflight["wave"]
+        if self._long is not None:
+            candidates.append(self._long["request"])
+        if self._long_inflight is not None:
+            candidates.append(self._long_inflight["request"])
+        matched = 0
+        for request in candidates:
+            if request.corr == corr and not request.cancelled:
+                request.cancelled = True
+                matched += 1
+        if matched:
+            self.stats.cancel_propagated += matched
+            self._cancel_dirty = True
+            self._wake.set()
+        return matched
+
+    def _raise_terminal(self, request: GenRequest) -> None:
+        """Typed stream endings: an engine-initiated cancel must surface
+        as a typed error at the consumer, not a silent short stream."""
+        if request.expired:
+            raise DeadlineExceededError(
+                f"request deadline passed after {request.generated} "
+                "generated tokens"
+            )
+        if request.stalled:
+            raise EngineOverloadedError(
+                "token delivery stalled past max_out_blocks="
+                f"{self.runtime.max_out_blocks}; request was cancelled",
+                lane="delivery",
+                pending=request.out.qsize(),
+                limit=self.runtime.max_out_blocks,
+            )
+
     async def _consume(self, request: GenRequest) -> AsyncIterator[int]:
         """Drain a queued request's tokens; abandoning the iterator flags
         cancellation for the scheduler to reap (both lanes share this)."""
@@ -1358,11 +1639,13 @@ class InferenceEngine:
                 item = await request.out.get()
                 if item is _DONE:
                     done = True
+                    self._raise_terminal(request)
                     return
                 if type(item) is list:  # one dispatch's token block
                     for token in item:
                         if token is _DONE:
                             done = True
+                            self._raise_terminal(request)
                             return
                         yield token
                     continue
@@ -1377,6 +1660,11 @@ class InferenceEngine:
     async def _serve(self) -> None:
         try:
             while self._running:
+                if self._chaos is not None:
+                    self._chaos("tick")
+                self._drain_deferred_cancels()
+                self._check_deadlines()
+                self._check_stalls()
                 self._reap_cancelled()
                 if self.runtime.chunked_prefill:
                     progressed = await self._admit_chunked()
@@ -1418,6 +1706,17 @@ class InferenceEngine:
                 logger.exception("flight-recorder fault dump failed")
             self._finish_all()
 
+    def _drain_deferred_cancels(self) -> None:
+        """Re-run cancel matches that lost the snapshot race (serve-loop
+        context: the decode tick is not in flight, so the snapshot cannot
+        fail again; a pathological re-defer lands in the fresh set and
+        retries next pass instead of spinning)."""
+        if not self._deferred_cancels:
+            return
+        pending, self._deferred_cancels = list(self._deferred_cancels), set()
+        for corr in pending:
+            self.cancel_correlation(corr)
+
     def _reap_cancelled(self) -> None:
         """Drain cancelled requests: active slots AND still-queued entries.
 
@@ -1443,43 +1742,43 @@ class InferenceEngine:
             r.cancelled for r in self._inflight["wave"]
         ):
             for request in self._inflight["wave"]:
-                self._journal.append(
-                    flightrec.EV_CANCEL, request.corr, request.slot
-                )
+                self._note_cancel(request)
                 if request.slot != -1:
                     self._retire_slot(request)
                 request.out.put_nowait(_DONE)
             self._inflight = None
         for request in list(self._active.values()):
             if request.cancelled:
-                self._journal.append(
-                    flightrec.EV_CANCEL, request.corr, request.slot
-                )
+                self._note_cancel(request)
                 self._retire_slot(request)
                 request.out.put_nowait(_DONE)
         if any(r.cancelled for r in self._carry):
             kept = []
             for request in self._carry:
                 if request.cancelled:
+                    self._note_cancel(request)
                     request.out.put_nowait(_DONE)
                 else:
                     kept.append(request)
             self._carry = kept
         if any(r.cancelled for r in self._pending):
-            kept_q: deque[GenRequest] = deque()
+            kept_q: deque[GenRequest] = deque()  # unbounded-ok: rebuild of the shed-bounded queue
             for request in self._pending:
                 if request.cancelled:
+                    self._note_cancel(request)
                     request.out.put_nowait(_DONE)
                 else:
                     kept_q.append(request)
             self._pending = kept_q
         if self._long is not None and self._long["request"].cancelled:
+            self._note_cancel(self._long["request"])
             self._long["request"].out.put_nowait(_DONE)
             self._long = None
         if any(r.cancelled for r in self._long_pending):
-            kept_l: deque[GenRequest] = deque()
+            kept_l: deque[GenRequest] = deque()  # unbounded-ok: rebuild of the shed-bounded queue
             for request in self._long_pending:
                 if request.cancelled:
+                    self._note_cancel(request)
                     request.out.put_nowait(_DONE)
                 else:
                     kept_l.append(request)
@@ -1491,6 +1790,7 @@ class InferenceEngine:
                 self._carry.pop(0) if self._carry else self._pending.popleft()
             )
             if request.cancelled:
+                self._note_cancel(request)
                 request.out.put_nowait(_DONE)
                 continue
             return request
@@ -1715,6 +2015,7 @@ class InferenceEngine:
             if request.cancelled:
                 # abandoned while its (chunked) admission was in flight:
                 # release the slot + pages instead of activating a corpse
+                self._note_cancel(request)
                 self._retire_slot(request)
                 request.out.put_nowait(_DONE)
                 continue
@@ -1743,7 +2044,11 @@ class InferenceEngine:
         admitted = False
         while (formed := self._form_wave()) is not None:
             wave, wave_bucket = formed
-            await asyncio.to_thread(self._prefill_wave, wave, wave_bucket)
+            self._admitting = wave
+            try:
+                await asyncio.to_thread(self._prefill_wave, wave, wave_bucket)
+            finally:
+                self._admitting = []
             self._activate_wave(wave)
             admitted = True
         return admitted
@@ -1788,6 +2093,7 @@ class InferenceEngine:
         while self._long_pending:
             candidate = self._long_pending.popleft()
             if candidate.cancelled:
+                self._note_cancel(candidate)
                 candidate.out.put_nowait(_DONE)
                 continue
             request = candidate
@@ -1802,7 +2108,11 @@ class InferenceEngine:
             # run between chunks (same latency bound as the short lane)
             self._start_long_inflight(request)
             return True
-        await asyncio.to_thread(self._long_prefill, request)
+        self._admitting = [request]
+        try:
+            await asyncio.to_thread(self._long_prefill, request)
+        finally:
+            self._admitting = []
         return True
 
     def _long_padded(self, n: int) -> int:
@@ -1910,6 +2220,7 @@ class InferenceEngine:
         inf = self._long_inflight
         request = inf["request"]
         if request.cancelled:
+            self._note_cancel(request)
             self._long_inflight = None
             # runs on the to_thread worker: queue puts marshal to the loop
             self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)
@@ -1994,8 +2305,10 @@ class InferenceEngine:
                 # one-dispatch-late retirement, long-lane edition: the
                 # pre-launched follow-up block is all pad now
                 self.stats.overlap_wasted_tokens += inflight["steps"]
+            self._drop_deadline(request)
             self._long = None
         elif state["t"] >= state["cap"] and inflight is None:
+            self._drop_deadline(request)
             self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)
             self._long = None
 
@@ -2282,6 +2595,8 @@ class InferenceEngine:
         inter-dispatch device-idle bubble collapses to the launch-enqueue
         cost.  Lockstep mode is the reference oracle: launch, sync, fan
         out, with the host as the retirement authority."""
+        if self._chaos is not None:
+            self._chaos("dispatch")
         if not self.runtime.overlap_dispatch:
             self._decode_tick_lockstep()
             return
@@ -2771,6 +3086,7 @@ class InferenceEngine:
         dispatch must never find its pages re-allocated under it, nor its
         shared prefix pages evicted while it still reads them.  Everything
         observable (``_active``, the retire heap, the gauge) updates now."""
+        self._drop_deadline(request)
         self._active.pop(request.slot, None)
         if self._drafter is not None and request.slot != -1:
             self._drafter.retire(request.slot)

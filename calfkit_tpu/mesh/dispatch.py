@@ -28,6 +28,7 @@ import weakref
 import zlib
 from typing import Awaitable, Callable
 
+from calfkit_tpu import protocol
 from calfkit_tpu.mesh.transport import Record
 from calfkit_tpu.observability.metrics import REGISTRY
 from calfkit_tpu.observability.trace import TRACER, TraceContext
@@ -161,6 +162,10 @@ class KeyOrderedDispatcher:
         # queue items are (record, enqueue perf_counter) for queue-wait
         # attribution; None is the drain sentinel
         self._queues: list[asyncio.Queue[tuple[Record, float] | None]] = [
+            # unbounded-ok: total queued records across all lanes are
+            # bounded by the 2*max_workers permit semaphore submit()
+            # acquires before enqueueing — a maxsize would deadlock the
+            # permit holder
             asyncio.Queue() for _ in range(max_workers)
         ]
         self._permits = _TripwireSemaphore(2 * max_workers)
@@ -245,6 +250,21 @@ class KeyOrderedDispatcher:
         if not self._started:
             raise RuntimeError("dispatcher not started")
         if self._stopping:
+            return
+        if (record.headers or {}).get(protocol.HDR_KIND) == "cancel":
+            # control-record preemption (ISSUE 5): a `cancel` rides the
+            # same task key as the call it abandons, so the ordered lane
+            # would queue it BEHIND that very call — undeliverable until
+            # the work it exists to stop has finished.  Cancels are
+            # advisory, body-less and idempotent: handle inline on the
+            # pull task, skipping lanes and permits.  Fail-open.
+            try:
+                await self._handler(record)
+            except Exception:  # noqa: BLE001 - advisory, never stalls intake
+                logger.exception(
+                    "[%s] cancel-record handler failed on %s",
+                    self._name, record.topic,
+                )
             return
         if record.key is None and not self._warned_keyless:
             self._warned_keyless = True

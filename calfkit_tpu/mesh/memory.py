@@ -143,6 +143,11 @@ class InMemoryMesh(MeshTransport):
         self._pumps: list[asyncio.Task[None]] = []
         self._dispatchers: list[KeyOrderedDispatcher] = []
         self._started = False
+        # chaos seam (tests/_chaos.py): a deterministic fault injector for
+        # scripted broker-failure scenarios.  Called per publish with
+        # (topic, headers); returning "drop" silently loses the record —
+        # the broker-drop-during-return scenario.  None = transparent.
+        self.chaos: "Callable[[str, dict[str, str]], str | None] | None" = None
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
@@ -229,6 +234,11 @@ class InMemoryMesh(MeshTransport):
             raise ValueError(
                 f"message of {len(value)} bytes exceeds max_message_bytes={self._max_bytes}"
             )
+        if self.chaos is not None and self.chaos(topic, headers or {}) == "drop":
+            # injected broker loss: the record never lands (scripted
+            # scenarios assert the timeout/cancel story downstream)
+            await asyncio.sleep(0)
+            return
         t = self._topic(topic)
         t.append(key, value, headers or {})
         # yield so same-task publish->consume chains interleave like real I/O

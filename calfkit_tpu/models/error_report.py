@@ -43,6 +43,17 @@ class FaultTypes:
     CONTEXT_WINDOW_EXCEEDED = "mesh.model.context_window_exceeded"
     OVERSIZED_MESSAGE = "mesh.oversized_message"
     LIFECYCLE_ERROR = "mesh.lifecycle_error"
+    # overload protection (ISSUE 5): a bounded queue shed the call, or a
+    # draining worker refused it — RETRIABLE elsewhere/later by contract
+    OVERLOADED = "mesh.overloaded"
+    # the call's x-mesh-deadline passed (on arrival, in queue, or while
+    # executing): the caller is gone, the work was abandoned — NOT
+    # retriable (the budget is spent)
+    DEADLINE_EXCEEDED = "mesh.deadline_exceeded"
+    # the run's caller published a mesh `cancel` before this call started
+    # executing (tombstone hit at the admission gate) — NOT retriable
+    # (the caller abandoned the run on purpose)
+    CANCELLED = "mesh.cancelled"
     UNHANDLED = "mesh.unhandled_exception"
 
     @classmethod

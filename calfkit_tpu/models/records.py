@@ -70,6 +70,16 @@ class EngineStatsRecord(BaseModel):
     # reads as off/unknown, not as overlapped-with-zero-waste
     overlap_dispatch: bool = False
     overlap_wasted_tokens: int = 0
+    # overload protection (ISSUE 5): admission sheds (max_pending bound),
+    # deadline expiries, reaped consumer cancels (with the mesh-propagated
+    # subset) and max_out_blocks stall-cancels.  Defaults 0 so records
+    # from pre-ISSUE-5 engines read as "no overload events", not unknown.
+    max_pending: int = 0
+    shed_requests: int = 0
+    expired_requests: int = 0
+    cancelled_requests: int = 0
+    cancel_propagated: int = 0
+    delivery_stalled: int = 0
     # flight-recorder ring accounting ({"appended", "dropped", "dumped"}):
     # None for records from engines predating the journal
     flightrec: dict[str, int] | None = None

@@ -28,15 +28,17 @@ Fault rail invariants preserved from the reference:
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import uuid
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, ClassVar, Sequence
 
 from pydantic import ValidationError
 
-from calfkit_tpu import protocol
-from calfkit_tpu.exceptions import NodeFaultError
+from calfkit_tpu import cancellation, protocol
+from calfkit_tpu.exceptions import NodeFaultError, error_type_for
 from calfkit_tpu.keying import partition_key
 from calfkit_tpu.mesh.transport import MeshTransport, Record
 from calfkit_tpu.models.actions import Call, Next, NodeResult, ReturnCall, TailCall
@@ -199,7 +201,16 @@ class BaseNodeDef(RegistryMixin):
         self.on_callee_error = list(on_callee_error)
         self.resources: dict[str, Any] = {}
         self._transport: MeshTransport | None = None
-        self._span_tasks: "set[Any]" = set()  # in-flight span exports
+        # in-flight background publishes (span exports, cancel forwards)
+        self._span_tasks: "set[Any]" = set()
+        # cancel forwarding (ISSUE 5): topics this kernel published CALLS
+        # to, per correlation id, so _handle_cancel can re-publish the
+        # cancel along the run's path — an engine in ANOTHER process
+        # (behind a downstream topic) is unreachable through the
+        # in-process registry alone.  Bounded LRU; entries are advisory
+        # (a stale forward to a finished child fans out to nothing), so
+        # eviction never costs correctness.
+        self._downstream_calls: "OrderedDict[str, set[str]]" = OrderedDict()
 
     # ------------------------------------------------------------ identity
     @property
@@ -256,6 +267,13 @@ class BaseNodeDef(RegistryMixin):
 
     async def _handle_delivery(self, record: Record) -> None:
         headers = record.headers
+        if headers.get(protocol.HDR_KIND) == "cancel":
+            # a cancel record is pure headers (no envelope body): fan it
+            # out to every in-process cancellation target (engines) so a
+            # dead caller's in-flight work is abandoned, then stop — there
+            # is nothing to execute and no reply owed
+            self._handle_cancel(headers)
+            return
         if not protocol.is_envelope(headers):
             return  # step/other wire kinds are not for the kernel
         try:
@@ -291,6 +309,17 @@ class BaseNodeDef(RegistryMixin):
         )
         log_id = (correlation_id or task_id)[:8]
 
+        # ---- deadline: the delivery's absolute budget rides a contextvar
+        # (same channel shape as the trace) so in-process children — the
+        # inference engine above all — enforce the caller's deadline
+        # without per-layer budget arithmetic.  Reset in the finally.
+        deadline = protocol.parse_deadline(headers.get(protocol.HDR_DEADLINE))
+        deadline_token = (
+            cancellation.current_deadline.set(deadline)
+            if deadline is not None
+            else None
+        )
+
         # ---- tracing: one HOP SPAN per traced delivery.  A missing trace
         # header is legal (pre-trace emitters, external producers) — the
         # hop simply runs untraced.  Everything here is fail-open.
@@ -320,14 +349,25 @@ class BaseNodeDef(RegistryMixin):
             sink, sink_token = _trace.collect_spans()
 
         try:
+            if kind == "call":
+                # expired-on-arrival + drain gate: record the typed fault
+                # FAST instead of executing for a caller that is gone (or
+                # a worker that is leaving) — raising here lands in the
+                # NodeFaultError arm below, so the fault rail, step flush
+                # and span bookkeeping all run normally
+                self._check_admission(ctx, deadline)
             await self._execute(ctx)
         except MintedFault as minted:
             await self._publish_fault(ctx, minted.error.report)
         except NodeFaultError as fault:
             await self._publish_fault(ctx, fault.report)
         except Exception as exc:  # noqa: BLE001 - the fault rail
+            # typed exceptions (EngineOverloadedError, DeadlineExceeded…)
+            # keep their wire code from the authoritative table in
+            # calfkit_tpu.exceptions; everything else harvests as this
+            # node kind's generic fault
             report = ErrorReport.build_safe(
-                self._own_fault_type(),
+                error_type_for(exc) or self._own_fault_type(),
                 exc=exc,
                 node=self.node_id,
                 route=ctx.route,
@@ -366,6 +406,8 @@ class BaseNodeDef(RegistryMixin):
                 )
             raise
         finally:
+            if deadline_token is not None:
+                cancellation.current_deadline.reset(deadline_token)
             await self._flush_steps(ctx)
             if hop_span is not None:
                 if ctx.fault_error_type is not None:
@@ -382,6 +424,149 @@ class BaseNodeDef(RegistryMixin):
 
     def _own_fault_type(self) -> str:
         return FaultTypes.NODE_ERROR
+
+    # --------------------------------------------- overload protection
+    # LRU cap on the per-kernel corr -> downstream-call-topics map: sized
+    # for every plausible concurrent-run count; eviction only degrades a
+    # cancel back to single-hop for the evicted (oldest) run
+    _DOWNSTREAM_CALLS_CAP = 2048
+
+    def _note_downstream_call(self, correlation_id: str, topic: str) -> None:
+        """Remember that this run published a call to ``topic`` so a later
+        cancel can follow it (``_handle_cancel``)."""
+        calls = self._downstream_calls
+        entry = calls.get(correlation_id)
+        if entry is None:
+            entry = calls[correlation_id] = set()
+        entry.add(topic)
+        calls.move_to_end(correlation_id)
+        while len(calls) > self._DOWNSTREAM_CALLS_CAP:
+            calls.popitem(last=False)
+
+    # per-topic bound on one forwarded publish, mirroring the client's
+    # _CANCEL_PUBLISH_TIMEOUT rationale: an unreachable broker is the
+    # LIKELY state when cancels storm in, and must not wedge the task
+    _CANCEL_FORWARD_TIMEOUT = 5.0
+
+    def _handle_cancel(self, headers: dict[str, str]) -> None:
+        """Route a ``cancel``-kind record to in-process abandonment AND
+        forward it along the run's path: every registered cancellation
+        target (the inference engines) drops its requests for the record's
+        correlation id, and every topic this kernel published one of the
+        run's calls to gets the cancel re-published — an engine in another
+        worker process is only reachable through its topic.  The pop makes
+        forwarding idempotent (a duplicate cancel delivery forwards
+        nothing); the forwards run as a retained, time-bounded background
+        task because this runs INLINE on the dispatcher's express intake
+        path — awaiting an unreachable broker here would head-of-line
+        block all record intake, the exact failure the express path
+        exists to avoid.  Fail-open — a cancel is advisory; a target or
+        hop that cannot honor it changes nothing."""
+        correlation_id = headers.get(protocol.HDR_CORRELATION)
+        if not correlation_id:
+            return
+        topics = self._downstream_calls.pop(correlation_id, None)
+        if topics:
+            task = asyncio.get_running_loop().create_task(
+                self._forward_cancel(
+                    sorted(topics), correlation_id,
+                    headers.get(protocol.HDR_TASK),
+                )
+            )
+            self._span_tasks.add(task)
+            task.add_done_callback(self._span_tasks.discard)
+        matched = cancellation.propagate_cancel(correlation_id)
+        if matched:
+            logger.info(
+                "[%s] cancel for %s abandoned %d in-flight request(s)",
+                self.node_id, correlation_id[:8], matched,
+            )
+
+    async def _forward_cancel(
+        self,
+        topics: "list[str]",
+        correlation_id: str,
+        task_id: "str | None",
+    ) -> None:
+        for topic in topics:
+            fwd = {
+                protocol.HDR_EMITTER: self.emitter,
+                protocol.HDR_KIND: "cancel",
+                protocol.HDR_CORRELATION: correlation_id,
+            }
+            if task_id:
+                fwd[protocol.HDR_TASK] = task_id
+            try:
+                await asyncio.wait_for(
+                    self.transport.publish(
+                        topic,
+                        b"",
+                        key=partition_key(task_id) if task_id else None,
+                        headers=fwd,
+                    ),
+                    self._CANCEL_FORWARD_TIMEOUT,
+                )
+            except Exception:  # noqa: BLE001 - advisory, never faults the hop
+                logger.warning(
+                    "[%s] cancel forward to %s failed for %s",
+                    self.node_id, topic, correlation_id[:8],
+                    exc_info=True,
+                )
+
+    # emitter kinds whose calls CONTINUE a run already admitted to the
+    # mesh (an agent's tool call, a tail call): a draining worker must let
+    # these finish — "in-flight work runs to completion" — and only refuse
+    # runs ENTERING the mesh (client-emitted, or unattributed external)
+    _CONTINUATION_EMITTERS = ("agent", "tool", "toolbox", "consumer", "worker")
+
+    def _check_admission(
+        self, ctx: NodeRunContext, deadline: "float | None"
+    ) -> None:
+        """The call-delivery gate (ISSUE 5): an already-expired call
+        records a typed ``mesh.deadline_exceeded`` fault instead of
+        executing, and a draining worker refuses NEW runs with a typed,
+        retriable ``mesh.overloaded`` fault while in-flight deliveries —
+        returns, faults, and node-emitted continuation calls belonging to
+        runs already executing — keep flowing to completion.  A call whose
+        run was already cancelled (tombstone hit: the cancel rode EXPRESS
+        past the lane this call was still queued in) faults fast instead
+        of executing for a caller that left."""
+        if cancellation.was_cancelled(ctx.correlation_id):
+            raise NodeFaultError(
+                ErrorReport.build_safe(
+                    FaultTypes.CANCELLED,
+                    f"run was cancelled before this call reached "
+                    f"{self.node_id}",
+                    node=self.node_id,
+                    route=ctx.route,
+                )
+            )
+        if deadline is not None:
+            overdue = cancellation.wall_clock() - deadline
+            if overdue >= 0:
+                raise NodeFaultError(
+                    ErrorReport.build_safe(
+                        FaultTypes.DEADLINE_EXCEEDED,
+                        f"call expired {overdue:.3f}s before reaching "
+                        f"{self.node_id}",
+                        node=self.node_id,
+                        route=ctx.route,
+                    )
+                )
+        worker = self.resources.get("worker")
+        if worker is not None and getattr(worker, "draining", False):
+            emitter = ctx.headers.get(protocol.HDR_EMITTER, "")
+            if emitter.split("/", 1)[0] in self._CONTINUATION_EMITTERS:
+                return  # a sub-call of an in-flight run: let it finish
+            raise NodeFaultError(
+                ErrorReport.build_safe(
+                    FaultTypes.OVERLOADED,
+                    f"{self.node_id} is draining for shutdown; "
+                    "retry against another instance",
+                    node=self.node_id,
+                    route=ctx.route,
+                )
+            )
 
     # =====================================================================
     # stages
@@ -881,9 +1066,17 @@ class BaseNodeDef(RegistryMixin):
             headers[protocol.HDR_CORRELATION] = ctx.correlation_id
         if error_type:
             headers[protocol.HDR_ERROR_TYPE] = error_type
+        # deadline propagation: every hop forwards the caller's absolute
+        # deadline unchanged (next to the trace headers) so downstream
+        # hops and engines enforce the SAME budget
+        incoming_deadline = ctx.headers.get(protocol.HDR_DEADLINE)
+        if incoming_deadline:
+            headers[protocol.HDR_DEADLINE] = incoming_deadline
         if ctx.trace is not None:
             # downstream hops parent to THIS hop's span
             headers.update(ctx.trace.headers())
+        if kind == "call" and ctx.correlation_id:
+            self._note_downstream_call(ctx.correlation_id, topic)
         await self.transport.publish(
             topic,
             envelope.to_wire(),

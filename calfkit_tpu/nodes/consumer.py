@@ -55,6 +55,15 @@ class ConsumerNode(BaseNodeDef):
 
     # overriding the whole pipeline: consumers have no kernel stages
     async def _handle_delivery(self, record: Record) -> None:
+        if record.headers.get(protocol.HDR_KIND) == "cancel":
+            # control record, not observable traffic: fan out to the
+            # in-process cancellation targets exactly like the kernel
+            # path.  Without this short-circuit the dispatcher's EXPRESS
+            # cancel delivery would run the user's consumer fn INLINE on
+            # the intake pull task — head-of-line blocking the very path
+            # built to avoid it, with a spurious envelope=None delivery.
+            self._handle_cancel(record.headers)
+            return
         envelope: Envelope | None = None
         if protocol.is_envelope(record.headers):
             try:

@@ -78,6 +78,8 @@ EV_RETIRE_DEFER = 15  # retired; frees deferred to the in-flight landing
 EV_SLOT_FREE = 16  # slot returned to the free list
 EV_CANCEL = 17  # consumer-cancelled request reaped
 EV_FAULT = 18  # exception crossed the dispatch loop (note=repr)
+EV_SHED = 19  # bounded admission refused the submit  a=pending b=limit
+EV_EXPIRE = 20  # deadline passed (submit/queue/active) a=overdue_ms
 
 EVENT_NAMES: tuple[str, ...] = (
     "SUBMIT",
@@ -99,6 +101,8 @@ EVENT_NAMES: tuple[str, ...] = (
     "SLOT_FREE",
     "CANCEL",
     "FAULT",
+    "SHED",
+    "EXPIRE",
 )
 
 # per-event meaning of the two int payload fields (the dump stays compact
@@ -123,6 +127,8 @@ ARG_LABELS: dict[str, tuple[str, str]] = {
     "SLOT_FREE": ("", ""),
     "CANCEL": ("", ""),
     "FAULT": ("", ""),
+    "SHED": ("pending", "limit"),
+    "EXPIRE": ("overdue_ms", ""),
 }
 
 # batch-scoped events a request's timeline borrows from its active window

@@ -21,9 +21,24 @@ Every envelope-bearing record carries:
                          correlation id by convention)
 - ``x-mesh-span``      — the EMITTING hop's span id; the receiving hop
                          parents its own span to it
+- ``x-mesh-deadline``  — absolute wall-clock deadline (epoch seconds,
+                         decimal string), minted by the client from its
+                         timeout and forwarded by every hop.  A hop that
+                         receives an already-expired call records a typed
+                         ``mesh.deadline_exceeded`` fault instead of
+                         executing — work for a dead caller is the mesh's
+                         most expensive no-op.
 
 Headers are advisory routing/telemetry metadata; the envelope body is always
 authoritative.  Consumers must tolerate missing headers (a ``None`` decode).
+
+The ``cancel`` message kind carries no envelope body: it is a pure header
+record (correlation id + task key) that asks every in-process cancellation
+target along the run's path — engines, long-running handlers — to abandon
+work for that correlation id.  Each hop re-publishes the cancel to the
+topics it sent the run's calls to, so it follows the run across process
+boundaries; a tombstone guards work the targets cannot see yet (see
+:mod:`calfkit_tpu.cancellation`).
 """
 
 from __future__ import annotations
@@ -43,6 +58,7 @@ HDR_CORRELATION: Final = "x-mesh-correlation"
 HDR_ERROR_TYPE: Final = "x-mesh-error-type"
 HDR_TRACE: Final = "x-mesh-trace"
 HDR_SPAN: Final = "x-mesh-span"
+HDR_DEADLINE: Final = "x-mesh-deadline"
 
 ALL_HEADERS: Final = (
     HDR_EMITTER,
@@ -54,6 +70,7 @@ ALL_HEADERS: Final = (
     HDR_ERROR_TYPE,
     HDR_TRACE,
     HDR_SPAN,
+    HDR_DEADLINE,
 )
 
 # --------------------------------------------------------------------------- #
@@ -61,10 +78,10 @@ ALL_HEADERS: Final = (
 # --------------------------------------------------------------------------- #
 
 NodeKind = Literal["agent", "tool", "consumer", "toolbox", "client", "worker"]
-MessageKind = Literal["call", "return", "fault"]
+MessageKind = Literal["call", "return", "fault", "cancel"]
 WireKind = Literal["envelope", "step", "span"]
 
-MESSAGE_KINDS: Final = ("call", "return", "fault")
+MESSAGE_KINDS: Final = ("call", "return", "fault", "cancel")
 WIRE_KINDS: Final = ("envelope", "step", "span")
 
 # --------------------------------------------------------------------------- #
@@ -92,6 +109,29 @@ def header_map(raw: dict[str, bytes | str] | None) -> dict[str, str]:
         if s is not None:
             out[k] = s
     return out
+
+
+def format_deadline(epoch_s: float) -> str:
+    """Encode an absolute wall-clock deadline for the wire (ms precision:
+    cross-host clock skew dwarfs anything finer)."""
+    return f"{epoch_s:.3f}"
+
+
+def parse_deadline(value: "bytes | str | None") -> "float | None":
+    """Decode an ``x-mesh-deadline`` header value; ``None`` for a missing
+    or malformed header (a corrupt deadline degrades to un-deadlined, it
+    must never fault the delivery)."""
+    s = decode_header_str(value)
+    if not s:
+        return None
+    try:
+        deadline = float(s)
+    except ValueError:
+        return None
+    # NaN/inf are not deadlines; negative epochs are clock garbage
+    if deadline != deadline or deadline in (float("inf"), float("-inf")):
+        return None
+    return deadline if deadline > 0 else None
 
 
 def emitter_header(node_kind: str, node_name: str) -> str:

@@ -99,7 +99,7 @@ class Worker(LifecycleHookMixin):
         self.resources: dict[str, Any] = {}
         self._subscriptions: list[Subscription] = []
         self._stores: list[KtablesFanoutBatchStore] = []
-        self._state = "new"  # new -> serving -> stopped
+        self._state = "new"  # new -> serving -> draining -> stopped
         self._advertiser: Any = None
 
     # ------------------------------------------------------------ lifecycle
@@ -120,10 +120,29 @@ class Worker(LifecycleHookMixin):
         """Readiness probe for ``MetricsServer.set_readiness``: True once
         boot finished — subscriptions registered, dispatch lanes running,
         control plane advertised.  Distinct from liveness: a worker mid-boot
-        (or one that failed boot) is alive but must not receive traffic."""
+        (or one that failed boot) is alive but must not receive traffic —
+        and a DRAINING worker flips unready so load balancers route away
+        while in-flight deliveries finish."""
         if self._state != "serving":
             return False, f"worker is {self._state}, not serving"
         return True, "serving"
+
+    @property
+    def draining(self) -> bool:
+        """True once :meth:`drain` ran: the node kernel refuses NEW calls
+        with a typed, retriable ``mesh.overloaded`` fault while in-flight
+        deliveries (and owed returns/faults) complete normally."""
+        return self._state == "draining"
+
+    def drain(self) -> None:
+        """Enter drain mode (ISSUE 5; SIGTERM does this automatically in
+        :meth:`serve_forever`): ``/readyz`` flips false, new calls get
+        typed ``OVERLOADED`` faults callers can retry elsewhere, in-flight
+        work runs to completion.  Follow with :meth:`stop` — the
+        dispatcher's graceful drain then finds empty lanes."""
+        if self._state == "serving":
+            self._state = "draining"
+            logger.info("worker draining: new calls will be refused")
 
     async def _boot(self) -> None:
         await self._run_hooks(self._on_startup, phase="on_startup")
@@ -227,13 +246,25 @@ class Worker(LifecycleHookMixin):
         await self.stop()
 
     async def serve_forever(self) -> None:
-        """Start and serve until cancelled (SIGINT/SIGTERM aware)."""
+        """Start and serve until cancelled (SIGINT/SIGTERM aware).
+
+        SIGTERM is the orchestrator's polite eviction: it triggers drain
+        mode FIRST (readiness flips, new calls fault ``OVERLOADED``) and
+        then the normal stop, whose dispatcher drain lets in-flight
+        deliveries finish.  SIGINT stops without the drain gate (the
+        operator at the keyboard wants out now)."""
         await self.start()
         stop_event = asyncio.Event()
         loop = asyncio.get_running_loop()
-        for sig in (signal.SIGINT, signal.SIGTERM):
-            with contextlib.suppress(NotImplementedError, RuntimeError):
-                loop.add_signal_handler(sig, stop_event.set)
+
+        def terminate() -> None:
+            self.drain()
+            stop_event.set()
+
+        with contextlib.suppress(NotImplementedError, RuntimeError):
+            loop.add_signal_handler(signal.SIGINT, stop_event.set)
+        with contextlib.suppress(NotImplementedError, RuntimeError):
+            loop.add_signal_handler(signal.SIGTERM, terminate)
         try:
             await stop_event.wait()
         finally:

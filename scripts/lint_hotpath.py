@@ -25,6 +25,14 @@ constructs the telemetry PR explicitly bans there (ISSUE 2):
   ``FlightRecorder.append`` itself in observability/flightrec.py: the
   journal's O(1)-per-event promise is the whole reason it may stay on
   in production.
+- unbounded queues (ISSUE 5): every ``asyncio.Queue()`` / ``deque()``
+  construction (including ``default_factory=asyncio.Queue`` /
+  ``default_factory=deque``) in engine.py and mesh/dispatch.py must
+  either pass an explicit bound (``maxsize=``/``maxlen=``) or carry an
+  ``# unbounded-ok: <why>`` justification on its own line or the line
+  above.  The overload-protection PR exists because two silent unbounded
+  deques turned saturation into invisible queue-wait growth — a new one
+  must state which admission bound, permit, or reaper makes it safe.
 
 Exit 0 when clean; exit 1 with a file:line listing otherwise.
 """
@@ -40,6 +48,9 @@ ENGINE = Path(__file__).resolve().parent.parent / (
 )
 FLIGHTREC = Path(__file__).resolve().parent.parent / (
     "calfkit_tpu/observability/flightrec.py"
+)
+DISPATCH = Path(__file__).resolve().parent.parent / (
+    "calfkit_tpu/mesh/dispatch.py"
 )
 
 # the dispatch loop: every function that runs per decode tick (or inside
@@ -247,6 +258,100 @@ def _append_body_violations(tree: ast.AST) -> "list[tuple[int, str]]":
                "(update lint_hotpath)")]
 
 
+# ---------------------------------------------------- unbounded queues
+# (ISSUE 5) a Queue/deque with no bound and no justification is exactly
+# how the pre-overload engine turned saturation into silent queue growth
+
+_QUEUE_NAMES = {"Queue", "deque", "LifoQueue", "PriorityQueue", "SimpleQueue"}
+_BOUND_KWARGS = {"maxsize", "maxlen"}
+_OK_MARK = "unbounded-ok:"
+
+
+def _queue_ctor_name(node: ast.AST) -> "str | None":
+    """'asyncio.Queue' / 'deque' when ``node`` references a queue type."""
+    if isinstance(node, ast.Name) and node.id in _QUEUE_NAMES:
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and node.attr in _QUEUE_NAMES
+        and isinstance(node.value, ast.Name)
+        and node.value.id in ("asyncio", "collections", "queue")
+    ):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _bound_value_ok(node: ast.AST, is_deque: bool) -> bool:
+    """A bound expression counts unless it is statically, verifiably
+    unbounded: a literal ``None`` for either type, or a literal ``<= 0``
+    for Queue kinds (asyncio/queue treat ``maxsize<=0`` as UNLIMITED —
+    the exact regression the rule exists to catch — while a deque
+    ``maxlen=0`` is a real bound: an always-empty deque).  Non-literal
+    expressions pass; the lint cannot evaluate them."""
+    if not isinstance(node, ast.Constant):
+        return True
+    if node.value is None:
+        return False
+    if is_deque:
+        return True
+    return not (
+        isinstance(node.value, int)
+        and not isinstance(node.value, bool)
+        and node.value <= 0
+    )
+
+
+def _is_bounded_call(call: ast.Call) -> bool:
+    is_deque = _queue_ctor_name(call.func) in ("deque", "collections.deque")
+    for kw in call.keywords:
+        if kw.arg in _BOUND_KWARGS:
+            return _bound_value_ok(kw.value, is_deque)
+    # positional bound: deque(iterable, maxlen) / Queue(maxsize)
+    if is_deque:
+        return len(call.args) >= 2 and _bound_value_ok(call.args[1], True)
+    return bool(call.args) and _bound_value_ok(call.args[0], False)
+
+
+def _justified(lines: "list[str]", lineno: int) -> bool:
+    """``# unbounded-ok:`` on the construction line or anywhere in the
+    contiguous comment block immediately above it (multi-line
+    justifications sit above the statement)."""
+    if 1 <= lineno <= len(lines) and _OK_MARK in lines[lineno - 1]:
+        return True
+    n = lineno - 1
+    while 1 <= n <= len(lines) and lines[n - 1].lstrip().startswith("#"):
+        if _OK_MARK in lines[n - 1]:
+            return True
+        n -= 1
+    return False
+
+
+def _unbounded_queue_violations(
+    tree: ast.AST, source: str, where: Path
+) -> "list[tuple[Path, int, str]]":
+    lines = source.splitlines()
+    out: list[tuple[Path, int, str]] = []
+    for node in ast.walk(tree):
+        name = None
+        lineno = 0
+        if isinstance(node, ast.Call):
+            ctor = _queue_ctor_name(node.func)
+            if ctor is not None and not _is_bounded_call(node):
+                name, lineno = f"{ctor}()", node.lineno
+        elif isinstance(node, ast.keyword) and node.arg == "default_factory":
+            ctor = _queue_ctor_name(node.value)
+            if ctor is not None:
+                name, lineno = f"default_factory={ctor}", node.value.lineno
+        if name and not _justified(lines, lineno):
+            out.append(
+                (where, lineno,
+                 f"unbounded {name} without an '# {_OK_MARK} <why>' "
+                 "justification (name the admission bound / permit / "
+                 "reaper that bounds it)")
+            )
+    return out
+
+
 def main() -> int:
     source = ENGINE.read_text()
     tree = ast.parse(source, filename=str(ENGINE))
@@ -257,6 +362,15 @@ def main() -> int:
     if fr_found:
         for line, message in sorted(fr_found):
             print(f"{FLIGHTREC}:{line}: {message}")
+    dispatch_source = DISPATCH.read_text()
+    dispatch_tree = ast.parse(dispatch_source, filename=str(DISPATCH))
+    queue_found = _unbounded_queue_violations(tree, source, ENGINE)
+    queue_found += _unbounded_queue_violations(
+        dispatch_tree, dispatch_source, DISPATCH
+    )
+    if queue_found:
+        for path, line, message in sorted(queue_found):
+            print(f"{path}:{line}: {message}")
     # the guarded function set must actually exist — a rename must break
     # this lint loudly, not silently lint nothing
     names = {
@@ -272,12 +386,12 @@ def main() -> int:
         print(f"lint_hotpath: guarded functions missing from engine.py: "
               f"{sorted(missing)} (update HOT_FUNCTIONS)")
         return 1
-    if found or fr_found:
+    if found or fr_found or queue_found:
         for line, message in sorted(found):
             print(f"{ENGINE}:{line}: {message}")
         print(
-            f"lint_hotpath: {len(found) + len(fr_found)} hot-path "
-            "violation(s)"
+            f"lint_hotpath: {len(found) + len(fr_found) + len(queue_found)} "
+            "hot-path violation(s)"
         )
         return 1
     journal_sites = sum(
@@ -286,7 +400,8 @@ def main() -> int:
     )
     print(
         f"lint_hotpath: clean ({len(HOT_FUNCTIONS & names)} dispatch-loop "
-        f"functions, {journal_sites} journal-append sites checked)"
+        f"functions, {journal_sites} journal-append sites checked, "
+        "unbounded-queue rule enforced)"
     )
     return 0
 

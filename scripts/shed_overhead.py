@@ -1,0 +1,231 @@
+"""Bounded-admission A/B microbench (ISSUE 5 acceptance artifact).
+
+Offers 2x the engine's serving capacity (slots + the `max_pending`
+queue bound) with a fixed-latency device stub, bounds ON vs OFF,
+holding everything else constant, and measures the two numbers the
+overload-protection tentpole promises:
+
+- **queue-wait p99 stays bounded**: with `max_pending` set, a caller
+  that is admitted waits AT MOST one queue-bound's worth of generations
+  regardless of offered load — the excess is refused instead of queued.
+  Without the bound, every extra caller stretches the tail: the same
+  offered load roughly multiplies p99 queue-wait by the oversubscription
+  factor (the silent queue-wait growth the PR exists to kill).
+- **the shed path is O(1) and fast**: a refused submit raises its typed
+  ``EngineOverloadedError`` in well under a millisecond, before ANY
+  device work — shedding under pressure must itself be cheap.
+
+Prints one JSON line (written to SHED.json via --out); exits non-zero
+unless the bounded run's p99 queue-wait stays under the single-backlog
+bar, the unbounded run's tail is demonstrably worse, and the shed path
+meets the sub-millisecond bar.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from calfkit_tpu.exceptions import EngineOverloadedError  # noqa: E402
+from calfkit_tpu.inference.config import RuntimeConfig, preset  # noqa: E402
+from calfkit_tpu.inference.engine import InferenceEngine  # noqa: E402
+from scripts._stub_common import (  # noqa: E402
+    stub_prefill_lens,
+    stub_retire_block,
+)
+
+BS = 8  # engine slots
+STEPS = 8
+NEW_TOKENS = 64
+DEVICE_MS = 4.0  # simulated device time per decode dispatch
+# capacity = BS active + BS queued (max_pending=BS); offer 2x that
+OFFERED = 4 * BS
+SHED_BAR_MS = 1.0  # a refusal must cost less than this
+# an admitted caller's worst case with the bound: the whole admitted
+# backlog (one slot-full generation) ahead of it, plus slack for host
+# scheduling.  NOT scaled to offered load — that is the whole point.
+GEN_MS = (NEW_TOKENS / STEPS) * DEVICE_MS
+BOUNDED_P99_BAR_MS = 2.5 * GEN_MS
+
+
+class _DeviceSim:
+    """Serialized fixed-latency device (see overlap_overhead.py)."""
+
+    def __init__(self, latency_s: float):
+        self.latency_s = latency_s
+        self.busy_until: float | None = None
+        self.dispatches = 0
+
+    def launch(self) -> float:
+        now = time.perf_counter()
+        start = max(now, self.busy_until or now)
+        self.busy_until = start + self.latency_s
+        self.dispatches += 1
+        return self.busy_until
+
+
+class _LazyBlock:
+    """A token block readable at ``ready_at`` — the engine's sync blocks
+    exactly like a real device_get."""
+
+    def __init__(self, arr: np.ndarray, ready_at: float):
+        self._arr = arr
+        self._ready_at = ready_at
+
+    def __array__(self, dtype=None, copy=None):
+        delay = self._ready_at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        return self._arr if dtype is None else self._arr.astype(dtype)
+
+    @property
+    def T(self):
+        return np.asarray(self).T
+
+
+def _stub_jits(engine: InferenceEngine, sim: _DeviceSim) -> None:
+    def fake_decode(window: int, steps: int | None = None, sampled: bool = False):
+        steps = steps or engine.runtime.decode_steps_per_dispatch
+
+        def run(params, k, v, last, lens, active, done_prev, _stop,
+                hard_end, *rest):
+            ready_at = sim.launch()
+            toks = np.ones((steps, BS), np.int32)
+            _act, n_valid, done, new_lens = stub_retire_block(
+                active, done_prev, lens, hard_end, steps
+            )
+            return (
+                k, v, last, new_lens,
+                _LazyBlock(toks, ready_at), n_valid, done,
+            )
+
+        return run
+
+    def fake_prefill_jit(bucket: int, rows: int, sampled: bool = False):
+        def run(params, k, v, last, lens, tokens, slots, true_lens,
+                *rest, tables=None, page_rows=None, scatter_ids=None):
+            firsts = jnp.ones((rows,), jnp.int32)
+            lens = stub_prefill_lens(lens, slots, true_lens)
+            return k, v, tables, last, lens, *rest[:4], firsts
+
+        return run
+
+    engine._decode_jit = fake_decode
+    engine._prefill_jit = fake_prefill_jit
+
+
+def _p(values: "list[float]", q: float) -> float:
+    if not values:
+        return 0.0
+    return float(np.percentile(np.asarray(values), q))
+
+
+async def measure(max_pending: int) -> dict:
+    config = preset("debug", max_seq_len=256)
+    runtime = RuntimeConfig(
+        max_batch_size=BS, max_seq_len=256, prefill_chunk=32,
+        decode_steps_per_dispatch=STEPS, overlap_dispatch=True,
+        max_pending=max_pending,
+    )
+    engine = InferenceEngine(config, runtime)
+    sim = _DeviceSim(DEVICE_MS / 1000.0)
+    _stub_jits(engine, sim)
+    await engine.start()
+
+    queue_wait_ms: list[float] = []
+    shed_ms: list[float] = []
+    served = 0
+    shed = 0
+
+    async def one(i: int) -> None:
+        nonlocal served, shed
+        t0 = time.perf_counter()
+        stream = engine.generate(
+            [1 + (i % 50), 3, 5], max_new_tokens=NEW_TOKENS
+        )
+        try:
+            first = True
+            n = 0
+            async for _ in stream:
+                if first:
+                    queue_wait_ms.append(
+                        (time.perf_counter() - t0) * 1000.0
+                    )
+                    first = False
+                n += 1
+            assert n == NEW_TOKENS, f"stub served {n} tokens"
+            served += 1
+        except EngineOverloadedError:
+            shed_ms.append((time.perf_counter() - t0) * 1000.0)
+            shed += 1
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*[one(i) for i in range(OFFERED)])
+    wall = time.perf_counter() - t0
+    await engine.stop()
+
+    return {
+        "max_pending": max_pending,
+        "offered": OFFERED,
+        "served": served,
+        "shed": shed,
+        "queue_wait_p50_ms": round(_p(queue_wait_ms, 50), 1),
+        "queue_wait_p99_ms": round(_p(queue_wait_ms, 99), 1),
+        "shed_p99_ms": round(_p(shed_ms, 99), 3),
+        "engine_shed_counter": engine.stats.shed_requests,
+        "wall_s": round(wall, 3),
+    }
+
+
+async def run() -> dict:
+    bounded = await measure(max_pending=BS)
+    unbounded = await measure(max_pending=0)
+    assert unbounded["shed"] == 0 and unbounded["served"] == OFFERED
+    assert bounded["shed"] == bounded["engine_shed_counter"] > 0
+    tail_growth = unbounded["queue_wait_p99_ms"] / max(
+        bounded["queue_wait_p99_ms"], 1.0
+    )
+    ok = (
+        bounded["queue_wait_p99_ms"] <= BOUNDED_P99_BAR_MS
+        and bounded["shed_p99_ms"] < SHED_BAR_MS
+        and tail_growth >= 2.0
+    )
+    return {
+        "metric": "bounded_admission_ab[fixed-latency device stub, "
+                  "2x oversubscription]",
+        "value": round(tail_growth, 1),
+        "unit": "x p99 queue-wait growth without the bound",
+        "bounded_p99_bar_ms": round(BOUNDED_P99_BAR_MS, 1),
+        "shed_bar_ms": SHED_BAR_MS,
+        "ok": ok,
+        "bounded": bounded,
+        "unbounded": unbounded,
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None, help="also write JSON here")
+    ns = parser.parse_args()
+    result = asyncio.run(run())
+    line = json.dumps(result)
+    print(line)
+    if ns.out:
+        with open(ns.out, "w") as f:
+            f.write(line + "\n")
+    sys.exit(0 if result["ok"] else 1)

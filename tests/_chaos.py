@@ -1,0 +1,185 @@
+"""Deterministic chaos harness (ISSUE 5).
+
+Small, composable fault-injection pieces the chaos scenarios in
+``tests/test_chaos.py`` (and the shed/expire parity matrix in
+``tests/test_overlap_dispatch.py``) script against:
+
+- :class:`VirtualClock` / :func:`virtual_clock` — drives EVERY deadline
+  comparison in the package (client mint, hop expiry, engine
+  admission/reap) through the single ``calfkit_tpu.cancellation.
+  wall_clock`` seam.  Scenarios advance time explicitly; nothing sleeps
+  to make a deadline pass.
+- :class:`ChaosScript` — the engine's ``_chaos`` seam: fires a scripted
+  exception at the Nth visit of a named point ("tick" per scheduler
+  pass, "dispatch" per decode tick), so a mid-stream engine fault lands
+  on an exact, reproducible dispatch.
+- :class:`BrokerChaos` — the in-memory mesh's publish hook
+  (``InMemoryMesh.chaos``): drops the Nth record matching a
+  topic/kind predicate ("broker loses the return"), counts everything
+  it sees, and can run scripted side effects at publish time (e.g.
+  advance the virtual clock between the client's mint and the node's
+  delivery — the expired-on-arrival scenario).
+- :func:`settle` — await a condition within a BOUNDED number of
+  event-loop ticks; the harness's only waiting primitive.
+- :func:`assert_engine_drained` — the no-leak oracle: no active slots,
+  no in-flight dispatch, every slot on the free list, every page back
+  in the pool.
+
+Everything is plain deterministic state — no randomness, no wall-clock
+dependence beyond the event loop needing to actually run.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from typing import Any, Callable, Iterator
+
+from calfkit_tpu import cancellation
+from calfkit_tpu import protocol
+
+
+class VirtualClock:
+    """A controllable stand-in for ``cancellation.wall_clock``."""
+
+    def __init__(self, start: float = 1_700_000_000.0):
+        self.now = float(start)
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> float:
+        self.now += seconds
+        return self.now
+
+
+@contextlib.contextmanager
+def virtual_clock(start: float = 1_700_000_000.0) -> "Iterator[VirtualClock]":
+    """Install a :class:`VirtualClock` as THE package deadline clock for
+    the duration of the block (every caller reads it through the module
+    attribute, so one swap moves all layers in lockstep)."""
+    clock = VirtualClock(start)
+    previous = cancellation.wall_clock
+    cancellation.wall_clock = clock
+    try:
+        yield clock
+    finally:
+        cancellation.wall_clock = previous
+
+
+class ChaosScript:
+    """Scripted failure points for the engine's ``_chaos`` seam.
+
+    >>> engine._chaos = ChaosScript().fail_at("dispatch", 3, RuntimeError("x"))
+
+    raises on the 3rd decode tick exactly; every other visit is a no-op.
+    ``calls`` keeps per-point visit counts for assertions.
+    """
+
+    def __init__(self) -> None:
+        self.calls: dict[str, int] = {}
+        self._plan: dict[tuple[str, int], BaseException] = {}
+
+    def fail_at(
+        self, point: str, nth: int, exc: BaseException
+    ) -> "ChaosScript":
+        self._plan[(point, nth)] = exc
+        return self
+
+    def __call__(self, point: str) -> None:
+        count = self.calls.get(point, 0) + 1
+        self.calls[point] = count
+        exc = self._plan.pop((point, count), None)
+        if exc is not None:
+            raise exc
+
+
+class BrokerChaos:
+    """Scripted broker misbehavior for ``InMemoryMesh.chaos``.
+
+    Rules match on message kind (the ``x-mesh-kind`` header) and/or a
+    topic substring; each drops up to ``count`` matching records.  All
+    publishes are recorded in ``seen`` as ``(topic, kind)`` so scenarios
+    can assert what crossed the broker (e.g. "a cancel record WAS
+    published after the timeout").  ``on_publish`` hooks run for every
+    record — the deterministic place to advance a virtual clock between
+    a client's deadline mint and the node's delivery.
+    """
+
+    def __init__(self) -> None:
+        self.seen: list[tuple[str, str]] = []
+        self.dropped: list[tuple[str, str]] = []
+        self._rules: list[dict[str, Any]] = []
+        self.on_publish: "Callable[[str, dict[str, str]], None] | None" = None
+
+    def drop(
+        self,
+        *,
+        kind: "str | None" = None,
+        topic_contains: "str | None" = None,
+        count: int = 1,
+    ) -> "BrokerChaos":
+        self._rules.append(
+            {"kind": kind, "topic": topic_contains, "count": count}
+        )
+        return self
+
+    def kinds_seen(self, kind: str) -> int:
+        return sum(1 for _, k in self.seen if k == kind)
+
+    def __call__(self, topic: str, headers: dict[str, str]) -> "str | None":
+        kind = headers.get(protocol.HDR_KIND, "")
+        self.seen.append((topic, kind))
+        if self.on_publish is not None:
+            self.on_publish(topic, headers)
+        for rule in self._rules:
+            if rule["count"] <= 0:
+                continue
+            if rule["kind"] is not None and kind != rule["kind"]:
+                continue
+            if rule["topic"] is not None and rule["topic"] not in topic:
+                continue
+            rule["count"] -= 1
+            self.dropped.append((topic, kind))
+            return "drop"
+        return None
+
+
+async def settle(
+    condition: Callable[[], bool],
+    *,
+    ticks: int = 400,
+    interval: float = 0.01,
+    message: str = "",
+) -> int:
+    """Await ``condition`` within a bounded number of event-loop ticks;
+    returns the tick count it took.  The ONLY waiting primitive chaos
+    scenarios use — an unmet condition is a bounded, attributable
+    failure, never a hang."""
+    for tick in range(ticks):
+        if condition():
+            return tick
+        await asyncio.sleep(interval)
+    raise AssertionError(
+        message or f"condition not met within {ticks} bounded ticks"
+    )
+
+
+def assert_engine_drained(engine: Any, total_free_pages: "int | None" = None) -> None:
+    """The no-leak oracle: every slot free, no in-flight dispatch, no
+    queued entries, and (paged) every page back in the pool."""
+    assert not engine._active, f"leaked active slots: {dict(engine._active)}"
+    assert engine._pend is None, "a dispatch is still marked in flight"
+    assert engine._inflight is None, "a chunked admission wave leaked"
+    assert not engine._admitting, "an admission prefill is still in flight"
+    assert not engine._pending and not engine._carry, "queued entries leaked"
+    assert not engine._long_pending and engine._long is None
+    assert len(engine._free) == engine.runtime.max_batch_size, (
+        f"free list has {len(engine._free)} of "
+        f"{engine.runtime.max_batch_size} slots"
+    )
+    if total_free_pages is not None and engine._page_alloc is not None:
+        assert engine._page_alloc.free_pages == total_free_pages, (
+            f"leaked pages: {engine._page_alloc.free_pages} free of "
+            f"{total_free_pages}"
+        )

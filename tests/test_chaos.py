@@ -1,0 +1,703 @@
+"""Chaos scenarios (ISSUE 5): deterministic, scripted failure drills.
+
+Each scenario injects an exact failure through the harness seams in
+``tests/_chaos.py`` (engine ``_chaos`` hook, ``InMemoryMesh.chaos``
+publish hook, the virtual deadline clock) and asserts the THREE
+robustness invariants end to end:
+
+1. failures surface as TYPED faults/exceptions (never silent hangs);
+2. engine resources — slots, pages, shared-prefix refs — free within a
+   BOUNDED number of ticks of the failure;
+3. the flight recorder's timeline stays parseable and records the
+   decision sequence (CANCEL/EXPIRE/SHED → frees, FAULT at a crash).
+
+Catalog: caller-timeout storm (100 scripted runs), 2x admission
+oversubscription, mid-stream engine fault, broker drop during return,
+expired-on-arrival at a hop, engine deadline reap (queued AND active),
+worker drain + bounded retry, and the max_out_blocks delivery stall.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+jax = pytest.importorskip("jax")
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp  # noqa: E402
+
+from calfkit_tpu import cancellation, protocol  # noqa: E402
+from calfkit_tpu.client import Client  # noqa: E402
+from calfkit_tpu.client.caller import RetryPolicy  # noqa: E402
+from calfkit_tpu.engine import TestModelClient  # noqa: E402
+from calfkit_tpu.exceptions import (  # noqa: E402
+    ClientTimeoutError,
+    DeadlineExceededError,
+    EngineOverloadedError,
+    NodeFaultError,
+    exception_for,
+)
+from calfkit_tpu.inference import model as M  # noqa: E402
+from calfkit_tpu.inference.client import JaxLocalModelClient  # noqa: E402
+from calfkit_tpu.inference.config import RuntimeConfig, preset  # noqa: E402
+from calfkit_tpu.inference.engine import InferenceEngine  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+from calfkit_tpu.models.error_report import FaultTypes  # noqa: E402
+from calfkit_tpu.nodes import Agent  # noqa: E402
+from calfkit_tpu.observability import flightrec  # noqa: E402
+from calfkit_tpu.worker import Worker  # noqa: E402
+
+from tests._chaos import (  # noqa: E402
+    BrokerChaos,
+    ChaosScript,
+    assert_engine_drained,
+    settle,
+    virtual_clock,
+)
+
+CFG = preset("debug")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return M.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def _rt(**over):
+    kw = dict(
+        max_batch_size=4, max_seq_len=128, prefill_chunk=16,
+        decode_steps_per_dispatch=4, page_size=16,
+    )
+    kw.update(over)
+    return RuntimeConfig(**kw)
+
+
+async def _collect(engine, prompt, n, **kw):
+    """Consume a generate() stream to completion (or typed failure)."""
+    return [t async for t in engine.generate(prompt, max_new_tokens=n, **kw)]
+
+
+def _journal_events(engine):
+    return flightrec.parse_dump(engine._journal.dump_lines())
+
+
+def _drained(engine, total_free_pages=None):
+    """Settle predicate mirroring assert_engine_drained: the decode thread
+    nulls ``_pend`` BEFORE _free_deferred returns the slot/pages, so the
+    free list (and page pool) must be part of the condition — settling on
+    the queues alone observes a state that is consistent one tick later."""
+    return (
+        not engine._active
+        and engine._pend is None
+        and engine._inflight is None
+        and not engine._admitting
+        and not engine._pending
+        and not engine._carry
+        and len(engine._free) == engine.runtime.max_batch_size
+        and (
+            total_free_pages is None
+            or engine._page_alloc is None
+            or engine._page_alloc.free_pages == total_free_pages
+        )
+    )
+
+
+class TestCallerTimeoutStorm:
+    """The acceptance scenario: a dead caller's work actually stops."""
+
+    async def test_storm_100_runs_zero_leaked_slots(self, params):
+        """100 scripted runs: one active + one queued request per run,
+        both cancelled through the mesh fan-out entry point
+        (``cancellation.propagate_cancel`` — what a ``cancel`` record
+        reaching ANY node in the process invokes).  After every run the
+        engine must be byte-for-byte drained: all slots free, all pages
+        back, nothing queued.  Every 20th run the flight-recorder
+        timeline is checked to end CANCEL → … → SLOT_FREE."""
+        runtime = _rt(
+            max_batch_size=1, kv_layout="paged", overlap_dispatch=True,
+            flightrec_events=1 << 15,
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        total_free = engine._page_alloc.free_pages
+        await engine.start()
+        try:
+            for run in range(100):
+                corr_a = f"storm-{run}-active"
+                corr_b = f"storm-{run}-queued"
+                task_a = asyncio.create_task(
+                    _collect(engine, [1, 2, 3 + run % 5], 64, corr=corr_a)
+                )
+                await settle(
+                    lambda: engine._active,
+                    message=f"run {run}: request never admitted",
+                )
+                task_b = asyncio.create_task(
+                    _collect(engine, [7, 8], 64, corr=corr_b)
+                )
+                await settle(
+                    lambda: len(engine._pending) + len(engine._carry) == 1,
+                    message=f"run {run}: second request never queued",
+                )
+                # the caller timed out: the mesh cancel fans out to every
+                # registered engine.  Both propagations run in ONE loop
+                # step — the queued entry cannot slip into admission
+                # between them.
+                flagged = cancellation.propagate_cancel(corr_a)
+                flagged += cancellation.propagate_cancel(corr_b)
+                assert flagged == 2, f"run {run}: fan-out flagged {flagged}"
+                ticks = await settle(
+                    lambda: _drained(engine, total_free),
+                    message=f"run {run}: engine not drained after cancel",
+                )
+                assert ticks < 400
+                assert_engine_drained(engine, total_free)
+                # plain consumer-cancel ends the stream without error
+                await task_a
+                await task_b
+                if run % 20 == 0:
+                    events = _journal_events(engine)
+                    tl = flightrec.timeline_events(events, corr_a)
+                    names = [e["event"] for e in tl]
+                    assert "CANCEL" in names, names
+                    assert "SLOT_FREE" in names, names
+                    assert names.index("CANCEL") < (
+                        len(names) - 1 - names[::-1].index("SLOT_FREE")
+                    ), f"CANCEL did not precede the final SLOT_FREE: {names}"
+                    # the queued request never held a slot: its timeline
+                    # is submit → cancel, nothing leaked to free
+                    tl_b = flightrec.timeline_events(events, corr_b)
+                    b_names = [e["event"] for e in tl_b]
+                    assert "CANCEL" in b_names, b_names
+            assert engine.stats.cancelled_requests == 200
+            assert engine.stats.cancel_propagated == 200
+            # the engine still serves after the storm
+            assert len(await _collect(engine, [9], 8)) == 8
+        finally:
+            await engine.stop()
+
+    async def test_client_timeout_cancels_engine_end_to_end(self, params):
+        """client → mesh → worker node → engine: after a REAL
+        ``ClientTimeoutError``, the cancel record crosses the mesh and
+        the engine frees the request's slot and pages within bounded
+        ticks.  The virtual clock is FROZEN so the engine-side deadline
+        reaper cannot race the cancel — propagation is the only path
+        that can reclaim the request."""
+        runtime = _rt(
+            max_batch_size=2, decode_steps_per_dispatch=1,
+            kv_layout="paged", overlap_dispatch=True,
+            flightrec_events=1 << 14,
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        total_free = engine._page_alloc.free_pages
+        # throttle decode (runs OFF the event loop, in to_thread) so the
+        # generation deterministically outlives the client timeout on any
+        # CPU: >= 10ms per emitted token vs a 0.3s budget for 100 tokens
+        throttle = ChaosScript()
+
+        def pace(point):
+            throttle(point)
+            if point == "dispatch":
+                time.sleep(0.01)
+
+        engine._chaos = pace
+        model = JaxLocalModelClient(
+            config=CFG, runtime=runtime, engine=engine, max_new_tokens=100
+        )
+        with virtual_clock():
+            mesh = InMemoryMesh()
+            agent = Agent("slow", model=model)
+            async with Worker([agent], mesh=mesh, owns_transport=True):
+                client = Client.connect(mesh)
+                handle = await client.agent("slow").start(
+                    "take your time", timeout=0.3
+                )
+                with pytest.raises(ClientTimeoutError):
+                    await handle.result()
+                # the timeout published the cancel; it must reach THIS
+                # engine and free everything within bounded ticks
+                await settle(
+                    lambda: engine.stats.cancel_propagated >= 1,
+                    message="mesh cancel never reached the engine",
+                )
+                await settle(
+                    lambda: _drained(engine, total_free),
+                    message="engine did not drain after the mesh cancel",
+                )
+                assert_engine_drained(engine, total_free)
+                assert engine.stats.expired_requests == 0  # frozen clock
+                events = _journal_events(engine)
+                tl = flightrec.timeline_events(
+                    events, handle.correlation_id
+                )
+                names = [e["event"] for e in tl]
+                assert "CANCEL" in names, names
+                await client.close()
+
+
+class TestOversubscription:
+    async def test_2x_oversubscription_sheds_typed(self, params):
+        """2x the engine's admission capacity arrives at once: the
+        excess is refused with a typed, attributed
+        ``EngineOverloadedError`` at submit (no device work), the
+        admitted requests complete in full, and the journal carries one
+        SHED per refusal."""
+        runtime = _rt(
+            max_batch_size=2, max_pending=2, overlap_dispatch=True,
+            flightrec_events=1 << 12,
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        await engine.start()
+        try:
+            results = await asyncio.gather(
+                *[
+                    _collect(engine, [1 + i], 8, corr=f"over-{i}")
+                    for i in range(8)
+                ],
+                return_exceptions=True,
+            )
+            shed = [r for r in results if isinstance(r, EngineOverloadedError)]
+            served = [r for r in results if isinstance(r, list)]
+            assert len(shed) + len(served) == 8
+            assert shed, "2x oversubscription produced no sheds"
+            assert served, "oversubscription shed everything"
+            for exc in shed:
+                assert exc.lane == "short"
+                assert exc.limit == 2
+                assert exc.pending >= 2
+            for stream in served:
+                assert len(stream) == 8, "an admitted request was starved"
+            assert engine.stats.shed_requests == len(shed)
+            sheds = [
+                e for e in _journal_events(engine) if e["event"] == "SHED"
+            ]
+            assert len(sheds) == len(shed)
+            # a shed is O(1) bookkeeping: the engine serves on
+            assert len(await _collect(engine, [9], 8)) == 8
+        finally:
+            await engine.stop()
+
+    async def test_shed_keeps_typed_code_across_the_mesh(self, params):
+        """An engine shed crossing the agent's model-call wrap
+        (``engine/turn.py``) must keep its ``mesh.overloaded`` code —
+        not flatten into ``mesh.model_error`` — or caller-side retry
+        can never classify it (regression: the wrap predates the
+        authoritative error-type table)."""
+        runtime = _rt(
+            max_batch_size=1, max_pending=1, decode_steps_per_dispatch=1
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        model = JaxLocalModelClient(
+            config=CFG, runtime=runtime, engine=engine, max_new_tokens=32
+        )
+        mesh = InMemoryMesh()
+        async with Worker(
+            [Agent("svc", model=model)], mesh=mesh, owns_transport=True
+        ):
+            client = Client.connect(mesh)
+            results = await asyncio.gather(
+                *[
+                    client.agent("svc").execute(f"p{i}", timeout=120)
+                    for i in range(6)
+                ],
+                return_exceptions=True,
+            )
+            served = [r for r in results if not isinstance(r, BaseException)]
+            faults = [r for r in results if isinstance(r, BaseException)]
+            assert served, "oversubscription shed everything"
+            assert faults, "2x oversubscription never shed over the mesh"
+            for exc in faults:
+                assert isinstance(exc, NodeFaultError), repr(exc)
+                assert exc.report.error_type == FaultTypes.OVERLOADED, (
+                    exc.report.error_type
+                )
+                assert RetryPolicy.retriable(exc)
+            assert engine.stats.shed_requests == len(faults)
+            await client.close()
+        await engine.stop()
+
+
+class TestMidStreamFault:
+    async def test_injected_dispatch_fault_dumps_and_terminates(
+        self, params, tmp_path, monkeypatch
+    ):
+        """A fault on the 3rd decode dispatch: consumers' streams
+        terminate (no hang), the scheduler stops, and the fault dump is
+        parseable JSONL whose final event is FAULT."""
+        monkeypatch.setenv("CALFKIT_FLIGHTREC_DIR", str(tmp_path))
+        runtime = _rt(overlap_dispatch=True)
+        engine = InferenceEngine(CFG, runtime, params=params)
+        engine._chaos = ChaosScript().fail_at(
+            "dispatch", 3, RuntimeError("injected mid-stream chaos fault")
+        )
+        await engine.start()
+        got = await _collect(engine, [1, 2, 3], 64, corr="chaos-fault")
+        assert len(got) < 64, "the injected fault never fired"
+        await settle(lambda: not engine._running)
+        dumps = sorted(tmp_path.glob("*.jsonl"))
+        assert dumps, "no fault dump was written"
+        events = flightrec.parse_dump(
+            dumps[-1].read_text().splitlines()
+        )
+        assert events, "fault dump is not parseable"
+        assert events[-1]["event"] == "FAULT"
+        assert "chaos fault" in events[-1].get("note", "")
+        assert any(e["event"] == "DISPATCH_LAUNCH" for e in events)
+        await engine.stop()  # teardown after a crash is clean
+
+
+class TestBrokerDropDuringReturn:
+    async def test_dropped_return_times_out_and_publishes_cancel(self):
+        """The broker loses the agent's return record: the caller gets a
+        typed ``ClientTimeoutError`` (bounded wait, no hang) and its
+        timeout publishes a ``cancel`` record that reaches in-process
+        cancellation targets through the node."""
+        mesh = InMemoryMesh()
+        chaos = BrokerChaos().drop(kind="return")
+        mesh.chaos = chaos
+        seen_cancels: list[str] = []
+
+        class _Target:
+            def cancel_correlation(self, corr: str) -> int:
+                seen_cancels.append(corr)
+                return 0
+
+        target = _Target()
+        cancellation.register_cancel_target(target)
+        agent = Agent(
+            "echo",
+            model=TestModelClient(custom_output_text="ok", call_tools="none"),
+        )
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            handle = await client.agent("echo").start("hi", timeout=0.4)
+            with pytest.raises(ClientTimeoutError):
+                await handle.result()
+            assert [kind for _, kind in chaos.dropped] == ["return"]
+            # the publish is fire-and-forget off the timeout rail: settle
+            # on it rather than asserting synchronously after the raise
+            await settle(
+                lambda: chaos.kinds_seen("cancel") >= 1,
+                message="the timeout did not publish a mesh cancel",
+            )
+            await settle(
+                lambda: handle.correlation_id in seen_cancels,
+                message="the cancel record never fanned out at the node",
+            )
+            await client.close()
+
+
+class TestConsumerCancelShortCircuit:
+    async def test_cancel_record_never_reaches_consumer_fn(self):
+        """A ``cancel``-kind record on a consumer's topic is a control
+        record: it must fan out to cancellation targets — never run the
+        user's fn, which the dispatcher's EXPRESS path would otherwise
+        execute inline on the intake pull task."""
+        from calfkit_tpu.mesh.transport import Record
+        from calfkit_tpu.nodes import ConsumerNode
+
+        seen_cancels: list[str] = []
+
+        class _Target:
+            def cancel_correlation(self, corr: str) -> int:
+                seen_cancels.append(corr)
+                return 1
+
+        target = _Target()
+        cancellation.register_cancel_target(target)
+        calls: list = []
+        node = ConsumerNode(
+            lambda ctx: calls.append(ctx), name="watch", topics=["t.obs"]
+        )
+        await node._handle_delivery(
+            Record(
+                topic="t.obs",
+                value=b"",
+                key=b"task-1",
+                headers={
+                    protocol.HDR_KIND: "cancel",
+                    protocol.HDR_CORRELATION: "corr-express",
+                    protocol.HDR_TASK: "task-1",
+                },
+            )
+        )
+        assert calls == [], "consumer fn ran for a control record"
+        assert seen_cancels == ["corr-express"]
+
+
+class TestCancelTombstone:
+    async def test_cancelled_before_delivery_faults_fast(self):
+        """A cancel that lands while the call record is still in flight
+        (queued behind a busy lane, on the wire) leaves a tombstone; the
+        admission gate hits it and faults typed ``mesh.cancelled``
+        instead of executing a full run for a caller that left."""
+        mesh = InMemoryMesh()
+        chaos = BrokerChaos()
+        mesh.chaos = chaos
+        ran: list[str] = []
+
+        def _tap(topic: str, headers: dict) -> None:
+            # the cancel "overtakes" the call deterministically: the
+            # tombstone is recorded the instant the call crosses the
+            # broker, before its delivery executes
+            if headers.get(protocol.HDR_KIND) == "call" and "svc" in topic:
+                cancellation.propagate_cancel(
+                    headers.get(protocol.HDR_CORRELATION, "")
+                )
+
+        chaos.on_publish = _tap
+        agent = Agent(
+            "svc",
+            model=TestModelClient(custom_output_text="ok", call_tools="none"),
+            before_node=[lambda ctx: ran.append(ctx.correlation_id) and None],
+        )
+        async with Worker([agent], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            with pytest.raises(NodeFaultError) as ei:
+                await client.agent("svc").execute("x", timeout=5)
+            assert ei.value.report.error_type == FaultTypes.CANCELLED
+            # deliberate abandonment is NOT retriable
+            assert not RetryPolicy.retriable(ei.value)
+            assert ran == [], "agent body ran for a cancelled run"
+            await client.close()
+
+
+class TestCancelForwarding:
+    async def test_cancel_follows_the_run_downstream(self):
+        """The cancel record is re-published along the run's path: the
+        agent's kernel remembers which topics it sent the run's calls to
+        and forwards the cancel there — an engine in ANOTHER process is
+        only reachable through its topic, never through the in-process
+        registry.  Scripted: cancel lands while the tool executes; the
+        tool's input topic must see a cancel record exactly once."""
+        from calfkit_tpu.nodes import agent_tool
+
+        mesh = InMemoryMesh()
+        chaos = BrokerChaos()
+        mesh.chaos = chaos
+        started = asyncio.Event()
+        release = asyncio.Event()
+
+        @agent_tool
+        async def probe(q: str) -> str:
+            """Parks until released.
+
+            Args:
+                q: ignored.
+            """
+            started.set()
+            await release.wait()
+            return "done"
+
+        agent = Agent(
+            "svc", model=TestModelClient(), tools=[probe],
+        )
+        tool_topic = protocol.tool_input_topic("probe")
+        async with Worker([agent, probe], mesh=mesh, owns_transport=True):
+            client = Client.connect(mesh)
+            handle = await client.agent("svc").start("go")
+            await asyncio.wait_for(started.wait(), 10)
+            assert chaos.seen.count((tool_topic, "cancel")) == 0
+            await handle.cancel()
+            await settle(
+                lambda: (tool_topic, "cancel") in chaos.seen,
+                message="cancel was never forwarded to the tool's topic",
+            )
+            # idempotent: a duplicate cancel record forwards nothing
+            # (the downstream entry was popped by the first)
+            agent_topic = next(
+                t for t, k in chaos.seen if k == "call" and "svc" in t
+            )
+            await mesh.publish(
+                agent_topic,
+                b"",
+                key=b"dup",
+                headers={
+                    protocol.HDR_KIND: "cancel",
+                    protocol.HDR_CORRELATION: handle.correlation_id,
+                },
+            )
+            release.set()
+            # the agent's final return proves its topic's pull advanced
+            # past the duplicate cancel (same pull task, in order)
+            await settle(
+                lambda: chaos.kinds_seen("return") >= 2,
+                message="run never settled after release",
+            )
+            assert chaos.seen.count((tool_topic, "cancel")) == 1
+            await client.close()
+
+
+class TestDeadlineExpiry:
+    async def test_expired_on_arrival_faults_typed(self):
+        """The clock jumps past the deadline while the call is on the
+        wire (scripted at the broker): the receiving hop records a typed
+        ``mesh.deadline_exceeded`` fault instead of executing."""
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            chaos = BrokerChaos()
+
+            def jump(topic, headers):
+                if headers.get(protocol.HDR_KIND) == "call":
+                    clock.advance(60)
+
+            chaos.on_publish = jump
+            mesh.chaos = chaos
+            agent = Agent(
+                "late",
+                model=TestModelClient(
+                    custom_output_text="never", call_tools="none"
+                ),
+            )
+            async with Worker([agent], mesh=mesh, owns_transport=True):
+                client = Client.connect(mesh)
+                with pytest.raises(NodeFaultError) as ei:
+                    await client.agent("late").execute("hi", timeout=30)
+                assert (
+                    ei.value.report.error_type
+                    == FaultTypes.DEADLINE_EXCEEDED
+                )
+                # the wire code maps back to the canonical local type
+                assert (
+                    exception_for(FaultTypes.DEADLINE_EXCEEDED)
+                    is DeadlineExceededError
+                )
+                await client.close()
+
+    async def test_engine_reaps_expired_queued_and_active(self, params):
+        """One active and one queued request, both deadlined: advancing
+        the virtual clock expires BOTH through the cancellation path —
+        typed ``DeadlineExceededError`` at each consumer, all resources
+        freed, EXPIRE events journaled."""
+        with virtual_clock() as clock:
+            runtime = _rt(
+                max_batch_size=1, kv_layout="paged", overlap_dispatch=True,
+                flightrec_events=1 << 12,
+            )
+            engine = InferenceEngine(CFG, runtime, params=params)
+            total_free = engine._page_alloc.free_pages
+            await engine.start()
+            try:
+                task_a = asyncio.create_task(
+                    _collect(
+                        engine, [1, 2, 3], 64, corr="exp-active",
+                        deadline=clock.now + 5,
+                    )
+                )
+                await settle(lambda: engine._active)
+                task_b = asyncio.create_task(
+                    _collect(
+                        engine, [4, 5], 64, corr="exp-queued",
+                        deadline=clock.now + 5,
+                    )
+                )
+                await settle(
+                    lambda: len(engine._pending) + len(engine._carry) == 1
+                )
+                clock.advance(10)
+                with pytest.raises(DeadlineExceededError):
+                    await task_a
+                with pytest.raises(DeadlineExceededError):
+                    await task_b
+                await settle(lambda: _drained(engine, total_free))
+                assert_engine_drained(engine, total_free)
+                assert engine.stats.expired_requests == 2
+                expires = [
+                    e for e in _journal_events(engine)
+                    if e["event"] == "EXPIRE"
+                ]
+                assert len(expires) == 2
+                # an expiry-driven reap is not a consumer cancel
+                assert engine.stats.cancelled_requests == 0
+                # un-deadlined work still serves
+                assert len(await _collect(engine, [9], 8)) == 8
+            finally:
+                await engine.stop()
+
+    async def test_expired_at_engine_admission(self, params):
+        """An already-expired submit is refused before ANY device work."""
+        with virtual_clock() as clock:
+            engine = InferenceEngine(CFG, _rt(), params=params)
+            await engine.start()
+            try:
+                with pytest.raises(DeadlineExceededError, match="expired"):
+                    await _collect(
+                        engine, [1, 2], 8, deadline=clock.now - 1
+                    )
+                assert engine.stats.expired_requests == 1
+            finally:
+                await engine.stop()
+
+
+class TestWorkerDrain:
+    async def test_drain_refuses_new_calls_typed_and_retriable(self):
+        """Drain mode: readiness flips false, NEW calls fault with the
+        typed, retriable ``mesh.overloaded`` code, and the caller-side
+        bounded retry actually re-publishes (and stays bounded)."""
+        mesh = InMemoryMesh()
+        chaos = BrokerChaos()
+        mesh.chaos = chaos
+        agent = Agent(
+            "svc",
+            model=TestModelClient(custom_output_text="ok", call_tools="none"),
+        )
+        worker = Worker([agent], mesh=mesh, owns_transport=True)
+        async with worker:
+            client = Client.connect(mesh)
+            result = await client.agent("svc").execute("a", timeout=5)
+            assert result.output == "ok"
+            assert worker.ready()[0] is True
+
+            worker.drain()
+            assert worker.ready()[0] is False
+            assert worker.draining
+
+            with pytest.raises(NodeFaultError) as ei:
+                await client.agent("svc").execute("b", timeout=5)
+            assert ei.value.report.error_type == FaultTypes.OVERLOADED
+            assert RetryPolicy.retriable(ei.value)
+
+            # bounded retry with backoff: exactly `attempts` publishes,
+            # then the typed fault surfaces (still draining)
+            calls_before = chaos.kinds_seen("call")
+            with pytest.raises(NodeFaultError):
+                await client.agent("svc").execute(
+                    "c", timeout=5,
+                    retry=RetryPolicy(attempts=3, base_delay=0.01),
+                )
+            assert chaos.kinds_seen("call") - calls_before == 3
+            await client.close()
+
+
+class TestDeliveryStall:
+    async def test_stalled_consumer_is_stall_cancelled(self, params):
+        """A consumer that stops draining accumulates at most
+        ``max_out_blocks`` undrained blocks before the scheduler
+        stall-cancels the request; resuming surfaces a typed
+        ``EngineOverloadedError`` and nothing leaked."""
+        runtime = _rt(
+            max_out_blocks=2, kv_layout="paged", overlap_dispatch=True
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        total_free = engine._page_alloc.free_pages
+        await engine.start()
+        try:
+            agen = engine.generate(
+                [1, 2, 3], max_new_tokens=100, corr="stall"
+            )
+            first = await agen.__anext__()
+            assert isinstance(first, int)
+            # the consumer stalls; the engine keeps decoding until the
+            # delivery bound trips the stall-cancel
+            await settle(
+                lambda: engine.stats.delivery_stalled >= 1,
+                message="stall was never detected",
+            )
+            with pytest.raises(EngineOverloadedError, match="max_out_blocks"):
+                async for _ in agen:
+                    pass
+            await settle(lambda: _drained(engine, total_free))
+            assert_engine_drained(engine, total_free)
+            # a healthy consumer is unaffected
+            assert len(await _collect(engine, [9], 8)) == 8
+        finally:
+            await engine.stop()

@@ -24,7 +24,10 @@ jax = pytest.importorskip("jax")
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-from calfkit_tpu.exceptions import InferenceError  # noqa: E402
+from calfkit_tpu.exceptions import (  # noqa: E402
+    EngineOverloadedError,
+    InferenceError,
+)
 from calfkit_tpu.inference import model as M  # noqa: E402
 from calfkit_tpu.inference.config import (  # noqa: E402
     RuntimeConfig,
@@ -422,3 +425,184 @@ class TestOverlapTelemetry:
         cum, delta = engine.stats.snapshot_and_delta()
         assert "overlap_wasted_tokens" in cum
         assert "overlap_wasted_tokens" in delta
+
+
+class TestQueuedCancellation:
+    """ISSUE 5 satellite: cancellation of STILL-QUEUED entries, and the
+    reap's ordering against a concurrent admission wave — the parity
+    matrix above covers active-slot cancels only."""
+
+    @pytest.mark.parametrize("overlap", [True, False])
+    async def test_cancel_queued_request_vs_concurrent_admission(
+        self, params, overlap
+    ):
+        from tests._chaos import assert_engine_drained, settle
+
+        runtime = _rt(
+            max_batch_size=2, kv_layout="paged", overlap_dispatch=overlap
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        total_free = engine._page_alloc.free_pages
+        await engine.start()
+        try:
+            # fill both slots with long-ish streams, then queue two more
+            active = [
+                asyncio.create_task(_gen(engine, [1 + i], 24))
+                for i in range(2)
+            ]
+            await settle(lambda: len(engine._active) == 2)
+            queued = [
+                asyncio.create_task(_gen(engine, [10 + i], 24))
+                for i in range(2)
+            ]
+            await settle(
+                lambda: len(engine._pending) + len(engine._carry) == 2
+            )
+            # abandon both queued consumers while the actives keep the
+            # engine mid-wave; the reap must drop the queued entries
+            # without disturbing admission of fresh work
+            for task in queued:
+                task.cancel()
+            fresh = asyncio.create_task(_gen(engine, [20], 8))
+            for task in queued:
+                with pytest.raises(asyncio.CancelledError):
+                    await task
+            # actives complete in full, the fresh submit admits and
+            # completes — cancelled queue entries never held resources
+            assert [len(s) for s in await asyncio.gather(*active)] == [24, 24]
+            assert len(await fresh) == 8
+            await settle(
+                lambda: not engine._active and engine._pend is None
+            )
+            assert_engine_drained(engine, total_free)
+            assert engine.stats.cancelled_requests == 2
+        finally:
+            await engine.stop()
+
+    @pytest.mark.parametrize("overlap", [True, False])
+    async def test_cancel_mid_chunked_admission_under_load(
+        self, params, overlap
+    ):
+        """Cancel ONE member of a chunked-admission wave while its
+        prefill chunks are still landing: the corpse is shed at
+        activation, the surviving member streams in full, and every
+        page the corpse reserved returns to the pool."""
+        from tests._chaos import assert_engine_drained, settle
+
+        runtime = _rt(
+            max_batch_size=2, kv_layout="paged", chunked_prefill=True,
+            prefill_chunk=16, overlap_dispatch=overlap,
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        total_free = engine._page_alloc.free_pages
+        await engine.start()
+        try:
+            # same bucket (64): both join one admission wave of 4 chunks
+            doomed = asyncio.create_task(
+                _gen(engine, list(range(1, 60)), 16)
+            )
+            survivor = asyncio.create_task(
+                _gen(engine, list(range(100, 158)), 16)
+            )
+            await settle(
+                lambda: engine._inflight is not None
+                and len(engine._inflight["wave"]) == 2,
+                message="chunked admission wave never formed",
+            )
+            doomed.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await doomed
+            assert len(await survivor) == 16
+            await settle(
+                lambda: not engine._active and engine._pend is None
+                and engine._inflight is None
+            )
+            assert_engine_drained(engine, total_free)
+            # the engine still admits chunked waves afterwards
+            assert len(await _gen(engine, list(range(50)), 8)) == 8
+        finally:
+            await engine.stop()
+
+
+class TestShedExpireParity:
+    """The shed and expire paths must behave identically under the
+    overlapped and lockstep schedulers: same typed errors, same
+    counters, byte-identical streams for the admitted survivors."""
+
+    async def _oversubscribe(self, params, overlap):
+        runtime = _rt(
+            max_batch_size=2, max_pending=2, overlap_dispatch=overlap
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        await engine.start()
+        try:
+            results = await asyncio.gather(
+                *[_gen(engine, [1 + i], 8) for i in range(8)],
+                return_exceptions=True,
+            )
+        finally:
+            await engine.stop()
+        served = {
+            i: r for i, r in enumerate(results) if isinstance(r, list)
+        }
+        shed = {
+            i for i, r in enumerate(results)
+            if isinstance(r, EngineOverloadedError)
+        }
+        return served, shed, engine.stats
+
+    async def test_shed_parity_overlap_vs_lockstep(self, params):
+        served_on, shed_on, stats_on = await self._oversubscribe(
+            params, True
+        )
+        served_off, shed_off, stats_off = await self._oversubscribe(
+            params, False
+        )
+        assert shed_on == shed_off, "shed sets diverged across schedulers"
+        assert shed_on, "oversubscription never shed"
+        assert served_on == served_off, (
+            "admitted survivors' streams diverged from the lockstep oracle"
+        )
+        assert stats_on.shed_requests == stats_off.shed_requests == len(
+            shed_on
+        )
+
+    @pytest.mark.parametrize("overlap", [True, False])
+    async def test_expire_parity_active_and_queued(self, params, overlap):
+        from calfkit_tpu.exceptions import DeadlineExceededError
+        from tests._chaos import assert_engine_drained, settle, virtual_clock
+
+        with virtual_clock() as clock:
+            runtime = _rt(
+                max_batch_size=1, kv_layout="paged",
+                overlap_dispatch=overlap,
+            )
+            engine = InferenceEngine(CFG, runtime, params=params)
+            total_free = engine._page_alloc.free_pages
+            await engine.start()
+            try:
+                active = asyncio.create_task(
+                    _gen(engine, [1, 2], 64, deadline=clock.now + 5)
+                )
+                await settle(lambda: engine._active)
+                queued = asyncio.create_task(
+                    _gen(engine, [3, 4], 64, deadline=clock.now + 5)
+                )
+                await settle(
+                    lambda: len(engine._pending) + len(engine._carry) == 1
+                )
+                clock.advance(10)
+                with pytest.raises(DeadlineExceededError):
+                    await active
+                with pytest.raises(DeadlineExceededError):
+                    await queued
+                await settle(
+                    lambda: not engine._active and engine._pend is None
+                )
+                assert_engine_drained(engine, total_free)
+                assert engine.stats.expired_requests == 2
+                assert engine.stats.cancelled_requests == 0
+                # un-deadlined work still serves under the same scheduler
+                assert len(await _gen(engine, [9], 8)) == 8
+            finally:
+                await engine.stop()
