@@ -106,22 +106,49 @@ _TPU_PEAKS = {
 }
 
 
-def _perf_model(model, cfg, wall_tps: float, occupancy: float) -> dict:
-    """Model-FLOPs and HBM-traffic per decoded token, and — when the chip's
-    published peaks are known — MFU and HBM-bandwidth utilization
+def _device_peaks() -> "tuple[float, float] | None":
+    """(bf16 TFLOP/s, HBM GB/s) for the live chip: the published table
+    keyed by device_kind, overridable by ``CALFKIT_DEVICE_PEAKS=
+    "<tflops>,<gb_s>"`` so unknown kinds (or a deliberately-normalized
+    CPU replay) still get MFU / bandwidth-utilization instead of null —
+    the ISSUE 6 satellite: ragged-wave wins must be reported against
+    roofline, not just against each other."""
+    import jax
+
+    override = os.environ.get("CALFKIT_DEVICE_PEAKS")
+    if override:
+        try:
+            tflops_s, gb_s_s = override.split(",")
+            return float(tflops_s), float(gb_s_s)
+        except ValueError:
+            pass  # malformed override: fall through to the table
+    kind = str(getattr(jax.devices()[0], "device_kind", "") or "").lower()
+    return next((v for k, v in _TPU_PEAKS.items() if k in kind), None)
+
+
+def _perf_model(
+    model, cfg, wall_tps: float, occupancy: float,
+    wave_stats: "dict | None" = None,
+) -> dict:
+    """Model-FLOPs and HBM-traffic per decoded token AND per ragged wave
+    (dispatch), and — when the chip's peaks are known (published table or
+    $CALFKIT_DEVICE_PEAKS) — MFU and HBM-bandwidth utilization
     (VERDICT r4 item 6: tok/s alone flatters small models; MFU is the
     honest cross-config metric).
 
     Decode FLOPs/token ≈ 2·params (every weight participates in one MAC)
     + 4·n_layers·d_model·ctx attention score/value FLOPs at mean context.
     Decode HBM bytes/token ≈ weight stream amortized over the effective
-    batch + the sequence's own KV read."""
+    batch + the sequence's own KV read.  ``wave_stats`` (tokens per
+    dispatch incl. absorbed prefill, dispatch rate) turns those into the
+    analytic per-WAVE numbers the ragged scheduler is judged by: one
+    fused dispatch reads the weights once for every token it carries, so
+    absorbed prefill tokens amortize the same stream a bifurcated
+    schedule paid a second dispatch for."""
     import jax
 
     kind = str(getattr(jax.devices()[0], "device_kind", "") or "").lower()
-    peaks = next(
-        (v for k, v in _TPU_PEAKS.items() if k in kind), None
-    )
+    peaks = _device_peaks()
     params = model.param_count
     ctx = cfg["prompt_len"] + cfg["new_tokens"] / 2.0
     attn_flops = 4.0 * model.n_layers * model.d_model * ctx
@@ -140,6 +167,25 @@ def _perf_model(model, cfg, wall_tps: float, occupancy: float) -> dict:
         "mfu": None,
         "hbm_bw_util": None,
     }
+    if wave_stats:
+        # per-ragged-wave roofline: tokens carried per dispatch (decode +
+        # absorbed prefill) × per-token FLOPs, against ONE weight stream
+        # per dispatch — the fused wave's arithmetic intensity
+        tokens_per_wave = wave_stats.get("tokens_per_dispatch", 0.0)
+        if tokens_per_wave:
+            wave_flops = tokens_per_wave * flops_per_token
+            wave_bytes = weight_bytes + tokens_per_wave * kv_bytes
+            out["per_wave"] = {
+                "tokens_per_dispatch": round(tokens_per_wave, 2),
+                "flops_per_wave_g": round(wave_flops / 1e9, 3),
+                "hbm_bytes_per_wave_m": round(wave_bytes / 1e6, 3),
+                "arith_intensity_flop_per_byte": round(
+                    wave_flops / max(wave_bytes, 1e-9), 2
+                ),
+                "prefill_absorbed_tokens": wave_stats.get(
+                    "prefill_absorbed_tokens", 0
+                ),
+            }
     if peaks is not None:
         tflops, gb_s = peaks
         out["mfu"] = round(wall_tps * flops_per_token / (tflops * 1e12), 4)
@@ -168,6 +214,10 @@ async def run() -> dict:
         quantization=cfg.get("quantization"),
         kv_layout=cfg.get("kv_layout", "dense"),
         num_kv_pages=cfg.get("num_kv_pages", 0),
+        # chunked admission is the ragged unified lane's substrate
+        # (ISSUE 6): the bench measures the default serving path —
+        # prefill chunks absorbed into decode dispatches
+        chunked_prefill=True,
     )
     params = None
     if cfg.get("random_quantized"):
@@ -220,6 +270,11 @@ async def run() -> dict:
     stats.occupancy_sum = 0.0
     stats.occupancy_hist = [0, 0, 0, 0]
     stats.short_dispatches = 0
+    # ragged-wave counters reset with the dispatch counters they are
+    # divided by — warmup absorption must not inflate the measured
+    # tokens_per_dispatch / per_wave roofline
+    stats.prefill_absorbed_tokens = 0
+    stats.unified_dispatches = 0
 
     async def one(i: int) -> int:
         n = 0
@@ -241,6 +296,12 @@ async def run() -> dict:
     mean_occupancy = stats.mean_occupancy
     occupancy_hist = list(stats.occupancy_hist)
     short_dispatches = stats.short_dispatches
+    wave_stats = {
+        "tokens_per_dispatch": stats.mean_tokens_per_dispatch,
+        "prefill_absorbed_tokens": stats.prefill_absorbed_tokens,
+        "unified_dispatches": stats.unified_dispatches,
+        "ragged_waves": engine._ragged,
+    }
 
     # ---- TTFT phase: p50 mesh-msg -> first streamed token through the FULL
     # agent path (client -> mesh -> agent -> engine -> token step -> client)
@@ -259,7 +320,8 @@ async def run() -> dict:
         "metric": (
             f"decode_tok_s_per_chip[{model.name} bs={cfg['bs']}"
             f"{' ' + cfg['quantization'] if cfg.get('quantization') else ''}"
-            f"{' paged-kv' if cfg.get('kv_layout') == 'paged' else ''} "
+            f"{' paged-kv' if cfg.get('kv_layout') == 'paged' else ''}"
+            f"{' ragged-waves' if wave_stats['ragged_waves'] else ''} "
             f"continuous-batching wall]"
         ),
         "value": round(wall_tps, 1),
@@ -279,6 +341,14 @@ async def run() -> dict:
             # dispatch counts per occupancy quartile [0-25%, .., 75-100%]
             "occupancy_hist": occupancy_hist,
             "short_dispatches": short_dispatches,
+            # ragged unified waves (ISSUE 6): whether the fused lane ran,
+            # and what each dispatch actually carried
+            "ragged_waves": wave_stats["ragged_waves"],
+            "prefill_absorbed_tokens": wave_stats["prefill_absorbed_tokens"],
+            "unified_dispatches": wave_stats["unified_dispatches"],
+            "tokens_per_dispatch": round(
+                wave_stats["tokens_per_dispatch"], 2
+            ),
             "p50_mesh_to_first_token_ms": ttft_p50_ms,
             "ttft_transport": ttft_transport,
             **({"ttft_error": ttft_error} if ttft_error else {}),
@@ -286,7 +356,7 @@ async def run() -> dict:
             "new_tokens_per_request": cfg["new_tokens"],
             "devices": n_dev,
             "platform": jax.devices()[0].platform,
-            **_perf_model(model, cfg, wall_tps, mean_occupancy),
+            **_perf_model(model, cfg, wall_tps, mean_occupancy, wave_stats),
         },
     }
 
@@ -563,7 +633,7 @@ def _run_sub(env_extra: dict, timeout_s: int, argv=None) -> tuple[int, str, str]
         )
 
 
-def _probe_accelerator(timeout_s: int = 120) -> tuple[bool, str]:
+def _probe_accelerator(timeout_s: int = 120) -> tuple[bool, str, str]:
     """Check the accelerator backend is alive, in a killable subprocess.
 
     A wedged axon/TPU grant makes ``jax.devices()`` HANG (not raise) in this
@@ -571,8 +641,15 @@ def _probe_accelerator(timeout_s: int = 120) -> tuple[bool, str]:
     retried — the wedge persists for hours and the retry only burns the
     driver's step budget; a fast failure gets one retry for transient
     unavailability.
+
+    Returns (ok, info, status): ``status`` is the structured probe
+    verdict the artifact carries when no fresh number exists (ISSUE 6
+    satellite — "no number" must be machine-distinguishable from "bad
+    number"): ``"wedged"`` = the runtime HUNG (a chip exists but its
+    grant is stuck), ``"absent"`` = no accelerator answered at all.
     """
     last = ""
+    status = "absent"
     for attempt in range(2):
         rc, out, err = _run_sub(
             {"CALFKIT_BENCH_INNER": "1"},
@@ -580,12 +657,15 @@ def _probe_accelerator(timeout_s: int = 120) -> tuple[bool, str]:
             argv=[sys.executable, "-c", _PROBE_SRC],
         )
         if rc == 0 and "PROBE_OK" in out and "PROBE_OK cpu" not in out:
-            return True, out.strip().splitlines()[-1]
+            return True, out.strip().splitlines()[-1], "ok"
         last = (out + "\n" + err)[-400:]
-        if rc == 124 or attempt == 1:
+        if rc == 124:
+            status = "wedged"
+            break
+        if attempt == 1:
             break
         time.sleep(10)
-    return False, last
+    return False, last, status
 
 
 _TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -688,18 +768,25 @@ def main() -> None:
     error = None
     explicit_cpu = os.environ.get("JAX_PLATFORMS") == "cpu"
     if explicit_cpu:
-        ok, info = False, "explicit JAX_PLATFORMS=cpu"
+        # a deliberately-chosen CPU run is a healthy artifact, not a
+        # degraded one — status stays "ok"
+        ok, info, probe_status = False, "explicit JAX_PLATFORMS=cpu", "ok"
     else:
-        ok, info = _probe_accelerator()
+        ok, info, probe_status = _probe_accelerator()
 
     if ok:
         rc, out, err = _run_sub({"CALFKIT_BENCH_INNER": "1"}, timeout_s=bench_timeout)
         result = _last_json_line(out)
         if rc == 0 and result is not None:
+            result["status"] = "ok"
             _save_tpu_cache(result)
             print(json.dumps(result))
             return
         error = f"accelerator bench failed rc={rc}: {(out + chr(10) + err)[-400:]}"
+        # the chip answered the probe but yielded no number (hang OR
+        # crash): the artifact must not claim "ok" — "wedged" = chip
+        # present but unusable this capture, vs "absent" = no chip
+        probe_status = "wedged"
     elif not explicit_cpu:
         error = f"accelerator unavailable: {info}"
 
@@ -716,6 +803,11 @@ def main() -> None:
             if stale:
                 label += " stale-code"
             cached["metric"] = cached["metric"].replace("]", label + "]", 1)
+            # structured provenance (ISSUE 6 satellite): "stale" = a
+            # number exists but may not describe the current code; else
+            # the probe's verdict ("wedged" hung grant / "absent" no
+            # chip) says WHY there is no fresh number
+            cached["status"] = "stale" if stale else probe_status
             cached["error"] = (
                 f"accelerator unavailable at capture; value is the last "
                 f"successful on-TPU run"
@@ -750,6 +842,7 @@ def main() -> None:
         error = (error or "") + (
             f" | cpu fallback failed rc={rc}: {(out + chr(10) + err)[-400:]}"
         )
+    result["status"] = probe_status
     if error:
         result["error"] = error.strip()
         result["metric"] = result["metric"].replace("]", " cpu-fallback]", 1)

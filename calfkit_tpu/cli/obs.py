@@ -82,7 +82,8 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
     """The live engine table: one row per engine-backed node."""
     rows = [
         (
-            "NODE", "MODEL", "TOK/S", "OCC", "ACTIVE", "SLOTS",
+            "NODE", "MODEL", "TOK/S", "OCC", "BATCH OCC", "TOK/DISP",
+            "ACTIVE", "SLOTS",
             "DECODED", "TTFT P50/P99 MS", "GAP P99 MS", "WASTE",
             "SHED", "EXPIRED", "CANCELS",
             "FREC APP/DROP",
@@ -125,12 +126,26 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
         window = r.window or {}
         tok_s = window.get("tokens_per_second", r.tokens_per_second)
         occupancy = window.get("mean_occupancy", r.mean_occupancy)
+        # BATCH OCC: lifetime mean batch occupancy — with ragged waves on
+        # it counts absorbed prefill rows as dispatch participants, so
+        # this is THE unified-wave fill metric (OCC stays the windowed
+        # rate); TOK/DISP is tokens processed (decode + absorbed prefill)
+        # per dispatch
+        batch_occ = (
+            f"{r.mean_occupancy:.2f}"
+            + ("*" if r.ragged_waves else "")
+        )
+        tok_disp = (
+            f"{r.tokens_per_dispatch:.1f}" if r.tokens_per_dispatch else "-"
+        )
         rows.append(
             (
                 r.node_id,
                 r.model_name,
                 f"{tok_s:.1f}",
                 f"{occupancy:.2f}",
+                batch_occ,
+                tok_disp,
                 str(r.active_requests),
                 f"{r.max_batch_size - r.free_slots}/{r.max_batch_size}"
                 if r.max_batch_size else "-",

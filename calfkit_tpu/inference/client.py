@@ -320,6 +320,15 @@ class JaxLocalModelClient(ModelClient):
                 "decode_dispatches": 0,
                 "overlap_dispatch": runtime.overlap_dispatch,
                 "overlap_wasted_tokens": 0,
+                # ragged unified waves: the EFFECTIVE setting (the flag
+                # engages only with chunked prefill + overlap dispatch)
+                "ragged_waves": bool(
+                    runtime.ragged_waves and runtime.chunked_prefill
+                    and runtime.overlap_dispatch
+                ),
+                "prefill_absorbed_tokens": 0,
+                "unified_dispatches": 0,
+                "tokens_per_dispatch": 0.0,
                 # overload protection: same key set as the live branch
                 "max_pending": runtime.max_pending,
                 "shed_requests": 0,
@@ -349,6 +358,14 @@ class JaxLocalModelClient(ModelClient):
             # and the pad tokens one-dispatch-late retirement discarded
             "overlap_dispatch": rt.overlap_dispatch,
             "overlap_wasted_tokens": stats.overlap_wasted_tokens,
+            # ragged unified waves (ISSUE 6): whether the fused
+            # prefill+decode lane is live, the chunk tokens it absorbed
+            # into decode dispatches, and tokens processed per dispatch
+            # (decode + absorbed — the win is measured, not asserted)
+            "ragged_waves": engine._ragged,
+            "prefill_absorbed_tokens": stats.prefill_absorbed_tokens,
+            "unified_dispatches": stats.unified_dispatches,
+            "tokens_per_dispatch": round(stats.mean_tokens_per_dispatch, 3),
             # overload protection (ISSUE 5): admission sheds, deadline
             # expiries, reaped consumer cancels (mesh-propagated subset),
             # and max_out_blocks stall-cancels

@@ -152,6 +152,27 @@ class RuntimeConfig:
     # early).  False = the lockstep reference path (sync-then-fan-out),
     # byte-identical token streams either way.
     overlap_dispatch: bool = True
+    # ragged unified prefill+decode waves (ISSUE 6; the Ragged Paged
+    # Attention design, arXiv:2604.15464): the scheduler's admission lane
+    # and decode lane collapse into ONE — each tick enqueues a single
+    # fused dispatch carrying the active decode rows AND the inflight
+    # admission wave's next prefill chunk, so a half-empty decode wave
+    # absorbs prefill work in the compute it would otherwise idle.
+    # Engages when chunked_prefill=True (the chunk lane is the absorption
+    # substrate) and overlap_dispatch=True (ragged launches ride the
+    # double-buffered path); otherwise the engine runs the legacy
+    # bifurcated schedule, which is also the byte-identical parity oracle
+    # (ragged_waves=False).
+    ragged_waves: bool = True
+    # token budget per ragged dispatch: decode contributes
+    # active_rows x decode_steps_per_dispatch query tokens, an absorbed
+    # chunk contributes wave_rows x prefill_chunk.  Bounds per-dispatch
+    # latency (absorbed prefill stretches the fused dispatch) AND caps
+    # admission-wave width at formation (occupancy-driven admission).
+    # 0 = auto: max_batch_size x steps + max_prefill_wave x chunk — a
+    # budget that never second-guesses the existing admission bounds;
+    # set explicitly to trade absorption for steadier inter-token latency.
+    ragged_token_budget: int = 0
     # device-side retirement needs each request's stop-token set as a
     # fixed-shape row: the per-slot table holds this many entries.  A
     # short-lane request with more stop tokens than this is rejected when

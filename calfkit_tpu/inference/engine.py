@@ -39,6 +39,7 @@ import numpy as np
 from jax import lax
 
 from calfkit_tpu import cancellation
+from calfkit_tpu.inference import ragged as ragged_math
 from calfkit_tpu.exceptions import (
     DeadlineExceededError,
     EngineOverloadedError,
@@ -371,6 +372,13 @@ class EngineStats:
     cancelled_requests: int = 0
     cancel_propagated: int = 0
     delivery_stalled: int = 0
+    # ragged unified waves (ISSUE 6): prefill chunk tokens absorbed into
+    # decode dispatches (slack compute that would otherwise idle), and
+    # how many dispatches actually carried both kinds of work.  The
+    # occupancy accounting above counts absorbed chunk rows as dispatch
+    # participants — mean_occupancy IS the unified-wave fill metric.
+    prefill_absorbed_tokens: int = 0
+    unified_dispatches: int = 0
     # snapshot_and_delta state: the previous window's counter values +
     # timestamp.  Single-consumer by design (the heartbeat advert) — two
     # delta readers would steal each other's intervals.
@@ -384,6 +392,7 @@ class EngineStats:
         "spec_emitted", "spec_rows", "overlap_wasted_tokens",
         "shed_requests", "expired_requests", "cancelled_requests",
         "cancel_propagated", "delivery_stalled",
+        "prefill_absorbed_tokens", "unified_dispatches",
     )
 
     def counters(self) -> dict:
@@ -443,6 +452,19 @@ class EngineStats:
         if not self.spec_proposed:
             return 0.0
         return self.spec_accepted / self.spec_proposed
+
+    @property
+    def mean_tokens_per_dispatch(self) -> float:
+        """Tokens PROCESSED per decode dispatch: decode tokens plus the
+        prefill chunk tokens the ragged scheduler absorbed into those
+        same dispatches.  The axis unified waves move — a bifurcated
+        schedule pays a separate device invocation for every chunk this
+        counts for free."""
+        if not self.decode_dispatches:
+            return 0.0
+        return (
+            self.decode_tokens + self.prefill_absorbed_tokens
+        ) / self.decode_dispatches
 
     @property
     def tokens_per_dispatch(self) -> float:
@@ -709,6 +731,18 @@ class InferenceEngine:
         self._long: dict | None = None  # active long request's device state
         self._long_inflight: dict | None = None  # chunked long prefill
         self._sp_mesh_cache: Any = None
+        # ragged unified waves (ISSUE 6): effective only where the fused
+        # dispatch has both of its substrates — the chunk lane to absorb
+        # from and the overlap launch path to ride; anything else runs
+        # the legacy bifurcated schedule (which doubles as the parity
+        # oracle at ragged_waves=False)
+        self._ragged = bool(
+            rt.ragged_waves and rt.chunked_prefill and rt.overlap_dispatch
+        )
+        self._ragged_budget = ragged_math.token_budget(
+            rt.ragged_token_budget, B, rt.decode_steps_per_dispatch,
+            rt.prefill_chunk, rt.max_prefill_wave,
+        )
         self._wake = asyncio.Event()
         self._task: asyncio.Task[None] | None = None
         self._running = False
@@ -808,6 +842,18 @@ class InferenceEngine:
         fn = self._decode_jits.get((window, steps, sampled))
         if fn is not None:
             return fn
+        fn = jax.jit(
+            self._decode_fn_dense(window, steps, sampled),
+            donate_argnums=(1, 2),
+        )
+        self._decode_jits[(window, steps, sampled)] = fn
+        return fn
+
+    def _decode_fn_dense(self, window: int, steps: int, sampled: bool) -> Any:
+        """The dense decode dispatch BODY (untraced): shared verbatim by
+        the standalone decode jit and the fused ragged-wave jit, so the
+        two compile the identical subgraph (ragged-on parity is structural,
+        not coincidental)."""
         cfg = self.config
         attn_impl = self._resolved_attn_impl("decode")
 
@@ -866,9 +912,7 @@ class InferenceEngine:
             )
             return k, v, last, new_lens, toks, n_valid, done  # toks [steps, B]
 
-        fn = jax.jit(decode, donate_argnums=(1, 2))
-        self._decode_jits[(window, steps, sampled)] = fn
-        return fn
+        return decode
 
     def _decode_jit_paged(
         self, window: int, steps: int | None, sampled: bool
@@ -880,6 +924,16 @@ class InferenceEngine:
         fn = self._decode_jits.get((wpages, steps, sampled, "paged"))
         if fn is not None:
             return fn
+        fn = jax.jit(
+            self._decode_fn_paged(wpages, steps, sampled),
+            donate_argnums=(1, 2),
+        )
+        self._decode_jits[(wpages, steps, sampled, "paged")] = fn
+        return fn
+
+    def _decode_fn_paged(self, wpages: int, steps: int, sampled: bool) -> Any:
+        """The paged decode dispatch body (untraced) — see
+        :meth:`_decode_fn_dense` for why the body builder is separate."""
         cfg = self.config
         attn_impl = self._resolved_attn_impl("paged_decode")
 
@@ -927,9 +981,7 @@ class InferenceEngine:
             )
             return k2, v2, last, new_lens, toks, n_valid, done
 
-        fn = jax.jit(decode, donate_argnums=(1, 2))
-        self._decode_jits[(wpages, steps, sampled, "paged")] = fn
-        return fn
+        return decode
 
     def _verify_jit(self, window: int, S: int, sampled: bool) -> Any:
         """The speculative VERIFY dispatch: feed [last, d_0..d_{S-2}] per
@@ -1147,6 +1199,18 @@ class InferenceEngine:
         fn = self._prefill_jits.get(("chunk", chunk, rows))
         if fn is not None:
             return fn
+        fn = jax.jit(self._chunk_fn(chunk), donate_argnums=(1, 2))
+        self._prefill_jits[("chunk", chunk, rows)] = fn
+        return fn
+
+    def _chunk_fn(self, chunk: int) -> Any:
+        """The prefill-chunk body (untraced): shared verbatim by the
+        standalone chunk jit and the fused ragged-wave jit (same
+        structural-parity argument as :meth:`_decode_fn_dense`).  The
+        chunk is a RAGGED row kind — q_len=chunk queries at data offset
+        ``start`` against a scratch holding the chunk itself (the
+        per-row positions/lens ARE the (kind, start, q_len, kv_len)
+        descriptor, serialized as arrays)."""
         cfg = self.config
         attn_impl = self._resolved_attn_impl("prefill")
 
@@ -1162,8 +1226,60 @@ class InferenceEngine:
             )
             return sk, sv, logits  # logits [R, chunk, V]
 
-        fn = jax.jit(chunk_step, donate_argnums=(1, 2))
-        self._prefill_jits[("chunk", chunk, rows)] = fn
+        return chunk_step
+
+    def _ragged_jit(
+        self, window: int, steps: int, sampled: bool, chunk: int, rows: int
+    ) -> Any:
+        """THE unified prefill+decode wave dispatch (ISSUE 6): one jitted
+        invocation that advances the active decode rows by ``steps``
+        tokens AND the inflight admission wave by one prefill chunk —
+        the ragged batch of arXiv:2604.15464's design, expressed as one
+        XLA program (one launch, one retirement-mask chain, one host
+        sync) instead of the bifurcated admission-dispatch + decode-
+        dispatch pair.  Both halves trace the SAME body builders as their
+        standalone jits, so ragged-on output is structurally identical to
+        ragged-off."""
+        page = self.runtime.page_size
+        wkey = -(-window // page) if self._paged else window
+        key = ("ragged", wkey, steps, sampled, chunk, rows)
+        fn = self._decode_jits.get(key)
+        if fn is not None:
+            return fn
+        chunk_fn = self._chunk_fn(chunk)
+        if self._paged:
+            decode_fn = self._decode_fn_paged(wkey, steps, sampled)
+
+            def ragged_paged(
+                params, k, v, tables, last, lens, active, done_prev,
+                stop_table, hard_end, slot_keys, temp, top_k, top_p,
+                sk, sv, tokens_chunk, offset,
+            ):
+                sk, sv, logits = chunk_fn(params, sk, sv, tokens_chunk, offset)
+                out = decode_fn(
+                    params, k, v, tables, last, lens, active, done_prev,
+                    stop_table, hard_end, slot_keys, temp, top_k, top_p,
+                )
+                return (*out, sk, sv, logits)
+
+            fn = jax.jit(ragged_paged, donate_argnums=(1, 2, 14, 15))
+        else:
+            decode_fn = self._decode_fn_dense(window, steps, sampled)
+
+            def ragged_dense(
+                params, k, v, last, lens, active, done_prev,
+                stop_table, hard_end, slot_keys, temp, top_k, top_p,
+                sk, sv, tokens_chunk, offset,
+            ):
+                sk, sv, logits = chunk_fn(params, sk, sv, tokens_chunk, offset)
+                out = decode_fn(
+                    params, k, v, last, lens, active, done_prev,
+                    stop_table, hard_end, slot_keys, temp, top_k, top_p,
+                )
+                return (*out, sk, sv, logits)
+
+            fn = jax.jit(ragged_dense, donate_argnums=(1, 2, 13, 14))
+        self._decode_jits[key] = fn
         return fn
 
     def _seed_scratch_jit(self, bucket: int, n_pages: int, rows: int) -> Any:
@@ -1666,6 +1782,20 @@ class InferenceEngine:
                 self._check_deadlines()
                 self._check_stalls()
                 self._reap_cancelled()
+                if self._ragged:
+                    # ragged unified waves: ONE scheduler lane — the pass
+                    # forms/advances the admission wave and the decode
+                    # rows through a single fused dispatch per tick
+                    progressed = await self._ragged_pass()
+                    progressed |= await self._advance_long()
+                    if not progressed:
+                        self._wake.clear()
+                        if (
+                            not self._pending and not self._carry
+                            and not self._long_pending and self._long is None
+                        ):
+                            await self._wake.wait()
+                    continue
                 if self.runtime.chunked_prefill:
                     progressed = await self._admit_chunked()
                 else:
@@ -1908,6 +2038,11 @@ class InferenceEngine:
 
         wave: list[GenRequest] = [self._next_pending()]
         wave_bucket = bucket_of(wave[0])
+        # ragged mode: occupancy-driven admission — the wave may grow only
+        # as wide as the token budget lets a dispatch absorb alongside
+        # the CURRENT decode load (never below the head; legacy mode
+        # returns the batch width and the cap is inert)
+        width_cap = self._ragged_wave_cap(wave_bucket)
         head_reuse = self._plan_prefix_reuse(wave[0], wave_bucket)
         if head_reuse:
             # acquire at FORMATION: a later member's _alloc_with_eviction
@@ -1921,6 +2056,7 @@ class InferenceEngine:
         while (
             len(wave) < len(self._free)
             and len(wave) < self.runtime.max_prefill_wave
+            and len(wave) < width_cap
             and (peeked := self._peek_pending()) is not None
             and bucket_of(peeked) == wave_bucket
         ):
@@ -2459,46 +2595,14 @@ class InferenceEngine:
         """One scheduler pass of chunked admission: start an inflight wave
         if none, then advance it by ONE chunk (finalizing on the last).  A
         decode tick runs between passes, so active streams' inter-token
-        latency is bounded by one chunk instead of a whole bucket."""
+        latency is bounded by one chunk instead of a whole bucket.  This
+        is the LEGACY (bifurcated) lane — with ragged waves on, the chunk
+        instead rides the decode dispatch (:meth:`_ragged_pass`)."""
         if self._inflight is None:
             formed = self._form_wave()
             if formed is None:
                 return False
-            wave, bucket = formed
-            chunk = min(self.runtime.prefill_chunk, bucket)
-            cfg = self.config
-            R = len(wave)
-            scratch_shape = (
-                cfg.n_layers, R, cfg.n_kv_heads, bucket, cfg.head_dim
-            )
-            dtype = self._k.dtype
-            reuse = wave[0].reuse_len  # uniform across the wave
-            if reuse:
-                # seed the scratch with the cached prefix K/V (each row's
-                # pages gathered from the pool) and resume the chunk loop
-                # at the reused offset — the chunk jit's offset is data,
-                # so no new compile per reuse length
-                npg_r = reuse // self.runtime.page_size
-                ids = np.asarray(
-                    [request.pages[:npg_r] for request in wave], np.int32
-                )
-                scratch = self._seed_scratch_jit(bucket, npg_r, R)(
-                    self._k, self._v, jnp.asarray(ids)
-                )
-                self.stats.prefix_hits += len(wave)
-                self.stats.prefix_reused_tokens += reuse * len(wave)
-            else:
-                scratch = (
-                    jnp.zeros(scratch_shape, dtype),
-                    jnp.zeros(scratch_shape, dtype),
-                )
-            self._inflight = dict(
-                wave=wave, bucket=bucket, chunk=chunk,
-                n_chunks=-(-bucket // chunk), idx=reuse // chunk,
-                arrays=self._wave_arrays(wave, bucket),
-                scratch=scratch,
-                started=time.perf_counter(),
-            )
+            self._start_inflight_wave(*formed)
         finished = await asyncio.to_thread(self._advance_inflight)
         if finished:
             wave = self._inflight["wave"]
@@ -2506,17 +2610,59 @@ class InferenceEngine:
             self._activate_wave(wave)
         return True
 
-    def _advance_inflight(self) -> bool:
-        """Run one chunk of the inflight wave; finalize after the last.
-        Returns True when the wave landed."""
-        inf = self._inflight
-        wave, bucket, chunk = inf["wave"], inf["bucket"], inf["chunk"]
-        arrays = inf["arrays"]
+    def _start_inflight_wave(
+        self, wave: "list[GenRequest]", bucket: int
+    ) -> None:
+        """Stage a formed wave for chunked advancement: allocate (or
+        prefix-seed) the scratch and record the chunk cursor.  Shared by
+        the legacy chunked lane and the ragged unified lane."""
+        chunk = min(self.runtime.prefill_chunk, bucket)
+        cfg = self.config
         R = len(wave)
+        scratch_shape = (
+            cfg.n_layers, R, cfg.n_kv_heads, bucket, cfg.head_dim
+        )
+        dtype = self._k.dtype
+        reuse = wave[0].reuse_len  # uniform across the wave
+        if reuse:
+            # seed the scratch with the cached prefix K/V (each row's
+            # pages gathered from the pool) and resume the chunk loop
+            # at the reused offset — the chunk jit's offset is data,
+            # so no new compile per reuse length
+            npg_r = reuse // self.runtime.page_size
+            ids = np.asarray(
+                [request.pages[:npg_r] for request in wave], np.int32
+            )
+            scratch = self._seed_scratch_jit(bucket, npg_r, R)(
+                self._k, self._v, jnp.asarray(ids)
+            )
+            self.stats.prefix_hits += len(wave)
+            self.stats.prefix_reused_tokens += reuse * len(wave)
+        else:
+            scratch = (
+                jnp.zeros(scratch_shape, dtype),
+                jnp.zeros(scratch_shape, dtype),
+            )
+        self._inflight = dict(
+            wave=wave, bucket=bucket, chunk=chunk,
+            n_chunks=-(-bucket // chunk), idx=reuse // chunk,
+            arrays=self._wave_arrays(wave, bucket),
+            scratch=scratch,
+            started=time.perf_counter(),
+        )
+
+    def _advance_inflight(self) -> bool:
+        """Run one chunk of the inflight wave in its OWN device invocation
+        (the legacy lane, and the ragged lane's fallback when the token
+        budget refuses absorption); finalize after the last.  Returns True
+        when the wave landed."""
+        inf = self._inflight
+        chunk = inf["chunk"]
+        R = len(inf["wave"])
         idx = inf["idx"]
         sk, sv = inf["scratch"]
         tok_chunk = jnp.asarray(
-            arrays["tokens"][:, idx * chunk:(idx + 1) * chunk]
+            inf["arrays"]["tokens"][:, idx * chunk:(idx + 1) * chunk]
         )
         sk, sv, logits = self._chunk_jit(chunk, R)(
             self.params, sk, sv, tok_chunk, jnp.int32(idx * chunk)
@@ -2528,7 +2674,20 @@ class InferenceEngine:
         )
         if inf["idx"] < inf["n_chunks"]:
             return False
-        # last chunk done: land the wave
+        return self._finalize_inflight(logits)
+
+    def _finalize_inflight(self, logits: Any) -> bool:
+        """The chunked wave's landing (last chunk done): finalize jit,
+        first-token sync, prefix registration.  One host sync per WAVE —
+        shared by the legacy and ragged lanes.  ``logits`` is the final
+        chunk's output, passed through (never stored on the inflight
+        dict — a [R, chunk, vocab] buffer pinned between ticks would
+        double transient logits HBM on large-vocab configs)."""
+        inf = self._inflight
+        wave, bucket = inf["wave"], inf["bucket"]
+        arrays = inf["arrays"]
+        R = len(wave)
+        sk, sv = inf["scratch"]
         fn = self._finalize_jit(bucket, R, arrays["sampled"])
         args = [
             self._k, self._v, sk, sv, self._last, self._lens,
@@ -2552,6 +2711,148 @@ class InferenceEngine:
             for request in wave:
                 self._register_prefix_pages(request)
         return True
+
+    # ------------------------------------------------- ragged unified waves
+    # (ISSUE 6; arXiv:2604.15464) ONE scheduler lane: each pass enqueues a
+    # single fused dispatch that advances the active decode rows AND the
+    # inflight admission wave's next prefill chunk.  The last on-TPU bench
+    # measured mean_batch_occupancy 0.365 — nearly two thirds of every
+    # decode dispatch was idle compute; the ragged wave spends exactly
+    # that slack on prefill, under an explicit token budget.
+
+    async def _ragged_pass(self) -> bool:
+        """One pass of the unified lane: form a wave when none is in
+        flight (width capped by the token budget — occupancy-driven
+        admission), then advance decode + chunk through one fused tick.
+        Returns False only when there was nothing at all to do."""
+        progressed = False
+        if self._inflight is None:
+            formed = self._form_wave()
+            if formed is not None:
+                self._start_inflight_wave(*formed)
+                progressed = True
+        if (
+            self._active or self._inflight is not None
+            or self._pend is not None
+        ):
+            finished = await asyncio.to_thread(self._ragged_tick)
+            if finished:
+                wave = self._inflight["wave"]
+                self._inflight = None
+                self._activate_wave(wave)
+            progressed = True
+        return progressed
+
+    def _ragged_tick(self) -> bool:
+        """One tick of the unified lane (decode-thread context): launch
+        the fused (or decode-only) dispatch, then land the previous one —
+        the same double-buffered shape as :meth:`_decode_tick`, with the
+        admission wave riding the launch.  Returns True when the inflight
+        wave landed (the serve loop activates it)."""
+        if self._drafter is not None:
+            # speculation stays lockstep (the host drafter needs landed
+            # history to propose), so there is no launch to fuse the
+            # chunk into — the wave still rides THIS lane, one scheduler
+            # pass, advancing right after the verify sync
+            if self._active:
+                self._spec_decode_tick()
+            if self._inflight is not None:
+                return self._advance_inflight()
+            return False
+        if self._chaos is not None and self._active:
+            self._chaos("dispatch")
+        pend = self._pend
+        finished = False
+        if self._active:
+            finished = self._launch_ragged()
+        else:
+            self._pend = None
+            if self._inflight is not None:
+                finished = self._advance_inflight()
+        if pend is not None:
+            deliveries = self._land_decode(pend)
+            if not self._active:
+                # the landing retired every participant: drain the
+                # follow-up before a consumer can observe completion
+                # (the same invariant _decode_tick keeps)
+                self._drain_decode()
+            if deliveries:
+                self._loop.call_soon_threadsafe(_deliver_batch, deliveries)
+        return finished
+
+    def _absorb_fits(self) -> bool:
+        """May THIS dispatch absorb the inflight wave's next chunk?  The
+        budget arithmetic lives in :mod:`calfkit_tpu.inference.ragged`."""
+        inf = self._inflight
+        return inf is not None and ragged_math.fits_budget(
+            self._ragged_budget, len(self._active),
+            self.runtime.decode_steps_per_dispatch,
+            len(inf["wave"]), inf["chunk"],
+        )
+
+    def _ragged_wave_cap(self, bucket: int) -> int:
+        """Admission-width bound at FORMATION time: how many prefill rows
+        the budget lets a dispatch absorb alongside the current decode
+        load.  Uses the wave's ACTUAL per-dispatch chunk —
+        min(prefill_chunk, bucket) — so short-bucket waves are not
+        admitted narrower than the budget allows (the same chunk
+        ``_absorb_fits`` later charges).  Legacy mode returns the batch
+        width (no extra bound)."""
+        if not self._ragged:
+            return self.runtime.max_batch_size
+        return ragged_math.wave_width_cap(
+            self._ragged_budget, len(self._active),
+            self.runtime.decode_steps_per_dispatch,
+            min(self.runtime.prefill_chunk, bucket),
+        )
+
+    def _launch_ragged(self) -> bool:
+        """Enqueue ONE dispatch for this tick — fused decode+chunk when a
+        wave is in flight and the token budget admits it, else plain
+        decode (with the over-budget chunk advancing in its own
+        invocation so admission never starves).  NO host sync anywhere on
+        this path; the fused outputs ride ``self._pend`` to the next
+        tick's landing exactly like a plain overlapped launch."""
+        inf = self._inflight
+        if inf is None or not self._absorb_fits():
+            self._launch_decode()
+            if inf is not None:
+                return self._advance_inflight()
+            return False
+        args, window, steps, sampled = self._decode_args()
+        if steps < self.runtime.decode_steps_per_dispatch:
+            self.stats.short_dispatches += 1
+        chunk, idx = inf["chunk"], inf["idx"]
+        R = len(inf["wave"])
+        sk, sv = inf["scratch"]
+        tok_chunk = jnp.asarray(
+            inf["arrays"]["tokens"][:, idx * chunk:(idx + 1) * chunk]
+        )
+        self._observe_gap()
+        self._journal.append(
+            flightrec.EV_DISPATCH_LAUNCH, None, -1, steps, len(self._active)
+        )
+        self._journal.append(
+            flightrec.EV_RAGGED_WAVE, None, -1, len(self._active), R
+        )
+        started = time.perf_counter()
+        (
+            self._k, self._v, self._last, self._lens, toks, n_valid, done,
+            sk, sv, logits,
+        ) = self._ragged_jit(window, steps, sampled, chunk, R)(
+            *args, sk, sv, tok_chunk, jnp.int32(idx * chunk)
+        )
+        inf["scratch"] = (sk, sv)
+        inf["idx"] = idx + 1
+        self._journal.append(
+            flightrec.EV_PREFILL_CHUNK, None, -1, inf["idx"], inf["n_chunks"]
+        )
+        self.stats.prefill_absorbed_tokens += R * chunk
+        self.stats.unified_dispatches += 1
+        self._stage_pend(toks, n_valid, done, steps, started, extra_rows=R)
+        if inf["idx"] == inf["n_chunks"]:
+            return self._finalize_inflight(logits)
+        return False
 
     def _register_prefix_pages(self, request: GenRequest) -> None:
         """After landing: publish the request's freshly-written
@@ -2726,6 +3027,17 @@ class InferenceEngine:
         (
             self._k, self._v, self._last, self._lens, toks, n_valid, done,
         ) = self._decode_jit(window, steps, sampled)(*args)
+        self._stage_pend(toks, n_valid, done, steps, started)
+
+    def _stage_pend(
+        self, toks: Any, n_valid: Any, done: Any, steps: int,
+        started: float, extra_rows: int = 0,
+    ) -> None:
+        """Record a just-enqueued dispatch as the in-flight pend (host
+        lens advance + the landing's snapshot) — ONE copy shared by the
+        plain and fused launches, so the two lanes' retirement
+        bookkeeping cannot drift.  ``extra_rows`` counts absorbed
+        prefill rows (occupancy participants landed with the dispatch)."""
         for slot in self._active:
             self._host_lens[slot] += steps
         self._pend = dict(
@@ -2737,6 +3049,7 @@ class InferenceEngine:
             participants=list(self._active.items()),
             slot_set=set(self._active.keys()),
             deferred=[],
+            extra_rows=extra_rows,
         )
 
     def _land_decode(self, pend: dict) -> "list[tuple[asyncio.Queue, list]]":
@@ -2763,7 +3076,14 @@ class InferenceEngine:
             start = self._last_sync_t
         self._last_sync_t = now
         steps = pend["steps"]
-        self._note_dispatch(now - start, steps, n_rows=len(pend["participants"]))
+        # occupancy participants: decode rows PLUS any prefill rows the
+        # ragged scheduler absorbed into this dispatch (they hold slots;
+        # a bifurcated schedule would have burned a whole extra dispatch
+        # on them) — mean_occupancy is the unified-wave fill metric
+        self._note_dispatch(
+            now - start, steps,
+            n_rows=len(pend["participants"]) + pend.get("extra_rows", 0),
+        )
         deliveries: list[tuple[asyncio.Queue, list]] = []
         block_cols = np.ascontiguousarray(block.T)  # [B, steps]
         wasted = 0

@@ -556,38 +556,99 @@ def _verify_step_with_ring(
     return logits, (ring_k, ring_v)  # logits [B, S, V]
 
 
-def _verify_merged_attention(
-    q: jax.Array,  # [B, S, H, hd] the chunk's queries
-    k_cache: jax.Array,  # [B, K, W, hd] main cache window (read-only)
+def ragged_attention_source(
+    qg: jax.Array,  # [B, S, K, G, hd] multi-query, kv-grouped (unscaled)
+    k_cache: jax.Array,  # [B, K, W, hd]
     v_cache: jax.Array,
-    ring_k: jax.Array,  # [S, B, K, hd] this layer's chunk K
-    ring_v: jax.Array,
-    base_lens: jax.Array,  # [B]
-) -> jax.Array:
-    """Multi-query merged attention for the verify step (XLA path).
+    q_starts: jax.Array,  # [B] absolute position of each row's query 0
+    kv_lens: jax.Array,  # [B] valid kv length each row may attend
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """THE ragged multi-query attention source (XLA reference path for the
+    unified prefill+decode wave, ISSUE 6) → (o unnormalized [B,K,G,S,hd],
+    m [B,K,G,S,1], z [B,K,G,S,1]).
 
-    Source 1 is the main cache masked by ``base_lens`` (everything there
-    precedes every query).  Source 2 is the chunk itself with a causal
-    within-chunk mask (query j attends chunk slots 0..j — slot j IS its own
-    token).  Merged with the shared logsumexp law; one batched einsum pair
-    reads the window ONCE for all S queries (the per-token window read is
-    what speculation amortizes).
+    One masking law serves every row kind of a ragged wave (see
+    :mod:`calfkit_tpu.inference.ragged` for the descriptor vocabulary):
+    query ``j`` of row ``b`` attends kv positions
+    ``< min(kv_lens[b], q_starts[b] + j + 1)`` — causal within the row's
+    own fresh span, bounded by its valid cache length.  Decode rows
+    (S=1, start=kv_len=lens) and spec-verify rows (start=kv_len=base_lens)
+    reduce to the plain length mask; prefill-chunk rows (start=offset,
+    kv_len=offset+chunk against a scratch holding the chunk itself) get
+    the within-chunk causal triangle.  One batched einsum pair reads the
+    window ONCE for all S queries — the multi-query amortization both
+    speculation and chunk absorption rely on.
     """
-    B, S, H, hd = q.shape
-    K = k_cache.shape[1]
-    G = H // K
-    scale = 1.0 / math.sqrt(hd)
-    qg = q.reshape(B, S, K, G, hd)
-
+    W = k_cache.shape[2]
+    S = qg.shape[1]
+    scale = 1.0 / math.sqrt(qg.shape[-1])
     s1 = _einsum_f32("bskgh,bkwh->bkgsw", qg, k_cache) * scale
-    valid1 = jnp.arange(k_cache.shape[2])[None, :] < base_lens[:, None]
-    s1 = jnp.where(valid1[:, None, None, None, :], s1, -1e30)
+    kv_pos = jnp.arange(W, dtype=jnp.int32)[None, None, :]  # [1, 1, W]
+    limit = jnp.minimum(
+        kv_lens[:, None], q_starts[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :] + 1
+    )  # [B, S]
+    valid = kv_pos < limit[:, :, None]  # [B, S, W]
+    s1 = jnp.where(valid[:, None, None, :, :], s1, -1e30)
     m1 = jnp.max(s1, axis=-1, keepdims=True)
-    m1 = jnp.maximum(m1, -1e29)  # fresh rows stay finite
+    m1 = jnp.maximum(m1, -1e29)  # fresh/padding rows stay finite
     p1 = jnp.exp(s1 - m1).astype(k_cache.dtype)
     z1 = jnp.sum(p1.astype(jnp.float32), axis=-1, keepdims=True)
     o1 = _einsum_f32("bkgsw,bkwh->bkgsh", p1, v_cache)
+    return o1, m1, z1
 
+
+def ragged_attention_xla(
+    q: jax.Array,  # [B, S, H, hd] ragged queries (padded to the wave max)
+    k_cache: jax.Array,  # [B, K, W, hd]
+    v_cache: jax.Array,
+    q_starts: jax.Array,  # [B]
+    kv_lens: jax.Array,  # [B]
+) -> jax.Array:
+    """Normalized ragged attention → [B, S, H, hd]: the single-source
+    closure of :func:`ragged_attention_source` (rows with no second
+    source — plain cache reads).  Queries past a row's true q_len are
+    padding; their output is garbage the caller must ignore (the same
+    beyond-valid-length law the decode ring relies on)."""
+    B, S, H, hd = q.shape
+    K = k_cache.shape[1]
+    qg = q.reshape(B, S, K, H // K, hd)
+    o, m, z = ragged_attention_source(qg, k_cache, v_cache, q_starts, kv_lens)
+    out = o / jnp.maximum(z, 1e-30)  # [B, K, G, S, hd]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd).astype(q.dtype)
+
+
+def ragged_attention_paged_xla(
+    q: jax.Array,  # [B, S, H, hd]
+    pool_layer_k: jax.Array,  # [N, K, page, hd] one layer's pages
+    pool_layer_v: jax.Array,
+    tables: jax.Array,  # [B, Pmax]
+    q_starts: jax.Array,  # [B]
+    kv_lens: jax.Array,  # [B]
+    *,
+    wpages: int,
+) -> jax.Array:
+    """Ragged attention through the block tables (XLA reference): gather
+    each row's window, then the shared ragged mask law — mixed decode /
+    prefill-chunk / verify rows served against the paged KV cache in one
+    call (the Pallas kernel DMAs pages instead of gathering)."""
+    return ragged_attention_xla(
+        q,
+        gather_window_paged(pool_layer_k, tables, wpages),
+        gather_window_paged(pool_layer_v, tables, wpages),
+        q_starts, kv_lens,
+    )
+
+
+def verify_chunk_source(
+    qg: jax.Array,  # [B, S, K, G, hd]
+    ring_k: jax.Array,  # [S, B, K, hd] this layer's chunk K (ring layout)
+    ring_v: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The verify chunk's self-attention source → (o, m, z): query j
+    attends chunk slots 0..j (slot j IS its own token).  Shared by the
+    XLA verify path and the Pallas ragged-kernel merge."""
+    S = qg.shape[1]
+    scale = 1.0 / math.sqrt(qg.shape[-1])
     s2 = _einsum_f32("bskgh,tbkh->bkgst", qg, ring_k) * scale
     causal = (
         jnp.arange(S, dtype=jnp.int32)[None, :]
@@ -599,7 +660,37 @@ def _verify_merged_attention(
     p2 = jnp.exp(s2 - m2).astype(ring_k.dtype)
     z2 = jnp.sum(p2.astype(jnp.float32), axis=-1, keepdims=True)
     o2 = _einsum_f32("bkgst,tbkh->bkgsh", p2, ring_v)
+    return o2, m2, z2
 
+
+def _verify_merged_attention(
+    q: jax.Array,  # [B, S, H, hd] the chunk's queries
+    k_cache: jax.Array,  # [B, K, W, hd] main cache window (read-only)
+    v_cache: jax.Array,
+    ring_k: jax.Array,  # [S, B, K, hd] this layer's chunk K
+    ring_v: jax.Array,
+    base_lens: jax.Array,  # [B]
+) -> jax.Array:
+    """Multi-query merged attention for the verify step (XLA path).
+
+    Source 1 is the main cache read through the shared ragged law
+    (:func:`ragged_attention_source` with start = kv_len = base_lens —
+    everything in the cache precedes every query, so the ragged mask
+    reduces to the plain length mask).  Source 2 is the chunk itself with
+    a causal within-chunk mask (:func:`verify_chunk_source`).  Merged
+    with the shared logsumexp law; one batched einsum pair reads the
+    window ONCE for all S queries (the per-token window read is what
+    speculation amortizes).
+    """
+    B, S, H, hd = q.shape
+    K = k_cache.shape[1]
+    G = H // K
+    qg = q.reshape(B, S, K, G, hd)
+
+    o1, m1, z1 = ragged_attention_source(
+        qg, k_cache, v_cache, base_lens, base_lens
+    )
+    o2, m2, z2 = verify_chunk_source(qg, ring_k, ring_v)
     out = logsumexp_merge((o1, m1, z1), (o2, m2, z2))  # [B, K, G, S, hd]
     return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd).astype(q.dtype)
 

@@ -285,6 +285,255 @@ def merged_decode_attention_pallas(
 
 
 # --------------------------------------------------------------------------- #
+# ragged unified attention: mixed decode / prefill-chunk / verify rows
+# (ISSUE 6; the Ragged Paged Attention shape, arXiv:2604.15464)
+# --------------------------------------------------------------------------- #
+
+# kv positions streamed per grid step of the dense ragged kernel (the
+# window is a power-of-two bucket, so divisibility holds; windows smaller
+# than this run as one chunk)
+RAGGED_KV_CHUNK = 512
+
+
+def _ragged_attn_kernel(
+    starts_ref, lens_ref, q_ref, k_ref, v_ref, o_ref, m_ref, z_ref,
+    acc, m_s, z_s,
+):
+    """One (batch row, kv head, kv chunk) program of the ragged kernel.
+
+    The q block carries ALL of a row's queries (S = the wave's padded
+    q_len — 1 for decode rows, chunk for prefill rows, k+1 for verify
+    rows), flattened to [S·G, hd] so one MXU matmul scores every
+    (query, group) pair against the kv chunk.  THE ragged mask law (see
+    inference/ragged.py): query j attends kv positions
+    < min(kv_len, start + j + 1).  Flash accumulation across the kv grid
+    dimension in VMEM scratch — the window streams through VMEM exactly
+    once for the whole multi-query block, which is the amortization the
+    per-position decomposition paid S times for.
+    """
+    import jax.lax as lax
+
+    c = pl.program_id(2)
+    S, G, hd = q_ref.shape[2], q_ref.shape[3], q_ref.shape[4]
+    C = k_ref.shape[2]
+
+    @pl.when(c == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_s[...] = jnp.full_like(m_s, -1e30)
+        z_s[...] = jnp.zeros_like(z_s)
+
+    q = q_ref[0, 0].astype(jnp.float32).reshape(S * G, hd)
+    k = k_ref[0, 0].astype(jnp.float32)  # [C, hd]
+    v = v_ref[0, 0].astype(jnp.float32)
+    scale = 1.0 / math.sqrt(hd)
+
+    scores = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [S*G, C]
+    kv_pos = c * C + lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    j = lax.broadcasted_iota(jnp.int32, scores.shape, 0) // G  # query index
+    limit = jnp.minimum(lens_ref[0], starts_ref[0] + j + 1)
+    scores = jnp.where(kv_pos < limit, scores, -1e30)
+
+    m_new = jnp.maximum(m_s[...], jnp.max(scores, axis=-1, keepdims=True))
+    m_new = jnp.maximum(m_new, -1e29)  # padding queries stay finite
+    alpha = jnp.exp(m_s[...] - m_new)
+    pexp = jnp.exp(scores - m_new)
+    z_s[...] = z_s[...] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+    acc[...] = acc[...] * alpha + lax.dot_general(
+        pexp, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_s[...] = m_new
+
+    @pl.when(c == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0, 0] = acc[...].reshape(S, G, hd)
+        m_ref[0, 0] = m_s[...].reshape(S, G)
+        z_ref[0, 0] = z_s[...].reshape(S, G)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def ragged_attention_pallas(
+    q: jax.Array,  # [B, K, S, G, hd] kv-head-major ragged queries
+    k_cache: jax.Array,  # [B, K, W, hd]
+    v_cache: jax.Array,
+    q_starts: jax.Array,  # [B] absolute position of each row's query 0
+    kv_lens: jax.Array,  # [B] valid kv length each row may attend
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Ragged unified attention over a dense window → (o [B,K,S,G,hd] f32
+    unnormalized, m [B,K,S,G], z [B,K,S,G]) — one kernel serving decode
+    (S=1), prefill-chunk (S=chunk), and verify (S=k+1) rows through the
+    shared mask law; same source contract as the single-query kernel so
+    the logsumexp merge composes unchanged."""
+    B, K, S, G, hd = q.shape
+    W = k_cache.shape[2]
+    kv_chunk = min(RAGGED_KV_CHUNK, W)
+    if W % kv_chunk:
+        kv_chunk = W  # non-power-of-two window: stream it whole
+
+    grid = (B, K, W // kv_chunk)
+    out_shapes = (
+        jax.ShapeDtypeStruct((B, K, S, G, hd), jnp.float32),
+        jax.ShapeDtypeStruct((B, K, S, G), jnp.float32),
+        jax.ShapeDtypeStruct((B, K, S, G), jnp.float32),
+    )
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        _ragged_attn_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, k, c: (b,)),  # q_starts
+            pl.BlockSpec((1,), lambda b, k, c: (b,)),  # kv_lens
+            pl.BlockSpec((1, 1, S, G, hd), lambda b, k, c: (b, k, 0, 0, 0)),
+            pl.BlockSpec((1, 1, kv_chunk, hd), lambda b, k, c: (b, k, c, 0)),
+            pl.BlockSpec((1, 1, kv_chunk, hd), lambda b, k, c: (b, k, c, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, S, G, hd), lambda b, k, c: (b, k, 0, 0, 0)),
+            pl.BlockSpec((1, 1, S, G), lambda b, k, c: (b, k, 0, 0)),
+            pl.BlockSpec((1, 1, S, G), lambda b, k, c: (b, k, 0, 0)),
+        ),
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((S * G, hd), jnp.float32),
+            pltpu.VMEM((S * G, 1), jnp.float32),
+            pltpu.VMEM((S * G, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(
+        q_starts.astype(jnp.int32), kv_lens.astype(jnp.int32),
+        q, k_cache, v_cache,
+    )
+
+
+def _ragged_paged_attn_kernel(
+    layer_ref, tables_ref, starts_ref, lens_ref,  # scalar-prefetch (SMEM)
+    q_ref, k_ref, v_ref,  # tensor blocks (VMEM)
+    o_ref, m_ref, z_ref,  # outputs
+    acc, m_s, z_s,  # VMEM scratch carried across the page grid dim
+):
+    """Paged ragged program: the block table drives page DMA (scalar
+    prefetch, like the single-query paged kernel) and every one of the
+    row's S queries scores against each page as it streams through — one
+    page read amortized over the whole ragged block."""
+    import jax.lax as lax
+
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    S, G, hd = q_ref.shape[2], q_ref.shape[3], q_ref.shape[4]
+    page = k_ref.shape[3]
+
+    @pl.when(p == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+        m_s[...] = jnp.full_like(m_s, -1e30)
+        z_s[...] = jnp.zeros_like(z_s)
+
+    q = q_ref[0, 0].astype(jnp.float32).reshape(S * G, hd)
+    k = k_ref[0, 0, 0].astype(jnp.float32)  # [page, hd]
+    v = v_ref[0, 0, 0].astype(jnp.float32)
+    scale = 1.0 / math.sqrt(hd)
+
+    scores = lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [S*G, page]
+    kv_pos = p * page + lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    j = lax.broadcasted_iota(jnp.int32, scores.shape, 0) // G
+    limit = jnp.minimum(lens_ref[b], starts_ref[b] + j + 1)
+    scores = jnp.where(kv_pos < limit, scores, -1e30)
+
+    m_new = jnp.maximum(m_s[...], jnp.max(scores, axis=-1, keepdims=True))
+    m_new = jnp.maximum(m_new, -1e29)
+    alpha = jnp.exp(m_s[...] - m_new)
+    pexp = jnp.exp(scores - m_new)
+    z_s[...] = z_s[...] * alpha + jnp.sum(pexp, axis=-1, keepdims=True)
+    acc[...] = acc[...] * alpha + lax.dot_general(
+        pexp, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    m_s[...] = m_new
+
+    @pl.when(p == pl.num_programs(2) - 1)
+    def _emit():
+        o_ref[0, 0] = acc[...].reshape(S, G, hd)
+        m_ref[0, 0] = m_s[...].reshape(S, G)
+        z_ref[0, 0] = z_s[...].reshape(S, G)
+
+
+@functools.partial(jax.jit, static_argnames=("wpages", "interpret"))
+def ragged_attention_paged_pallas(
+    q: jax.Array,  # [B, K, S, G, hd]
+    pool_k: jax.Array,  # [L, N, K, page, hd] the WHOLE pool (no slicing)
+    pool_v: jax.Array,
+    layer: jax.Array,  # scalar int32
+    tables: jax.Array,  # [B, Pmax] int32 block tables
+    q_starts: jax.Array,  # [B]
+    kv_lens: jax.Array,  # [B]
+    *,
+    wpages: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Ragged unified attention through the block tables → (o, m, z), the
+    paged analog of :func:`ragged_attention_pallas` (same full-pool
+    no-materialization contract as the single-query paged kernel)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, K, S, G, hd = q.shape
+    page = pool_k.shape[3]
+
+    grid = (B, K, wpages)
+    kv_spec = pl.BlockSpec(
+        (1, 1, 1, page, hd),
+        lambda b, k, p, layer_ref, tables_ref, starts_ref, lens_ref: (
+            layer_ref[0], tables_ref[b, p], k, 0, 0
+        ),
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, S, G, hd), lambda b, k, p, *_refs: (b, k, 0, 0, 0)
+            ),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec(
+                (1, 1, S, G, hd), lambda b, k, p, *_refs: (b, k, 0, 0, 0)
+            ),
+            pl.BlockSpec((1, 1, S, G), lambda b, k, p, *_refs: (b, k, 0, 0)),
+            pl.BlockSpec((1, 1, S, G), lambda b, k, p, *_refs: (b, k, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((S * G, hd), jnp.float32),
+            pltpu.VMEM((S * G, 1), jnp.float32),
+            pltpu.VMEM((S * G, 1), jnp.float32),
+        ],
+    )
+    out_shapes = (
+        jax.ShapeDtypeStruct((B, K, S, G, hd), jnp.float32),
+        jax.ShapeDtypeStruct((B, K, S, G), jnp.float32),
+        jax.ShapeDtypeStruct((B, K, S, G), jnp.float32),
+    )
+    return pl.pallas_call(
+        _ragged_paged_attn_kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        tables.astype(jnp.int32),
+        q_starts.astype(jnp.int32),
+        kv_lens.astype(jnp.int32),
+        q, pool_k, pool_v,
+    )
+
+
+# --------------------------------------------------------------------------- #
 # speculative verify: k+1 queries per row against (main cache ⊕ chunk)
 # --------------------------------------------------------------------------- #
 
@@ -299,26 +548,31 @@ def verify_attention_pallas(
     *,
     interpret: bool = False,
 ) -> jax.Array:
-    """Multi-query verify attention on the Pallas lane (host fallback).
+    """Multi-query verify attention on the Pallas lane.
 
-    Decomposes the S-query verify into S single-query calls of the proven
-    decode kernel: the chunk plays the ring, and ring-slot validity
-    (``slot <= t``) at ``t = j`` IS query j's within-chunk causal mask, so
-    each call computes exactly one verify position's semantics.  Correct
-    everywhere (including interpret mode on CPU) at the cost of reading
-    the window S times; a true multi-query kernel — one window DMA
-    amortized over all k+1 queries, the "Ragged Paged Attention" shape —
-    is the follow-up once profiled on hardware.
+    ONE ragged-kernel call scores all S = k+1 queries against the window
+    (one window DMA amortized over the whole block — the Ragged Paged
+    Attention shape this used to decompose into S single-query calls);
+    the (tiny) chunk's causal self-attention folds in via the shared
+    logsumexp merge, exactly like the XLA path.  The verify rows reduce
+    to the ragged law with start = kv_len = base_lens.
     """
-    S = q.shape[1]
-    outs = [
-        merged_decode_attention_pallas(
-            q[:, j : j + 1], k_cache, v_cache, chunk_k, chunk_v,
-            base_lens, jnp.int32(j), interpret=interpret,
-        )
-        for j in range(S)
-    ]
-    return jnp.concatenate(outs, axis=1)
+    from calfkit_tpu.inference.model import logsumexp_merge, verify_chunk_source
+
+    B, S, H, hd = q.shape
+    K = k_cache.shape[1]
+    G = H // K
+    qg = q.reshape(B, S, K, G, hd)
+    o1, m1, z1 = ragged_attention_pallas(
+        jnp.transpose(qg, (0, 2, 1, 3, 4)), k_cache, v_cache,
+        base_lens, base_lens, interpret=interpret,
+    )  # [B, K, S, G, hd] / [B, K, S, G] x2 → merge layout [B, K, G, S, ·]
+    o1 = jnp.transpose(o1, (0, 1, 3, 2, 4))
+    m1 = jnp.transpose(m1, (0, 1, 3, 2))[..., None]
+    z1 = jnp.transpose(z1, (0, 1, 3, 2))[..., None]
+    o2, m2, z2 = verify_chunk_source(qg, chunk_k, chunk_v)
+    out = logsumexp_merge((o1, m1, z1), (o2, m2, z2))  # [B, K, G, S, hd]
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd).astype(q.dtype)
 
 
 def verify_attention_paged_pallas(
@@ -334,19 +588,25 @@ def verify_attention_paged_pallas(
     wpages: int,
     interpret: bool = False,
 ) -> jax.Array:
-    """Paged analog of :func:`verify_attention_pallas`: per chunk position,
-    the block-table kernel reads the main pages and the chunk folds in as
-    the ring — same decomposition, same follow-up kernel noted there."""
-    S = q.shape[1]
-    outs = [
-        merged_paged_decode_attention_pallas(
-            q[:, j : j + 1], pool_k, pool_v, layer, tables,
-            chunk_k, chunk_v, base_lens, jnp.int32(j),
-            wpages=wpages, interpret=interpret,
-        )
-        for j in range(S)
-    ]
-    return jnp.concatenate(outs, axis=1)
+    """Paged analog of :func:`verify_attention_pallas`: one ragged
+    block-table kernel call reads each page exactly once for all S
+    queries; the chunk folds in as the second source."""
+    from calfkit_tpu.inference.model import logsumexp_merge, verify_chunk_source
+
+    B, S, H, hd = q.shape
+    K = pool_k.shape[2]
+    G = H // K
+    qg = q.reshape(B, S, K, G, hd)
+    o1, m1, z1 = ragged_attention_paged_pallas(
+        jnp.transpose(qg, (0, 2, 1, 3, 4)), pool_k, pool_v, layer, tables,
+        base_lens, base_lens, wpages=wpages, interpret=interpret,
+    )
+    o1 = jnp.transpose(o1, (0, 1, 3, 2, 4))
+    m1 = jnp.transpose(m1, (0, 1, 3, 2))[..., None]
+    z1 = jnp.transpose(z1, (0, 1, 3, 2))[..., None]
+    o2, m2, z2 = verify_chunk_source(qg, chunk_k, chunk_v)
+    out = logsumexp_merge((o1, m1, z1), (o2, m2, z2))
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, S, H, hd).astype(q.dtype)
 
 
 # --------------------------------------------------------------------------- #

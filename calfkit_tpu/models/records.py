@@ -70,6 +70,14 @@ class EngineStatsRecord(BaseModel):
     # reads as off/unknown, not as overlapped-with-zero-waste
     overlap_dispatch: bool = False
     overlap_wasted_tokens: int = 0
+    # ragged unified prefill+decode waves (ISSUE 6): whether the fused
+    # lane is live, prefill chunk tokens absorbed into decode dispatches,
+    # and tokens processed (decode + absorbed) per dispatch.  Defaults
+    # read a pre-ragged engine's record as off/zero, not unknown.
+    ragged_waves: bool = False
+    prefill_absorbed_tokens: int = 0
+    unified_dispatches: int = 0
+    tokens_per_dispatch: float = 0.0
     # overload protection (ISSUE 5): admission sheds (max_pending bound),
     # deadline expiries, reaped consumer cancels (with the mesh-propagated
     # subset) and max_out_blocks stall-cancels.  Defaults 0 so records

@@ -80,6 +80,7 @@ EV_CANCEL = 17  # consumer-cancelled request reaped
 EV_FAULT = 18  # exception crossed the dispatch loop (note=repr)
 EV_SHED = 19  # bounded admission refused the submit  a=pending b=limit
 EV_EXPIRE = 20  # deadline passed (submit/queue/active) a=overdue_ms
+EV_RAGGED_WAVE = 21  # unified dispatch: decode+chunk  a=decode_rows b=chunk_rows
 
 EVENT_NAMES: tuple[str, ...] = (
     "SUBMIT",
@@ -103,6 +104,7 @@ EVENT_NAMES: tuple[str, ...] = (
     "FAULT",
     "SHED",
     "EXPIRE",
+    "RAGGED_WAVE",
 )
 
 # per-event meaning of the two int payload fields (the dump stays compact
@@ -129,6 +131,7 @@ ARG_LABELS: dict[str, tuple[str, str]] = {
     "FAULT": ("", ""),
     "SHED": ("pending", "limit"),
     "EXPIRE": ("overdue_ms", ""),
+    "RAGGED_WAVE": ("decode_rows", "chunk_rows"),
 }
 
 # batch-scoped events a request's timeline borrows from its active window
@@ -141,6 +144,7 @@ _BATCH_EVENTS = {
     "DISPATCH_LAUNCH",
     "DISPATCH_LAND",
     "SPEC_TICK",
+    "RAGGED_WAVE",
     "PAGE_EVICT",
     "FAULT",
 }

@@ -76,6 +76,17 @@ HOT_FUNCTIONS = {
     "_retirement_near",
     "_retirement_bound",
     "_deliver_batch",
+    # ragged unified waves (ISSUE 6): the fused-lane tick/launch, the
+    # budget/absorption math, and the wave-formation packing loop — the
+    # descriptor build and packing must stay sync-free and never format
+    # or journal-format on the lane (the fused launch is the overlap
+    # launch; a stray host sync would serialize the unified dispatch)
+    "_ragged_tick",
+    "_launch_ragged",
+    "_stage_pend",
+    "_absorb_fits",
+    "_ragged_wave_cap",
+    "_form_wave",
 }
 
 # pure host-side metric/heap helpers: never handed a device array, so the
@@ -381,6 +392,7 @@ def main() -> int:
     missing = {
         "_decode_tick", "_record_token", "_note_dispatch",
         "_launch_decode", "_land_decode", "_sync_host",
+        "_ragged_tick", "_launch_ragged", "_form_wave",
     } - names
     if missing:
         print(f"lint_hotpath: guarded functions missing from engine.py: "

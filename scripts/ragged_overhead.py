@@ -1,0 +1,248 @@
+"""Ragged unified prefill+decode waves A/B (ISSUE 6 acceptance artifact).
+
+Deterministic fixed-latency device-stub comparison of the BIFURCATED
+schedule (admission chunks and decode waves as separate device
+invocations — ``ragged_waves=False``) against the RAGGED unified lane
+(one fused invocation carrying decode rows AND the inflight wave's next
+prefill chunk), holding the workload and the simulated device constant:
+
+- every jit boundary (decode / chunk / fused / finalize) is replaced by
+  a host stub; each *invocation* occupies the serialized device for
+  ``DEVICE_MS`` (dispatches queue behind each other, like a real
+  accelerator stream) and token blocks become host-readable only when
+  the device would have finished them (the ``_sync_host`` →
+  ``np.asarray`` block, exactly like OVERLAP.json's stub);
+- the workload is MIXED prefill+decode by construction: multi-chunk
+  prompts arriving faster than they drain, short decode tails — the
+  shape where the round-5 TPU bench measured mean_batch_occupancy 0.365
+  (two thirds of every decode dispatch idle).
+
+Reported per mode: mean batch occupancy (absorbed prefill rows count as
+dispatch participants — the point of the unified wave), total device
+invocations and invocations-per-request, host us per invocation, and
+prefill tokens absorbed.  Exits non-zero unless the ragged lane's
+occupancy is at least ``OCCUPANCY_BAR``x the bifurcated baseline, it
+uses strictly fewer invocations per request, and both modes served every
+request in full (token-count parity; stream-content parity is pinned by
+tests/test_ragged_waves.py against the real model).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from calfkit_tpu.inference.config import RuntimeConfig, preset  # noqa: E402
+from calfkit_tpu.inference.engine import InferenceEngine  # noqa: E402
+from scripts._stub_common import (  # noqa: E402
+    stub_prefill_lens,
+    stub_retire_block,
+)
+
+BS = 8
+STEPS = 8
+CHUNK = 32
+PROMPT_CHUNKS = 4  # 4-chunk prompts: admission dominates the mix
+NEW_TOKENS = 16  # two decode dispatches per request
+REQUESTS = 32
+WAVE = 4  # max_prefill_wave: half the batch prefills while half decodes
+DEVICE_MS = 4.0  # per INVOCATION — the fused dispatch pays it once
+OCCUPANCY_BAR = 1.5  # ragged occupancy must beat bifurcated by this factor
+
+
+class _DeviceSim:
+    """A serialized fixed-latency device (see scripts/overlap_overhead.py):
+    each invocation starts at max(now, previous ready time) and finishes
+    ``latency_s`` later."""
+
+    def __init__(self, latency_s: float):
+        self.latency_s = latency_s
+        self.busy_until: float | None = None
+        self.idle_s = 0.0
+        self.dispatches = 0
+
+    def launch(self) -> float:
+        now = time.perf_counter()
+        if self.busy_until is not None:
+            self.idle_s += max(0.0, now - self.busy_until)
+        start = max(now, self.busy_until or now)
+        self.busy_until = start + self.latency_s
+        self.dispatches += 1
+        return self.busy_until
+
+
+class _LazyBlock:
+    """A token block readable at ``ready_at`` — ``np.asarray`` blocks
+    like a real device_get."""
+
+    def __init__(self, arr: np.ndarray, ready_at: float):
+        self._arr = arr
+        self._ready_at = ready_at
+
+    def __array__(self, dtype=None, copy=None):
+        delay = self._ready_at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        return self._arr if dtype is None else self._arr.astype(dtype)
+
+    @property
+    def T(self):
+        return np.asarray(self).T
+
+
+def _stub_jits(engine: InferenceEngine, sim: _DeviceSim) -> None:
+    """Stub every jit boundary the chunked/ragged scheduler crosses.
+    Decode math mirrors the device retirement contract via
+    scripts/_stub_common; the chunk/finalize stubs mirror the scratch /
+    lens-scatter contracts."""
+
+    def decode_outputs(k, v, last, lens, active, done_prev, hard_end, steps):
+        ready_at = sim.launch()
+        toks = np.ones((steps, BS), np.int32)
+        _act, n_valid, done, new_lens = stub_retire_block(
+            active, done_prev, lens, hard_end, steps
+        )
+        return k, v, last, new_lens, _LazyBlock(toks, ready_at), n_valid, done
+
+    def fake_decode(window: int, steps: int | None = None, sampled: bool = False):
+        steps = steps or engine.runtime.decode_steps_per_dispatch
+
+        def run(params, k, v, last, lens, active, done_prev, _stop,
+                hard_end, *rest):
+            return decode_outputs(
+                k, v, last, lens, active, done_prev, hard_end, steps
+            )
+
+        return run
+
+    def fake_chunk(chunk: int, rows: int):
+        def run(params, sk, sv, tokens_chunk, offset):
+            sim.launch()  # a bifurcated chunk is its own device invocation
+            return sk, sv, np.ones((rows, chunk, 8), np.float32)
+
+        return run
+
+    def fake_ragged(window: int, steps: int, sampled: bool,
+                    chunk: int, rows: int):
+        def run(params, k, v, last, lens, active, done_prev, _stop,
+                hard_end, keys, temp, tk, tp, sk, sv, tokens_chunk, offset):
+            # ONE invocation covers decode AND the chunk — the fused lane
+            out = decode_outputs(
+                k, v, last, lens, active, done_prev, hard_end, steps
+            )
+            return (*out, sk, sv, np.ones((rows, chunk, 8), np.float32))
+
+        return run
+
+    def fake_finalize(bucket: int, rows: int, sampled: bool):
+        def run(k, v, sk, sv, last, lens, slots, true_lens, logits,
+                *rest, tables=None, page_rows=None, scatter_ids=None):
+            sim.launch()  # the wave landing is one invocation in BOTH modes
+            firsts = np.ones((rows,), np.int32)
+            lens = stub_prefill_lens(lens, slots, true_lens)
+            return k, v, tables, last, lens, *rest[:4], firsts
+
+        return run
+
+    engine._decode_jit = fake_decode
+    engine._chunk_jit = fake_chunk
+    engine._ragged_jit = fake_ragged
+    engine._finalize_jit = fake_finalize
+
+
+async def measure(ragged: bool) -> dict:
+    config = preset("debug", max_seq_len=256)
+    runtime = RuntimeConfig(
+        max_batch_size=BS, max_seq_len=256, prefill_chunk=CHUNK,
+        decode_steps_per_dispatch=STEPS, chunked_prefill=True,
+        max_prefill_wave=WAVE, ragged_waves=ragged,
+    )
+    engine = InferenceEngine(config, runtime)
+    sim = _DeviceSim(DEVICE_MS / 1000.0)
+    _stub_jits(engine, sim)
+    await engine.start()
+
+    prompt_len = CHUNK * PROMPT_CHUNKS - 3  # straddles the last chunk
+
+    async def one(i: int) -> int:
+        n = 0
+        async for _ in engine.generate(
+            [1 + (i % 50), *range(2, prompt_len)], max_new_tokens=NEW_TOKENS
+        ):
+            n += 1
+        return n
+
+    t0 = time.perf_counter()
+    counts = await asyncio.gather(*[one(i) for i in range(REQUESTS)])
+    wall = time.perf_counter() - t0
+    await engine.stop()
+    assert all(c == NEW_TOKENS for c in counts), "stub served wrong lengths"
+
+    stats = engine.stats
+    host_us = max(0.0, wall - sim.dispatches * DEVICE_MS / 1000.0)
+    return {
+        "ragged_waves": ragged,
+        "mean_batch_occupancy": round(stats.mean_occupancy, 4),
+        "decode_dispatches": stats.decode_dispatches,
+        "device_invocations": sim.dispatches,
+        "invocations_per_request": round(sim.dispatches / REQUESTS, 3),
+        "host_us_per_invocation": round(host_us / sim.dispatches * 1e6, 1),
+        "prefill_absorbed_tokens": stats.prefill_absorbed_tokens,
+        "unified_dispatches": stats.unified_dispatches,
+        "tokens_per_dispatch": round(stats.mean_tokens_per_dispatch, 2),
+        "tokens": int(stats.decode_tokens),
+        "wall_s": round(wall, 3),
+    }
+
+
+async def run() -> dict:
+    bifurcated = await measure(ragged=False)
+    unified = await measure(ragged=True)
+    ratio = unified["mean_batch_occupancy"] / max(
+        bifurcated["mean_batch_occupancy"], 1e-9
+    )
+    ok = (
+        ratio >= OCCUPANCY_BAR
+        and unified["invocations_per_request"]
+        < bifurcated["invocations_per_request"]
+        and unified["prefill_absorbed_tokens"] > 0
+        and unified["tokens"] == bifurcated["tokens"]
+    )
+    return {
+        "metric": "ragged_unified_waves_ab[fixed-latency device stub, "
+        "mixed prefill+decode]",
+        "value": round(ratio, 2),
+        "unit": "x mean batch occupancy (ragged/bifurcated)",
+        "bar": OCCUPANCY_BAR,
+        "ok": ok,
+        "bifurcated": bifurcated,
+        "ragged": unified,
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None, help="also write JSON here")
+    ns = parser.parse_args()
+    result = asyncio.run(run())
+    line = json.dumps(result)
+    print(line)
+    if ns.out:
+        with open(ns.out, "w") as f:
+            f.write(line + "\n")
+    sys.exit(0 if result["ok"] else 1)

@@ -701,3 +701,59 @@ class TestDeliveryStall:
             assert len(await _collect(engine, [9], 8)) == 8
         finally:
             await engine.stop()
+
+
+class TestRaggedWaveCancellation:
+    async def test_cancel_request_packed_into_mixed_wave(self, params):
+        """ISSUE 6 chaos: cancel a request while its prefill chunk is
+        riding a MIXED ragged dispatch (decode rows + its admission
+        wave fused into one invocation).  The corpse must shed at
+        activation, its co-wave survivor must stream in full, the
+        decoding bystanders must be untouched, and no slot or page may
+        leak — the unified lane keeps the bifurcated lane's cancel
+        semantics."""
+        runtime = _rt(
+            kv_layout="paged", chunked_prefill=True, ragged_waves=True,
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        total_free = engine._page_alloc.free_pages
+        await engine.start()
+        try:
+            # two decoding bystanders keep the fused lane busy
+            bystanders = [
+                asyncio.create_task(_collect(engine, [1 + i], 24))
+                for i in range(2)
+            ]
+            await settle(lambda: len(engine._active) == 2)
+            # same bucket (48 → 3 chunks): both join one admission wave
+            # that must be ABSORBED into the bystanders' decode dispatches
+            doomed = asyncio.create_task(
+                _collect(engine, list(range(1, 44)), 16, corr="doomed")
+            )
+            survivor = asyncio.create_task(
+                _collect(engine, list(range(100, 143)), 16)
+            )
+            await settle(
+                lambda: engine._inflight is not None
+                and len(engine._inflight["wave"]) == 2
+                and engine.stats.unified_dispatches >= 1,
+                message="no mixed (decode+chunk) wave ever formed",
+            )
+            doomed.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await doomed
+            assert len(await survivor) == 16
+            assert [len(s) for s in await asyncio.gather(*bystanders)] == [
+                24, 24,
+            ]
+            await settle(lambda: _drained(engine, total_free))
+            assert_engine_drained(engine, total_free)
+            assert engine.stats.prefill_absorbed_tokens > 0
+            # the journal shows the fused lane ran and the cancel reaped
+            names = {e["event"] for e in _journal_events(engine)}
+            assert "RAGGED_WAVE" in names
+            assert "CANCEL" in names
+            # the lane still admits mixed waves afterwards
+            assert len(await _collect(engine, list(range(1, 44)), 8)) == 8
+        finally:
+            await engine.stop()

@@ -227,16 +227,19 @@ class TestFaultDump:
         """Serve until the 3rd decode tick raises (so the dump holds real
         pre-fault dispatch events)."""
         monkeypatch.setenv("CALFKIT_FLIGHTREC_DIR", str(tmp_path))
-        original = engine._decode_tick
+        # patch whichever dispatch lane is live: the ragged unified tick
+        # (chunked + overlap, the default) or the legacy decode tick
+        lane = "_ragged_tick" if engine._ragged else "_decode_tick"
+        original = getattr(engine, lane)
         ticks = {"n": 0}
 
         def exploding_tick():
             ticks["n"] += 1
             if ticks["n"] >= 3:
                 raise RuntimeError("injected dispatch fault")
-            original()
+            return original()
 
-        engine._decode_tick = exploding_tick
+        setattr(engine, lane, exploding_tick)
         await engine.start()
         out = []
         async for token in engine.generate(
