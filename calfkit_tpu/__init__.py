@@ -47,6 +47,9 @@ _LAZY: dict[str, str] = {
     "MCPServerSpec": "calfkit_tpu.mcp",
     "Messaging": "calfkit_tpu.peers",
     "Handoff": "calfkit_tpu.peers",
+    # fleet routing (replicated engines; ISSUE 7)
+    "FleetRouter": "calfkit_tpu.fleet",
+    "ReplicaRegistry": "calfkit_tpu.fleet",
     # faults + exceptions
     "NodeFaultError": "calfkit_tpu.exceptions",
     "ClientTimeoutError": "calfkit_tpu.exceptions",

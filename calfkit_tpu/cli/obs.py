@@ -1,18 +1,24 @@
-"""``ck trace`` / ``ck stats`` / ``ck timeline`` — the operator surface.
+"""``ck trace`` / ``ck stats`` / ``ck fleet`` / ``ck timeline`` — the
+operator surface.
 
 ``ck trace <correlation-id>`` reads the compacted ``mesh.traces`` topic
 and prints the run's per-hop waterfall (trace_id equals the correlation
 id by client convention, so the id on any log line or client handle is
 the lookup key).  ``ck stats`` reads the ``mesh.engine_stats`` directory
 and prints a live table of every engine's serving metrics.
+``ck fleet`` reads the SAME directory per-instance (ISSUE 7): one row
+per replica, with exactly the eligibility signals the fleet router
+routes on — readiness, drain state, heartbeat age, queue depth,
+shed/expired deltas — so "why is this replica (not) getting traffic"
+is answerable from the operator's chair.
 ``ck timeline <correlation-id>`` reconstructs one request's scheduler
 lifecycle — admission → waves → spec/overlap dispatches → retirement →
 frees — from an engine flight-recorder dump (same correlation id as the
 trace, so a fault report's id works for both commands).
 
 Rendering is split into pure functions (``render_waterfall`` /
-``render_stats_table`` / ``render_timeline``) so tests cover the
-formatting without a mesh.
+``render_stats_table`` / ``render_fleet_table`` / ``render_timeline``)
+so tests cover the formatting without a mesh.
 """
 
 from __future__ import annotations
@@ -26,6 +32,7 @@ import click
 
 from calfkit_tpu import protocol
 from calfkit_tpu.cli._common import resolve_mesh_for_cli
+from calfkit_tpu.fleet.registry import DEFAULT_STALE_AFTER
 from calfkit_tpu.models.records import (
     ControlPlaneRecord,
     EngineStatsRecord,
@@ -168,6 +175,86 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
     )
 
 
+def render_fleet_table(
+    replicas: "Iterable", *, stale_after: float, now: "float | None" = None
+) -> str:
+    """One row per replica instance: the router's view of the fleet.
+
+    ``ROUTE`` is the verdict the router's eligibility filter returns for
+    a NEW run right now — ``yes``, or the FIRST reason the replica is
+    skipped (``drain`` / ``stale`` / ``unready`` / ``shared-only``) —
+    computed by the SAME :func:`~calfkit_tpu.fleet.registry.
+    eligibility_verdict` the router uses, so this table cannot drift
+    from actual routing behavior.  SHED/EXPIRED prefer the
+    per-heartbeat-interval delta (``+n``) over lifetime values: what
+    matters for routing is whether a replica is shedding NOW."""
+    from calfkit_tpu import cancellation
+    from calfkit_tpu.fleet.registry import eligibility_verdict
+
+    if now is None:
+        now = cancellation.wall_clock()
+    rows = [
+        (
+            "MODEL", "NODE", "INSTANCE", "ROUTE", "READY", "DRAIN",
+            "HB AGE S", "DEPTH", "ACTIVE", "PENDING", "SLOTS",
+            "SHED", "EXPIRED", "TOK/S", "PREFIX HIT",
+        )
+    ]
+    for r in replicas:
+        s = r.stats
+        age = r.age(now)
+        verdict = eligibility_verdict(r, stale_after=stale_after, now=now)
+        window = s.window or {}
+        shed = (
+            f"+{window['shed_requests']}"
+            if "shed_requests" in window else str(s.shed_requests)
+        )
+        expired = (
+            f"+{window['expired_requests']}"
+            if "expired_requests" in window else str(s.expired_requests)
+        )
+        tok_s = window.get("tokens_per_second", s.tokens_per_second)
+        rows.append(
+            (
+                s.model_name,
+                s.node_id,
+                r.instance_id,
+                verdict,
+                "y" if s.ready else "n",
+                "y" if s.draining else "n",
+                f"{age:.1f}",
+                str(r.queue_depth),
+                str(s.active_requests),
+                str(s.pending_requests),
+                f"{s.max_batch_size - s.free_slots}/{s.max_batch_size}"
+                if s.max_batch_size else "-",
+                shed,
+                expired,
+                f"{tok_s:.1f}",
+                # "-" ONLY when the replica shows no sign of a prefix
+                # cache at all: a momentarily-evicted cache (0 resident
+                # pages, nonzero lifetime hits) must not render like
+                # caching-disabled
+                str(s.prefix_hits)
+                if (
+                    s.prefix_cached_pages or s.prefix_hits
+                    or s.prefix_reused_tokens
+                )
+                else "-",
+            )
+        )
+    if len(rows) == 1:
+        return (
+            "no advertised replicas (is a worker with a local model "
+            "running, and the control plane enabled?)"
+        )
+    widths = [max(len(row[i]) for row in rows) for i in range(len(rows[0]))]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in rows
+    )
+
+
 def _parse_spans(items: dict[str, bytes], correlation_id: str) -> list[SpanRecord]:
     spans: list[SpanRecord] = []
     prefix = f"{correlation_id}/"
@@ -234,6 +321,44 @@ def stats_command(mesh_url: str | None, timeout: float) -> None:
         finally:
             await mesh.stop()
         click.echo(render_stats_table(records))
+
+    asyncio.run(main())
+
+
+@click.command(
+    "fleet",
+    help="print the live replica fleet per model: readiness, drain, "
+    "heartbeat age, queue depth — the router's eligibility view",
+)
+@click.option("--mesh", "mesh_url", default=None, help="mesh url (or $CALFKIT_MESH_URL)")
+@click.option("--timeout", default=15.0, show_default=True, help="catch-up timeout (s)")
+@click.option(
+    "--stale-after",
+    # the router's own default, imported so tuning it cannot silently
+    # desynchronize the operator table's ROUTE verdicts from routing
+    default=DEFAULT_STALE_AFTER,
+    show_default=True,
+    help="heartbeat age (s) past which a replica is routed around "
+    "(match the router's setting)",
+)
+def fleet_command(
+    mesh_url: str | None, timeout: float, stale_after: float
+) -> None:
+    from calfkit_tpu.fleet.registry import parse_replicas
+
+    async def main() -> None:
+        mesh = resolve_mesh_for_cli(mesh_url, hosts_worker=False)
+        await mesh.start()
+        try:
+            reader = mesh.table_reader(protocol.ENGINE_STATS_TOPIC)
+            await reader.start(timeout=timeout)
+            await reader.barrier(timeout=timeout)
+            replicas = parse_replicas(reader.items())
+            await reader.stop()
+        finally:
+            await mesh.stop()
+        replicas.sort(key=lambda r: (r.model_name, r.key))
+        click.echo(render_fleet_table(replicas, stale_after=stale_after))
 
     asyncio.run(main())
 

@@ -91,6 +91,7 @@ class Client:
         client_id: str | None = None,
         default_timeout: float = DEFAULT_TIMEOUT,
         retry: "RetryPolicy | None" = None,
+        router: Any = None,  # FleetRouter | policy name | None
     ):
         self.mesh = mesh
         self.client_id = client_id or uuid.uuid4().hex[:12]
@@ -100,6 +101,17 @@ class Client:
         # pre-ISSUE-5 behavior; retries change at-most-once semantics for
         # non-idempotent agents, so the caller must choose them)
         self.retry = retry
+        # opt-in fleet routing (ISSUE 7): a FleetRouter (or a policy name
+        # — "least-loaded" / "p2c" / "prefix-affinity" — that builds one
+        # over this client's transport) replaces the hardcoded shared
+        # agent topic with a per-call replica placement; None = the
+        # pre-fleet behavior (shared topic, consumer-group balancing).
+        # The router's lifecycle is owned here: close() stops it.
+        if isinstance(router, str):
+            from calfkit_tpu.fleet import FleetRouter
+
+            router = FleetRouter(mesh, router)
+        self.router = router
         self._hub = Hub()
         self._subscription: Subscription | None = None
         self._started = False
@@ -122,6 +134,7 @@ class Client:
         client_id: str | None = None,
         default_timeout: float = DEFAULT_TIMEOUT,
         retry: "RetryPolicy | None" = None,
+        router: Any = None,
     ) -> "Client":
         """Lazy constructor: performs no I/O (reference: caller.py:102).
 
@@ -135,7 +148,7 @@ class Client:
         transport, owned = resolve_mesh(mesh, allow_memory=False)
         client = cls(
             transport, client_id=client_id, default_timeout=default_timeout,
-            retry=retry,
+            retry=retry, router=router,
         )
         client._owns_mesh = owned
         return client
@@ -181,6 +194,11 @@ class Client:
             with contextlib.suppress(Exception):
                 await self._subscription.stop()
             self._subscription = None
+        if self.router is not None:
+            # the router's registry holds a table reader on this client's
+            # transport: stop it before the transport goes away
+            with contextlib.suppress(Exception):
+                await self.router.stop()
         if self._owns_mesh:
             # connect() built this transport from a url: stop it too, or a
             # per-job client would leak sockets and reader tasks
@@ -337,6 +355,48 @@ class AgentGateway(Generic[OutputT]):
             return [TextPart(text=prompt)]
         return list(prompt)
 
+    # the affinity key only ever reads the page-aligned head (64-char
+    # pages × 4 max pages — see fleet/policy.py); collecting more would
+    # copy a whole long-history prompt per routed call for nothing
+    _AFFINITY_TEXT_CAP = 256
+
+    @classmethod
+    def _prompt_text(cls, parts: list[ContentPart]) -> str:
+        """The prompt's text-projection HEAD, for affinity hashing only."""
+        out: list[str] = []
+        length = 0
+        for p in parts:
+            text = getattr(p, "text", "") or ""
+            if not text:
+                continue
+            out.append(text[: cls._AFFINITY_TEXT_CAP - length])
+            length += len(out[-1])
+            if length >= cls._AFFINITY_TEXT_CAP:
+                break
+        return "".join(out)
+
+    async def _route_topic(
+        self,
+        parts: list[ContentPart],
+        correlation_id: str,
+        exclude_replicas: "frozenset[str]",
+    ) -> "tuple[str, Any]":
+        """The engine/topic-selection seam (ISSUE 7): with a fleet
+        router on the client, each call is placed on a specific
+        replica's addressed topic; without one (or with no eligible
+        replica) the shared agent topic load-balances as before.
+        Returns ``(topic, Replica | None)``."""
+        router = self._client.router
+        if router is None:
+            return self.input_topic, None
+        route = await router.route(
+            self.name,
+            prompt_text=self._prompt_text(parts),
+            correlation_id=correlation_id,
+            exclude=exclude_replicas,
+        )
+        return route.topic, route.replica
+
     async def start(
         self,
         prompt: str | list[ContentPart],
@@ -345,13 +405,20 @@ class AgentGateway(Generic[OutputT]):
         deps: dict[str, Any] | None = None,
         route: str = "run",
         timeout: float | None = None,
+        exclude_replicas: "frozenset[str]" = frozenset(),
     ) -> InvocationHandle[OutputT]:
         """Begin a run; returns a handle (reference: gateway.py:70).
 
         The effective timeout also mints the run's ``x-mesh-deadline``
         (absolute epoch), and the handle carries a cancel hook: a timeout
         (or an explicit ``handle.cancel()``) publishes a mesh ``cancel``
-        record so downstream engines abandon the run's work."""
+        record so downstream engines abandon the run's work.
+
+        ``exclude_replicas`` (fleet-routed clients only) bars specific
+        replica instances from this placement — the shed-retry loop in
+        :meth:`execute` passes the instances that already refused.  The
+        placement lands on ``handle.routed_replica`` (None = shared
+        topic)."""
         client = self._client
         await client._ensure_started()
         correlation_id = new_id()
@@ -359,6 +426,16 @@ class AgentGateway(Generic[OutputT]):
         effective_timeout = (
             timeout if timeout is not None else client.default_timeout
         )
+        parts = self._as_parts(prompt)
+        # place BEFORE minting the deadline: the first routed call may
+        # pay the registry's table catch-up (seconds on a slow broker),
+        # and that setup cost must not be charged against the caller's
+        # serving budget — an expired-at-publish call would fault
+        # non-retriable DeadlineExceeded for work that never started
+        target_topic, routed = await self._route_topic(
+            parts, correlation_id, exclude_replicas
+        )
+        routed_replica = routed.instance_id if routed is not None else None
         deadline = (
             cancellation.wall_clock() + effective_timeout
             if effective_timeout is not None
@@ -366,8 +443,10 @@ class AgentGateway(Generic[OutputT]):
         )
 
         async def publish_cancel() -> None:
+            # the cancel follows the CALL's placement: a replica-routed
+            # run is abandoned on the replica's topic
             await client._publish_cancel(
-                self.input_topic, correlation_id, task_id
+                target_topic, correlation_id, task_id
             )
 
         # register BEFORE publish: the reply cannot beat the handle
@@ -379,16 +458,40 @@ class AgentGateway(Generic[OutputT]):
             on_abandon=publish_cancel,
             task_registry=client._cancel_tasks,
         )
-        await client._publish_call(
-            self.input_topic,
-            self._as_parts(prompt),
-            route=route,
-            correlation_id=correlation_id,
-            task_id=task_id,
-            state=self._build_state(message_history),
-            deps=deps or {},
-            deadline=deadline,
-        )
+        handle.routed_replica = routed_replica
+        router = client.router if routed is not None else None
+        if router is not None:
+            # least-request accounting, keyed by the FULL replica key
+            # (instance ids may be operator-pinned and collide across
+            # agents): the router counts this run against the replica
+            # until its terminal reply lands (TTL sweep covers terminals
+            # that never arrive)
+            replica_key = routed.key
+            router.note_dispatch(replica_key, correlation_id)
+            channel.terminal.add_done_callback(
+                lambda _f, r=router, k=replica_key, c=correlation_id: (
+                    r.note_done(k, c)
+                )
+            )
+        try:
+            await client._publish_call(
+                target_topic,
+                parts,
+                route=route,
+                correlation_id=correlation_id,
+                task_id=task_id,
+                state=self._build_state(message_history),
+                deps=deps or {},
+                deadline=deadline,
+            )
+        except BaseException:
+            # the call never reached the mesh: no terminal will resolve,
+            # so uncharge the replica NOW — a phantom in-flight entry
+            # would bias placement away from a healthy replica for the
+            # whole TTL
+            if router is not None:
+                router.note_done(routed.key, correlation_id)
+            raise
         return handle
 
     async def send(
@@ -420,25 +523,41 @@ class AgentGateway(Generic[OutputT]):
         the client), faults typed retriable — overload sheds, draining
         workers — are retried with jittered exponential backoff; each
         retry is a FRESH run (new correlation id, new deadline).  Timeouts
-        and non-retriable faults surface immediately."""
+        and non-retriable faults surface immediately.
+
+        Fleet-routed clients retry ``mesh.overloaded`` sheds against a
+        DIFFERENT replica: the shed source's instance id is excluded from
+        every subsequent attempt's placement (ISSUE 7), so a retry storm
+        spreads across the fleet instead of hammering the replica that
+        just refused."""
         policy = retry if retry is not None else self._client.retry
         attempts = policy.attempts if policy is not None else 1
         last: BaseException | None = None
+        shed_sources: set[str] = set()
         for attempt in range(max(1, attempts)):
             if attempt:
                 await asyncio.sleep(policy.delay(attempt - 1))
+            handle = await self.start(
+                prompt,
+                message_history=message_history,
+                deps=deps,
+                route=route,
+                timeout=timeout,
+                exclude_replicas=frozenset(shed_sources),
+            )
             try:
-                handle = await self.start(
-                    prompt,
-                    message_history=message_history,
-                    deps=deps,
-                    route=route,
-                    timeout=timeout,
-                )
                 return await handle.result()
             except NodeFaultError as exc:
                 if policy is None or not RetryPolicy.retriable(exc):
                     raise
                 last = exc
+                if handle.routed_replica is not None:
+                    # EVERY retriable fault excludes the replica that
+                    # produced it, not just sheds: a hung replica
+                    # faulting mesh.timeout would otherwise be re-picked
+                    # deterministically (affinity re-homes there;
+                    # fail-fast keeps it the least-loaded minimum) while
+                    # a healthy replica sits idle
+                    shed_sources.add(handle.routed_replica)
         assert last is not None
         raise last

@@ -76,6 +76,10 @@ class _RunChannel:
 class InvocationHandle(Generic[OutputT]):
     """The caller's grip on one in-flight run."""
 
+    # fleet routing (ISSUE 7): the replica instance id this run was
+    # placed on, set by AgentGateway.start; None = shared-topic placement
+    routed_replica: "str | None" = None
+
     def __init__(
         self,
         channel: _RunChannel,

@@ -19,6 +19,7 @@ import weakref
 from dataclasses import dataclass
 from typing import Any
 
+from calfkit_tpu import cancellation
 from calfkit_tpu.mesh.transport import MeshTransport
 from calfkit_tpu.models.records import ControlPlaneRecord, ControlPlaneStamp
 from calfkit_tpu.observability.metrics import REGISTRY
@@ -96,7 +97,10 @@ class ControlPlanePublisher:
             for topic in {a.topic for a in adverts}
         }
         self._task: asyncio.Task[None] | None = None
-        self._started_at = time.time()
+        # liveness stamps ride the ONE deadline clock (cancellation.
+        # wall_clock): readers compare heartbeat_at against the same seam,
+        # so a chaos virtual clock drives staleness deterministically
+        self._started_at = cancellation.wall_clock()
         self._last_beat_at: float | None = None  # monotonic; None pre-start
 
     def _record(self, advert: Advert) -> ControlPlaneRecord:
@@ -106,7 +110,7 @@ class ControlPlanePublisher:
                 node_kind=advert.node_kind,
                 instance_id=advert.instance_id,
                 started_at=self._started_at,
-                heartbeat_at=time.time(),
+                heartbeat_at=cancellation.wall_clock(),
             ),
             record=advert.current_payload(),
         )

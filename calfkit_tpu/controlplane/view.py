@@ -10,11 +10,11 @@ surfaced health: ``status``/``failure``/``is_caught_up``).
 from __future__ import annotations
 
 import logging
-import time
 from typing import Generic, Type, TypeVar
 
 from pydantic import BaseModel, ValidationError
 
+from calfkit_tpu import cancellation
 from calfkit_tpu.mesh.transport import MeshTransport
 from calfkit_tpu.models.records import SCHEMA_VERSION, ControlPlaneRecord
 
@@ -72,7 +72,8 @@ class ControlPlaneView(Generic[RecordT]):
     # --------------------------------------------------------------- reads
     def _live_members(self) -> dict[str, ControlPlaneRecord]:
         """name -> freshest live instance record."""
-        now = time.time()
+        # same clock seam the publisher stamps with (chaos-patchable)
+        now = cancellation.wall_clock()
         best: dict[str, ControlPlaneRecord] = {}
         for key, raw in self._reader.items().items():
             try:

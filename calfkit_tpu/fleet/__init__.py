@@ -1,0 +1,78 @@
+"""Serving fleet: replicated engines behind load-, drain- and
+prefix-aware mesh routing (ISSUE 7; see docs/fleet.md).
+
+Layers:
+
+- :mod:`calfkit_tpu.fleet.selection` — pure hashing/ranking primitives
+  shared with the mesh dispatcher's lane law;
+- :mod:`calfkit_tpu.fleet.registry` — the per-instance replica view
+  over the compacted ``mesh.engine_stats`` heartbeats;
+- :mod:`calfkit_tpu.fleet.policy` — the routing-policy seam
+  (least-loaded, power-of-two-choices, prefix-affinity, random);
+- :mod:`calfkit_tpu.fleet.router` — registry + policy → one topic per
+  call, shared-topic fail-open.
+
+Re-exports are LAZY (mirroring ``calfkit_tpu/__init__``): the mesh
+dispatcher imports ``fleet.selection`` for its lane law, and that import
+must stay stdlib-only — an eager ``__init__`` would drag pydantic and
+the control-plane models into every process that merely dispatches
+records.
+
+The whole package is under the real mypy gate (not in the pyproject
+allowlist) and its selection path is guarded by
+``scripts/lint_hotpath.py``.
+"""
+
+from __future__ import annotations
+
+from importlib import import_module
+from typing import TYPE_CHECKING, Any
+
+_LAZY: dict[str, str] = {
+    "FleetRouter": "calfkit_tpu.fleet.router",
+    "Route": "calfkit_tpu.fleet.router",
+    "LeastLoaded": "calfkit_tpu.fleet.policy",
+    "PowerOfTwoChoices": "calfkit_tpu.fleet.policy",
+    "PrefixAffinity": "calfkit_tpu.fleet.policy",
+    "RandomChoice": "calfkit_tpu.fleet.policy",
+    "RouteRequest": "calfkit_tpu.fleet.policy",
+    "RoutingPolicy": "calfkit_tpu.fleet.policy",
+    "affinity_key_for": "calfkit_tpu.fleet.policy",
+    "resolve_policy": "calfkit_tpu.fleet.policy",
+    "Replica": "calfkit_tpu.fleet.registry",
+    "ReplicaRegistry": "calfkit_tpu.fleet.registry",
+    "eligibility_verdict": "calfkit_tpu.fleet.registry",
+    "parse_replicas": "calfkit_tpu.fleet.registry",
+}
+
+__all__ = sorted(_LAZY)
+
+if TYPE_CHECKING:  # pragma: no cover
+    from calfkit_tpu.fleet.policy import (
+        LeastLoaded,
+        PowerOfTwoChoices,
+        PrefixAffinity,
+        RandomChoice,
+        RouteRequest,
+        RoutingPolicy,
+        affinity_key_for,
+        resolve_policy,
+    )
+    from calfkit_tpu.fleet.registry import (
+        Replica,
+        ReplicaRegistry,
+        eligibility_verdict,
+        parse_replicas,
+    )
+    from calfkit_tpu.fleet.router import FleetRouter, Route
+
+
+def __getattr__(name: str) -> Any:
+    module = _LAZY.get(name)
+    if module is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    return getattr(import_module(module), name)
+
+
+def __dir__() -> "list[str]":
+    return sorted(set(globals()) | set(_LAZY))

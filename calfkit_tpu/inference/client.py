@@ -312,6 +312,7 @@ class JaxLocalModelClient(ModelClient):
                 "tokens_per_second": 0.0,
                 "mean_occupancy": 0.0,
                 "active_requests": 0,
+                "pending_requests": 0,
                 "free_slots": runtime.max_batch_size,
                 "max_batch_size": runtime.max_batch_size,
                 "kv_layout": runtime.kv_layout,
@@ -348,6 +349,12 @@ class JaxLocalModelClient(ModelClient):
             "tokens_per_second": round(stats.tokens_per_second, 1),
             "mean_occupancy": round(stats.mean_occupancy, 4),
             "active_requests": len(engine._active),
+            # admitted but not yet holding a slot: active + pending is the
+            # fleet router's queue-depth load signal (ISSUE 7)
+            "pending_requests": (
+                len(engine._pending) + len(engine._carry)
+                + len(engine._long_pending)
+            ),
             "free_slots": len(engine._free),
             "max_batch_size": rt.max_batch_size,
             "kv_layout": rt.kv_layout,

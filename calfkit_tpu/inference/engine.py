@@ -2878,10 +2878,15 @@ class InferenceEngine:
             page = request.pages[i]
             if self._prefix.register(request.page_hashes[i], page):
                 fresh.append(page)
-            else:
-                # another request registered this chain position first;
-                # this duplicate page stays private and frees at retire
-                break
+            # else: another request registered this chain position first;
+            # this duplicate page stays private (slot-held, freed at
+            # retire) — but LATER positions must still register: agent
+            # fleets share a scaffold/system page 0 across sessions, and
+            # stopping at the first collision used to mean only the
+            # FIRST session's chain ever entered the cache (every other
+            # session re-prefilled its whole prompt forever).  Chain
+            # hashing keeps mixed-origin chains content-correct: equal
+            # hash ⇒ equal page content ⇒ lookup may stitch them.
         if fresh:
             self._page_alloc.transfer_out(request.slot, fresh)
             self._prefix.acquire(fresh)

@@ -25,10 +25,10 @@ import logging
 import threading
 import time
 import weakref
-import zlib
 from typing import Awaitable, Callable
 
 from calfkit_tpu import protocol
+from calfkit_tpu.fleet import selection
 from calfkit_tpu.mesh.transport import Record
 from calfkit_tpu.observability.metrics import REGISTRY
 from calfkit_tpu.observability.trace import TRACER, TraceContext
@@ -241,9 +241,10 @@ class KeyOrderedDispatcher:
 
     # -------------------------------------------------------------- intake
     def lane_of(self, key: bytes | None) -> int:
-        if key is None:
-            return 0
-        return zlib.crc32(key) % self._lanes
+        # the lane law lives in the fleet selection seam (ISSUE 7) so
+        # lane assignment and replica placement share one set of
+        # primitives; semantics unchanged (crc32, keyless -> lane 0)
+        return selection.lane_of(key, self._lanes)
 
     async def submit(self, record: Record) -> None:
         """Enqueue for ordered dispatch; blocks at the 2N in-flight bound."""

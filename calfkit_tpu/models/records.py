@@ -8,12 +8,23 @@ calfkit/controlplane/records.py:54, view at controlplane/view.py:116-123).
 
 from __future__ import annotations
 
-import time
 from typing import Any
 
 from pydantic import BaseModel, Field
 
+from calfkit_tpu import cancellation
+
 SCHEMA_VERSION = 1
+
+
+def _now() -> float:
+    # through the module attribute, NOT a bound reference: liveness stamps
+    # must follow the ONE deadline clock (ISSUE 5's wall_clock seam) so
+    # the chaos harness's virtual clock governs staleness-based replica
+    # eligibility deterministically — a time.time stamp here would make a
+    # frozen-clock fleet scenario see every replica as stale (or fresh)
+    # depending on the host's real clock, not the script
+    return cancellation.wall_clock()
 
 
 class ControlPlaneStamp(BaseModel):
@@ -21,8 +32,8 @@ class ControlPlaneStamp(BaseModel):
     node_name: str
     node_kind: str
     instance_id: str
-    started_at: float = Field(default_factory=time.time)
-    heartbeat_at: float = Field(default_factory=time.time)
+    started_at: float = Field(default_factory=_now)
+    heartbeat_at: float = Field(default_factory=_now)
 
     def key(self) -> str:
         return f"{self.node_name}@{self.instance_id}"
@@ -54,9 +65,22 @@ class EngineStatsRecord(BaseModel):
     node_id: str
     model_name: str = ""
     platform: str = ""
+    # fleet identity + routability (ISSUE 7): which replica instance this
+    # record describes, the replica-addressed topic the router may publish
+    # to ("" = not individually addressable, shared-topic only), and the
+    # worker's readiness/drain state at heartbeat time.  Defaults read a
+    # pre-fleet record as an anonymous, routable-only-via-shared-topic
+    # replica that is serving — not as unknown.
+    instance_id: str = ""
+    replica_topic: str = ""
+    ready: bool = True
+    draining: bool = False
     tokens_per_second: float = 0.0
     mean_occupancy: float = 0.0
     active_requests: int = 0
+    # requests admitted but not yet holding a slot (queued + carry + long
+    # queue): active + pending is the router's queue-depth load signal
+    pending_requests: int = 0
     free_slots: int = 0
     max_batch_size: int = 0
     kv_layout: str = "dense"
@@ -88,6 +112,12 @@ class EngineStatsRecord(BaseModel):
     cancelled_requests: int = 0
     cancel_propagated: int = 0
     delivery_stalled: int = 0
+    # prefix-cache health (ISSUE 7): cached pages resident plus lifetime
+    # hit/reuse counters — the signal prefix-affinity routing exists to
+    # improve, surfaced per replica in `ck fleet` and ROUTER.json
+    prefix_cached_pages: int = 0
+    prefix_hits: int = 0
+    prefix_reused_tokens: int = 0
     # flight-recorder ring accounting ({"appended", "dropped", "dumped"}):
     # None for records from engines predating the journal
     flightrec: dict[str, int] | None = None

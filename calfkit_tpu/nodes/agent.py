@@ -155,7 +155,22 @@ class BaseAgentNodeDef(BaseNodeDef):
 
     # ------------------------------------------------------------- topics
     def input_topics(self) -> list[str]:
-        return [protocol.agent_input_topic(self.name)]
+        topics = [protocol.agent_input_topic(self.name)]
+        replica = self.replica_topic()
+        if replica is not None:
+            topics.append(replica)
+        return topics
+
+    def replica_topic(self) -> "str | None":
+        """The replica-ADDRESSED input topic (ISSUE 7), for agents whose
+        model exposes serving stats (the engine-backed ones the fleet
+        router places): consumed only by THIS instance, advertised in
+        the engine-stats heartbeat so routing policies can pick a
+        specific replica.  None for plain agents — they stay
+        shared-topic only and never enter the replica registry."""
+        if getattr(self.model, "stats_snapshot", None) is None:
+            return None
+        return protocol.agent_replica_topic(self.name, self.instance_id)
 
     def return_topic(self) -> str:
         return protocol.agent_return_topic(self.name)
@@ -186,8 +201,22 @@ class BaseAgentNodeDef(BaseNodeDef):
                 snapshot = snapshot_fn(window=True)
             except TypeError:
                 snapshot = snapshot_fn()  # third-party snapshot: no kwarg
+            # fleet identity + routability (ISSUE 7): which instance this
+            # is, where to address it, and whether the hosting worker
+            # would admit a NEW run right now — re-derived per heartbeat
+            # tick, so a drain() flips the advert on the next beat and
+            # the router stops picking this replica
+            worker = self.resources.get("worker")
+            ready, _ = (
+                worker.ready() if hasattr(worker, "ready") else (True, "")
+            )
             return EngineStatsRecord(
-                node_id=self.node_id, **snapshot
+                node_id=self.node_id,
+                instance_id=self.instance_id,
+                replica_topic=self.replica_topic() or "",
+                ready=bool(ready),
+                draining=bool(getattr(worker, "draining", False)),
+                **snapshot,
             ).model_dump()
         except Exception:  # noqa: BLE001 - metrics must never fault serving
             logger.debug("engine stats snapshot failed", exc_info=True)

@@ -183,10 +183,18 @@ class BaseNodeDef(RegistryMixin):
         after_node: Sequence[Any] = (),
         on_node_error: Sequence[Any] = (),
         on_callee_error: Sequence[Any] = (),
+        instance_id: "str | None" = None,
     ):
         protocol.require_topic_safe(name, what="node name")
         self.name = name
-        self.instance_id = uuid.uuid4().hex[:12]
+        # per-boot random by default.  Operators deploying replica fleets
+        # on clusters where topics must PRE-exist (provisioning disabled,
+        # ACL-restricted admin) pin a stable id per replica ("r0", "r1",
+        # …) so the replica-addressed topic is knowable ahead of boot and
+        # survives restarts; the control-plane key stays <name>@<id>.
+        if instance_id is not None:
+            protocol.require_topic_safe(instance_id, what="instance_id")
+        self.instance_id = instance_id or uuid.uuid4().hex[:12]
         for seam in before_node:
             validate_seam_arity(seam, 1, name="before_node")
         for seam in after_node:

@@ -209,6 +209,17 @@ def agent_return_topic(name: str) -> str:
     return require_topic_safe(f"agent.{name}.private.return")
 
 
+def agent_replica_topic(name: str, instance_id: str) -> str:
+    """The replica-ADDRESSED input topic (ISSUE 7): one per engine-backed
+    agent instance, consumed only by that instance.  The shared
+    ``agent_input_topic`` load-balances blindly via consumer-group
+    membership; the fleet router publishes here instead when a routing
+    policy picked a specific replica (least-loaded, power-of-two,
+    prefix-affinity).  The shared topic remains the fallback for meshes
+    with no control plane or no live replica adverts."""
+    return require_topic_safe(f"agent.{name}.replica.{instance_id}.private.input")
+
+
 def agent_publish_topic(name: str) -> str:
     return require_topic_safe(f"agent.{name}.events")
 

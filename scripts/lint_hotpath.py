@@ -33,6 +33,16 @@ constructs the telemetry PR explicitly bans there (ISSUE 2):
   above.  The overload-protection PR exists because two silent unbounded
   deques turned saturation into invisible queue-wait growth — a new one
   must state which admission bound, permit, or reaper makes it safe.
+- the fleet router's per-dispatch selection path (ISSUE 7): the
+  functions every routed call runs through — ``FleetRouter.select`` /
+  ``_outstanding``, every policy ``select`` body, the registry's
+  ``eligible``/``replicas``/``parse_replicas`` reads, and the pure
+  selection primitives — must not block (no ``time.sleep``, no
+  ``open``/``input``/``subprocess``, no ``await``-bearing broker
+  round-trips: these are sync functions by contract, enforced by their
+  ``def``-not-``async def`` shape), must not log or call ``time.time``,
+  and the fleet modules may not construct unbounded queues/deques
+  without the same ``# unbounded-ok:`` justification.
 
 Exit 0 when clean; exit 1 with a file:line listing otherwise.
 """
@@ -52,6 +62,7 @@ FLIGHTREC = Path(__file__).resolve().parent.parent / (
 DISPATCH = Path(__file__).resolve().parent.parent / (
     "calfkit_tpu/mesh/dispatch.py"
 )
+FLEET_DIR = Path(__file__).resolve().parent.parent / "calfkit_tpu/fleet"
 
 # the dispatch loop: every function that runs per decode tick (or inside
 # one) on the scheduler/decode threads
@@ -269,6 +280,96 @@ def _append_body_violations(tree: ast.AST) -> "list[tuple[int, str]]":
                "(update lint_hotpath)")]
 
 
+# ------------------------------------------------- fleet selection path
+# (ISSUE 7) every routed call runs these synchronously between "the
+# caller wants a topic" and "the publish happens": a blocking call or a
+# log line here is a per-request stall multiplied across the fleet.
+# parse_replicas is deliberately NOT guarded: it is the shared
+# render/CLI read helper and owns the undecodable-record debug floor
+# (lazily formatted); the per-dispatch functions below must stay clean.
+FLEET_SELECT_FUNCTIONS = {
+    "router.py": {"select", "_outstanding", "_sweep_inflight"},
+    "policy.py": {"select", "_least", "affinity_key_for"},
+    "registry.py": {"eligible", "replicas", "_parsed", "eligibility_verdict"},
+    "selection.py": {
+        "lane_of", "stable_hash", "rendezvous_rank", "page_aligned_prefix",
+    },
+}
+
+_FLEET_BANNED_CALLS = {"print", "open", "input", "exec", "eval"}
+_FLEET_BANNED_ATTR_CALLS = {
+    ("time", "time"),
+    ("time", "sleep"),
+    ("os", "system"),
+    ("subprocess", "run"),
+    ("subprocess", "Popen"),
+    ("socket", "socket"),
+}
+
+
+def _fleet_violations() -> "list[tuple[Path, int, str]]":
+    out: list[tuple[Path, int, str]] = []
+    for filename, wanted in sorted(FLEET_SELECT_FUNCTIONS.items()):
+        path = FLEET_DIR / filename
+        if not path.exists():
+            out.append((path, 0, "fleet module missing (update lint_hotpath)"))
+            continue
+        source = path.read_text()
+        tree = ast.parse(source, filename=str(path))
+        found_names: set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in wanted:
+                continue
+            found_names.add(node.name)
+            if isinstance(node, ast.AsyncFunctionDef):
+                # the selection path is sync BY CONTRACT: an await here
+                # means a broker round-trip snuck into per-call routing
+                out.append(
+                    (path, node.lineno,
+                     f"{node.name}: selection-path function became async "
+                     "(no broker round-trips per routed call)")
+                )
+            for call in ast.walk(node):
+                if not isinstance(call, ast.Call):
+                    continue
+                fn = call.func
+                if isinstance(fn, ast.Name) and fn.id in _FLEET_BANNED_CALLS:
+                    out.append(
+                        (path, call.lineno,
+                         f"{node.name}: blocking/banned call {fn.id}()")
+                    )
+                elif isinstance(fn, ast.Attribute) and isinstance(
+                    fn.value, ast.Name
+                ):
+                    pair = (fn.value.id, fn.attr)
+                    if pair in _FLEET_BANNED_ATTR_CALLS:
+                        out.append(
+                            (path, call.lineno,
+                             f"{node.name}: {pair[0]}.{pair[1]}() on the "
+                             "selection path")
+                        )
+                    elif fn.value.id in BANNED_RECEIVERS:
+                        out.append(
+                            (path, call.lineno,
+                             f"{node.name}: {fn.value.id}.{fn.attr}() — no "
+                             "logging on the selection path")
+                        )
+        missing = wanted - found_names
+        if missing:
+            out.append(
+                (path, 0,
+                 f"guarded selection functions missing: {sorted(missing)} "
+                 "(update FLEET_SELECT_FUNCTIONS)")
+            )
+        # the unbounded-queue rule covers the whole fleet module set: a
+        # router buffering routed calls in an unbounded queue would
+        # rebuild exactly the silent-saturation failure ISSUE 5 killed
+        out.extend(_unbounded_queue_violations(tree, source, path))
+    return out
+
+
 # ---------------------------------------------------- unbounded queues
 # (ISSUE 5) a Queue/deque with no bound and no justification is exactly
 # how the pre-overload engine turned saturation into silent queue growth
@@ -379,6 +480,7 @@ def main() -> int:
     queue_found += _unbounded_queue_violations(
         dispatch_tree, dispatch_source, DISPATCH
     )
+    queue_found += _fleet_violations()
     if queue_found:
         for path, line, message in sorted(queue_found):
             print(f"{path}:{line}: {message}")
@@ -410,9 +512,11 @@ def main() -> int:
         isinstance(c, ast.Call) and _is_journal_append(c)
         for c in ast.walk(tree)
     )
+    fleet_guarded = sum(len(v) for v in FLEET_SELECT_FUNCTIONS.values())
     print(
         f"lint_hotpath: clean ({len(HOT_FUNCTIONS & names)} dispatch-loop "
-        f"functions, {journal_sites} journal-append sites checked, "
+        f"functions, {journal_sites} journal-append sites, "
+        f"{fleet_guarded} fleet selection-path functions checked, "
         "unbounded-queue rule enforced)"
     )
     return 0

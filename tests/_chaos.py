@@ -24,6 +24,13 @@ Small, composable fault-injection pieces the chaos scenarios in
 - :func:`assert_engine_drained` — the no-leak oracle: no active slots,
   no in-flight dispatch, every slot on the free list, every page back
   in the pool.
+- :class:`FleetTopology` (ISSUE 7) — spawns MULTI-WORKER topologies: N
+  workers on one shared mesh, each hosting a replica of the same agent
+  name, with fast heartbeats and per-replica delivery ledgers, so
+  replica failover, drain handoff, and shed-retry storms run
+  deterministically under the virtual clock.  Includes the
+  heartbeat-wedge/resume seam for stale-replica scenarios (a wedged
+  publisher stops re-stamping; everything else keeps serving).
 
 Everything is plain deterministic state — no randomness, no wall-clock
 dependence beyond the event loop needing to actually run.
@@ -33,6 +40,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import time
 from typing import Any, Callable, Iterator
 
 from calfkit_tpu import cancellation
@@ -163,6 +171,162 @@ async def settle(
     raise AssertionError(
         message or f"condition not met within {ticks} bounded ticks"
     )
+
+
+class ServingStubModel:
+    """A scripted model that LOOKS engine-backed to the fleet machinery:
+    ``stats_snapshot`` makes its agent advertise on ``mesh.engine_stats``
+    (and subscribe its replica-addressed topic) without paying for a real
+    inference engine.  ``load`` feeds the queue-depth signal policies
+    rank on; ``replies`` counts turns served by THIS replica."""
+
+    def __init__(self, *, text: str = "ok", load: int = 0):
+        self.text = text
+        self.load = load
+        self.replies = 0
+
+    @property
+    def model_name(self) -> str:
+        return "serving-stub"
+
+    def stats_snapshot(self, *, window: bool = False) -> dict:
+        return {
+            "model_name": self.model_name,
+            "active_requests": self.load,
+            "pending_requests": 0,
+        }
+
+    async def request(self, messages, settings=None, params=None):
+        from calfkit_tpu.engine.testing import _estimate_tokens
+        from calfkit_tpu.models.messages import (
+            ModelResponse,
+            TextOutput,
+            Usage,
+        )
+
+        self.replies += 1
+        return ModelResponse(
+            parts=[TextOutput(text=self.text)],
+            usage=Usage(
+                input_tokens=_estimate_tokens(messages), output_tokens=1
+            ),
+            model_name=self.model_name,
+        )
+
+
+class FleetTopology:
+    """N workers hosting replicas of ONE agent name on a shared mesh.
+
+    Each replica is its own :class:`~calfkit_tpu.worker.Worker` (own
+    dispatch lanes, own control-plane publisher, own drain state) —
+    exactly the multi-process fleet shape, collapsed into one event loop
+    so scenarios stay deterministic.  ``delivered[i]`` ledgers the
+    correlation ids whose CALLS were admitted by replica ``i`` (the
+    drain/stale scenarios' "zero new calls" oracle).
+
+    Heartbeats tick fast on the REAL event loop; liveness stamps ride
+    the virtual clock (the ``wall_clock`` seam), so staleness is driven
+    by ``clock.advance``, never by sleeping.
+    """
+
+    def __init__(
+        self,
+        mesh: Any,
+        models: "list[Any]",
+        *,
+        name: str = "svc",
+        heartbeat_interval: float = 0.05,
+        stale_multiplier: float = 100.0,
+        agent_kwargs: "dict | None" = None,
+    ):
+        from calfkit_tpu.controlplane import ControlPlaneConfig
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        self.mesh = mesh
+        self.name = name
+        self.config = ControlPlaneConfig(
+            heartbeat_interval=heartbeat_interval,
+            stale_multiplier=stale_multiplier,
+        )
+        self.delivered: "list[list[str]]" = [[] for _ in models]
+        self.agents = []
+        self.workers = []
+        for i, model in enumerate(models):
+            agent = Agent(
+                name,
+                model=model,
+                before_node=[self._ledger(i)],
+                **(agent_kwargs or {}),
+            )
+            self.agents.append(agent)
+            self.workers.append(
+                Worker([agent], mesh=mesh, control_plane=self.config)
+            )
+
+    def _ledger(self, i: int) -> Callable[[Any], None]:
+        def note(ctx: Any) -> None:
+            if ctx.delivery_kind == "call":
+                self.delivered[i].append(ctx.correlation_id or "")
+            return None
+
+        return note
+
+    # ------------------------------------------------------------ lifecycle
+    async def __aenter__(self) -> "FleetTopology":
+        for worker in self.workers:
+            await worker.start()
+        return self
+
+    async def __aexit__(self, *exc: Any) -> None:
+        for worker in self.workers:
+            with contextlib.suppress(Exception):
+                await worker.stop()
+
+    # ------------------------------------------------------------- identity
+    def instance_id(self, i: int) -> str:
+        return self.agents[i].instance_id
+
+    def replica_key(self, i: int) -> str:
+        return f"{self.agents[i].node_id}@{self.instance_id(i)}"
+
+    def index_of_lowest_key(self) -> int:
+        """The replica a depth-tied least-loaded pick lands on (policies
+        tie-break on the lexicographic replica key)."""
+        return min(range(len(self.agents)), key=self.replica_key)
+
+    def calls_delivered(self, i: int) -> int:
+        return len(self.delivered[i])
+
+    # ---------------------------------------------------- heartbeat chaos
+    def _publisher(self, i: int) -> Any:
+        attached = self.workers[i]._advertiser
+        assert attached is not None, "control plane not attached"
+        return attached._publisher
+
+    def wedge_heartbeat(self, i: int) -> None:
+        """Simulate a wedged worker: the heartbeat loop dies, the record
+        stays on the table with its last stamp (no tombstone — that
+        would be a clean shutdown, a DIFFERENT scenario), and serving
+        continues.  Advancing the virtual clock past ``stale_after``
+        then makes the replica ineligible."""
+        publisher = self._publisher(i)
+        if publisher._task is not None:
+            publisher._task.cancel()
+            publisher._task = None
+
+    async def resume_heartbeat(self, i: int) -> None:
+        """The wedged worker recovers: one immediate re-advert (fresh
+        stamp on the current virtual clock) and the tick loop restarts."""
+        publisher = self._publisher(i)
+        for advert in publisher._adverts:
+            await publisher._writers[advert.topic].put(
+                advert.key, publisher._record(advert).to_wire()
+            )
+        publisher._last_beat_at = time.monotonic()
+        publisher._task = asyncio.get_running_loop().create_task(
+            publisher._beat(), name=f"chaos-resumed-heartbeat-{i}"
+        )
 
 
 def assert_engine_drained(engine: Any, total_free_pages: "int | None" = None) -> None:

@@ -37,6 +37,7 @@ from calfkit_tpu.exceptions import (  # noqa: E402
     NodeFaultError,
     exception_for,
 )
+from calfkit_tpu.fleet import FleetRouter  # noqa: E402
 from calfkit_tpu.inference import model as M  # noqa: E402
 from calfkit_tpu.inference.client import JaxLocalModelClient  # noqa: E402
 from calfkit_tpu.inference.config import RuntimeConfig, preset  # noqa: E402
@@ -50,6 +51,8 @@ from calfkit_tpu.worker import Worker  # noqa: E402
 from tests._chaos import (  # noqa: E402
     BrokerChaos,
     ChaosScript,
+    FleetTopology,
+    ServingStubModel,
     assert_engine_drained,
     settle,
     virtual_clock,
@@ -757,3 +760,256 @@ class TestRaggedWaveCancellation:
             assert len(await _collect(engine, list(range(1, 44)), 8)) == 8
         finally:
             await engine.stop()
+
+
+class TestFleetChaos:
+    """Multi-worker topologies (ISSUE 7): replica failover, drain
+    handoff, and shed-retry storms run deterministically — fast real
+    heartbeats, virtual-clock staleness, per-replica delivery ledgers,
+    and the engine no-leak oracle where real engines serve."""
+
+    @staticmethod
+    def _engine_fleet(params, n, **rt_over):
+        """n real engines wrapped as agent models (debug preset)."""
+        engines, models = [], []
+        for _ in range(n):
+            runtime = _rt(**rt_over)
+            engine = InferenceEngine(CFG, runtime, params=params)
+            engines.append(engine)
+            models.append(
+                JaxLocalModelClient(
+                    config=CFG, runtime=runtime, engine=engine,
+                    max_new_tokens=24,
+                )
+            )
+        return engines, models
+
+    @staticmethod
+    async def _eligible(router, n, message):
+        """Boot adverts say ready=False by design (a booting worker
+        must not draw traffic): wait for the first post-boot beat."""
+        await router.start()
+        await settle(
+            lambda: len(router.registry.eligible("svc")) == n,
+            message=message,
+        )
+
+    async def test_draining_replica_gets_zero_new_calls(self, params):
+        """Drain one of two replicas mid-generation: the in-flight run
+        completes ON the draining replica, every subsequent call lands
+        on the other one (zero NEW deliveries to the drained worker),
+        and both engines drain leak-free."""
+        with virtual_clock():
+            mesh = InMemoryMesh()
+            engines, models = self._engine_fleet(params, 2)
+            async with FleetTopology(mesh, models) as fleet:
+                low = fleet.index_of_lowest_key()
+                # pace the replica the first (depth-tied) pick lands on,
+                # so its run is still decoding when the drain hits
+                slow = ChaosScript()
+
+                def pace(point):
+                    slow(point)
+                    if point == "dispatch":
+                        time.sleep(0.02)
+
+                engines[low]._chaos = pace
+                router = FleetRouter(
+                    mesh, "least-loaded",
+                    stale_after=fleet.config.stale_after,
+                )
+                client = Client.connect(mesh, router=router)
+                await self._eligible(router, 2, "fleet never became routable")
+
+                inflight = asyncio.create_task(
+                    client.agent("svc").execute("long haul", timeout=60)
+                )
+                await settle(
+                    lambda: engines[low]._active,
+                    message="the in-flight run never reached the engine",
+                )
+                assert fleet.calls_delivered(low) == 1
+
+                fleet.workers[low].drain()
+                assert fleet.workers[low].ready()[0] is False
+                await settle(
+                    lambda: [
+                        r.instance_id
+                        for r in router.registry.eligible("svc")
+                    ] == [fleet.instance_id(1 - low)],
+                    message="drain never reached the registry",
+                )
+                # the run is still in flight on the draining replica
+                assert engines[low]._active, "paced run finished too early"
+
+                for i in range(4):
+                    result = await client.agent("svc").execute(
+                        f"post-drain {i}", timeout=60
+                    )
+                    assert result.output
+                # zero NEW calls on the drained replica; all four on the
+                # survivor — and the in-flight run finished normally
+                assert fleet.calls_delivered(low) == 1
+                assert fleet.calls_delivered(1 - low) == 4
+                assert (await inflight).output
+                await settle(lambda: _drained(engines[low]))
+                assert_engine_drained(engines[low])
+                assert_engine_drained(engines[1 - low])
+                assert engines[low].stats.shed_requests == 0
+                await client.close()
+            for engine in engines:
+                await engine.stop()
+            await mesh.stop()
+
+    async def test_shed_retried_on_a_different_replica(self, params):
+        """A prefix-affinity storm on one tightly-bounded home replica
+        (capacity 2: one slot + max_pending 1): the overflow sheds
+        typed, every shed is retried against the OTHER replica (the
+        shed source is excluded from the retry's placement), every run
+        ultimately succeeds, and the home replica's topic saw exactly
+        the first attempts — a shed retry NEVER re-picks its shed
+        source."""
+        with virtual_clock():
+            mesh = InMemoryMesh()
+            chaos = BrokerChaos()
+            mesh.chaos = chaos
+            # asymmetric capacity, so the scenario is deterministic for
+            # ANY shed count: replica 0 sheds its overflow, replica 1
+            # has the headroom to absorb every retry without shedding
+            engines, models = [], []
+            for max_pending in (1, 8):
+                runtime = _rt(
+                    max_batch_size=1, max_pending=max_pending,
+                    decode_steps_per_dispatch=1,
+                )
+                engine = InferenceEngine(CFG, runtime, params=params)
+                engines.append(engine)
+                models.append(
+                    JaxLocalModelClient(
+                        config=CFG, runtime=runtime, engine=engine,
+                        max_new_tokens=24,
+                    )
+                )
+            home = 0
+            async with FleetTopology(mesh, models) as fleet:
+                router = FleetRouter(
+                    mesh, "prefix-affinity",
+                    stale_after=fleet.config.stale_after,
+                )
+                client = Client.connect(mesh, router=router)
+                await self._eligible(router, 2, "fleet never became routable")
+
+                # find a session prompt (>= one 64-char affinity page,
+                # small enough to fit max_seq_len 128 with scaffolding)
+                # whose rendezvous home is the BOUNDED replica — the
+                # search is over session ids, exactly how real sessions
+                # scatter across homes
+                from calfkit_tpu.fleet import affinity_key_for
+
+                candidates = [
+                    f"session-{i:02d}: shared preamble " * 3
+                    for i in range(64)
+                ]
+                assert all(
+                    affinity_key_for(p) is not None for p in candidates
+                ), "candidate prompts are below one affinity page"
+                prompt = next(
+                    p
+                    for p in candidates
+                    if (picked := router.select("svc", prompt_text=p))
+                    is not None
+                    and picked.instance_id == fleet.instance_id(home)
+                )
+                # pace the home so the storm overlaps one generation
+                slow = ChaosScript()
+
+                def pace(point):
+                    slow(point)
+                    if point == "dispatch":
+                        time.sleep(0.01)
+
+                engines[home]._chaos = pace
+
+                results = await asyncio.gather(
+                    *[
+                        client.agent("svc").execute(
+                            prompt, timeout=60,
+                            retry=RetryPolicy(attempts=3, base_delay=0.01),
+                        )
+                        for _ in range(4)
+                    ]
+                )
+                assert all(r.output for r in results)
+                sheds = engines[home].stats.shed_requests
+                assert sheds >= 1, "the storm never overflowed the home"
+                assert engines[1 - home].stats.shed_requests == 0
+                home_topic = fleet.agents[home].replica_topic()
+                other_topic = fleet.agents[1 - home].replica_topic()
+                home_calls = chaos.seen.count((home_topic, "call"))
+                other_calls = chaos.seen.count((other_topic, "call"))
+                # affinity homed all four first attempts; every shed
+                # retried on the OTHER replica and nowhere else
+                assert home_calls == 4, (home_calls, other_calls, sheds)
+                assert other_calls == sheds, (home_calls, other_calls, sheds)
+                assert fleet.calls_delivered(1 - home) == sheds
+                await settle(lambda: _drained(engines[home]))
+                await settle(lambda: _drained(engines[1 - home]))
+                assert_engine_drained(engines[home])
+                assert_engine_drained(engines[1 - home])
+                await client.close()
+            for engine in engines:
+                await engine.stop()
+            await mesh.stop()
+
+    async def test_stale_heartbeat_excluded_until_readvertise(self):
+        """A replica whose heartbeat loop wedges keeps serving nothing
+        NEW once the virtual clock passes stale_after; the moment it
+        re-advertises (fresh stamp) it is routable again.  Pure routing
+        scenario — scripted stub models, ledgers as the oracle."""
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            models = [ServingStubModel(text=f"r{i}") for i in range(2)]
+            async with FleetTopology(mesh, models) as fleet:
+                router = FleetRouter(
+                    mesh, "least-loaded",
+                    stale_after=fleet.config.stale_after,
+                )
+                client = Client.connect(mesh, router=router)
+                await self._eligible(router, 2, "fleet never became routable")
+
+                low = fleet.index_of_lowest_key()
+                # depth-tied least-loaded picks the lowest key: pin it
+                result = await client.agent("svc").execute("warm", timeout=10)
+                assert result.output == f"r{low}"
+                assert fleet.calls_delivered(low) == 1
+
+                # the lowest-key replica's heartbeat wedges; time passes
+                fleet.wedge_heartbeat(low)
+                clock.advance(fleet.config.stale_after + 1)
+                await settle(
+                    lambda: [
+                        r.instance_id
+                        for r in router.registry.eligible("svc")
+                    ] == [fleet.instance_id(1 - low)],
+                    message="the wedged replica never went stale "
+                    "(is the survivor re-stamping?)",
+                )
+                for i in range(3):
+                    result = await client.agent("svc").execute(
+                        f"while-stale {i}", timeout=10
+                    )
+                    assert result.output == f"r{1 - low}"
+                assert fleet.calls_delivered(low) == 1  # nothing new
+
+                # recovery: one fresh advert restores eligibility and
+                # the depth-tied pick returns to the lowest key
+                await fleet.resume_heartbeat(low)
+                await settle(
+                    lambda: len(router.registry.eligible("svc")) == 2,
+                    message="re-advertising did not restore eligibility",
+                )
+                result = await client.agent("svc").execute("back", timeout=10)
+                assert result.output == f"r{low}"
+                assert fleet.calls_delivered(low) == 2
+                await client.close()
+            await mesh.stop()

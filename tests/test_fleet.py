@@ -1,0 +1,589 @@
+"""Fleet routing unit suite (ISSUE 7).
+
+Policies are pure functions over pre-filtered candidates, so their
+distribution and stickiness properties pin here WITHOUT a mesh:
+least-loaded vs power-of-two-choices on skewed load, prefix-affinity
+stickiness (and its stable fallback when the home replica drains or
+goes stale), and the shed-retry exclusion law.  The registry and router
+halves run against an in-memory mesh; the end-to-end drain/stale/shed
+drills live in tests/test_chaos.py (TestFleetChaos).
+"""
+
+import random
+
+import pytest
+
+from calfkit_tpu import protocol
+from calfkit_tpu.client import Client
+from calfkit_tpu.cli.obs import render_fleet_table
+from calfkit_tpu.fleet import (
+    FleetRouter,
+    LeastLoaded,
+    PowerOfTwoChoices,
+    PrefixAffinity,
+    RandomChoice,
+    Replica,
+    ReplicaRegistry,
+    RouteRequest,
+    affinity_key_for,
+    parse_replicas,
+    resolve_policy,
+)
+from calfkit_tpu.fleet.selection import (
+    lane_of,
+    page_aligned_prefix,
+    rendezvous_rank,
+    stable_hash,
+)
+from calfkit_tpu.mesh import InMemoryMesh
+from calfkit_tpu.models.records import (
+    ControlPlaneRecord,
+    ControlPlaneStamp,
+    EngineStatsRecord,
+)
+
+from tests._chaos import FleetTopology, ServingStubModel, settle, virtual_clock
+
+NOW = 1_700_000_000.0
+
+
+def _replica(
+    instance: str,
+    *,
+    active: int = 0,
+    pending: int = 0,
+    agent: str = "svc",
+    heartbeat_at: float = NOW,
+    ready: bool = True,
+    draining: bool = False,
+    topic: "str | None" = None,
+) -> Replica:
+    node_id = f"agent.{agent}"
+    stats = EngineStatsRecord(
+        node_id=node_id,
+        model_name="m8b",
+        instance_id=instance,
+        replica_topic=(
+            protocol.agent_replica_topic(agent, instance)
+            if topic is None
+            else topic
+        ),
+        ready=ready,
+        draining=draining,
+        active_requests=active,
+        pending_requests=pending,
+    )
+    return Replica(
+        key=f"{node_id}@{instance}",
+        node_id=node_id,
+        instance_id=instance,
+        heartbeat_at=heartbeat_at,
+        stats=stats,
+    )
+
+
+def _wire(replica: Replica) -> "tuple[str, bytes]":
+    record = ControlPlaneRecord(
+        stamp=ControlPlaneStamp(
+            node_name=replica.node_id,
+            node_kind="agent",
+            instance_id=replica.instance_id,
+            started_at=replica.heartbeat_at,
+            heartbeat_at=replica.heartbeat_at,
+        ),
+        record=replica.stats.model_dump(),
+    )
+    return replica.key, record.to_wire()
+
+
+REQ = RouteRequest(agent="svc")
+
+
+# ---------------------------------------------------------------- selection
+class TestSelectionPrimitives:
+    def test_lane_of_matches_historical_dispatch_law(self):
+        import zlib
+
+        for key in (b"", b"a", b"task-123", b"\x00\xff"):
+            assert lane_of(key, 8) == zlib.crc32(key) % 8
+        assert lane_of(None, 8) == 0
+
+    def test_stable_hash_is_process_independent(self):
+        # pinned value: the router must agree with itself across
+        # restarts AND with peer routers — a changed constant here is a
+        # fleet-wide affinity reshuffle and must be a conscious decision
+        assert stable_hash(b"session-1") == stable_hash(b"session-1")
+        assert stable_hash(b"session-1") != stable_hash(b"session-2")
+        assert stable_hash(b"session-1", salt=b"a") != stable_hash(
+            b"session-1", salt=b"b"
+        )
+
+    def test_long_candidate_ids_still_differentiate(self):
+        """Review regression: the rendezvous salt used to ride blake2b's
+        key parameter, which silently caps at 64 bytes — replica keys
+        sharing a ≥64-byte 'agent.<long-name>@' prefix all hashed
+        identically and affinity collapsed onto one replica.  Long-named
+        fleets must still spread sessions."""
+        name = "agent." + "x" * 80 + "@"
+        pool = [f"{name}{i:04d}" for i in range(8)]
+        homes = {
+            rendezvous_rank(f"session-{k}".encode(), pool)[0]
+            for k in range(64)
+        }
+        assert len(homes) > 1, "long replica keys collapsed to one home"
+
+    def test_rendezvous_rank_minimal_disruption(self):
+        keys = [f"k{i}".encode() for i in range(64)]
+        pool = [f"replica-{c}" for c in "abcde"]
+        homes = {k: rendezvous_rank(k, pool)[0] for k in keys}
+        # removing ONE candidate moves only the keys homed on it
+        smaller = [c for c in pool if c != "replica-c"]
+        for k in keys:
+            new_home = rendezvous_rank(k, smaller)[0]
+            if homes[k] == "replica-c":
+                assert new_home != "replica-c"
+            else:
+                assert new_home == homes[k]
+
+    def test_page_aligned_prefix(self):
+        assert page_aligned_prefix("ab", 4) is None
+        assert page_aligned_prefix("abcdefgh", 4) == b"abcdefgh"
+        # sub-page tail does not change the key, and neither does a
+        # GROWING session history past the max_pages head: every turn of
+        # one session maps to one affinity key
+        assert page_aligned_prefix("abcdefgh-x", 4, max_pages=2) == b"abcdefgh"
+        assert page_aligned_prefix(
+            "abcdefgh" + "history" * 40, 4, max_pages=2
+        ) == b"abcdefgh"
+        assert page_aligned_prefix([1, 2, 3], 2) == page_aligned_prefix(
+            [1, 2, 7], 2
+        )
+        assert page_aligned_prefix([1], 2) is None
+        assert page_aligned_prefix("abc", 0) is None
+
+
+# ----------------------------------------------------------------- policies
+class TestPolicies:
+    def test_least_loaded_picks_global_minimum(self):
+        pool = [
+            _replica("a", active=3),
+            _replica("b", active=1, pending=1),
+            _replica("c", active=0, pending=1),
+        ]
+        assert LeastLoaded().select(pool, REQ).instance_id == "c"
+
+    def test_least_loaded_tie_breaks_on_stable_key(self):
+        pool = [_replica("b"), _replica("a")]
+        assert LeastLoaded().select(pool, REQ).instance_id == "a"
+        assert LeastLoaded().select(list(reversed(pool)), REQ).instance_id == "a"
+
+    def test_empty_candidates(self):
+        for policy in (
+            LeastLoaded(), PowerOfTwoChoices(), PrefixAffinity(),
+            RandomChoice(),
+        ):
+            assert policy.select([], REQ) is None
+
+    def test_p2c_skewed_load_distribution(self):
+        """On a fleet with one hot replica, p2c must (a) send almost
+        nothing to the hot one and (b) spread the rest across the cold
+        ones rather than herding onto a single global minimum — the
+        herd is exactly least-loaded's failure mode between heartbeats."""
+        pool = [_replica("hot", active=50)] + [
+            _replica(f"cold{i}", active=i % 2) for i in range(8)
+        ]
+        rng = random.Random(7).random
+        policy = PowerOfTwoChoices(rng=rng)
+        picks = [policy.select(pool, REQ).instance_id for _ in range(600)]
+        hot = picks.count("hot")
+        # the hot replica loses every comparison it appears in: picked 0
+        assert hot == 0, f"p2c sent {hot} picks to the saturated replica"
+        spread = {p for p in picks}
+        assert len(spread) >= 6, f"p2c herded: only {spread}"
+
+    def test_least_loaded_vs_p2c_on_skew(self):
+        """The documented contrast: least-loaded herds every pick onto
+        the single minimum; p2c spreads across the cold majority."""
+        pool = [
+            _replica("hot", active=50),
+            _replica("min", active=0),
+            _replica("mid1", active=1),
+            _replica("mid2", active=1),
+        ]
+        ll_picks = {
+            LeastLoaded().select(pool, REQ).instance_id for _ in range(50)
+        }
+        assert ll_picks == {"min"}
+        rng = random.Random(3).random
+        p2c_picks = {
+            PowerOfTwoChoices(rng=rng).select(pool, REQ).instance_id
+            for _ in range(200)
+        }
+        assert "hot" not in p2c_picks
+        assert len(p2c_picks) >= 2  # not herded onto "min"
+
+    def test_p2c_two_or_fewer_is_least_loaded(self):
+        pool = [_replica("a", active=2), _replica("b", active=1)]
+        assert PowerOfTwoChoices().select(pool, REQ).instance_id == "b"
+        assert PowerOfTwoChoices().select(pool[:1], REQ).instance_id == "a"
+
+    def test_affinity_stickiness(self):
+        pool = [_replica(c) for c in "abcdef"]
+        key = affinity_key_for("You are a support agent. " * 16)
+        assert key is not None
+        req = RouteRequest(agent="svc", affinity_key=key)
+        picks = {
+            PrefixAffinity().select(pool, req).instance_id
+            for _ in range(20)
+        }
+        assert len(picks) == 1, f"affinity not sticky: {picks}"
+        # candidate list ORDER must not matter
+        shuffled = list(pool)
+        random.Random(1).shuffle(shuffled)
+        assert PrefixAffinity().select(shuffled, req).instance_id in picks
+
+    def test_affinity_pick_matches_rendezvous_rank(self):
+        """The policy's O(n) max and selection.rendezvous_rank share one
+        ordering law — a drift between them would re-home sessions."""
+        pool = [_replica(c) for c in "abcdef"]
+        keys = [r.key for r in pool]
+        for i in range(16):
+            key = affinity_key_for(f"sess {i}: " + "y" * 100)
+            pick = PrefixAffinity().select(
+                pool, RouteRequest(agent="svc", affinity_key=key)
+            )
+            assert pick.key == rendezvous_rank(key, keys)[0]
+
+    def test_affinity_fallback_when_home_ineligible(self):
+        """A draining/stale/excluded home never reaches the candidate
+        list; the key's next-ranked replica takes over, stably — and the
+        OTHER keys' homes do not move (no fleet-wide reshuffle)."""
+        pool = [_replica(c) for c in "abcd"]
+        keys = [
+            affinity_key_for(f"session {i}: " + "x" * 128) for i in range(32)
+        ]
+        policy = PrefixAffinity()
+        homes = {
+            i: policy.select(
+                pool, RouteRequest(agent="svc", affinity_key=k)
+            ).instance_id
+            for i, k in enumerate(keys)
+        }
+        victim = homes[0]
+        survivors = [r for r in pool if r.instance_id != victim]
+        for i, k in enumerate(keys):
+            moved = policy.select(
+                survivors, RouteRequest(agent="svc", affinity_key=k)
+            ).instance_id
+            if homes[i] == victim:
+                assert moved != victim
+            else:
+                assert moved == homes[i], "unrelated session reshuffled"
+        # and the fallback is itself stable
+        again = policy.select(
+            survivors, RouteRequest(agent="svc", affinity_key=keys[0])
+        ).instance_id
+        first = policy.select(
+            survivors, RouteRequest(agent="svc", affinity_key=keys[0])
+        ).instance_id
+        assert again == first
+
+    def test_affinity_without_key_uses_load_aware_fallback(self):
+        pool = [_replica("a", active=9), _replica("b", active=0)]
+        req = RouteRequest(agent="svc", affinity_key=None)
+        assert PrefixAffinity().select(pool, req).instance_id == "b"
+
+    def test_short_prompt_has_no_affinity_key(self):
+        assert affinity_key_for("hi") is None
+        assert affinity_key_for([1, 2, 3], page=16) is None
+
+    def test_resolve_policy_names(self):
+        assert isinstance(resolve_policy("least-loaded"), LeastLoaded)
+        assert isinstance(resolve_policy("p2c"), PowerOfTwoChoices)
+        assert isinstance(resolve_policy("prefix-affinity"), PrefixAffinity)
+        assert isinstance(resolve_policy("random"), RandomChoice)
+        custom = LeastLoaded()
+        assert resolve_policy(custom) is custom
+        with pytest.raises(ValueError, match="unknown routing policy"):
+            resolve_policy("bogus")
+
+
+# ----------------------------------------------------------------- registry
+class TestReplicaRegistry:
+    async def test_per_instance_records_not_collapsed(self):
+        """N replicas of ONE node name must all surface — the exact
+        read ControlPlaneView's freshest-wins collapse cannot serve."""
+        mesh = InMemoryMesh()
+        await mesh.start()
+        writer = mesh.table_writer(protocol.ENGINE_STATS_TOPIC)
+        for replica in (_replica("i1", active=1), _replica("i2", active=2)):
+            key, wire = _wire(replica)
+            await writer.put(key, wire)
+        registry = ReplicaRegistry(mesh)
+        await registry.start()
+        with virtual_clock(NOW + 1):
+            live = registry.replicas(agent="svc")
+            assert [r.instance_id for r in live] == ["i1", "i2"]
+            assert [r.queue_depth for r in live] == [1, 2]
+            assert registry.eligible("svc") == live
+        await registry.stop()
+        await mesh.stop()
+
+    async def test_eligibility_filters(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        writer = mesh.table_writer(protocol.ENGINE_STATS_TOPIC)
+        fleet = [
+            _replica("ok"),
+            _replica("draining", draining=True),
+            _replica("unready", ready=False),
+            _replica("stale", heartbeat_at=NOW - 60),
+            _replica("sharedonly", topic=""),
+            _replica("excluded"),
+        ]
+        for replica in fleet:
+            key, wire = _wire(replica)
+            await writer.put(key, wire)
+        registry = ReplicaRegistry(mesh, stale_after=15.0)
+        await registry.start()
+        with virtual_clock(NOW + 1):
+            assert len(registry.replicas(agent="svc")) == 6  # render view
+            eligible = registry.eligible("svc", exclude={"excluded"})
+            assert [r.instance_id for r in eligible] == ["ok"]
+            # the stale replica re-advertising restores eligibility
+            key, wire = _wire(_replica("stale", heartbeat_at=NOW + 1))
+            await writer.put(key, wire)
+            assert [
+                r.instance_id
+                for r in registry.eligible("svc", exclude={"excluded"})
+            ] == ["ok", "stale"]
+        await registry.stop()
+        await mesh.stop()
+
+    async def test_undecodable_records_skipped(self):
+        mesh = InMemoryMesh()
+        await mesh.start()
+        writer = mesh.table_writer(protocol.ENGINE_STATS_TOPIC)
+        await writer.put("garbage", b"\xff not json")
+        key, wire = _wire(_replica("ok"))
+        await writer.put(key, wire)
+        registry = ReplicaRegistry(mesh)
+        await registry.start()
+        with virtual_clock(NOW + 1):
+            assert [r.instance_id for r in registry.replicas()] == ["ok"]
+        await registry.stop()
+        await mesh.stop()
+
+
+# ------------------------------------------------------------------- router
+class TestFleetRouter:
+    async def test_routes_to_policy_pick_and_falls_back_shared(self):
+        with virtual_clock(NOW):
+            mesh = InMemoryMesh()
+            await mesh.start()
+            writer = mesh.table_writer(protocol.ENGINE_STATS_TOPIC)
+            router = FleetRouter(mesh, "least-loaded")
+            # zero replicas advertised: shared-topic fallback
+            route = await router.route("svc")
+            assert route.topic == protocol.agent_input_topic("svc")
+            assert route.replica is None
+            key, wire = _wire(_replica("i1", active=0))
+            await writer.put(key, wire)
+            key, wire = _wire(_replica("i2", active=4))
+            await writer.put(key, wire)
+            route = await router.route("svc")
+            assert route.instance_id == "i1"
+            assert route.topic == protocol.agent_replica_topic("svc", "i1")
+            # excluding the pick moves to the next replica; excluding
+            # everything falls back to the shared topic
+            route = await router.route("svc", exclude={"i1"})
+            assert route.instance_id == "i2"
+            route = await router.route("svc", exclude={"i1", "i2"})
+            assert route.replica is None
+            await router.stop()
+            await mesh.stop()
+
+    async def test_concurrent_first_routes_start_registry_once(self):
+        """Review regression: N concurrent FIRST route() calls must
+        single-flight the registry start — unguarded, each would start
+        its own table reader (leaked broker clients/pumps on a real
+        transport)."""
+        import asyncio
+
+        starts = []
+
+        class _CountingMesh(InMemoryMesh):
+            def table_reader(self, topic):
+                inner = super().table_reader(topic)
+
+                class _Reader:
+                    async def start(self, *, timeout=30.0):
+                        starts.append(topic)
+                        await asyncio.sleep(0)  # widen the race window
+                        await inner.start(timeout=timeout)
+
+                    def __getattr__(self, name):
+                        return getattr(inner, name)
+
+                return _Reader()
+
+        with virtual_clock(NOW):
+            mesh = _CountingMesh()
+            await mesh.start()
+            router = FleetRouter(mesh)
+            routes = await asyncio.gather(
+                *[router.route("svc") for _ in range(8)]
+            )
+            assert len(starts) == 1, f"registry started {len(starts)} times"
+            assert all(
+                r.topic == protocol.agent_input_topic("svc") for r in routes
+            )
+            await router.stop()
+            await mesh.stop()
+
+    async def test_router_failure_degrades_to_shared_topic(self):
+        class _BrokenReader:
+            async def start(self, *, timeout=30.0):
+                raise RuntimeError("directory unavailable")
+
+        class _BrokenMesh(InMemoryMesh):
+            def table_reader(self, topic):
+                return _BrokenReader()
+
+        mesh = _BrokenMesh()
+        await mesh.start()
+        router = FleetRouter(mesh)
+        route = await router.route("svc")
+        assert route.topic == protocol.agent_input_topic("svc")
+        # the known-broken directory is not re-paid per call
+        route = await router.route("svc")
+        assert route.replica is None
+        await mesh.stop()
+
+    async def test_execute_retries_shed_on_different_replica_unit(self):
+        """The exclusion law at the gateway level, without engines: a
+        fleet-routed execute() whose first attempt faults OVERLOADED
+        must exclude the shed source on the retry — the second attempt's
+        call lands on the OTHER replica's topic."""
+        from calfkit_tpu.client.caller import RetryPolicy
+
+        with virtual_clock(NOW):
+            mesh = InMemoryMesh()
+            models = [ServingStubModel(text=f"r{i}") for i in range(2)]
+            async with FleetTopology(mesh, models) as fleet:
+                low = fleet.index_of_lowest_key()
+                # the low replica sheds at the engine seam: its model
+                # raises the typed overload error
+                from calfkit_tpu.exceptions import EngineOverloadedError
+
+                async def shed(messages, settings=None, params=None):
+                    raise EngineOverloadedError(
+                        "synthetic shed", lane="short", pending=9, limit=1
+                    )
+
+                models[low].request = shed
+                router = FleetRouter(
+                    mesh, "least-loaded",
+                    stale_after=fleet.config.stale_after,
+                )
+                client = Client.connect(mesh, router=router)
+                await router.start()
+                # boot adverts say ready=False; wait for the first
+                # post-boot heartbeat so both replicas are routable
+                await settle(
+                    lambda: len(router.registry.eligible("svc")) == 2,
+                    message="replicas never became eligible",
+                )
+                result = await client.agent("svc").execute(
+                    "hello",
+                    timeout=10,
+                    retry=RetryPolicy(attempts=3, base_delay=0.01),
+                )
+                other = 1 - low
+                assert result.output == f"r{other}"
+                assert models[other].replies == 1
+                # the shed source was tried once, then excluded
+                assert fleet.calls_delivered(low) == 1
+                assert fleet.calls_delivered(other) == 1
+                await client.close()
+            await mesh.stop()
+
+
+# ----------------------------------------------------------------- ck fleet
+class TestFleetCli:
+    def test_render_fleet_table_verdicts(self):
+        fleet = [
+            _replica("aaaa", active=2, pending=1),
+            _replica("bbbb", draining=True),
+            _replica("cccc", heartbeat_at=NOW - 120),
+            _replica("dddd", ready=False),
+            _replica("eeee", topic=""),
+        ]
+        out = render_fleet_table(fleet, stale_after=15.0, now=NOW + 1)
+        lines = out.splitlines()
+        assert lines[0].startswith("MODEL")
+        by_instance = {line.split()[2]: line for line in lines[1:]}
+        assert " yes" in by_instance["aaaa"]
+        assert by_instance["aaaa"].split()[7] == "3"  # DEPTH = active+pending
+        assert " drain" in by_instance["bbbb"]
+        assert " stale" in by_instance["cccc"]
+        assert " unready" in by_instance["dddd"]
+        assert " shared-only" in by_instance["eeee"]
+
+    def test_render_fleet_table_empty(self):
+        assert "no advertised replicas" in render_fleet_table(
+            [], stale_after=15.0, now=NOW
+        )
+
+    def test_parse_replicas_round_trip(self):
+        key, wire = _wire(_replica("i1", active=2))
+        out = parse_replicas({key: wire})
+        assert len(out) == 1
+        assert out[0].key == key
+        assert out[0].queue_depth == 2
+        assert out[0].agent_name == "svc"
+
+    def test_pinned_instance_id_makes_replica_topic_stable(self):
+        """Clusters where topics must PRE-exist (provisioning disabled)
+        need the replica topic knowable before boot: a pinned
+        instance_id yields a deterministic topic, across 'restarts'
+        (re-construction), and illegal ids fail loudly."""
+        from calfkit_tpu.nodes import Agent
+
+        a = Agent("svc", model=ServingStubModel(), instance_id="r0")
+        b = Agent("svc", model=ServingStubModel(), instance_id="r0")
+        expected = protocol.agent_replica_topic("svc", "r0")
+        assert a.replica_topic() == b.replica_topic() == expected
+        assert expected in a.input_topics()
+        with pytest.raises(ValueError, match="instance_id"):
+            Agent("svc", model=ServingStubModel(), instance_id="bad id!")
+
+    async def test_fleet_command_reads_live_topology(self):
+        """The `ck fleet` read path against a live in-memory fleet: one
+        row per replica, both marked routable."""
+        with virtual_clock(NOW):
+            mesh = InMemoryMesh()
+            models = [ServingStubModel() for _ in range(2)]
+            async with FleetTopology(mesh, models) as fleet:
+                reader = mesh.table_reader(protocol.ENGINE_STATS_TOPIC)
+                await reader.start()
+                # the first advert lands mid-boot (ready=False by
+                # design: a booting worker must not draw traffic); the
+                # first post-boot heartbeat tick flips it
+                await settle(
+                    lambda: all(
+                        r.stats.ready
+                        for r in parse_replicas(reader.items())
+                    )
+                    and len(parse_replicas(reader.items())) == 2,
+                    message="replicas never advertised ready",
+                )
+                replicas = parse_replicas(reader.items())
+                await reader.stop()
+                out = render_fleet_table(
+                    replicas, stale_after=fleet.config.stale_after
+                )
+                assert out.count(" yes") == 2
+                for i in range(2):
+                    assert fleet.instance_id(i) in out
+            await mesh.stop()

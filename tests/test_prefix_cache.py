@@ -135,6 +135,50 @@ class TestEngineReuse:
 
         asyncio.run(run())
 
+    def test_shared_scaffold_page_does_not_block_registration(self):
+        """ISSUE 7 regression: N sessions sharing ONE scaffold page (an
+        agent fleet's system preamble) then diverging, with a chunk too
+        large for the single shared page to be reused (alignment forces
+        reuse=0).  Registration used to STOP at the first already-cached
+        chain position, so only the first session's chain ever entered
+        the cache and every other session re-prefilled forever; now the
+        collision page stays slot-private while the divergent suffix
+        registers, and each session's REPEAT prompt hits."""
+
+        async def run() -> None:
+            scaffold = [(7 * i + 2) % CFG.vocab_size for i in range(16)]
+            sessions = [
+                scaffold + [(13 * i + offset) % CFG.vocab_size
+                            for i in range(33)]
+                for offset in (3, 5, 11)
+            ]
+            # chunk 32 > the 16-token shared page: lcm alignment makes
+            # the scaffold-only match unreusable (reuse=0), which is the
+            # exact shape that used to break registration
+            engine = InferenceEngine(
+                CFG, _runtime(prefill_chunk=32), seed=11
+            )
+            await engine.start()
+            firsts = [await _generate(engine, p) for p in sessions]
+            assert engine.stats.prefix_hits == 0  # nothing alignable yet
+            repeats = [await _generate(engine, p) for p in sessions]
+            # EVERY session's repeat reuses its own registered chain —
+            # not just the first session's
+            assert engine.stats.prefix_hits == len(sessions)
+            assert repeats == firsts
+            await engine.stop()
+
+            # parity: a STITCHED chain (scaffold page from session 0's
+            # registration + own divergent suffix) must be content-exact
+            # — one fresh engine on the last session pins it (the other
+            # sessions share the identical code path)
+            fresh = InferenceEngine(CFG, _runtime(prefill_chunk=32), seed=11)
+            await fresh.start()
+            assert await _generate(fresh, sessions[-1]) == firsts[-1]
+            await fresh.stop()
+
+        asyncio.run(run())
+
     def test_no_page_leaks_across_reuse_and_retire(self):
         async def run() -> None:
             engine = InferenceEngine(CFG, _runtime(), seed=3)
