@@ -49,6 +49,7 @@ _LAZY: dict[str, str] = {
     "Handoff": "calfkit_tpu.peers",
     # fleet routing (replicated engines; ISSUE 7)
     "FleetRouter": "calfkit_tpu.fleet",
+    "FailoverPolicy": "calfkit_tpu.fleet",
     "ReplicaRegistry": "calfkit_tpu.fleet",
     # faults + exceptions
     "NodeFaultError": "calfkit_tpu.exceptions",

@@ -92,7 +92,7 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
             "NODE", "MODEL", "TOK/S", "OCC", "BATCH OCC", "TOK/DISP",
             "ACTIVE", "SLOTS",
             "DECODED", "TTFT P50/P99 MS", "GAP P99 MS", "WASTE",
-            "SHED", "EXPIRED", "CANCELS",
+            "SHED", "EXPIRED", "CANCELS", "FAILOVER/HEDGE", "WEDGE",
             "FREC APP/DROP",
         )
     ]
@@ -126,6 +126,17 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
             f"{r.cancelled_requests}({r.cancel_propagated})"
             if r.cancel_propagated
             else str(r.cancelled_requests)
+        )
+        # failure recovery (ISSUE 9): arrivals that were failover
+        # re-dispatches / hedge duplicates — which replicas absorb
+        # recovered work — and the wedge watchdog's state: "WEDGED!"
+        # while tripped (requests are being faulted retriable), else
+        # lifetime trips (requests faulted in parentheses)
+        recovery = f"{r.failover_requests}/{r.hedge_requests}"
+        wedge = (
+            "WEDGED!" if r.wedged
+            else f"{r.watchdog_trips}({r.watchdog_faulted})"
+            if r.watchdog_trips else "-"
         )
         # prefer the per-heartbeat-interval rates: lifetime cumulative
         # tok/s flattens toward the mean (an engine idle for an hour then
@@ -163,6 +174,8 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
                 shed,
                 str(r.expired_requests),
                 cancels,
+                recovery,
+                wedge,
                 frec,
             )
         )
@@ -185,10 +198,17 @@ def render_fleet_table(
     skipped (``drain`` / ``stale`` / ``unready`` / ``shared-only``) —
     computed by the SAME :func:`~calfkit_tpu.fleet.registry.
     eligibility_verdict` the router uses, so this table cannot drift
-    from actual routing behavior.  SHED/EXPIRED prefer the
-    per-heartbeat-interval delta (``+n``) over lifetime values: what
-    matters for routing is whether a replica is shedding NOW."""
+    from actual routing behavior.  When the DEAD-placement law
+    (:func:`~calfkit_tpu.fleet.failover.placement_verdict`, ISSUE 9)
+    declares the replica dead — stale heartbeat, or unready without
+    drain — the verdict renders as ``dead(stale)`` / ``dead(unready)``
+    with the last-seen heartbeat age in HB AGE S: runs still placed
+    there are being failed over, not just new runs routed away.
+    SHED/EXPIRED prefer the per-heartbeat-interval delta (``+n``) over
+    lifetime values: what matters for routing is whether a replica is
+    shedding NOW."""
     from calfkit_tpu import cancellation
+    from calfkit_tpu.fleet.failover import placement_verdict
     from calfkit_tpu.fleet.registry import eligibility_verdict
 
     if now is None:
@@ -204,6 +224,12 @@ def render_fleet_table(
         s = r.stats
         age = r.age(now)
         verdict = eligibility_verdict(r, stale_after=stale_after, now=now)
+        placement = placement_verdict(r, stale_after=stale_after, now=now)
+        if placement != "alive":
+            # the dead-placement law outranks the routing verdict: this
+            # replica isn't merely skipped for new runs — outstanding
+            # placements on it are declared dead and failed over
+            verdict = f"dead({placement.partition(':')[2]})"
         window = s.window or {}
         shed = (
             f"+{window['shed_requests']}"

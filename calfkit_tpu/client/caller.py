@@ -17,14 +17,20 @@ import contextlib
 import random
 import uuid
 from dataclasses import dataclass
-from typing import Any, Callable, Generic, TypeVar
+from typing import TYPE_CHECKING, Any, AsyncIterator, Callable, Generic, TypeVar
 
 from calfkit_tpu import cancellation, protocol
 from calfkit_tpu.exceptions import (
     RETRIABLE_FAULT_TYPES,
     ClientClosedError,
+    ClientTimeoutError,
     NodeFaultError,
 )
+from calfkit_tpu.models.error_report import ErrorReport, FaultTypes
+
+if TYPE_CHECKING:  # pragma: no cover
+    from calfkit_tpu.fleet.failover import FailoverPolicy, StreamLedger
+    from calfkit_tpu.models.step import StepEvent
 from calfkit_tpu.keying import partition_key
 from calfkit_tpu.mesh.transport import MeshTransport, Subscription
 from calfkit_tpu.models.messages import ModelMessage
@@ -92,6 +98,7 @@ class Client:
         default_timeout: float = DEFAULT_TIMEOUT,
         retry: "RetryPolicy | None" = None,
         router: Any = None,  # FleetRouter | policy name | None
+        failover: "FailoverPolicy | None" = None,
     ):
         self.mesh = mesh
         self.client_id = client_id or uuid.uuid4().hex[:12]
@@ -112,6 +119,14 @@ class Client:
 
             router = FleetRouter(mesh, router)
         self.router = router
+        # opt-in in-flight failure recovery (ISSUE 9): with a router AND a
+        # FailoverPolicy, execute()/stream() supervise each outstanding
+        # placement against the dead-placement law and re-dispatch (fresh
+        # correlation id, remaining deadline, dead replica excluded, old
+        # correlation cancel-tombstoned) when the placed replica dies
+        # mid-run.  None = calls ride their placement to the caller's
+        # timeout, the pre-ISSUE-9 behavior.
+        self.failover = failover
         self._hub = Hub()
         self._subscription: Subscription | None = None
         self._started = False
@@ -135,6 +150,7 @@ class Client:
         default_timeout: float = DEFAULT_TIMEOUT,
         retry: "RetryPolicy | None" = None,
         router: Any = None,
+        failover: "FailoverPolicy | None" = None,
     ) -> "Client":
         """Lazy constructor: performs no I/O (reference: caller.py:102).
 
@@ -148,7 +164,7 @@ class Client:
         transport, owned = resolve_mesh(mesh, allow_memory=False)
         client = cls(
             transport, client_id=client_id, default_timeout=default_timeout,
-            retry=retry, router=router,
+            retry=retry, router=router, failover=failover,
         )
         client._owns_mesh = owned
         return client
@@ -271,6 +287,7 @@ class Client:
         state: State,
         deps: dict[str, Any],
         deadline: float | None = None,
+        attempt: str | None = None,
     ) -> None:
         from calfkit_tpu.observability.trace import TRACER
 
@@ -311,6 +328,10 @@ class Client:
             # the mesh deadline: minted once from the caller's timeout,
             # forwarded absolute by every hop (protocol.HDR_DEADLINE)
             headers[protocol.HDR_DEADLINE] = protocol.format_deadline(deadline)
+        if attempt:
+            # failure recovery (ISSUE 9): "failover" | "hedge" — this
+            # placement only, counted by the serving agent's advert
+            headers[protocol.HDR_ATTEMPT] = attempt
         try:
             await self.mesh.publish(
                 target_topic,
@@ -406,6 +427,7 @@ class AgentGateway(Generic[OutputT]):
         route: str = "run",
         timeout: float | None = None,
         exclude_replicas: "frozenset[str]" = frozenset(),
+        mark: "str | None" = None,
     ) -> InvocationHandle[OutputT]:
         """Begin a run; returns a handle (reference: gateway.py:70).
 
@@ -417,8 +439,11 @@ class AgentGateway(Generic[OutputT]):
         ``exclude_replicas`` (fleet-routed clients only) bars specific
         replica instances from this placement — the shed-retry loop in
         :meth:`execute` passes the instances that already refused.  The
-        placement lands on ``handle.routed_replica`` (None = shared
-        topic)."""
+        placement lands on ``handle.routed_replica`` /
+        ``handle.routed_replica_key`` (None = shared topic).  ``mark``
+        stamps the call's ``x-mesh-attempt`` header ("failover" |
+        "hedge", ISSUE 9) so the serving replica's advert counts
+        recovery arrivals."""
         client = self._client
         await client._ensure_started()
         correlation_id = new_id()
@@ -459,6 +484,7 @@ class AgentGateway(Generic[OutputT]):
             task_registry=client._cancel_tasks,
         )
         handle.routed_replica = routed_replica
+        handle.routed_replica_key = routed.key if routed is not None else None
         router = client.router if routed is not None else None
         if router is not None:
             # least-request accounting, keyed by the FULL replica key
@@ -483,6 +509,7 @@ class AgentGateway(Generic[OutputT]):
                 state=self._build_state(message_history),
                 deps=deps or {},
                 deadline=deadline,
+                attempt=mark,
             )
         except BaseException:
             # the call never reached the mesh: no terminal will resolve,
@@ -518,6 +545,7 @@ class AgentGateway(Generic[OutputT]):
         route: str = "run",
         timeout: float | None = None,
         retry: "RetryPolicy | None" = None,
+        failover: "FailoverPolicy | None" = None,
     ) -> InvocationResult[OutputT]:
         """Run to a typed result.  With a :class:`RetryPolicy` (here or on
         the client), faults typed retriable — overload sheds, draining
@@ -529,8 +557,29 @@ class AgentGateway(Generic[OutputT]):
         DIFFERENT replica: the shed source's instance id is excluded from
         every subsequent attempt's placement (ISSUE 7), so a retry storm
         spreads across the fleet instead of hammering the replica that
-        just refused."""
+        just refused.
+
+        With a :class:`~calfkit_tpu.fleet.failover.FailoverPolicy` (here
+        or on the client) on a fleet-routed client, the call is
+        additionally SUPERVISED in flight (ISSUE 9): the placed replica's
+        health is probed while awaiting the terminal, a dead placement
+        (heartbeat lapsed, advert gone, unready without drain) is
+        re-dispatched to a surviving replica under the REMAINING deadline
+        with the old correlation cancel-tombstoned, and an optional
+        ``hedge_after`` races a duplicate on a second replica — first
+        terminal wins, the loser is cancelled."""
         policy = retry if retry is not None else self._client.retry
+        fo = failover if failover is not None else self._client.failover
+        if fo is not None and self._client.router is not None:
+            return await self._execute_failover(
+                prompt,
+                message_history=message_history,
+                deps=deps,
+                route=route,
+                timeout=timeout,
+                policy=policy,
+                failover=fo,
+            )
         attempts = policy.attempts if policy is not None else 1
         last: BaseException | None = None
         shed_sources: set[str] = set()
@@ -561,3 +610,430 @@ class AgentGateway(Generic[OutputT]):
                     shed_sources.add(handle.routed_replica)
         assert last is not None
         raise last
+
+    # ================================================== failure recovery
+    # (ISSUE 9; laws in calfkit_tpu/fleet/failover.py, docs/robustness.md
+    # "Failure recovery")
+
+    @staticmethod
+    async def _first_terminal(
+        handles: "list[InvocationHandle]", timeout: float
+    ) -> "InvocationHandle | None":
+        """Park until the FIRST of ``handles`` lands a terminal, or
+        ``timeout`` (one probe tick) elapses — whichever is sooner.
+        Returns the finished handle, or None on a quiet tick."""
+        for handle in handles:
+            if handle.terminal_arrived:
+                return handle
+        waiters = [
+            asyncio.ensure_future(h.wait(timeout)) for h in handles
+        ]
+        try:
+            await asyncio.wait(waiters, return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            for w in waiters:
+                w.cancel()
+        for handle in handles:
+            if handle.terminal_arrived:
+                return handle
+        return None
+
+    async def _await_placement(
+        self,
+        exclude: "frozenset[str]",
+        *,
+        probe_interval: float,
+        remaining: "Callable[[], float | None]",
+    ) -> None:
+        """Park until the router can place a call on SOME eligible
+        replica outside ``exclude`` (a dead fleet usually means one
+        heartbeat interval of waiting — a replica re-advertises or a
+        fresh one boots), bounded by the remaining budget."""
+        router = self._client.router
+        while router.select(self.name, exclude=exclude) is None:
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                raise ClientTimeoutError(
+                    "no eligible replica for the failover re-dispatch "
+                    "within the remaining budget"
+                )
+            await asyncio.sleep(
+                probe_interval if rem is None else min(probe_interval, rem)
+            )
+
+    def _no_placement_fault(self, reason: str) -> NodeFaultError:
+        """The typed, RETRIABLE fault raised when a run keeps losing its
+        placements past the failover budget: the fleet cannot currently
+        hold this call — the caller may back off and try again."""
+        return NodeFaultError(
+            ErrorReport.build_safe(
+                FaultTypes.CAPABILITY_UNAVAILABLE,
+                f"run lost its placement ({reason}) and the failover "
+                "budget is spent; the fleet cannot hold this call "
+                "right now",
+            )
+        )
+
+    async def _execute_failover(
+        self,
+        prompt: str | list[ContentPart],
+        *,
+        message_history: list[ModelMessage] | None,
+        deps: dict[str, Any] | None,
+        route: str,
+        timeout: float | None,
+        policy: "RetryPolicy | None",
+        failover: "FailoverPolicy",
+    ) -> InvocationResult[OutputT]:
+        """The supervised execute: one absolute budget, N placements.
+
+        The loop holds one PRIMARY handle (plus at most one HEDGE) and
+        alternates between waiting for a terminal and probing each
+        outstanding placement against the dead-placement law.  Every
+        re-dispatch runs under the REMAINING budget (the mesh deadline is
+        absolute), a fresh correlation id, and the accumulated exclusion
+        set (shed sources AND dead replicas — one set, so a failover
+        never re-picks a replica that already refused, and a shed retry
+        never lands on a corpse)."""
+        client = self._client
+        router = client.router
+        effective = timeout if timeout is not None else client.default_timeout
+        deadline = (
+            cancellation.wall_clock() + effective
+            if effective is not None else None
+        )
+        exclude: set[str] = set()
+        failovers = 0
+        fault_attempts = 1  # terminals consumed (the original counts)
+        max_fault_attempts = max(1, policy.attempts) if policy else 1
+
+        def remaining() -> "float | None":
+            if deadline is None:
+                return None
+            return deadline - cancellation.wall_clock()
+
+        async def dispatch(
+            mark: "str | None",
+            extra_exclude: "frozenset[str]" = frozenset(),
+        ) -> InvocationHandle[OutputT]:
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                raise ClientTimeoutError(
+                    f"budget spent after {failovers} failover(s); "
+                    "no terminal reply"
+                )
+            if mark is not None:
+                # a failover/hedge re-dispatch must NOT fail open to the
+                # shared topic: the shared consumer group may still count
+                # the corpse as a member (a dead consumer holds its
+                # partitions until the broker's session timeout), which is
+                # exactly the blackhole failover exists to escape.  Wait —
+                # within the remaining budget — for an eligible replica.
+                await self._await_placement(
+                    frozenset(exclude | set(extra_exclude)),
+                    probe_interval=failover.probe_interval,
+                    remaining=remaining,
+                )
+            return await self.start(
+                prompt,
+                message_history=message_history,
+                deps=deps,
+                route=route,
+                timeout=remaining(),
+                exclude_replicas=frozenset(exclude | set(extra_exclude)),
+                mark=mark,
+            )
+
+        primary = await dispatch(None)
+        dispatched_at = cancellation.wall_clock()
+        hedge: "InvocationHandle[OutputT] | None" = None
+        hedged = False  # at most one hedge per call
+
+        while True:
+            live = [h for h in (primary, hedge) if h is not None]
+            winner = await self._first_terminal(live, failover.probe_interval)
+
+            if winner is not None:
+                loser = hedge if winner is primary else primary
+                try:
+                    result = await winner.result()
+                except NodeFaultError as exc:
+                    if policy is None or not RetryPolicy.retriable(exc):
+                        if loser is not None and loser is not winner:
+                            await loser.cancel()
+                        raise
+                    if winner.routed_replica is not None:
+                        exclude.add(winner.routed_replica)
+                    if loser is not None and loser is not winner:
+                        # the duplicate may still answer: promote it and
+                        # keep supervising instead of burning a retry
+                        primary, hedge = loser, None
+                        continue
+                    fault_attempts += 1
+                    if fault_attempts > max_fault_attempts:
+                        raise
+                    await asyncio.sleep(policy.delay(fault_attempts - 2))
+                    primary = await dispatch(None)
+                    dispatched_at = cancellation.wall_clock()
+                    hedge = None
+                    continue
+                if loser is not None and loser is not winner:
+                    # first terminal wins: cancel the duplicate through
+                    # the ordinary cancel propagation (tombstone included
+                    # — a zombie cannot execute the losing correlation)
+                    await loser.cancel()
+                return result
+
+            # ---- quiet probe tick: budget, then placement health
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                for h in live:
+                    h._cancel_soon()
+                raise ClientTimeoutError(
+                    f"run produced no terminal reply within {effective}s "
+                    f"({failovers} failover(s) attempted)"
+                )
+            if hedge is not None and hedge.routed_replica_key is not None:
+                if router.placement_verdict(hedge.routed_replica_key) != "alive":
+                    # a dead hedge is simply dropped (and its correlation
+                    # tombstoned) — the primary is still supervised
+                    if hedge.routed_replica is not None:
+                        exclude.add(hedge.routed_replica)
+                    await hedge.cancel()
+                    hedge = None
+            if primary.routed_replica_key is not None:
+                verdict = router.placement_verdict(primary.routed_replica_key)
+                if verdict != "alive":
+                    # dead placement: tombstone the orphaned correlation
+                    # FIRST (a zombie that resumes consuming must fault
+                    # the old call at its admission gate), then exclude
+                    # the corpse and re-dispatch under what's left
+                    if primary.routed_replica is not None:
+                        exclude.add(primary.routed_replica)
+                    await primary.cancel()
+                    if hedge is not None:
+                        # the duplicate is already running elsewhere:
+                        # promote it instead of spending a failover
+                        primary, hedge = hedge, None
+                        dispatched_at = cancellation.wall_clock()
+                        continue
+                    failovers += 1
+                    if failovers > failover.max_failovers:
+                        raise self._no_placement_fault(verdict)
+                    primary = await dispatch("failover")
+                    dispatched_at = cancellation.wall_clock()
+                    continue
+            # ---- tail-latency hedge (execute() only): race a duplicate
+            if (
+                not hedged
+                and failover.hedge_after is not None
+                and cancellation.wall_clock() - dispatched_at
+                >= failover.hedge_after
+                and primary.routed_replica is not None
+                and router.select(
+                    self.name,
+                    exclude=frozenset(exclude | {primary.routed_replica}),
+                ) is not None
+            ):
+                hedged = True
+                hedge = await dispatch(
+                    "hedge",
+                    extra_exclude=frozenset({primary.routed_replica}),
+                )
+
+    def _filter_step(
+        self, event: "StepEvent", ledger: "StreamLedger"
+    ) -> "StepEvent | None":
+        """Apply the stream-resume dedupe law to one step event: token
+        steps pass through the ledger (suppressing the replayed prefix
+        after a failover); None = fully-replayed, drop it.  Non-token
+        steps pass through unchanged — they carry no offsets to dedupe
+        on, so a failover may repeat them (documented)."""
+        step = event.step
+        if getattr(step, "kind", "") != "token":
+            return event
+        text = ledger.filter(step.text)
+        if not text:
+            return None
+        if text != step.text:
+            return event.model_copy(
+                update={"step": step.model_copy(update={"text": text})}
+            )
+        return event
+
+    async def stream(
+        self,
+        prompt: str | list[ContentPart],
+        *,
+        message_history: list[ModelMessage] | None = None,
+        deps: dict[str, Any] | None = None,
+        route: str = "run",
+        timeout: float | None = None,
+        failover: "FailoverPolicy | None" = None,
+    ) -> "AsyncIterator[Any]":
+        """Stream a run's step events live, ending with the typed result
+        — ``handle.stream()`` with in-flight failure recovery (ISSUE 9).
+
+        On a fleet-routed client with a FailoverPolicy, the placement is
+        supervised while streaming: when the placed replica dies
+        mid-stream (or faults typed-retriable), the call is re-issued as
+        a continuation on a surviving replica — same prompt (it rides
+        the prefix cache there), remaining deadline, old correlation
+        cancel-tombstoned, ``deps["calfkit.resume_text"]`` carrying the
+        already-delivered text — and the replayed token prefix is
+        suppressed so the caller observes ONE contiguous stream (the
+        :class:`~calfkit_tpu.fleet.failover.StreamLedger` law).  Without
+        a policy (or a router) this is plain ``start()+stream()``."""
+        client = self._client
+        fo = failover if failover is not None else client.failover
+        if fo is None or client.router is None:
+            handle = await self.start(
+                prompt, message_history=message_history, deps=deps,
+                route=route, timeout=timeout,
+            )
+            async for item in handle.stream():
+                yield item
+            return
+        from calfkit_tpu.fleet.failover import StreamLedger
+
+        router = client.router
+        ledger = StreamLedger()
+        effective = timeout if timeout is not None else client.default_timeout
+        deadline = (
+            cancellation.wall_clock() + effective
+            if effective is not None else None
+        )
+
+        def remaining() -> "float | None":
+            if deadline is None:
+                return None
+            return deadline - cancellation.wall_clock()
+
+        exclude: set[str] = set()
+        failovers = 0
+        handle = await self.start(
+            prompt, message_history=message_history, deps=deps,
+            route=route, timeout=effective,
+        )
+        while True:
+            dead_reason: "str | None" = None
+            pending_exc: "NodeFaultError | None" = None
+            channel = handle._channel
+            step_task: asyncio.Task = asyncio.ensure_future(
+                channel.steps.get()
+            )
+            try:
+                while dead_reason is None:
+                    rem = remaining()
+                    if rem is not None and rem <= 0:
+                        handle._cancel_soon()
+                        raise ClientTimeoutError(
+                            f"stream produced no terminal within "
+                            f"{effective}s ({failovers} failover(s))"
+                        )
+                    tick = (
+                        fo.probe_interval if rem is None
+                        else min(fo.probe_interval, rem)
+                    )
+                    done, _ = await asyncio.wait(
+                        [step_task, channel.terminal],
+                        timeout=tick,
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                    if step_task in done:
+                        event = self._filter_step(
+                            step_task.result(), ledger
+                        )
+                        if event is not None:
+                            yield event
+                        step_task = asyncio.ensure_future(
+                            channel.steps.get()
+                        )
+                        continue
+                    if channel.terminal.done():
+                        while not channel.steps.empty():
+                            event = self._filter_step(
+                                channel.steps.get_nowait(), ledger
+                            )
+                            if event is not None:
+                                yield event
+                        try:
+                            yield await handle.result()
+                        except NodeFaultError as exc:
+                            if not RetryPolicy.retriable(exc):
+                                raise
+                            # a retriable fault ends THIS attempt, not
+                            # the stream: re-dispatch and resume
+                            dead_reason = (
+                                f"fault:{exc.report.error_type}"
+                            )
+                            pending_exc = exc
+                            continue
+                        return
+                    # quiet tick: probe the placement
+                    if handle.routed_replica_key is not None:
+                        verdict = router.placement_verdict(
+                            handle.routed_replica_key
+                        )
+                        if verdict != "alive":
+                            dead_reason = verdict
+            finally:
+                step_task.cancel()
+            # ---- failover re-dispatch (dead placement / retriable fault)
+            failovers += 1
+            if failovers > fo.max_failovers:
+                if pending_exc is not None:
+                    raise pending_exc
+                raise self._no_placement_fault(dead_reason or "unknown")
+            if handle.routed_replica is not None:
+                exclude.add(handle.routed_replica)
+            # tombstone the orphan BEFORE the replacement publishes: a
+            # zombie that resumes consuming faults the old correlation
+            # at its admission gate instead of executing it
+            await handle.cancel()
+            ledger.begin_attempt()
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                if pending_exc is not None:
+                    raise pending_exc
+                raise ClientTimeoutError(
+                    f"stream placement died ({dead_reason}) with no "
+                    "budget left to re-dispatch"
+                )
+            if pending_exc is None:
+                # DEATH re-dispatch: never fail open to the shared topic
+                # — the shared group may still count the corpse as a
+                # member — wait for an eligible replica instead
+                await self._await_placement(
+                    frozenset(exclude),
+                    probe_interval=fo.probe_interval,
+                    remaining=remaining,
+                )
+            else:
+                # FAULT re-dispatch: the replica is alive and answering
+                # (it shed/wedged us, typed) — a brief backoff, then
+                # fail-open placement is SAFE and required: on a fleet
+                # with no alternative replica, waiting on the exclusion
+                # set would burn the whole deadline for a transient shed
+                # that the shared topic (or the same replica, recovered)
+                # can absorb in milliseconds
+                rem = remaining()
+                await asyncio.sleep(
+                    fo.probe_interval if rem is None
+                    else min(fo.probe_interval, max(rem, 0.0))
+                )
+            resume_deps = dict(deps or {})
+            if ledger.text:
+                # the continuation hint: prompt + already-delivered text
+                # (agents MAY seed generation with it; the dedupe ledger
+                # guarantees contiguity either way)
+                resume_deps["calfkit.resume_text"] = ledger.text
+            handle = await self.start(
+                prompt,
+                message_history=message_history,
+                deps=resume_deps,
+                route=route,
+                timeout=remaining(),
+                exclude_replicas=frozenset(exclude),
+                mark="failover",
+            )

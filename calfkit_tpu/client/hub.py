@@ -79,6 +79,10 @@ class InvocationHandle(Generic[OutputT]):
     # fleet routing (ISSUE 7): the replica instance id this run was
     # placed on, set by AgentGateway.start; None = shared-topic placement
     routed_replica: "str | None" = None
+    # the FULL control-plane replica key ("<node_id>@<instance>") of the
+    # placement — what the failover supervisor's dead-placement probe
+    # looks up in the registry (ISSUE 9); None = shared-topic placement
+    routed_replica_key: "str | None" = None
 
     def __init__(
         self,
@@ -147,6 +151,26 @@ class InvocationHandle(Generic[OutputT]):
         if self._task_registry is not None:
             self._task_registry.add(task)
             task.add_done_callback(self._task_registry.discard)
+
+    @property
+    def terminal_arrived(self) -> bool:
+        """True once the run's terminal reply (return OR fault) landed."""
+        return self._channel.terminal.done()
+
+    async def wait(self, timeout: "float | None") -> bool:
+        """Await the terminal for up to ``timeout`` seconds WITHOUT
+        consuming it or publishing a cancel on expiry — the failover
+        supervisor's probe primitive (ISSUE 9): returns True once the
+        terminal landed, False on a quiet timeout (the run is still
+        in flight; call :meth:`result` to consume, :meth:`cancel` to
+        abandon)."""
+        try:
+            await asyncio.wait_for(
+                asyncio.shield(self._channel.terminal), timeout
+            )
+            return True
+        except asyncio.TimeoutError:
+            return False
 
     async def result(self, timeout: float | None = None) -> InvocationResult[OutputT]:
         """Await the terminal reply; faults raise :class:`NodeFaultError`.

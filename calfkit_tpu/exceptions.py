@@ -33,6 +33,7 @@ __all__ = [
     "ProvisioningError",
     "InferenceError",
     "EngineOverloadedError",
+    "EngineWedgedError",
     "DeadlineExceededError",
     "RunCancelledError",
     "FAULT_TYPE_BY_EXCEPTION",
@@ -126,6 +127,22 @@ class EngineOverloadedError(CalfkitError):
         super().__init__(message)
 
 
+class EngineWedgedError(CalfkitError):
+    """The engine's dispatch-progress watchdog tripped (ISSUE 9): work was
+    pending but no dispatch landed for ``RuntimeConfig.watchdog_stall_s``
+    — the BENCH-documented "wedged device grant" state.  Requests caught
+    in (or queued behind) the wedge are faulted with this instead of
+    silently burning their deadlines.  Typed and RETRIABLE by contract:
+    the caller observed no tokens from this engine, so the same call may
+    run whole on another replica — the fleet gateway's failover path
+    treats it exactly like a shed.
+    """
+
+    def __init__(self, message: str, *, stalled_s: float = 0.0):
+        self.stalled_s = stalled_s
+        super().__init__(message)
+
+
 class DeadlineExceededError(CalfkitError, TimeoutError):
     """The request's absolute deadline (``x-mesh-deadline``) passed.
 
@@ -156,6 +173,7 @@ class RunCancelledError(CalfkitError):
 
 FAULT_TYPE_BY_EXCEPTION: dict[type[BaseException], str] = {
     EngineOverloadedError: FaultTypes.OVERLOADED,
+    EngineWedgedError: FaultTypes.WEDGED,
     DeadlineExceededError: FaultTypes.DEADLINE_EXCEEDED,
     RunCancelledError: FaultTypes.CANCELLED,
     ClientTimeoutError: FaultTypes.TIMEOUT,
@@ -176,6 +194,10 @@ RETRIABLE_FAULT_TYPES: frozenset[str] = frozenset(
         FaultTypes.OVERLOADED,
         FaultTypes.TIMEOUT,
         FaultTypes.CAPABILITY_UNAVAILABLE,
+        # a wedge fault means NOTHING reached the caller from this engine
+        # (the watchdog faults before any terminal): the call is whole and
+        # another replica can serve it — failover territory (ISSUE 9)
+        FaultTypes.WEDGED,
     }
 )
 

@@ -10,7 +10,10 @@ Layers:
 - :mod:`calfkit_tpu.fleet.policy` — the routing-policy seam
   (least-loaded, power-of-two-choices, prefix-affinity, random);
 - :mod:`calfkit_tpu.fleet.router` — registry + policy → one topic per
-  call, shared-topic fail-open.
+  call, shared-topic fail-open;
+- :mod:`calfkit_tpu.fleet.failover` — in-flight failure recovery
+  (ISSUE 9): the dead-placement law, the caller's failover/hedge
+  policy, and the stream-resume dedupe ledger.
 
 Re-exports are LAZY (mirroring ``calfkit_tpu/__init__``): the mesh
 dispatcher imports ``fleet.selection`` for its lane law, and that import
@@ -29,6 +32,9 @@ from importlib import import_module
 from typing import TYPE_CHECKING, Any
 
 _LAZY: dict[str, str] = {
+    "FailoverPolicy": "calfkit_tpu.fleet.failover",
+    "StreamLedger": "calfkit_tpu.fleet.failover",
+    "placement_verdict": "calfkit_tpu.fleet.failover",
     "FleetRouter": "calfkit_tpu.fleet.router",
     "Route": "calfkit_tpu.fleet.router",
     "LeastLoaded": "calfkit_tpu.fleet.policy",
@@ -48,6 +54,11 @@ _LAZY: dict[str, str] = {
 __all__ = sorted(_LAZY)
 
 if TYPE_CHECKING:  # pragma: no cover
+    from calfkit_tpu.fleet.failover import (
+        FailoverPolicy,
+        StreamLedger,
+        placement_verdict,
+    )
     from calfkit_tpu.fleet.policy import (
         LeastLoaded,
         PowerOfTwoChoices,

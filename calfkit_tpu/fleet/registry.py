@@ -169,14 +169,18 @@ class ReplicaRegistry:
         self.stale_after = stale_after
         self._catchup_timeout = catchup_timeout
         self._started = False
-        # parsed-replica cache keyed on a cheap fingerprint of the raw
-        # table bytes: the table only changes once per heartbeat tick,
-        # but routing reads it per CALL — re-running pydantic validation
-        # per replica per pick would put JSON decode on the exact path
-        # lint_hotpath guards.  crc32 over keys+values is ~100x cheaper
-        # than the parse and detects every heartbeat rewrite.
-        self._cache_fp: "int | None" = None
+        # parsed-replica cache keyed on a cheap fingerprint: the table
+        # only changes once per heartbeat tick, but routing reads it per
+        # CALL — re-running pydantic validation per replica per pick
+        # would put JSON decode on the exact path lint_hotpath guards.
+        # Readers that maintain a mutation version counter (all in-repo
+        # transports do — ISSUE 9 satellite) make the no-change case a
+        # single int compare, O(1) in table size; readers without one
+        # fall back to the crc32 byte scan (~100x cheaper than the
+        # parse, but still O(table bytes) per pick).
+        self._cache_fp: "tuple | None" = None
         self._cache: "list[Replica]" = []
+        self._cache_by_key: "dict[str, Replica]" = {}
 
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
@@ -200,13 +204,25 @@ class ReplicaRegistry:
 
     # --------------------------------------------------------------- reads
     def _parsed(self) -> "list[Replica]":
-        items = self._reader.items()
-        fp = 0
-        for key, value in items.items():
-            fp = zlib.crc32(value, zlib.crc32(key.encode("utf-8"), fp))
-        fp = (fp << 1) | 1 if items else 0  # empty table ≠ crc seed 0
+        version = self._reader.version
+        if version is not None:
+            # O(1) no-change fast path: the reader bumps its version on
+            # every view mutation, so an unchanged table is one int
+            # compare — no byte scan, no items() dict copy
+            fp: tuple = ("v", version)
+            if fp == self._cache_fp:
+                return self._cache
+            items = self._reader.items()
+        else:
+            items = self._reader.items()
+            crc = 0
+            for key, value in items.items():
+                crc = zlib.crc32(value, zlib.crc32(key.encode("utf-8"), crc))
+            # empty table ≠ crc seed 0
+            fp = ("crc", (crc << 1) | 1 if items else 0)
         if fp != self._cache_fp:
             self._cache = parse_replicas(items)
+            self._cache_by_key = {r.key: r for r in self._cache}
             self._cache_fp = fp
         return self._cache
 
@@ -227,6 +243,14 @@ class ReplicaRegistry:
         # never hand out the cache list itself: a caller-side sort/append
         # would poison every later read
         return list(out) if out is self._cache else out
+
+    def replica(self, key: str) -> "Replica | None":
+        """One replica by its full ``<node_id>@<instance>`` key, or None
+        when its record left the table (tombstoned, compacted away).  The
+        failover supervisor's per-probe lookup — O(1) off the parsed
+        cache (ISSUE 9)."""
+        self._parsed()
+        return self._cache_by_key.get(key)
 
     def eligible(
         self,

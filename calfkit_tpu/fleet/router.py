@@ -136,6 +136,24 @@ class FleetRouter:
         entries = self._inflight.get(replica_key)
         return len(entries) if entries else 0
 
+    # ---------------------------------------------- dead-placement law
+    def placement_verdict(self, replica_key: str) -> str:
+        """Is a run placed on ``replica_key`` still being served (ISSUE
+        9)?  ``"alive"``, or ``"dead:gone"`` / ``"dead:stale"`` /
+        ``"dead:unready"`` per :func:`calfkit_tpu.fleet.failover.
+        placement_verdict`.  Fail-SAFE, not fail-open: with no registry
+        view (router never started, directory down) the verdict is
+        ``"alive"`` — failover must never fire on blindness, only on
+        positive evidence of death."""
+        if not self._started:
+            return "alive"
+        from calfkit_tpu.fleet.failover import placement_verdict
+
+        return placement_verdict(
+            self.registry.replica(replica_key),
+            stale_after=self.registry.stale_after,
+        )
+
     # ------------------------------------------------------------ lifecycle
     async def start(self) -> None:
         if self._started:

@@ -287,6 +287,15 @@ class JaxLocalModelClient(ModelClient):
             return False, "engine not built (weights not loaded)"
         if not getattr(engine, "_running", False):
             return False, "engine not started"
+        if getattr(engine, "_wedged", False):
+            # the dispatch-progress watchdog tripped (ISSUE 9): the engine
+            # is alive but the device grant is hung — /readyz flips false
+            # and the heartbeat advert follows, so routers place nothing
+            # new here and outstanding placements are declared dead
+            return False, (
+                "engine wedged: no dispatch progress for "
+                f"{engine.runtime.watchdog_stall_s:.1f}s with work pending"
+            )
         return True, "engine running"
 
     def stats_snapshot(self, *, window: bool = False) -> dict:
@@ -337,6 +346,10 @@ class JaxLocalModelClient(ModelClient):
                 "cancelled_requests": 0,
                 "cancel_propagated": 0,
                 "delivery_stalled": 0,
+                # wedge watchdog (ISSUE 9): same key set as the live branch
+                "wedged": False,
+                "watchdog_trips": 0,
+                "watchdog_faulted": 0,
                 "flightrec": {"appended": 0, "dropped": 0, "dumped": 0},
             }
         import jax
@@ -382,6 +395,12 @@ class JaxLocalModelClient(ModelClient):
             "cancelled_requests": stats.cancelled_requests,
             "cancel_propagated": stats.cancel_propagated,
             "delivery_stalled": stats.delivery_stalled,
+            # wedge watchdog (ISSUE 9): whether the dispatch-progress
+            # watchdog currently declares the engine wedged (the advert's
+            # ready flag follows it) plus its lifetime trip/fault counts
+            "wedged": engine._wedged,
+            "watchdog_trips": stats.watchdog_trips,
+            "watchdog_faulted": stats.watchdog_faulted,
             # flight-recorder ring accounting: overflow (dropped) must be
             # an observable signal, never silent truncation
             "flightrec": engine._journal.counts(),

@@ -193,6 +193,20 @@ class RuntimeConfig:
     # counter; the consumer sees a typed EngineOverloadedError when it
     # finally resumes).  0 = unbounded.
     max_out_blocks: int = 0
+    # engine wedge watchdog (ISSUE 9): with work pending, no dispatch
+    # landing for this many seconds (on the cancellation.wall_clock seam)
+    # declares the engine WEDGED — the BENCH r05 "hung device grant"
+    # state, where the decode thread blocks inside a device sync forever
+    # and the scheduler loop with it.  Tripping dumps the flight
+    # recorder, flips readiness (and the heartbeat advert) false, and
+    # faults every pending request with a typed RETRIABLE
+    # EngineWedgedError so callers fail over instead of burning their
+    # deadlines.  If a landing ever arrives after the trip, the engine
+    # un-wedges and resumes serving.  0 = off (the default: a first
+    # dispatch legitimately blocks for a whole XLA compile, which can
+    # take minutes on cold caches — enable with a threshold comfortably
+    # above your worst compile time, or after warmup).
+    watchdog_stall_s: float = 0.0
     # flight recorder: capacity (events) of the engine's in-memory ring
     # journal of scheduler events (admission, waves, page alloc/free,
     # spec/overlap dispatches, retirement, faults).  Rounds up to a power

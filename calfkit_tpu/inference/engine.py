@@ -43,6 +43,7 @@ from calfkit_tpu.inference import ragged as ragged_math
 from calfkit_tpu.exceptions import (
     DeadlineExceededError,
     EngineOverloadedError,
+    EngineWedgedError,
     InferenceError,
 )
 from calfkit_tpu.inference import model as M
@@ -314,6 +315,10 @@ class GenRequest:
     deadline: "float | None" = None
     expired: bool = False
     stalled: bool = False
+    # the dispatch-progress watchdog faulted this request (ISSUE 9): the
+    # consumer's _consume raises a typed RETRIABLE EngineWedgedError so
+    # the caller fails over to another replica instead of timing out
+    wedged: bool = False
     # back-pointer into _deadline_heap so a FINISHED request's entry can
     # be nulled immediately (_drop_deadline) instead of strongly holding
     # the prompt/history/queue until the deadline lazily pops — minutes
@@ -379,6 +384,12 @@ class EngineStats:
     # participants — mean_occupancy IS the unified-wave fill metric.
     prefill_absorbed_tokens: int = 0
     unified_dispatches: int = 0
+    # engine wedge watchdog (ISSUE 9): how many times the dispatch-
+    # progress watchdog declared the engine wedged, and how many requests
+    # it faulted with the typed retriable EngineWedgedError (so callers
+    # failed over instead of burning their deadlines)
+    watchdog_trips: int = 0
+    watchdog_faulted: int = 0
     # snapshot_and_delta state: the previous window's counter values +
     # timestamp.  Single-consumer by design (the heartbeat advert) — two
     # delta readers would steal each other's intervals.
@@ -393,6 +404,7 @@ class EngineStats:
         "shed_requests", "expired_requests", "cancelled_requests",
         "cancel_propagated", "delivery_stalled",
         "prefill_absorbed_tokens", "unified_dispatches",
+        "watchdog_trips", "watchdog_faulted",
     )
 
     def counters(self) -> dict:
@@ -746,6 +758,20 @@ class InferenceEngine:
         self._wake = asyncio.Event()
         self._task: asyncio.Task[None] | None = None
         self._running = False
+        # engine wedge watchdog (ISSUE 9): a separate event-loop task —
+        # the serve loop itself blocks inside asyncio.to_thread when a
+        # device grant wedges, which is exactly the state the watchdog
+        # exists to detect.  ``_progress_at`` is stamped (wall_clock seam,
+        # so the chaos virtual clock drives it) at every dispatch/wave
+        # LANDING; with work pending and no stamp for watchdog_stall_s
+        # the engine is declared wedged: journal dump, readiness false,
+        # every pending request faulted typed-retriable.  A later landing
+        # un-wedges (the stuck requests were already cancelled; the
+        # ordinary reap frees their resources).
+        self._wedged = False
+        self._wedged_at = 0.0
+        self._progress_at = cancellation.wall_clock()
+        self._watchdog_task: asyncio.Task[None] | None = None
         self.stats = EngineStats()
         # flight recorder: the ring journal every scheduler decision point
         # appends to (admission, waves, page alloc/free, spec/overlap
@@ -1365,10 +1391,22 @@ class InferenceEngine:
         # or signal-less platforms simply skip; recording still works)
         flightrec.install_sigusr2()
         self._task = self._loop.create_task(self._serve(), name="inference-engine")
+        if self.runtime.watchdog_stall_s > 0:
+            self._progress_at = cancellation.wall_clock()
+            self._watchdog_task = self._loop.create_task(
+                self._watchdog(), name="inference-engine-watchdog"
+            )
 
     async def stop(self) -> None:
         self._running = False
         self._wake.set()
+        if self._watchdog_task is not None:
+            self._watchdog_task.cancel()
+            try:
+                await self._watchdog_task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._watchdog_task = None
         if self._task is not None:
             try:
                 await asyncio.wait_for(self._task, timeout=30)
@@ -1440,6 +1478,17 @@ class InferenceEngine:
         """
         if not self._running:
             raise InferenceError("engine not started")
+        if self._wedged:
+            # fast typed rejection while wedged: admitting work behind a
+            # hung device grant would only grow the pile the watchdog
+            # just faulted — callers should be failing over
+            self.stats.watchdog_faulted += 1
+            raise EngineWedgedError(
+                "engine is wedged (no dispatch progress for "
+                f"{self.runtime.watchdog_stall_s:.1f}s with work pending); "
+                "retry against another replica",
+                stalled_s=self.runtime.watchdog_stall_s,
+            )
         if deadline is not None:
             overdue = cancellation.wall_clock() - deadline
             if overdue >= 0:
@@ -1672,6 +1721,110 @@ class InferenceEngine:
                 request.out.qsize(),
             )
 
+    # ------------------------------------------------- wedge watchdog
+    def _note_progress(self) -> None:
+        """A dispatch/wave actually LANDED (device produced output and the
+        host observed it) — the watchdog's progress signal.  Called from
+        the decode thread and the serve loop; a bare float store, so no
+        lock.  Reads the wall_clock seam: the chaos virtual clock drives
+        wedge detection deterministically."""
+        self._progress_at = cancellation.wall_clock()
+
+    def _watchdog_requests(self) -> "list[GenRequest]":
+        """Every request the engine currently owes an outcome: active
+        slots, queued lanes, mid-admission prefills, the inflight chunked
+        wave, and the long lane."""
+        out: list[GenRequest] = [
+            *self._active.values(), *self._carry, *self._pending,
+            *self._admitting, *self._long_pending,
+        ]
+        if self._inflight is not None:
+            out.extend(self._inflight["wave"])
+        if self._long is not None:
+            out.append(self._long["request"])
+        if self._long_inflight is not None:
+            out.append(self._long_inflight["request"])
+        return out
+
+    def _work_pending(self) -> bool:
+        return bool(
+            self._active or self._pending or self._carry
+            or self._admitting or self._inflight is not None
+            or self._pend is not None or self._long is not None
+            or self._long_inflight is not None or self._long_pending
+        )
+
+    async def _watchdog(self) -> None:
+        """Dispatch-progress watchdog (ISSUE 9): its OWN task because the
+        state it detects — a device grant that never returns — blocks the
+        serve loop inside asyncio.to_thread, so no in-loop check can ever
+        run.  Polls on real time; measures the stall on the wall_clock
+        seam (deterministic under the chaos virtual clock)."""
+        threshold = self.runtime.watchdog_stall_s
+        interval = max(0.01, min(threshold / 4.0, 0.25))
+        while self._running:
+            await asyncio.sleep(interval)
+            now = cancellation.wall_clock()
+            if self._wedged:
+                if self._progress_at > self._wedged_at:
+                    # the grant came back: resume serving.  The faulted
+                    # requests were flagged cancelled at the trip, so the
+                    # ordinary reap frees their slots/pages on the very
+                    # pass that just landed.
+                    self._wedged = False
+                    logger.warning(
+                        "engine un-wedged: a dispatch landed after the "
+                        "watchdog tripped; serving resumes"
+                    )
+                continue
+            if not self._work_pending():
+                # idle is not a stall: re-anchor so the next submit starts
+                # its stall clock from now, not from the last busy period
+                self._progress_at = now
+                continue
+            if now - self._progress_at >= threshold:
+                self._trip_wedge(now - self._progress_at)
+
+    def _trip_wedge(self, stalled_s: float) -> None:
+        """Declare the engine wedged: journal + dump the flight recorder
+        (the postmortem IS the decision sequence that led here), flip the
+        readiness signal, and fault every owed request with the typed
+        RETRIABLE EngineWedgedError so callers fail over NOW instead of
+        burning the rest of their deadlines.  Requests are also flagged
+        cancelled: if the wedge ever clears, the ordinary cancellation
+        reap reclaims their slots/pages — nothing is freed here, because
+        an in-flight dispatch may still write through them."""
+        self._wedged = True
+        self._wedged_at = cancellation.wall_clock()
+        self.stats.watchdog_trips += 1
+        requests = self._watchdog_requests()
+        self._journal.append(
+            flightrec.EV_WEDGE, None, -1, int(stalled_s * 1000),
+            len(requests),
+        )
+        try:
+            path = self._journal.dump(reason="wedge")
+            logger.error(
+                "engine WEDGED: no dispatch landing for %.1fs with %d "
+                "request(s) pending; flight-recorder dump: %s",
+                stalled_s, len(requests), path,
+            )
+        except Exception:  # noqa: BLE001 - the dump must never mask the fault
+            logger.exception("flight-recorder wedge dump failed")
+        faulted = 0
+        for request in requests:
+            if request.wedged:
+                continue
+            request.wedged = True
+            request.cancelled = True
+            faulted += 1
+            # wake the consumer NOW — the serve loop that normally
+            # delivers _DONE is the thing that is stuck
+            request.out.put_nowait(_DONE)
+        self.stats.watchdog_faulted += faulted
+        self._cancel_dirty = True
+        self._wake.set()
+
     def _note_cancel(self, request: GenRequest) -> None:
         """One cancelled request drained from any lane or queue: the
         journal line + counter.  Expiry- and stall-driven cancels were
@@ -1679,7 +1832,8 @@ class InferenceEngine:
         the stall flag) and have their own counters — they ride the same
         drain but must not double-count as consumer cancels."""
         self._drop_deadline(request)
-        if request.expired or request.stalled:
+        if request.expired or request.stalled or request.wedged:
+            # wedge-faulted requests were journaled/counted at the trip
             return
         self._journal.append(flightrec.EV_CANCEL, request.corr, request.slot)
         self.stats.cancelled_requests += 1
@@ -1732,6 +1886,16 @@ class InferenceEngine:
     def _raise_terminal(self, request: GenRequest) -> None:
         """Typed stream endings: an engine-initiated cancel must surface
         as a typed error at the consumer, not a silent short stream."""
+        if request.wedged:
+            # checked FIRST: a wedged request may also look expired by the
+            # time its consumer resumes, but the watchdog faulted it so
+            # the caller would fail over — the retriable code must win
+            raise EngineWedgedError(
+                "engine wedged while this request was pending "
+                f"({request.generated} tokens delivered); "
+                "retry against another replica",
+                stalled_s=self.runtime.watchdog_stall_s,
+            )
         if request.expired:
             raise DeadlineExceededError(
                 f"request deadline passed after {request.generated} "
@@ -2428,6 +2592,7 @@ class InferenceEngine:
         state["synced_at"] = now
         # NOT decode_dispatches: that counter is mean_occupancy's
         # denominator, and a long dispatch uses the whole mesh, not slots
+        self._note_progress()  # sp-lane landing: watchdog progress too
         self.stats.long_dispatches += 1
         self.stats.decode_time_s += now - start
         done = False
@@ -2535,6 +2700,7 @@ class InferenceEngine:
         whole wave.  The device-side last/lens scatter happens inside the
         prefill jit (``_finalize_wave_math``)."""
         deliveries: list[tuple[asyncio.Queue, list]] = []
+        self._note_progress()  # a wave landing is watchdog progress
         self._observe("prefill_ms", elapsed_ms)
         self._journal.append(
             flightrec.EV_WAVE_LAND, None, -1, len(wave), int(elapsed_ms)
@@ -3218,6 +3384,7 @@ class InferenceEngine:
         ``_active``)."""
         with self._retire_lock:
             self._decode_clock += clock_steps
+        self._note_progress()  # every landed dispatch is watchdog progress
         self.stats.decode_dispatches += 1
         self.stats.decode_time_s += elapsed
         rows = n_rows if n_rows is not None else len(self._active)

@@ -1909,6 +1909,10 @@ class _WireTableReader(TableReader):
         self._stopped = False
         self._advanced = asyncio.Event()
         self._caught_up = False
+        # view-mutation counter (TableReader.version): bumps per applied
+        # record and at every rebuild swap — the no-change fast path for
+        # per-call readers (the fleet registry)
+        self._version = 0
 
     async def start(self, *, timeout: float = 30.0) -> None:
         self._client = KafkaWireClient(
@@ -1973,6 +1977,7 @@ class _WireTableReader(TableReader):
             return  # loop fails its next fetch and retries the rebuild
         self._view = shadow
         self._fetch_positions = positions
+        self._version += 1  # the whole view may have changed: one bump
         self._advanced.set()
 
     async def _pump_once(
@@ -2020,6 +2025,9 @@ class _WireTableReader(TableReader):
                         view[text_key] = value
                     else:
                         view.pop(text_key, None)
+                    if view is self._view:
+                        # shadow rebuilds bump once at the swap instead
+                        self._version += 1
                 positions[part] = off + 1
 
     async def stop(self) -> None:
@@ -2070,6 +2078,10 @@ class _WireTableReader(TableReader):
     @property
     def is_caught_up(self) -> bool:
         return self._caught_up
+
+    @property
+    def version(self) -> "int | None":
+        return self._version
 
 
 class _WireTableWriter(TableWriter):

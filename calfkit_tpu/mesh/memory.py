@@ -51,8 +51,11 @@ class _Topic:
         self.changed = asyncio.Event()
         self.consumer_count = 0  # log-position consumers (pumps); gates trimming
         # compacted topics also maintain the folded view at publish time so
-        # table reads are O(1) instead of re-folding the log
+        # table reads are O(1) instead of re-folding the log; the version
+        # counter bumps on every fold mutation (TableReader.version — the
+        # fleet registry's O(1) no-change fast path reads it)
         self.table: dict[str, bytes] = {}
+        self.table_version = 0
         # set by the mesh: remaps persisted group cursors after a log trim
         self.on_compact: Callable[["_Topic", int, list[Record], list[Record]], None] | None = None
         self._rr = itertools.count()
@@ -75,6 +78,7 @@ class _Topic:
         self.partitions[p].append(record)
         if self.compacted and key is not None:
             k = key.decode("utf-8", errors="replace")
+            self.table_version += 1
             if len(value) == 0:
                 self.table.pop(k, None)  # tombstone
             else:
@@ -197,6 +201,7 @@ class InMemoryMesh(MeshTransport):
                 if record.key is None:
                     continue
                 k = record.key.decode("utf-8", errors="replace")
+                topic.table_version += 1
                 if len(record.value) == 0:
                     topic.table.pop(k, None)
                 else:
@@ -427,6 +432,14 @@ class _MemoryTableReader(TableReader):
     @property
     def is_caught_up(self) -> bool:
         return self._started
+
+    @property
+    def version(self) -> "int | None":
+        # the topic folds at publish time, so its mutation counter IS the
+        # view version (reads are always caught up on the local mesh)
+        return self._mesh._topic(
+            self._topic_name, create=True, compacted=True
+        ).table_version
 
 
 class _MemoryTableWriter(TableWriter):

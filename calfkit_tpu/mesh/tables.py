@@ -37,6 +37,18 @@ class TableReader(abc.ABC):
     @abc.abstractmethod
     def is_caught_up(self) -> bool: ...
 
+    @property
+    def version(self) -> "int | None":
+        """Monotonic view-mutation counter: bumps at least once whenever
+        the folded view changes (put, tombstone, rebuild swap), never
+        otherwise.  Lets per-call readers (the fleet registry's parsed-
+        replica cache, ISSUE 9) make the no-change case a single int
+        compare instead of re-scanning the table's bytes.  ``None`` (the
+        default, for third-party readers) means "no counter — fall back
+        to content fingerprinting"; all in-repo transports implement it.
+        """
+        return None
+
 
 class TableWriter(abc.ABC):
     @abc.abstractmethod

@@ -361,6 +361,7 @@ class _TcpTableReader(TableReader):
         self._topic = topic
         self._view: dict[str, bytes] = {}
         self._positions = [0] * 16  # consumed count per partition
+        self._version = 0  # view-mutation counter (TableReader.version)
         self._advanced = asyncio.Event()
         self._conn: _Conn | None = None
         self._task: asyncio.Task[None] | None = None
@@ -399,6 +400,7 @@ class _TcpTableReader(TableReader):
                         self._view[k] = v
                     else:
                         self._view.pop(k, None)
+                    self._version += 1
                 self._positions[int(part)] += 1
             if lines:
                 self._advanced.set()
@@ -442,6 +444,10 @@ class _TcpTableReader(TableReader):
     @property
     def is_caught_up(self) -> bool:
         return self._started
+
+    @property
+    def version(self) -> "int | None":
+        return self._version
 
 
 class _TcpTableWriter(TableWriter):

@@ -54,6 +54,12 @@ class FaultTypes:
     # executing (tombstone hit at the admission gate) — NOT retriable
     # (the caller abandoned the run on purpose)
     CANCELLED = "mesh.cancelled"
+    # the engine's dispatch-progress watchdog declared the device wedged
+    # (work pending, no dispatch landing within watchdog_stall_s) and
+    # faulted the request instead of letting it burn its whole deadline —
+    # RETRIABLE by contract: nothing was delivered to the caller, and a
+    # different replica can serve the same call (ISSUE 9)
+    WEDGED = "mesh.wedged"
     UNHANDLED = "mesh.unhandled_exception"
 
     @classmethod

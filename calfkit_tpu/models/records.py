@@ -112,6 +112,18 @@ class EngineStatsRecord(BaseModel):
     cancelled_requests: int = 0
     cancel_propagated: int = 0
     delivery_stalled: int = 0
+    # failure recovery (ISSUE 9): whether the engine's dispatch-progress
+    # watchdog currently declares it wedged (ready goes false with it —
+    # routers route around, and outstanding placements are declared
+    # dead), its trip/fault lifetime counters, and how many of this
+    # replica's arrivals were failover re-dispatches / hedge duplicates
+    # (counted by the serving agent from the x-mesh-attempt marker).
+    # Defaults read a pre-ISSUE-9 record as never-wedged / no-recovery.
+    wedged: bool = False
+    watchdog_trips: int = 0
+    watchdog_faulted: int = 0
+    failover_requests: int = 0
+    hedge_requests: int = 0
     # prefix-cache health (ISSUE 7): cached pages resident plus lifetime
     # hit/reuse counters — the signal prefix-affinity routing exists to
     # improve, surfaced per replica in `ck fleet` and ROUTER.json

@@ -143,6 +143,12 @@ class BaseAgentNodeDef(BaseNodeDef):
             # onto the kernel's on_callee_error seam (reference:
             # nodes/_tool_error.py:42-150)
             self.on_callee_error.append(_adapt_on_tool_error(on_tool_error))
+        # failure recovery (ISSUE 9): arrivals marked as failover
+        # re-dispatches / hedge duplicates (the caller's x-mesh-attempt
+        # marker), folded into the engine-stats advert so `ck stats` /
+        # `ck fleet` show which replicas are absorbing recovered work
+        self._failover_requests = 0
+        self._hedge_requests = 0
 
     # --------------------------------------------------------- decorators
     def instructions_fn(self, fn: Callable[[NodeRunContext], str]) -> Callable:
@@ -210,12 +216,20 @@ class BaseAgentNodeDef(BaseNodeDef):
             ready, _ = (
                 worker.ready() if hasattr(worker, "ready") else (True, "")
             )
+            # a wedged engine advertises unready WITHOUT draining (ISSUE
+            # 9): routers stop placing new runs here, and the dead-
+            # placement law declares outstanding placements dead so their
+            # callers fail over instead of timing out
+            if snapshot.get("wedged"):
+                ready = False
             return EngineStatsRecord(
                 node_id=self.node_id,
                 instance_id=self.instance_id,
                 replica_topic=self.replica_topic() or "",
                 ready=bool(ready),
                 draining=bool(getattr(worker, "draining", False)),
+                failover_requests=self._failover_requests,
+                hedge_requests=self._hedge_requests,
                 **snapshot,
             ).model_dump()
         except Exception:  # noqa: BLE001 - metrics must never fault serving
@@ -264,6 +278,14 @@ class BaseAgentNodeDef(BaseNodeDef):
 
     @handler("run")
     async def run(self, ctx: NodeRunContext) -> NodeResult | Observed:
+        if ctx.delivery_kind == "call":
+            # recovery accounting (ISSUE 9): count failover/hedge arrivals
+            # once per placed call (not per tool-return resumption)
+            attempt = ctx.headers.get(protocol.HDR_ATTEMPT)
+            if attempt == "failover":
+                self._failover_requests += 1
+            elif attempt == "hedge":
+                self._hedge_requests += 1
         for _ in range(self._MAX_REJECTED_LOOPS):
             try:
                 return await self._run_one_turn(ctx)

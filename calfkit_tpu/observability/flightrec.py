@@ -81,6 +81,7 @@ EV_FAULT = 18  # exception crossed the dispatch loop (note=repr)
 EV_SHED = 19  # bounded admission refused the submit  a=pending b=limit
 EV_EXPIRE = 20  # deadline passed (submit/queue/active) a=overdue_ms
 EV_RAGGED_WAVE = 21  # unified dispatch: decode+chunk  a=decode_rows b=chunk_rows
+EV_WEDGE = 22  # dispatch-progress watchdog tripped  a=stalled_ms b=pending
 
 EVENT_NAMES: tuple[str, ...] = (
     "SUBMIT",
@@ -105,6 +106,7 @@ EVENT_NAMES: tuple[str, ...] = (
     "SHED",
     "EXPIRE",
     "RAGGED_WAVE",
+    "WEDGE",
 )
 
 # per-event meaning of the two int payload fields (the dump stays compact
@@ -132,6 +134,7 @@ ARG_LABELS: dict[str, tuple[str, str]] = {
     "SHED": ("pending", "limit"),
     "EXPIRE": ("overdue_ms", ""),
     "RAGGED_WAVE": ("decode_rows", "chunk_rows"),
+    "WEDGE": ("stalled_ms", "pending"),
 }
 
 # batch-scoped events a request's timeline borrows from its active window

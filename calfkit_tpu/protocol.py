@@ -59,6 +59,12 @@ HDR_ERROR_TYPE: Final = "x-mesh-error-type"
 HDR_TRACE: Final = "x-mesh-trace"
 HDR_SPAN: Final = "x-mesh-span"
 HDR_DEADLINE: Final = "x-mesh-deadline"
+# failure recovery (ISSUE 9): marks a call record as a failover
+# re-dispatch or a hedge duplicate ("failover" | "hedge").  Describes
+# THIS placement only — hops do not forward it downstream; the serving
+# agent counts arrivals into its engine-stats advert (FAILOVER/HEDGE in
+# ``ck stats``).
+HDR_ATTEMPT: Final = "x-mesh-attempt"
 
 ALL_HEADERS: Final = (
     HDR_EMITTER,
@@ -71,6 +77,7 @@ ALL_HEADERS: Final = (
     HDR_TRACE,
     HDR_SPAN,
     HDR_DEADLINE,
+    HDR_ATTEMPT,
 )
 
 # --------------------------------------------------------------------------- #

@@ -1,0 +1,239 @@
+"""Failure-recovery A/B microbench (ISSUE 9 acceptance artifact).
+
+Kill-mid-run on the REAL mesh → worker → engine path: an in-memory mesh,
+two Workers each hosting a replica of one agent over a REAL debug
+inference engine, a fleet-routed Client — then one replica is
+HARD-KILLED (FleetTopology's process-death seam: publishes vanish,
+consumption freezes, heartbeats stop, no drain) while its runs are
+mid-generation.
+
+Two arms, identical workload and kill:
+
+- **failover on** — the client supervises each placement
+  (``FailoverPolicy``): the dead placement is detected when the corpse's
+  heartbeat lapses ``stale_after``, the orphaned correlation is
+  cancel-tombstoned, and the call re-dispatches to the survivor under
+  the REMAINING deadline.  Every request completes; the headline number
+  is the worst time-to-recover (kill → terminal) against the caller
+  deadline.
+- **failover off** — the pre-ISSUE-9 behavior: the victim's runs have no
+  supervisor, so each burns its ENTIRE caller deadline and dies with
+  ClientTimeoutError; only the survivor's share completes.
+
+Prints one JSON line (written to FAILOVER.json via --out); exits
+non-zero unless the failover arm completes EVERY request with worst
+recovery under half the caller deadline AND the baseline arm loses the
+victim's runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from calfkit_tpu.client import Client  # noqa: E402
+from calfkit_tpu.exceptions import ClientTimeoutError  # noqa: E402
+from calfkit_tpu.fleet import FailoverPolicy, FleetRouter  # noqa: E402
+from calfkit_tpu.inference import model as M  # noqa: E402
+from calfkit_tpu.inference.client import JaxLocalModelClient  # noqa: E402
+from calfkit_tpu.inference.config import RuntimeConfig, preset  # noqa: E402
+from calfkit_tpu.inference.engine import InferenceEngine  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+from tests._chaos import FleetTopology  # noqa: E402 - the process-death seam
+
+AGENT = "svc"
+OFFERED = 4  # requests in flight when the replica dies
+NEW_TOKENS = 24
+DEADLINE_S = 8.0  # the caller budget recovery is measured against
+HEARTBEAT_S = 0.05
+STALE_MULT = 6.0  # stale_after = 0.3s: the detection floor
+PACE_S = 0.03  # per-dispatch pacing so the kill lands mid-generation
+RECOVERY_BAR_FRACTION = 0.5  # worst recover must be < deadline/2
+
+CFG = preset("debug")
+PARAMS = M.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def _engines(n: int):
+    engines, models = [], []
+    for _ in range(n):
+        runtime = RuntimeConfig(
+            max_batch_size=4, max_seq_len=128, prefill_chunk=16,
+            decode_steps_per_dispatch=4, page_size=16,
+        )
+        engine = InferenceEngine(CFG, runtime, params=PARAMS)
+        engines.append(engine)
+        models.append(
+            JaxLocalModelClient(
+                config=CFG, runtime=runtime, engine=engine,
+                max_new_tokens=NEW_TOKENS,
+            )
+        )
+    return engines, models
+
+
+async def _until(condition, *, seconds: float = 20.0, what: str = "") -> None:
+    deadline = time.perf_counter() + seconds
+    while not condition():
+        if time.perf_counter() > deadline:
+            raise RuntimeError(f"never settled: {what}")
+        await asyncio.sleep(0.01)
+
+
+async def measure(failover_on: bool) -> dict:
+    engines, models = _engines(2)
+    mesh = InMemoryMesh()
+    fleet = FleetTopology(
+        mesh, models, name=AGENT,
+        heartbeat_interval=HEARTBEAT_S, stale_multiplier=STALE_MULT,
+    )
+    async with fleet:
+        # pace BOTH engines so the victim's runs are still decoding when
+        # the kill lands (and the arms stay symmetric)
+        def pace(point):
+            if point == "dispatch":
+                time.sleep(PACE_S)
+
+        for engine in engines:
+            engine._chaos = pace
+        router = FleetRouter(
+            mesh, "least-loaded", stale_after=fleet.config.stale_after
+        )
+        client = Client.connect(
+            mesh,
+            router=router,
+            failover=(
+                FailoverPolicy(probe_interval=0.05, max_failovers=2)
+                if failover_on else None
+            ),
+        )
+        await router.start()
+        await _until(
+            lambda: len(router.registry.eligible(AGENT)) == 2,
+            what="both replicas eligible",
+        )
+        victim = fleet.index_of_lowest_key()
+
+        # warm BOTH engines first (one run each, placed round-robin by
+        # least-loaded) so the measured window contains serving and
+        # recovery, not first-use XLA compilation — a cold survivor
+        # would bill multi-second jit builds to the failover path
+        warm = [
+            asyncio.create_task(
+                client.agent(AGENT).execute(
+                    f"request {i}: payload", timeout=60.0
+                )
+            )
+            for i in range(2)
+        ]
+        await asyncio.gather(*warm)
+
+        done_at: dict[int, float] = {}
+        outcomes: dict[int, str] = {}
+
+        async def one(i: int):
+            try:
+                result = await client.agent(AGENT).execute(
+                    f"request {i}: payload", timeout=DEADLINE_S
+                )
+                assert result.output is not None
+                outcomes[i] = "ok"
+            except ClientTimeoutError:
+                outcomes[i] = "timeout"
+            except Exception as exc:  # noqa: BLE001 - report, don't crash
+                outcomes[i] = f"error:{type(exc).__name__}"
+            done_at[i] = time.perf_counter()
+
+        tasks = []
+        for i in range(OFFERED):
+            tasks.append(asyncio.create_task(one(i)))
+            await asyncio.sleep(0.02)
+        await _until(
+            lambda: engines[victim]._active,
+            what="the victim engine never had active work",
+        )
+        t_kill = time.perf_counter()
+        fleet.kill(victim)
+        await asyncio.gather(*tasks)
+
+        completed = sum(1 for o in outcomes.values() if o == "ok")
+        timeouts = sum(1 for o in outcomes.values() if o == "timeout")
+        # requests finishing after the kill either recovered (failover)
+        # or burned their deadline (baseline): their kill→terminal time
+        # IS the recovery/failure latency
+        post_kill_s = [
+            round(done_at[i] - t_kill, 3)
+            for i in range(OFFERED)
+            if done_at[i] > t_kill
+        ]
+        out = {
+            "failover": failover_on,
+            "offered": OFFERED,
+            "completed": completed,
+            "timeouts": timeouts,
+            "outcomes": sorted(outcomes.values()),
+            "kill_to_terminal_s": sorted(post_kill_s),
+            "worst_kill_to_terminal_s": max(post_kill_s) if post_kill_s else 0.0,
+            "stale_after_s": fleet.config.stale_after,
+            "survivor_failover_arrivals": (
+                fleet.agents[1 - victim]._failover_requests
+            ),
+        }
+        await client.close()
+    for engine in engines:
+        await engine.stop()
+    await mesh.stop()
+    return out
+
+
+async def run() -> dict:
+    on = await measure(True)
+    off = await measure(False)
+    worst = on["worst_kill_to_terminal_s"]
+    ok = (
+        on["completed"] == OFFERED
+        and worst < DEADLINE_S * RECOVERY_BAR_FRACTION
+        and on["survivor_failover_arrivals"] >= 1
+        and off["completed"] < OFFERED
+        and off["timeouts"] >= 1
+    )
+    return {
+        "metric": "failover_ab[kill-mid-run, real mesh->worker->engine "
+                  "path, 2 replicas, real debug engines, hard-kill via "
+                  "the process-death seam]",
+        "value": worst,
+        "unit": "s worst kill->terminal with failover on (vs the "
+                f"{DEADLINE_S}s caller deadline the baseline burns whole)",
+        "deadline_s": DEADLINE_S,
+        "recovery_bar_s": DEADLINE_S * RECOVERY_BAR_FRACTION,
+        "ok": ok,
+        "on": on,
+        "off": off,
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None, help="also write JSON here")
+    ns = parser.parse_args()
+    result = asyncio.run(run())
+    line = json.dumps(result)
+    print(line)
+    if ns.out:
+        with open(ns.out, "w") as f:
+            f.write(line + "\n")
+    sys.exit(0 if result["ok"] else 1)

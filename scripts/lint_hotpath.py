@@ -290,10 +290,16 @@ def _append_body_violations(tree: ast.AST) -> "list[tuple[int, str]]":
 FLEET_SELECT_FUNCTIONS = {
     "router.py": {"select", "_outstanding", "_sweep_inflight"},
     "policy.py": {"select", "_least", "affinity_key_for"},
-    "registry.py": {"eligible", "replicas", "_parsed", "eligibility_verdict"},
+    "registry.py": {
+        "eligible", "replicas", "_parsed", "eligibility_verdict", "replica",
+    },
     "selection.py": {
         "lane_of", "stable_hash", "rendezvous_rank", "page_aligned_prefix",
     },
+    # failure recovery (ISSUE 9): the dead-placement probe runs every
+    # probe_interval per OUTSTANDING call, and the stream dedupe filter
+    # runs per token-step event — same no-blocking/no-logging contract
+    "failover.py": {"placement_verdict", "filter"},
 }
 
 _FLEET_BANNED_CALLS = {"print", "open", "input", "exec", "eval"}

@@ -40,11 +40,14 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import threading
 import time
 from typing import Any, Callable, Iterator
 
 from calfkit_tpu import cancellation
 from calfkit_tpu import protocol
+from calfkit_tpu.mesh.tables import TableWriter
+from calfkit_tpu.mesh.transport import MeshTransport
 
 
 class VirtualClock:
@@ -87,6 +90,7 @@ class ChaosScript:
     def __init__(self) -> None:
         self.calls: dict[str, int] = {}
         self._plan: dict[tuple[str, int], BaseException] = {}
+        self._blocks: dict[tuple[str, int], "threading.Event"] = {}
 
     def fail_at(
         self, point: str, nth: int, exc: BaseException
@@ -94,9 +98,24 @@ class ChaosScript:
         self._plan[(point, nth)] = exc
         return self
 
+    def block_at(
+        self, point: str, nth: int, gate: "threading.Event"
+    ) -> "ChaosScript":
+        """On the Nth visit of ``point``, BLOCK until ``gate`` is set —
+        the wedged-device-grant simulator (ISSUE 9): the decode thread
+        (and with it the whole serve loop, stuck in its to_thread) hangs
+        exactly like a hung device sync, and only the watchdog's own
+        task can observe it.  ``gate.set()`` releases the dispatch, which
+        then lands normally (the recovery path)."""
+        self._blocks[(point, nth)] = gate
+        return self
+
     def __call__(self, point: str) -> None:
         count = self.calls.get(point, 0) + 1
         self.calls[point] = count
+        gate = self._blocks.pop((point, count), None)
+        if gate is not None:
+            gate.wait()
         exc = self._plan.pop((point, count), None)
         if exc is not None:
             raise exc
@@ -214,6 +233,162 @@ class ServingStubModel:
         )
 
 
+class _GatedTableWriter(TableWriter):
+    """A dead replica's heartbeat puts/tombstones never reach the table —
+    its last stamp stays frozen there, exactly what a killed process
+    leaves behind (no tombstone: that would be a CLEAN shutdown)."""
+
+    def __init__(self, owner: "ReplicaTransport", inner: TableWriter):
+        self._owner = owner
+        self._inner = inner
+
+    async def put(self, key: str, value: bytes) -> None:
+        if self._owner.dead:
+            self._owner.dropped.append(("<table-put>", key))
+            return
+        await self._inner.put(key, value)
+
+    async def tombstone(self, key: str) -> None:
+        if self._owner.dead:
+            self._owner.dropped.append(("<table-tombstone>", key))
+            return
+        await self._inner.tombstone(key)
+
+
+class _DeliveryGate:
+    """The consumption half of a process death: while dead, deliveries
+    buffer (the dead process's partition backlog) instead of reaching
+    the node handler; ``replay()`` on resume drains the backlog with
+    cancel records FIRST — mirroring the dispatcher's express intake,
+    where a cancel skips the ordered lanes and therefore lands before
+    the queued work it abandons gets to execute."""
+
+    def __init__(self, owner: "ReplicaTransport", inner: Any):
+        self._owner = owner
+        self._inner = inner
+        self.buffered: list[Any] = []
+
+    async def __call__(self, record: Any) -> None:
+        if self._owner.dead:
+            self.buffered.append(record)
+            return
+        await self._inner(record)
+
+    async def replay(self) -> None:
+        backlog, self.buffered = self.buffered, []
+        cancels = [
+            r for r in backlog
+            if r.headers.get(protocol.HDR_KIND) == "cancel"
+        ]
+        rest = [
+            r for r in backlog
+            if r.headers.get(protocol.HDR_KIND) != "cancel"
+        ]
+        for record in cancels + rest:
+            await self._inner(record)
+
+
+class ReplicaTransport(MeshTransport):
+    """One replica's I/O boundary over the (shared) mesh — the
+    process-death seam (ISSUE 9).
+
+    ``kill()`` models a hard kill: NOTHING the replica publishes reaches
+    the mesh (heartbeats stop landing with the last stamp frozen on the
+    table, a half-delivered stream just stops, terminal replies vanish)
+    and nothing is consumed (deliveries buffer like the dead consumer's
+    backlog).  Compute the replica had in flight keeps burning — exactly
+    the zombie the cancel-tombstone law exists for.  ``resume()`` models
+    that zombie coming back: publishes flow again, the backlog replays
+    (cancels first, per the dispatcher's express law), and the next
+    heartbeat re-stamps the advert."""
+
+    def __init__(self, inner: MeshTransport):
+        self.inner = inner
+        self.dead = False
+        self.dropped: list[tuple[str, str]] = []  # publishes lost while dead
+        self._gates: list[_DeliveryGate] = []
+
+    def kill(self) -> None:
+        self.dead = True
+
+    async def resume(self) -> None:
+        self.dead = False
+        for gate in self._gates:
+            await gate.replay()
+
+    # ------------------------------------------------------- transport
+    async def start(self) -> None:
+        await self.inner.start()
+
+    async def stop(self) -> None:
+        await self.inner.stop()
+
+    @property
+    def max_message_bytes(self) -> int:
+        return self.inner.max_message_bytes
+
+    async def publish(self, topic, value, *, key=None, headers=None):
+        if self.dead:
+            self.dropped.append(
+                (topic, (headers or {}).get(protocol.HDR_KIND, ""))
+            )
+            return
+        await self.inner.publish(topic, value, key=key, headers=headers)
+
+    async def subscribe(self, topics, handler, **kwargs):
+        gate = _DeliveryGate(self, handler)
+        self._gates.append(gate)
+        return await self.inner.subscribe(topics, gate, **kwargs)
+
+    async def ensure_topics(self, names, *, compacted=False):
+        await self.inner.ensure_topics(names, compacted=compacted)
+
+    def table_reader(self, topic):
+        return self.inner.table_reader(topic)
+
+    def table_writer(self, topic):
+        return _GatedTableWriter(self, self.inner.table_writer(topic))
+
+
+class StreamingStubModel(ServingStubModel):
+    """A ServingStubModel whose ``request_stream`` yields word-sized
+    deltas and PAUSES after ``pause_after`` of them until ``release`` is
+    set — the deterministic mid-stream seam: a scenario observes the
+    first delivered tokens, kills the replica, and knows exactly how
+    much text the caller saw.  The stream keeps yielding after the kill
+    (a dead replica's compute keeps burning); the transport seam drops
+    the output."""
+
+    def __init__(
+        self,
+        *,
+        text: str = "alpha beta gamma delta",
+        pause_after: int = 1,
+        load: int = 0,
+    ):
+        super().__init__(text=text, load=load)
+        self.pause_after = pause_after
+        self.release = asyncio.Event()
+        self.streamed: list[str] = []
+
+    async def request_stream(self, messages, settings=None, params=None):
+        from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
+
+        words = self.text.split(" ")
+        deltas = [
+            w + (" " if i < len(words) - 1 else "")
+            for i, w in enumerate(words)
+        ]
+        for i, delta in enumerate(deltas):
+            if i == self.pause_after:
+                await self.release.wait()
+            self.streamed.append(delta)
+            yield TextDelta(delta)
+            await asyncio.sleep(0)
+        response = await super().request(messages, settings, params)
+        yield ResponseDone(response)
+
+
 class FleetTopology:
     """N workers hosting replicas of ONE agent name on a shared mesh.
 
@@ -238,6 +413,7 @@ class FleetTopology:
         heartbeat_interval: float = 0.05,
         stale_multiplier: float = 100.0,
         agent_kwargs: "dict | None" = None,
+        meshes: "list[Any] | None" = None,
     ):
         from calfkit_tpu.controlplane import ControlPlaneConfig
         from calfkit_tpu.nodes import Agent
@@ -252,6 +428,14 @@ class FleetTopology:
         self.delivered: "list[list[str]]" = [[] for _ in models]
         self.agents = []
         self.workers = []
+        # every replica's I/O rides its own ReplicaTransport proxy — the
+        # process-death seam (kill/resume).  ``meshes`` supplies a
+        # per-replica INNER transport (e.g. one KafkaWireMesh connection
+        # each, the real multi-process shape); default = the shared mesh.
+        self.transports = [
+            ReplicaTransport(inner)
+            for inner in (meshes if meshes is not None else [mesh] * len(models))
+        ]
         for i, model in enumerate(models):
             agent = Agent(
                 name,
@@ -261,7 +445,12 @@ class FleetTopology:
             )
             self.agents.append(agent)
             self.workers.append(
-                Worker([agent], mesh=mesh, control_plane=self.config)
+                Worker(
+                    [agent],
+                    mesh=self.transports[i],
+                    control_plane=self.config,
+                    owns_transport=meshes is not None,
+                )
             )
 
     def _ledger(self, i: int) -> Callable[[Any], None]:
@@ -297,6 +486,20 @@ class FleetTopology:
 
     def calls_delivered(self, i: int) -> int:
         return len(self.delivered[i])
+
+    # ------------------------------------------------------ process death
+    def kill(self, i: int) -> None:
+        """Hard-kill replica ``i`` (ISSUE 9): stop consuming AND stop
+        heartbeating, without drain — its advert stays on the table with
+        the last stamp (staleness is then driven by ``clock.advance``),
+        its in-flight output vanishes, its backlog buffers."""
+        self.transports[i].kill()
+
+    async def resume(self, i: int) -> None:
+        """The killed replica returns as a ZOMBIE: backlog replays
+        (cancels first, the express law), publishes flow, the next
+        heartbeat re-stamps the advert fresh."""
+        await self.transports[i].resume()
 
     # ---------------------------------------------------- heartbeat chaos
     def _publisher(self, i: int) -> Any:

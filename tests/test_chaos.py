@@ -1013,3 +1013,446 @@ class TestFleetChaos:
                 assert fleet.calls_delivered(low) == 2
                 await client.close()
             await mesh.stop()
+
+
+class TestFailoverChaos:
+    """In-flight failure recovery (ISSUE 9): hard replica death driven
+    through FleetTopology's process-death seam (kill = stop consuming +
+    stop heartbeating + publishes vanish, no drain), recovery supervised
+    by the gateway's FailoverPolicy under the virtual clock."""
+
+    @staticmethod
+    def _failover_client(mesh, fleet, **policy_over):
+        from calfkit_tpu.fleet import FailoverPolicy, FleetRouter
+
+        kw = dict(probe_interval=0.02, max_failovers=2)
+        kw.update(policy_over)
+        router = FleetRouter(
+            mesh, "least-loaded", stale_after=fleet.config.stale_after
+        )
+        client = Client.connect(
+            mesh, router=router, failover=FailoverPolicy(**kw)
+        )
+        return router, client
+
+    async def test_kill_mid_stream_completes_contiguous(self):
+        """THE acceptance scenario: hard-kill a replica mid-stream.  The
+        request completes on the survivor, the caller observes ONE
+        contiguous stream (concatenated token deltas == the terminal
+        output: no duplicated, no missing text), and — after the zombie
+        resumes — the old correlation is tombstoned so the orphaned run
+        never executes twice.  StreamingStubModel pins exactly how much
+        text the caller saw before the death."""
+        from calfkit_tpu.models.node_result import InvocationResult
+        from tests._chaos import StreamingStubModel
+
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            chaos = BrokerChaos()
+            mesh.chaos = chaos
+            models = [
+                StreamingStubModel(text="alpha beta gamma delta")
+                for _ in range(2)
+            ]
+            async with FleetTopology(
+                mesh, models, agent_kwargs={"stream_tokens": True}
+            ) as fleet:
+                low = fleet.index_of_lowest_key()
+                models[1 - low].release.set()  # only the victim pauses
+                victim_topic = fleet.agents[low].replica_topic()
+                victim_corrs: list = []
+
+                def note(topic, headers):
+                    if (
+                        topic == victim_topic
+                        and headers.get(protocol.HDR_KIND) == "call"
+                    ):
+                        victim_corrs.append(
+                            headers.get(protocol.HDR_CORRELATION)
+                        )
+
+                chaos.on_publish = note
+                router, client = self._failover_client(mesh, fleet)
+                await TestFleetChaos._eligible(
+                    router, 2, "fleet never became routable"
+                )
+
+                token_texts: list = []
+                result = None
+                killed = False
+                async for item in client.agent("svc").stream(
+                    "tell me a story", timeout=60
+                ):
+                    if isinstance(item, InvocationResult):
+                        result = item
+                        continue
+                    if getattr(item.step, "kind", "") != "token":
+                        continue
+                    token_texts.append(item.step.text)
+                    if not killed:
+                        # the first delivered tokens ("alpha ") are on
+                        # the wire; the replica dies NOW, mid-stream
+                        killed = True
+                        fleet.kill(low)
+                        clock.advance(fleet.config.stale_after + 1)
+                assert killed, "the stream never delivered a first token"
+                assert result is not None
+                assert result.output == "alpha beta gamma delta"
+                # contiguity law: what streamed is exactly the answer —
+                # no duplicated "alpha ", no missing words
+                assert "".join(token_texts) == result.output
+                # the call was placed once on each replica (original +
+                # failover re-dispatch, marked for the advert), and the
+                # orphan was cancelled toward the dead replica's topic
+                assert fleet.calls_delivered(low) == 1
+                assert fleet.calls_delivered(1 - low) == 1
+                assert len(victim_corrs) == 1
+                assert (victim_topic, "cancel") in chaos.seen
+                assert fleet.agents[1 - low]._failover_requests == 1
+                # zombie returns: the buffered cancel replays FIRST
+                # (express law) and tombstones the orphaned correlation
+                models[low].release.set()
+                await fleet.resume(low)
+                await settle(
+                    lambda: cancellation.was_cancelled(victim_corrs[0]),
+                    message="the zombie never tombstoned the orphan",
+                )
+                await client.close()
+            await mesh.stop()
+
+    async def test_kill_mid_run_real_engines_no_leaks(self, params):
+        """The engine-oracle half of the acceptance: hard-kill a replica
+        while its REAL engine is decoding the run.  The survivor serves
+        the re-dispatch, the caller gets a result well inside its
+        deadline, and BOTH engines — including the corpse, whose
+        in-flight compute keeps burning into dropped publishes — drain
+        with zero leaked slots or pages."""
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            chaos = BrokerChaos()
+            mesh.chaos = chaos
+            engines, models = TestFleetChaos._engine_fleet(params, 2)
+            async with FleetTopology(mesh, models) as fleet:
+                low = fleet.index_of_lowest_key()
+                # pace the victim so the kill lands mid-generation
+                slow = ChaosScript()
+
+                def pace(point):
+                    slow(point)
+                    if point == "dispatch":
+                        time.sleep(0.02)
+
+                engines[low]._chaos = pace
+                router, client = self._failover_client(mesh, fleet)
+                await TestFleetChaos._eligible(
+                    router, 2, "fleet never became routable"
+                )
+                call = asyncio.create_task(
+                    client.agent("svc").execute("long haul", timeout=60)
+                )
+                await settle(
+                    lambda: engines[low]._active,
+                    message="the run never reached the victim engine",
+                )
+                fleet.kill(low)
+                clock.advance(fleet.config.stale_after + 1)
+                result = await call
+                assert result.output
+                assert fleet.calls_delivered(low) == 1
+                assert fleet.calls_delivered(1 - low) == 1
+                victim_topic = fleet.agents[low].replica_topic()
+                assert (victim_topic, "cancel") in chaos.seen
+                # the corpse finishes its abandoned decode into dropped
+                # publishes and must STILL free everything
+                await settle(lambda: _drained(engines[low]))
+                await settle(lambda: _drained(engines[1 - low]))
+                assert_engine_drained(engines[low])
+                assert_engine_drained(engines[1 - low])
+                await client.close()
+            for engine in engines:
+                await engine.stop()
+            await mesh.stop()
+
+    async def test_kill_mid_prefill_reissues_whole_call(self, params):
+        """Kill the placed replica before ANY token was delivered (the
+        mid-prefill shape): execute() re-issues the whole call on the
+        survivor under the remaining deadline and returns its answer."""
+        del params
+
+        class BlockedStubModel(ServingStubModel):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.release = asyncio.Event()
+
+            async def request(self, messages, settings=None, params=None):
+                await self.release.wait()
+                return await super().request(messages, settings, params)
+
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            chaos = BrokerChaos()
+            mesh.chaos = chaos
+            models = [BlockedStubModel(text=f"r{i}") for i in range(2)]
+            async with FleetTopology(mesh, models) as fleet:
+                low = fleet.index_of_lowest_key()
+                models[1 - low].release.set()  # only the victim blocks
+                router, client = self._failover_client(mesh, fleet)
+                await TestFleetChaos._eligible(
+                    router, 2, "fleet never became routable"
+                )
+                call = asyncio.create_task(
+                    client.agent("svc").execute("prefill me", timeout=60)
+                )
+                await settle(
+                    lambda: fleet.calls_delivered(low) == 1,
+                    message="the call never reached the victim",
+                )
+                fleet.kill(low)
+                clock.advance(fleet.config.stale_after + 1)
+                result = await call
+                assert result.output == f"r{1 - low}"
+                assert fleet.calls_delivered(1 - low) == 1
+                victim_topic = fleet.agents[low].replica_topic()
+                assert (victim_topic, "cancel") in chaos.seen
+                models[low].release.set()  # unblock for clean teardown
+                await client.close()
+            await mesh.stop()
+
+    async def test_zombie_replica_never_executes_orphaned_run(self):
+        """A call lands on a replica that is ALREADY dead (killed before
+        consuming it).  Failover completes the run elsewhere; when the
+        zombie resumes consuming, the buffered cancel replays FIRST (the
+        dispatcher's express law) and the orphaned call faults at the
+        admission gate — the zombie executes nothing."""
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            chaos = BrokerChaos()
+            mesh.chaos = chaos
+            models = [ServingStubModel(text=f"r{i}") for i in range(2)]
+            async with FleetTopology(mesh, models) as fleet:
+                low = fleet.index_of_lowest_key()
+                victim_topic = fleet.agents[low].replica_topic()
+                victim_corrs: list = []
+
+                def note(topic, headers):
+                    if (
+                        topic == victim_topic
+                        and headers.get(protocol.HDR_KIND) == "call"
+                    ):
+                        victim_corrs.append(
+                            headers.get(protocol.HDR_CORRELATION)
+                        )
+
+                chaos.on_publish = note
+                router, client = self._failover_client(mesh, fleet)
+                await TestFleetChaos._eligible(
+                    router, 2, "fleet never became routable"
+                )
+                # the replica dies FIRST; its advert is still fresh, so
+                # the depth-tied pick still places the call on it
+                fleet.kill(low)
+                call = asyncio.create_task(
+                    client.agent("svc").execute("orphan me", timeout=60)
+                )
+                await settle(
+                    lambda: len(victim_corrs) == 1,
+                    message="the call never targeted the dead replica",
+                )
+                clock.advance(fleet.config.stale_after + 1)
+                result = await call
+                assert result.output == f"r{1 - low}"
+                # nothing executed on the corpse: the gate buffered it
+                assert fleet.calls_delivered(low) == 0
+                assert models[low].replies == 0
+                # the zombie resumes: cancel replays first, the orphaned
+                # call dies at the admission gate (tombstone), zero turns
+                await fleet.resume(low)
+                await settle(
+                    lambda: cancellation.was_cancelled(victim_corrs[0]),
+                    message="the zombie never saw the cancel",
+                )
+                await settle(
+                    lambda: chaos.kinds_seen("fault") >= 1,
+                    message="the tombstoned call never faulted",
+                )
+                assert fleet.calls_delivered(low) == 0
+                assert models[low].replies == 0
+                await client.close()
+            await mesh.stop()
+
+    async def test_stream_fault_fails_open_on_single_replica(self):
+        """Review regression: a retriable FAULT mid-stream on a fleet
+        with NO alternative replica must not burn the deadline waiting
+        for an eligible placement — the faulting replica is alive and
+        answering, so the re-dispatch fails open (shared topic) and the
+        recovered replica serves the retry within milliseconds."""
+        from calfkit_tpu.exceptions import EngineOverloadedError
+        from calfkit_tpu.models.node_result import InvocationResult
+
+        class ShedOnceStubModel(ServingStubModel):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.shed_once = True
+
+            async def request(self, messages, settings=None, params=None):
+                if self.shed_once:
+                    self.shed_once = False
+                    raise EngineOverloadedError(
+                        "transient shed", lane="short", pending=9, limit=1
+                    )
+                return await super().request(messages, settings, params)
+
+        with virtual_clock():
+            mesh = InMemoryMesh()
+            models = [ShedOnceStubModel(text="recovered")]
+            async with FleetTopology(mesh, models) as fleet:
+                router, client = self._failover_client(mesh, fleet)
+                await TestFleetChaos._eligible(
+                    router, 1, "the replica never became routable"
+                )
+                result = None
+                async for item in client.agent("svc").stream(
+                    "shed me once", timeout=20
+                ):
+                    if isinstance(item, InvocationResult):
+                        result = item
+                assert result is not None
+                assert result.output == "recovered"
+                # both attempts reached the same (only) replica
+                assert fleet.calls_delivered(0) == 2
+                assert models[0].replies == 1
+                await client.close()
+            await mesh.stop()
+
+    async def test_hedge_race_first_terminal_wins(self):
+        """hedge_after: a slow primary gets a duplicate dispatched on
+        the OTHER replica after the latency threshold (virtual clock);
+        the first terminal wins and the loser's correlation is
+        cancelled."""
+
+        class SlowStubModel(ServingStubModel):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.release = asyncio.Event()
+
+            async def request(self, messages, settings=None, params=None):
+                await self.release.wait()
+                return await super().request(messages, settings, params)
+
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            chaos = BrokerChaos()
+            mesh.chaos = chaos
+            models = [SlowStubModel(text=f"r{i}") for i in range(2)]
+            async with FleetTopology(mesh, models) as fleet:
+                low = fleet.index_of_lowest_key()
+                models[1 - low].release.set()  # only the primary is slow
+                router, client = self._failover_client(
+                    mesh, fleet, hedge_after=1.0
+                )
+                await TestFleetChaos._eligible(
+                    router, 2, "fleet never became routable"
+                )
+                call = asyncio.create_task(
+                    client.agent("svc").execute("race me", timeout=60)
+                )
+                await settle(
+                    lambda: fleet.calls_delivered(low) == 1,
+                    message="the primary never got the call",
+                )
+                clock.advance(1.5)  # past hedge_after: the duplicate fires
+                result = await call
+                assert result.output == f"r{1 - low}"
+                assert fleet.calls_delivered(1 - low) == 1
+                # the duplicate was marked and the loser cancelled
+                assert fleet.agents[1 - low]._hedge_requests == 1
+                victim_topic = fleet.agents[low].replica_topic()
+                await settle(
+                    lambda: (victim_topic, "cancel") in chaos.seen,
+                    message="the losing attempt was never cancelled",
+                )
+                models[low].release.set()  # clean teardown
+                await client.close()
+            await mesh.stop()
+
+
+class TestWedgeWatchdog:
+    """The engine wedge watchdog (ISSUE 9): a scripted hung device grant
+    (the decode thread blocks mid-dispatch, exactly the BENCH r05 state)
+    converts to typed RETRIABLE faults within the threshold, readiness
+    flips false, the flight recorder dumps — and a late landing
+    un-wedges the engine with zero leaked slots or pages."""
+
+    async def test_wedged_dispatch_faults_typed_and_recovers(
+        self, params, tmp_path, monkeypatch
+    ):
+        import threading
+
+        from calfkit_tpu.exceptions import EngineWedgedError
+
+        monkeypatch.setenv("CALFKIT_FLIGHTREC_DIR", str(tmp_path))
+        with virtual_clock() as clock:
+            runtime = _rt(
+                max_batch_size=1, watchdog_stall_s=0.5,
+                decode_steps_per_dispatch=2,
+            )
+            engine = InferenceEngine(CFG, runtime, params=params)
+            gate = threading.Event()
+            script = ChaosScript().block_at("dispatch", 2, gate)
+            engine._chaos = script
+            await engine.start()
+            try:
+                active = asyncio.create_task(
+                    _collect(engine, [1, 2, 3], 32, corr="wedge-active")
+                )
+                await settle(
+                    lambda: script.calls.get("dispatch", 0) >= 2,
+                    message="the dispatch never reached the block point",
+                )
+                queued = asyncio.create_task(
+                    _collect(engine, [4, 5], 32, corr="wedge-queued")
+                )
+                await settle(
+                    lambda: engine._pending,
+                    message="the second request never queued",
+                )
+                # no landing while the clock passes the threshold
+                clock.advance(0.6)
+                with pytest.raises(EngineWedgedError):
+                    await asyncio.wait_for(active, timeout=10)
+                with pytest.raises(EngineWedgedError):
+                    await asyncio.wait_for(queued, timeout=10)
+                assert engine._wedged
+                assert engine.stats.watchdog_trips == 1
+                assert engine.stats.watchdog_faulted == 2
+                # readiness follows the wedge (advert + /readyz)
+                model = JaxLocalModelClient(
+                    config=CFG, runtime=runtime, engine=engine
+                )
+                ready, reason = model.ready()
+                assert ready is False and "wedged" in reason
+                assert model.stats_snapshot()["wedged"] is True
+                # a submit during the wedge sheds fast and typed
+                with pytest.raises(EngineWedgedError):
+                    await _collect(engine, [9], 4, corr="wedge-late")
+                # the dump landed and carries the WEDGE event
+                dumps = list(tmp_path.glob("*.jsonl"))
+                assert dumps, "no wedge dump written"
+                events = _journal_events(engine)
+                assert any(e["event"] == "WEDGE" for e in events)
+                # ---- recovery: the grant returns, a landing un-wedges
+                clock.advance(0.01)
+                gate.set()
+                await settle(
+                    lambda: not engine._wedged,
+                    message="a landing never un-wedged the engine",
+                )
+                assert model.ready()[0] is True
+                await settle(lambda: _drained(engine))
+                assert_engine_drained(engine)
+                # serving resumes for new work
+                tokens = await _collect(engine, [1, 2], 4, corr="after")
+                assert tokens
+            finally:
+                gate.set()
+                await engine.stop()

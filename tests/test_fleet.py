@@ -526,8 +526,13 @@ class TestFleetCli:
         assert " yes" in by_instance["aaaa"]
         assert by_instance["aaaa"].split()[7] == "3"  # DEPTH = active+pending
         assert " drain" in by_instance["bbbb"]
-        assert " stale" in by_instance["cccc"]
-        assert " unready" in by_instance["dddd"]
+        # the dead-placement law (ISSUE 9) outranks the routing verdict:
+        # stale and unready-without-drain replicas render as DEAD (runs
+        # placed there are being failed over), with the heartbeat age
+        # visible in the HB AGE S column
+        assert " dead(stale)" in by_instance["cccc"]
+        assert by_instance["cccc"].split()[6] == "121.0"  # HB age
+        assert " dead(unready)" in by_instance["dddd"]
         assert " shared-only" in by_instance["eeee"]
 
     def test_render_fleet_table_empty(self):
@@ -586,4 +591,215 @@ class TestFleetCli:
                 assert out.count(" yes") == 2
                 for i in range(2):
                     assert fleet.instance_id(i) in out
+            await mesh.stop()
+
+
+# ---------------------------------------------------------- failure recovery
+class TestFailureRecoveryLaws:
+    """Pure-law units for ISSUE 9: the dead-placement verdict, the
+    stream-resume dedupe ledger, RetryPolicy jitter bounds, and the
+    registry's version-counter fast path."""
+
+    def test_placement_verdict_law(self):
+        from calfkit_tpu.fleet import placement_verdict
+
+        alive = _replica("a1")
+        assert placement_verdict(alive, stale_after=15.0, now=NOW) == "alive"
+        # gone: the advert left the table without a drain
+        assert (
+            placement_verdict(None, stale_after=15.0, now=NOW) == "dead:gone"
+        )
+        # stale: heartbeat lapsed past stale_after on the wall_clock seam
+        stale = _replica("a2", heartbeat_at=NOW - 20)
+        assert (
+            placement_verdict(stale, stale_after=15.0, now=NOW)
+            == "dead:stale"
+        )
+        # unready WITHOUT draining: the wedge watchdog's signature
+        wedged = _replica("a3", ready=False)
+        assert (
+            placement_verdict(wedged, stale_after=15.0, now=NOW)
+            == "dead:unready"
+        )
+        # draining is ALIVE: in-flight work finishes by contract — even
+        # when the drain also flipped readiness
+        draining = _replica("a4", ready=False, draining=True)
+        assert (
+            placement_verdict(draining, stale_after=15.0, now=NOW) == "alive"
+        )
+
+    def test_stream_ledger_contiguity(self):
+        from calfkit_tpu.fleet import StreamLedger
+
+        ledger = StreamLedger()
+        # first attempt: everything is fresh
+        assert ledger.filter("alpha ") == "alpha "
+        assert ledger.filter("beta ") == "beta "
+        assert ledger.delivered == len("alpha beta ")
+        # failover: the replay suppresses exactly the delivered prefix,
+        # across chunk boundaries that do not line up with the original
+        ledger.begin_attempt()
+        assert ledger.filter("alp") == ""
+        assert ledger.filter("ha bet") == ""
+        assert ledger.filter("a gamma ") == "gamma "
+        assert ledger.filter("delta") == "delta"
+        assert ledger.text == "alpha beta gamma delta"
+        # a second failover mid-replay: the cursor resets again
+        ledger.begin_attempt()
+        assert ledger.filter("alpha beta gamma delta!") == "!"
+
+    def test_retry_delay_jitter_bounds(self):
+        """RetryPolicy.delay(attempt) must stay in
+        [raw * (1 - jitter), raw] with raw = min(base * mult^attempt,
+        max_delay) — a delay outside the band either hammers (too
+        short) or wastes deadline budget (too long)."""
+        from calfkit_tpu.client.caller import RetryPolicy
+
+        policy = RetryPolicy(
+            attempts=5, base_delay=0.05, max_delay=2.0, multiplier=2.0,
+            jitter=0.5,
+        )
+        # rng = 0 draws NO jitter (the full raw delay); rng -> 1 removes
+        # the full jitter fraction
+        for attempt in range(6):
+            raw = min(0.05 * 2.0**attempt, 2.0)
+            full = RetryPolicy(
+                attempts=5, base_delay=0.05, jitter=0.5, rng=lambda: 0.0
+            ).delay(attempt)
+            floor = RetryPolicy(
+                attempts=5, base_delay=0.05, jitter=0.5,
+                rng=lambda: 0.9999999,
+            ).delay(attempt)
+            assert abs(full - raw) < 1e-12
+            assert raw * 0.5 - 1e-9 <= floor <= raw
+        # deterministic rng: the whole schedule pins
+        rng = random.Random(7).random
+        got = [
+            round(
+                RetryPolicy(
+                    attempts=5, base_delay=0.05, jitter=0.5, rng=rng
+                ).delay(a),
+                6,
+            )
+            for a in range(4)
+        ]
+        rng2 = random.Random(7).random
+        expected = [
+            round(
+                min(0.05 * 2.0**a, 2.0) * (1.0 - 0.5 * rng2()), 6
+            )
+            for a in range(4)
+        ]
+        assert got == expected
+        # the cap: delays never exceed max_delay
+        assert policy.delay(50) <= 2.0
+
+    async def test_registry_version_fast_path(self, monkeypatch):
+        """The O(1) no-change path (ISSUE 9 satellite): with a version-
+        counting reader, an unchanged table re-parses NOTHING — and a
+        heartbeat rewrite is detected by the counter, not a byte scan."""
+        from calfkit_tpu.fleet import registry as registry_mod
+
+        calls = {"n": 0}
+        real = registry_mod.parse_replicas
+
+        def counting(items):
+            calls["n"] += 1
+            return real(items)
+
+        monkeypatch.setattr(registry_mod, "parse_replicas", counting)
+        with virtual_clock(NOW):
+            mesh = InMemoryMesh()
+            await mesh.start()
+            writer = mesh.table_writer(protocol.ENGINE_STATS_TOPIC)
+            key, wire = _wire(_replica("i1"))
+            await writer.put(key, wire)
+            registry = ReplicaRegistry(mesh)
+            await registry.start()
+            assert registry._reader.version is not None
+            assert len(registry.eligible("svc")) == 1
+            first = calls["n"]
+            assert first == 1
+            for _ in range(50):
+                registry.eligible("svc")
+            assert calls["n"] == first, "unchanged table was re-parsed"
+            # a rewrite (same key, fresh heartbeat) bumps the version and
+            # re-parses exactly once
+            key, wire = _wire(_replica("i1", active=3))
+            await writer.put(key, wire)
+            assert registry.eligible("svc")[0].stats.active_requests == 3
+            assert calls["n"] == first + 1
+            # by-key lookup rides the same cache (the failover probe)
+            assert registry.replica(key) is not None
+            assert registry.replica("agent.svc@nope") is None
+            assert calls["n"] == first + 1
+            await registry.stop()
+            await mesh.stop()
+
+    async def test_exclusion_accumulates_across_shed_and_failover(self):
+        """Mixed recovery on one call (ISSUE 9 satellite): attempt 1
+        sheds (typed OVERLOADED -> excluded), attempt 2 lands on a
+        replica that is already dead (killed -> placement dead ->
+        excluded), attempt 3 completes on the last replica.  The
+        exclusion set must ACCUMULATE across both mechanisms — neither
+        the shed source nor the corpse is ever re-picked."""
+        from calfkit_tpu.client.caller import RetryPolicy
+        from calfkit_tpu.exceptions import EngineOverloadedError
+        from calfkit_tpu.fleet import FailoverPolicy, FleetRouter
+
+        with virtual_clock(NOW) as clock:
+            mesh = InMemoryMesh()
+            models = [ServingStubModel(text=f"r{i}") for i in range(3)]
+            async with FleetTopology(mesh, models) as fleet:
+                order = sorted(range(3), key=fleet.replica_key)
+                shedder, corpse, survivor = order
+
+                async def shed(messages, settings=None, params=None):
+                    raise EngineOverloadedError(
+                        "synthetic shed", lane="short", pending=9, limit=1
+                    )
+
+                models[shedder].request = shed
+                router = FleetRouter(
+                    mesh, "least-loaded",
+                    stale_after=fleet.config.stale_after,
+                )
+                client = Client.connect(
+                    mesh, router=router,
+                    failover=FailoverPolicy(
+                        probe_interval=0.02, max_failovers=2
+                    ),
+                )
+                await router.start()
+                await settle(
+                    lambda: len(router.registry.eligible("svc")) == 3,
+                    message="fleet never became routable",
+                )
+                # the corpse dies BEFORE the call: its advert is still
+                # fresh, so attempt 2 places onto it after the shed
+                fleet.kill(corpse)
+                call = __import__("asyncio").create_task(
+                    client.agent("svc").execute(
+                        "mixed", timeout=30,
+                        retry=RetryPolicy(attempts=3, base_delay=0.01),
+                    )
+                )
+                # attempt 1 -> shedder (lowest key) sheds; attempt 2 ->
+                # corpse (next key) buffers in the dead gate
+                await settle(
+                    lambda: fleet.transports[corpse].dead
+                    and any(
+                        g.buffered for g in fleet.transports[corpse]._gates
+                    ),
+                    message="attempt 2 never targeted the corpse",
+                )
+                clock.advance(fleet.config.stale_after + 1)
+                result = await call
+                assert result.output == f"r{survivor}"
+                assert fleet.calls_delivered(shedder) == 1
+                assert fleet.calls_delivered(corpse) == 0
+                assert fleet.calls_delivered(survivor) == 1
+                # the final placement was marked as a failover re-dispatch
+                assert fleet.agents[survivor]._failover_requests == 1
+                await client.close()
             await mesh.stop()
