@@ -30,6 +30,7 @@ def _register() -> None:
         ("calfkit_tpu.cli.obs", "trace_command"),
         ("calfkit_tpu.cli.obs", "stats_command"),
         ("calfkit_tpu.cli.obs", "fleet_command"),
+        ("calfkit_tpu.cli.obs", "leases_command"),
         ("calfkit_tpu.cli.obs", "timeline_command"),
     ):
         if find_spec(module_name) is None:

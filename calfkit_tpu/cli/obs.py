@@ -42,6 +42,18 @@ from calfkit_tpu.models.records import (
 _BAR_WIDTH = 32
 
 
+def _format_table(rows: "list[tuple]") -> str:
+    """Shared column-aligned table rendering (stats / fleet / leases —
+    one layout authority, not three drifting copies)."""
+    widths = [
+        max(len(row[i]) for row in rows) for i in range(len(rows[0]))
+    ]
+    return "\n".join(
+        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
+        for row in rows
+    )
+
+
 def _depth_of(span: SpanRecord, by_id: dict[str, SpanRecord]) -> int:
     depth = 0
     seen: set[str] = {span.span_id}
@@ -92,8 +104,8 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
             "NODE", "MODEL", "TOK/S", "OCC", "BATCH OCC", "TOK/DISP",
             "ACTIVE", "SLOTS",
             "DECODED", "TTFT P50/P99 MS", "GAP P99 MS", "WASTE",
-            "SHED", "EXPIRED", "CANCELS", "FAILOVER/HEDGE", "WEDGE",
-            "FREC APP/DROP",
+            "SHED", "EXPIRED", "CANCELS", "ORPHANS", "FAILOVER/HEDGE",
+            "WEDGE", "FREC APP/DROP",
         )
     ]
     for r in records:
@@ -174,6 +186,11 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
                 shed,
                 str(r.expired_requests),
                 cancels,
+                # caller liveness (ISSUE 10): runs the server-side
+                # reaper abandoned because their caller's lease lapsed —
+                # nonzero here means dead callers' work is being
+                # reclaimed instead of burning TPU time to its deadline
+                str(r.orphaned_requests),
                 recovery,
                 wedge,
                 frec,
@@ -181,11 +198,7 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
         )
     if len(rows) == 1:
         return "no live engines (is a worker with a local model running?)"
-    widths = [max(len(row[i]) for row in rows) for i in range(len(rows[0]))]
-    return "\n".join(
-        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
-        for row in rows
-    )
+    return _format_table(rows)
 
 
 def render_fleet_table(
@@ -274,11 +287,7 @@ def render_fleet_table(
             "no advertised replicas (is a worker with a local model "
             "running, and the control plane enabled?)"
         )
-    widths = [max(len(row[i]) for row in rows) for i in range(len(rows[0]))]
-    return "\n".join(
-        "  ".join(cell.ljust(w) for cell, w in zip(row, widths)).rstrip()
-        for row in rows
-    )
+    return _format_table(rows)
 
 
 def _parse_spans(items: dict[str, bytes], correlation_id: str) -> list[SpanRecord]:
@@ -385,6 +394,71 @@ def fleet_command(
             await mesh.stop()
         replicas.sort(key=lambda r: (r.model_name, r.key))
         click.echo(render_fleet_table(replicas, stale_after=stale_after))
+
+    asyncio.run(main())
+
+
+# ----------------------------------------------------------------- leases
+def render_leases_table(
+    items: "dict[str, bytes]", *, now: "float | None" = None
+) -> str:
+    """The caller-liveness view (ISSUE 10): one row per lease on the
+    compacted ``mesh.caller_liveness`` table — lease id, beat age, TTL,
+    and the verdict the engines' orphan reaper would reach RIGHT NOW
+    (``live`` / ``lapsed``), computed by the same lapse law
+    (``age > ttl``) so the operator table cannot drift from reaping."""
+    import json as _json
+
+    from calfkit_tpu import cancellation
+
+    if now is None:
+        now = cancellation.wall_clock()
+    rows = [("LEASE", "BEAT AGE S", "TTL S", "VERDICT")]
+    for key in sorted(items):
+        try:
+            body = _json.loads(items[key])
+            beat_at = float(body["beat_at"])
+            ttl = float(body["ttl_s"])
+        except (ValueError, KeyError, TypeError):
+            rows.append((key, "?", "?", "undecodable"))
+            continue
+        age = max(0.0, now - beat_at)
+        rows.append(
+            (
+                key,
+                f"{age:.1f}",
+                f"{ttl:.1f}",
+                "lapsed" if age > ttl else "live",
+            )
+        )
+    if len(rows) == 1:
+        return (
+            "no caller leases (no leased client is running, or none has "
+            "beaten yet — leases are opt-in via Client(lease_ttl=...))"
+        )
+    return _format_table(rows)
+
+
+@click.command(
+    "leases",
+    help="print live caller-liveness leases: beat age vs TTL, and the "
+    "orphan reaper's live/lapsed verdict per lease",
+)
+@click.option("--mesh", "mesh_url", default=None, help="mesh url (or $CALFKIT_MESH_URL)")
+@click.option("--timeout", default=15.0, show_default=True, help="catch-up timeout (s)")
+def leases_command(mesh_url: str | None, timeout: float) -> None:
+    async def main() -> None:
+        mesh = resolve_mesh_for_cli(mesh_url, hosts_worker=False)
+        await mesh.start()
+        try:
+            reader = mesh.table_reader(protocol.CALLER_LIVENESS_TOPIC)
+            await reader.start(timeout=timeout)
+            await reader.barrier(timeout=timeout)
+            items = reader.items()
+            await reader.stop()
+        finally:
+            await mesh.stop()
+        click.echo(render_leases_table(items))
 
     asyncio.run(main())
 

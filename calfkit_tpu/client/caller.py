@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import logging
 import random
 import uuid
 from dataclasses import dataclass
@@ -47,9 +48,15 @@ from calfkit_tpu.models.state import State
 from calfkit_tpu.client.events import EventStream
 from calfkit_tpu.client.hub import Hub, InvocationHandle
 
+logger = logging.getLogger(__name__)
+
 OutputT = TypeVar("OutputT")
 
 DEFAULT_TIMEOUT = 60.0
+# a leased run with no deadline still leaves the outstanding set
+# eventually: the beat loop prunes it after this many seconds, so a
+# dropped fire-and-forget terminal cannot pin heartbeats forever
+_LEASE_RUN_FALLBACK_S = 3600.0
 
 
 @dataclass(frozen=True)
@@ -99,6 +106,7 @@ class Client:
         retry: "RetryPolicy | None" = None,
         router: Any = None,  # FleetRouter | policy name | None
         failover: "FailoverPolicy | None" = None,
+        lease_ttl: "float | None" = None,
     ):
         self.mesh = mesh
         self.client_id = client_id or uuid.uuid4().hex[:12]
@@ -127,7 +135,22 @@ class Client:
         # mid-run.  None = calls ride their placement to the caller's
         # timeout, the pre-ISSUE-9 behavior.
         self.failover = failover
+        # opt-in caller liveness lease (ISSUE 10): with a TTL set, every
+        # call carries an ``x-mesh-lease`` header and — while any run is
+        # outstanding — this client heartbeats the compacted
+        # ``mesh.caller_liveness`` table at ttl/3.  Engines whose run's
+        # lease lapses reap it server-side (typed ``mesh.orphaned``): the
+        # recovery path that covers fire-and-forget ``send()``, which no
+        # client-side supervisor can.  None = un-leased (pre-ISSUE-10):
+        # a dead caller's runs burn until their deadline.
+        self.lease_ttl = lease_ttl
+        self._lease_id = uuid.uuid4().hex[:12] if lease_ttl else None
+        self._lease_runs: dict[str, float] = {}  # corr -> prune-after epoch
+        self._lease_task: "asyncio.Task | None" = None
+        self._lease_writer: Any = None
         self._hub = Hub()
+        if self._lease_id is not None:
+            self._hub.on_terminal = self._note_run_terminal
         self._subscription: Subscription | None = None
         self._started = False
         self._closed = False
@@ -151,6 +174,7 @@ class Client:
         retry: "RetryPolicy | None" = None,
         router: Any = None,
         failover: "FailoverPolicy | None" = None,
+        lease_ttl: "float | None" = None,
     ) -> "Client":
         """Lazy constructor: performs no I/O (reference: caller.py:102).
 
@@ -165,6 +189,7 @@ class Client:
         client = cls(
             transport, client_id=client_id, default_timeout=default_timeout,
             retry=retry, router=router, failover=failover,
+            lease_ttl=lease_ttl,
         )
         client._owns_mesh = owned
         return client
@@ -181,6 +206,13 @@ class Client:
                 return
             await self.mesh.start()
             await self.mesh.ensure_topics([self.inbox_topic])
+            if self._lease_id is not None:
+                await self.mesh.ensure_topics(
+                    [protocol.CALLER_LIVENESS_TOPIC], compacted=True
+                )
+                self._lease_writer = self.mesh.table_writer(
+                    protocol.CALLER_LIVENESS_TOPIC
+                )
             # inbox must be consuming BEFORE any call publishes
             self._subscription = await self.mesh.subscribe(
                 [self.inbox_topic],
@@ -191,8 +223,109 @@ class Client:
             )
             self._started = True
 
+    # ------------------------------------------------- caller liveness
+    # (ISSUE 10) One lease per CLIENT process, not per run: the beat loop
+    # publishes a compact record keyed by the lease id while any leased
+    # run is outstanding, and close() releases the lease (tombstone) so
+    # a clean departure orphans its leftovers immediately instead of
+    # after a TTL of silence.
+
+    @property
+    def lease_id(self) -> "str | None":
+        return self._lease_id
+
+    def _lease_header(self) -> "str | None":
+        if self._lease_id is None or self.lease_ttl is None:
+            return None
+        return protocol.format_lease(self._lease_id, self.lease_ttl)
+
+    def _note_run_started(
+        self, correlation_id: str, deadline: "float | None"
+    ) -> None:
+        """Count a leased run as outstanding (and start beating).  The
+        prune horizon bounds fire-and-forget runs whose terminal nobody
+        awaits: the run's own deadline when it has one, else a fallback
+        — a dropped terminal must not pin heartbeats forever."""
+        if self._lease_id is None:
+            return
+        prune_at = (
+            deadline
+            if deadline is not None
+            else cancellation.wall_clock() + _LEASE_RUN_FALLBACK_S
+        )
+        self._lease_runs[correlation_id] = prune_at
+        if self._lease_task is None or self._lease_task.done():
+            self._lease_task = asyncio.get_running_loop().create_task(
+                self._beat_lease(), name="caller-lease-heartbeat"
+            )
+
+    def _note_run_terminal(self, correlation_id: str) -> None:
+        """Hub hook: ANY terminal reply (including one for a dropped
+        fire-and-forget handle) retires the run from the outstanding
+        set — the beat loop goes quiet once the set empties."""
+        self._lease_runs.pop(correlation_id, None)
+
+    def _prune_lease_runs(self) -> None:
+        """Drop runs past their prune horizon — UNLESS the caller still
+        holds a live handle (the hub's weak channel map answers that):
+        the fallback horizon exists for dropped fire-and-forget
+        terminals, and silently stopping heartbeats under an
+        un-deadlined run somebody is actively awaiting would make the
+        engine orphan a LIVE caller's run.  Awaited runs re-arm."""
+        now = cancellation.wall_clock()
+        for corr, at in list(self._lease_runs.items()):
+            if at > now:
+                continue
+            if self._hub._channels.get(corr) is not None:
+                # handle still alive: the caller is awaiting — keep
+                # beating and push the horizon out another window
+                self._lease_runs[corr] = now + _LEASE_RUN_FALLBACK_S
+            else:
+                del self._lease_runs[corr]
+
+    async def _beat_lease(self) -> None:
+        """Publish caller heartbeats at ttl/3 while runs are outstanding.
+        Per-beat resilient (a flaky broker logs and retries next tick —
+        the engine grants a full TTL of grace); exits when the
+        outstanding set drains, restarted by the next leased start()."""
+        assert self.lease_ttl is not None and self._lease_id is not None
+        from calfkit_tpu import leases
+
+        interval = max(0.02, self.lease_ttl / 3.0)
+        while not self._closed:
+            self._prune_lease_runs()
+            if not self._lease_runs:
+                return
+            try:
+                await self._lease_writer.put(
+                    self._lease_id,
+                    leases.beat_payload(self._lease_id, self.lease_ttl),
+                )
+            except Exception:  # noqa: BLE001 - per-beat resilience
+                logger.warning(
+                    "caller lease beat failed (retrying next tick)",
+                    exc_info=True,
+                )
+            await asyncio.sleep(interval)
+
+    async def _release_lease(self) -> None:
+        """Clean departure: stop beating and tombstone the lease —
+        outstanding leased runs become orphans NOW (the server-side
+        reaper grants no TTL grace to a deliberate close)."""
+        if self._lease_task is not None:
+            self._lease_task.cancel()
+            with contextlib.suppress(asyncio.CancelledError, Exception):
+                await self._lease_task
+            self._lease_task = None
+        if self._lease_writer is not None and self._lease_id is not None:
+            with contextlib.suppress(Exception):
+                await asyncio.wait_for(
+                    self._lease_writer.tombstone(self._lease_id), 2.0
+                )
+
     async def close(self) -> None:
         self._closed = True
+        await self._release_lease()
         pending = {
             t
             for t in (*self._span_tasks, *self._cancel_tasks)
@@ -328,6 +461,12 @@ class Client:
             # the mesh deadline: minted once from the caller's timeout,
             # forwarded absolute by every hop (protocol.HDR_DEADLINE)
             headers[protocol.HDR_DEADLINE] = protocol.format_deadline(deadline)
+        lease = self._lease_header()
+        if lease is not None:
+            # the caller liveness lease (ISSUE 10): forwarded by every
+            # hop like the deadline — downstream work runs on the
+            # ORIGINAL caller's behalf and dies with its lease
+            headers[protocol.HDR_LEASE] = lease
         if attempt:
             # failure recovery (ISSUE 9): "failover" | "hedge" — this
             # placement only, counted by the serving agent's advert
@@ -476,6 +615,11 @@ class AgentGateway(Generic[OutputT]):
 
         # register BEFORE publish: the reply cannot beat the handle
         channel = client._hub.track(correlation_id, task_id)
+        # caller liveness (ISSUE 10): the run joins the lease's
+        # outstanding set BEFORE publish (heartbeats must be flowing by
+        # the time the engine registers the run); the hub's terminal
+        # hook retires it — even for a dropped fire-and-forget handle
+        client._note_run_started(correlation_id, deadline)
         handle: InvocationHandle[OutputT] = InvocationHandle(
             channel,
             self.output_type,
@@ -515,9 +659,11 @@ class AgentGateway(Generic[OutputT]):
             # the call never reached the mesh: no terminal will resolve,
             # so uncharge the replica NOW — a phantom in-flight entry
             # would bias placement away from a healthy replica for the
-            # whole TTL
+            # whole TTL — and retire the run from the lease's
+            # outstanding set (its terminal can never arrive)
             if router is not None:
                 router.note_done(routed.key, correlation_id)
+            client._note_run_terminal(correlation_id)
             raise
         return handle
 
@@ -846,13 +992,16 @@ class AgentGateway(Generic[OutputT]):
     ) -> "StepEvent | None":
         """Apply the stream-resume dedupe law to one step event: token
         steps pass through the ledger (suppressing the replayed prefix
-        after a failover); None = fully-replayed, drop it.  Non-token
-        steps pass through unchanged — they carry no offsets to dedupe
-        on, so a failover may repeat them (documented)."""
+        after a failover); None = fully-replayed, drop it.  Offset-
+        stamped steps (ISSUE 10) align the ledger exactly — a resumed
+        attempt's first chunk arrives at the delivered-prefix offset and
+        passes through whole.  Non-token steps pass through unchanged —
+        they carry no offsets to dedupe on, so a failover may repeat
+        them (documented)."""
         step = event.step
         if getattr(step, "kind", "") != "token":
             return event
-        text = ledger.filter(step.text)
+        text = ledger.filter(step.text, getattr(step, "offset", None))
         if not text:
             return None
         if text != step.text:
@@ -911,6 +1060,12 @@ class AgentGateway(Generic[OutputT]):
 
         exclude: set[str] = set()
         failovers = 0
+        # decode-from-offset resume is a SINGLE-TURN contract: the hint
+        # seeds the re-attempt's first model turn, so a run that already
+        # dispatched tool calls (its delivered text spans turns) must
+        # replay wholly instead — the ledger's cumulative law keeps the
+        # stream contiguous either way
+        multi_turn = False
         handle = await self.start(
             prompt, message_history=message_history, deps=deps,
             route=route, timeout=effective,
@@ -941,9 +1096,12 @@ class AgentGateway(Generic[OutputT]):
                         return_when=asyncio.FIRST_COMPLETED,
                     )
                     if step_task in done:
-                        event = self._filter_step(
-                            step_task.result(), ledger
-                        )
+                        raw = step_task.result()
+                        if getattr(raw.step, "kind", "") in (
+                            "tool_call", "tool_result", "handoff"
+                        ):
+                            multi_turn = True
+                        event = self._filter_step(raw, ledger)
                         if event is not None:
                             yield event
                         step_task = asyncio.ensure_future(
@@ -1023,10 +1181,13 @@ class AgentGateway(Generic[OutputT]):
                     else min(fo.probe_interval, max(rem, 0.0))
                 )
             resume_deps = dict(deps or {})
-            if ledger.text:
-                # the continuation hint: prompt + already-delivered text
-                # (agents MAY seed generation with it; the dedupe ledger
-                # guarantees contiguity either way)
+            if ledger.text and not multi_turn:
+                # the continuation hint: prompt + already-delivered text.
+                # The agent's first model turn CONSUMES it (decode-from-
+                # offset, ISSUE 10); multi-turn runs omit it — delivered
+                # text spanning tool-call turns would corrupt the first
+                # turn's continuation — and replay wholly instead (the
+                # dedupe ledger guarantees contiguity either way)
                 resume_deps["calfkit.resume_text"] = ledger.text
             handle = await self.start(
                 prompt,

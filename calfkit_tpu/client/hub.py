@@ -255,6 +255,13 @@ class Hub:
             weakref.WeakValueDictionary()
         )
         self._firehose_taps: list[Any] = []  # EventStream instances
+        # terminal-arrival hook (ISSUE 10): called with the correlation
+        # id of EVERY terminal reply this inbox observes — including
+        # replies to abandoned/fire-and-forget runs whose channel is
+        # gone.  The client's lease heartbeat uses it to stop counting a
+        # run as outstanding the moment its terminal lands, which no
+        # handle-side callback can do for a dropped handle.
+        self.on_terminal: "Any | None" = None
 
     def track(self, correlation_id: str, task_id: str) -> _RunChannel:
         channel = _RunChannel(correlation_id=correlation_id, task_id=task_id)
@@ -304,6 +311,11 @@ class Hub:
         except ValueError:
             logger.warning("undecodable reply on client inbox dropped")
             return
+        if self.on_terminal is not None and correlation_id:
+            try:
+                self.on_terminal(correlation_id)
+            except Exception:  # noqa: BLE001 - the hook never blocks replies
+                logger.debug("on_terminal hook failed", exc_info=True)
         channel = self._channels.get(correlation_id or "")
         if channel is None:
             logger.debug(

@@ -22,6 +22,11 @@ logger = logging.getLogger(__name__)
 
 CAPABILITY_VIEW_KEY = "capability_view"
 AGENTS_VIEW_KEY = "agents_view"
+# set truthy on every node once the caller-liveness feed is consuming:
+# the node kernel only ENFORCES leases (registers runs for the orphan
+# reaper) where beats can actually arrive — a worker with no control
+# plane must not orphan a live caller's run one TTL after admission
+CALLER_LIVENESS_FEED_KEY = "caller_liveness_feed"
 
 
 class _Attached:
@@ -29,9 +34,11 @@ class _Attached:
         self,
         publisher: ControlPlanePublisher,
         views: list[ControlPlaneView[Any]],
+        liveness: Any = None,  # caller-liveness feed subscription
     ):
         self._publisher = publisher
         self._views = views
+        self._liveness = liveness
 
     async def stop(self) -> None:
         await self._publisher.stop()  # tombstones first
@@ -40,6 +47,21 @@ class _Attached:
                 await view.stop()
             except Exception:  # noqa: BLE001
                 logger.debug("view stop failed", exc_info=True)
+        if self._liveness is not None:
+            try:
+                await self._liveness.stop()
+            except Exception:  # noqa: BLE001
+                logger.debug("liveness feed stop failed", exc_info=True)
+
+
+async def _fold_caller_liveness(record: Any) -> None:
+    """The caller-liveness feed handler (ISSUE 10): fold every beat /
+    tombstone on ``mesh.caller_liveness`` into the process-wide lease
+    store the engine's orphan reaper reads.  Fail-open by construction
+    (``fold_liveness_record`` drops undecodables)."""
+    from calfkit_tpu import leases
+
+    leases.fold_liveness_record(record.key, record.value)
 
 
 class ControlPlane:
@@ -124,6 +146,7 @@ class ControlPlane:
                     protocol.CAPABILITIES_TOPIC,
                     protocol.ENGINE_STATS_TOPIC,
                     protocol.TRACES_TOPIC,
+                    protocol.CALLER_LIVENESS_TOPIC,
                 ],
                 compacted=True,
             )
@@ -131,18 +154,33 @@ class ControlPlane:
         # half-read directory.  Anything started before a failure is stopped
         # again — a failed attach must not orphan readers.
         started: list[ControlPlaneView[Any]] = []
+        liveness = None
         try:
             for view in (capability_view, agents_view):
                 await view.start()
                 started.append(view)
+
+            # caller-liveness feed (ISSUE 10): every worker folds the
+            # compacted beat table into the process lease store, so the
+            # engines it hosts can reap runs whose caller died — no
+            # per-engine subscription, one feed per worker process
+            liveness = await transport.subscribe(
+                [protocol.CALLER_LIVENESS_TOPIC],
+                _fold_caller_liveness,
+                group_id=None,
+                from_latest=False,
+                ordered=False,
+            )
 
             adverts: list[Advert] = []
             for node in worker.nodes:
                 adverts.extend(self.adverts_for(node))
                 node.resources.setdefault(CAPABILITY_VIEW_KEY, capability_view)
                 node.resources.setdefault(AGENTS_VIEW_KEY, agents_view)
+                node.resources.setdefault(CALLER_LIVENESS_FEED_KEY, True)
             worker.resources.setdefault(CAPABILITY_VIEW_KEY, capability_view)
             worker.resources.setdefault(AGENTS_VIEW_KEY, agents_view)
+            worker.resources.setdefault(CALLER_LIVENESS_FEED_KEY, True)
 
             publisher = ControlPlanePublisher(transport, adverts, config)
             await publisher.start(ensure=ensure)  # fail-loud first adverts
@@ -152,8 +190,17 @@ class ControlPlane:
                     await view.stop()
                 except Exception:  # noqa: BLE001
                     logger.debug("view rollback stop failed", exc_info=True)
+            if liveness is not None:
+                try:
+                    await liveness.stop()
+                except Exception:  # noqa: BLE001
+                    logger.debug(
+                        "liveness rollback stop failed", exc_info=True
+                    )
             raise
         logger.info(
             "control plane attached: %d adverts, views live", len(adverts)
         )
-        return _Attached(publisher, [capability_view, agents_view])
+        return _Attached(
+            publisher, [capability_view, agents_view], liveness=liveness
+        )

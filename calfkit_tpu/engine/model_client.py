@@ -31,6 +31,15 @@ class ModelSettings(BaseModel):
     top_k: int | None = None
     stop_sequences: list[str] = Field(default_factory=list)
     seed: int | None = None
+    # decode-from-offset resume (ISSUE 10): text of THIS answer already
+    # delivered to the caller by a failed-over attempt.  A backend that
+    # honors it admits the prefix via prefill (the survivor's prefix
+    # cache absorbs the shared prompt pages), decodes only the remaining
+    # tokens, yields a ResumeOffset stream event first, and returns the
+    # FULL answer (prefix + continuation) in its terminal response.
+    # Backends that ignore it simply re-generate — the caller-side
+    # StreamLedger dedupes either way.
+    resume_text: str | None = None
     extra: dict[str, Any] = Field(default_factory=dict)
 
 
@@ -55,13 +64,23 @@ class TextDelta:
 
 
 @dataclass(frozen=True)
+class ResumeOffset:
+    """First event of a RESUMED stream (ISSUE 10): the backend honored
+    ``ModelSettings.resume_text`` and this attempt's TextDeltas begin at
+    character ``chars`` of the answer — nothing before that offset is
+    re-generated.  Consumers that ignore it see only the fresh text."""
+
+    chars: int
+
+
+@dataclass(frozen=True)
 class ResponseDone:
     """Terminal stream event carrying the complete response."""
 
     response: ModelResponse
 
 
-StreamEvent = Union[TextDelta, ResponseDone]
+StreamEvent = Union[TextDelta, ResumeOffset, ResponseDone]
 
 
 class ModelClient(abc.ABC):

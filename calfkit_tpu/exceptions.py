@@ -36,6 +36,7 @@ __all__ = [
     "EngineWedgedError",
     "DeadlineExceededError",
     "RunCancelledError",
+    "RunOrphanedError",
     "FAULT_TYPE_BY_EXCEPTION",
     "RETRIABLE_FAULT_TYPES",
     "error_type_for",
@@ -162,6 +163,23 @@ class RunCancelledError(CalfkitError):
     """
 
 
+class RunOrphanedError(CalfkitError):
+    """The run's CALLER liveness lease lapsed (ISSUE 10): heartbeats on
+    ``mesh.caller_liveness`` stopped for longer than the lease TTL (hard
+    caller death), or the caller released the lease on clean close — and
+    the engine's orphan reaper abandoned the run, freeing its slot,
+    pages, and prefix refs for callers that are still alive.  NOT
+    retriable: there is nobody left to answer.  This is what makes
+    fire-and-forget ``send()`` safe — the client-side failover
+    supervisor (ISSUE 9) cannot cover a run nobody awaits.
+    """
+
+    def __init__(self, message: str, *, lease_id: str = "", lapsed_s: float = 0.0):
+        self.lease_id = lease_id
+        self.lapsed_s = lapsed_s
+        super().__init__(message)
+
+
 # --------------------------------------------------------------------------- #
 # the authoritative x-mesh-error-type ↔ exception-class table
 # --------------------------------------------------------------------------- #
@@ -176,6 +194,7 @@ FAULT_TYPE_BY_EXCEPTION: dict[type[BaseException], str] = {
     EngineWedgedError: FaultTypes.WEDGED,
     DeadlineExceededError: FaultTypes.DEADLINE_EXCEEDED,
     RunCancelledError: FaultTypes.CANCELLED,
+    RunOrphanedError: FaultTypes.ORPHANED,
     ClientTimeoutError: FaultTypes.TIMEOUT,
     DeserializationError: FaultTypes.DESERIALIZATION_ERROR,
     InferenceError: FaultTypes.MODEL_ERROR,

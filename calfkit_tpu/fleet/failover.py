@@ -144,11 +144,21 @@ class StreamLedger:
     def begin_attempt(self) -> None:
         self._attempt_seen = 0
 
-    def filter(self, chunk: str) -> str:
+    def filter(self, chunk: str, offset: "int | None" = None) -> str:
         """The not-yet-observed suffix of ``chunk`` (empty while the
-        replay is still inside the already-delivered prefix)."""
-        start = self._attempt_seen
-        self._attempt_seen += len(chunk)
+        replay is still inside the already-delivered prefix).
+
+        ``offset`` (ISSUE 10) is the chunk's ABSOLUTE char offset within
+        the attempt's answer when the emitter stamped it
+        (``TokenStep.offset``): a decode-from-offset RESUME stamps its
+        first chunk at the delivered-prefix length — the ledger then
+        suppresses nothing, because nothing was re-generated — while a
+        re-generating attempt stamps from 0 and the replayed prefix is
+        trimmed exactly.  ``None`` (pre-ISSUE-10 emitters) falls back to
+        the cumulative chars-seen-this-attempt law, which is identical
+        for replay-from-zero streams."""
+        start = offset if offset is not None else self._attempt_seen
+        self._attempt_seen = start + len(chunk)
         overlap = len(self.text) - start  # chars of chunk already observed
         if overlap >= len(chunk):
             return ""

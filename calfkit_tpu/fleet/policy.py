@@ -72,11 +72,22 @@ class RoutingPolicy(Protocol):
 
 
 def _least(candidates: Sequence[Replica]) -> "Replica | None":
-    # ties break on the stable replica key, never on list order: two
-    # routers looking at the same directory must agree
+    # ties break FIRST on the advert's EWMA dispatch latency (ISSUE 10:
+    # between heartbeat beats N routers see identical depths — breaking
+    # the tie on which replica actually dispatches faster spreads the
+    # herd), THEN on the stable replica key, never on list order: two
+    # routers looking at the same directory must still agree.  A 0.0
+    # EWMA means NO SIGNAL (pre-EWMA advert in a rolling upgrade, or an
+    # engine that never dispatched) and ranks LAST among ties — sorting
+    # it first would deterministically herd ALL tied traffic onto the
+    # one replica nobody has latency evidence for, the exact failure
+    # this tiebreak exists to kill.  All-unknown ties fall through to
+    # the stable key, the pre-EWMA law.
     return min(
         candidates,
-        key=lambda r: (r.queue_depth, r.key),
+        key=lambda r: (
+            r.queue_depth, r.dispatch_ewma or float("inf"), r.key
+        ),
         default=None,
     )
 

@@ -95,6 +95,18 @@ class Replica:
             + self.router_inflight
         )
 
+    @property
+    def dispatch_ewma(self) -> float:
+        """EWMA decode-dispatch latency (ms) from the advert — the
+        many-router coherence tiebreak (ISSUE 10): when queue depths tie
+        (the normal state between heartbeat beats), policies prefer the
+        replica that is actually dispatching faster, so N independent
+        routers stop herding onto one lexicographic winner.  0.0 = no
+        signal (pre-EWMA advert, never-dispatched engine): the policy
+        ranks it LAST among ties — no latency evidence must not read as
+        zero latency — and all-unknown ties fall to the stable key."""
+        return self.stats.dispatch_ewma_ms
+
     def age(self, now: "float | None" = None) -> float:
         if now is None:
             now = cancellation.wall_clock()

@@ -23,6 +23,7 @@ from calfkit_tpu.engine.model_client import (
     ModelRequestParameters,
     ModelSettings,
     ResponseDone,
+    ResumeOffset,
     StreamEvent,
     TextDelta,
 )
@@ -346,6 +347,10 @@ class JaxLocalModelClient(ModelClient):
                 "cancelled_requests": 0,
                 "cancel_propagated": 0,
                 "delivery_stalled": 0,
+                # caller liveness (ISSUE 10) + router tiebreak: same key
+                # set as the live branch
+                "orphaned_requests": 0,
+                "dispatch_ewma_ms": 0.0,
                 # wedge watchdog (ISSUE 9): same key set as the live branch
                 "wedged": False,
                 "watchdog_trips": 0,
@@ -395,6 +400,12 @@ class JaxLocalModelClient(ModelClient):
             "cancelled_requests": stats.cancelled_requests,
             "cancel_propagated": stats.cancel_propagated,
             "delivery_stalled": stats.delivery_stalled,
+            # caller liveness (ISSUE 10): runs reaped because their
+            # caller's lease lapsed — the `ck stats` ORPHANS column
+            "orphaned_requests": stats.orphaned_requests,
+            # per-dispatch latency EWMA: the advert's many-router
+            # tiebreak signal (PowerOfTwoChoices breaks depth ties on it)
+            "dispatch_ewma_ms": round(stats.dispatch_ewma_ms, 3),
             # wedge watchdog (ISSUE 9): whether the dispatch-progress
             # watchdog currently declares the engine wedged (the advert's
             # ready flag follows it) plus its lifetime trip/fault counts
@@ -464,12 +475,9 @@ class JaxLocalModelClient(ModelClient):
         settings: ModelSettings | None = None,
         params: ModelRequestParameters | None = None,
     ) -> ModelResponse:
-        chunks: list[str] = []
-        usage = Usage()
         async for event in self.request_stream(messages, settings, params):
             if isinstance(event, ResponseDone):
                 return event.response
-            chunks.append(event.text)
         raise InferenceError("stream ended without a terminal response")
 
     async def request_stream(
@@ -485,6 +493,60 @@ class JaxLocalModelClient(ModelClient):
         prompt_text = render_messages(messages, params)
         prompt = [tokenizer.bos_id, *tokenizer.encode(prompt_text)]
         max_new = settings.max_tokens or self._max_new_tokens
+
+        # decode-from-offset resume (ISSUE 10): the delivered prefix of a
+        # failed-over stream enters as PREFILL — appended to the prompt,
+        # so the survivor's prefix cache absorbs the shared prompt pages
+        # and the chunk lane prefills only the continuation — and decode
+        # produces ONLY the remaining budget.  The caller-side ledger
+        # then dedupes nothing, because nothing is re-generated; under
+        # greedy decode the continuation is byte-exact with an unkilled
+        # run (round-trip tokenizers; BPE re-tokenization drift is
+        # documented in docs/robustness.md).
+        resume_tokens: list[int] = []
+        prior = ""
+        if settings.resume_text:
+            resume_tokens = list(tokenizer.encode(settings.resume_text))
+            prior = tokenizer.decode(resume_tokens)
+            prompt = prompt + resume_tokens
+            max_new = max(0, max_new - len(resume_tokens))
+
+        def terminal(full_text: str, generated_tokens: int) -> ResponseDone:
+            # ONE terminal builder for both exits (the resumed
+            # spent-budget short-circuit below and the normal tail):
+            # parser gating, parts assembly, and usage accounting must
+            # not fork.  Resume usage semantics (documented in
+            # docs/robustness.md): output_tokens counts what THIS
+            # engine generated — a resumed run's delivered prefix is
+            # input (it entered via prefill and was billed as output by
+            # the attempt that generated it), so summing attempts never
+            # double-counts the answer.
+            remaining, calls = (
+                self._parser(full_text)
+                if params.tool_defs or params.output_tool
+                else (full_text, [])
+            )
+            parts: list[Any] = []
+            if remaining:
+                parts.append(TextOutput(text=remaining))
+            parts.extend(calls)
+            return ResponseDone(
+                ModelResponse(
+                    parts=parts,
+                    usage=Usage(
+                        input_tokens=len(prompt),
+                        output_tokens=generated_tokens,
+                    ),
+                    model_name=self.model_name,
+                )
+            )
+
+        if settings.resume_text and max_new <= 0:
+            # the delivered prefix already spent the whole token budget:
+            # nothing to decode — the resumed stream is just its terminal
+            yield ResumeOffset(len(prior))
+            yield terminal(prior, 0)
+            return
 
         # per-request sampling: each provided knob overrides that knob of
         # the engine default (top_p alone must NOT force greedy by zeroing
@@ -550,16 +612,24 @@ class JaxLocalModelClient(ModelClient):
 
         started = time.perf_counter()
         generated: list[int] = []
-        emitted = 0
+        # a resumed stream's deltas begin past the already-delivered
+        # prefix: everything before ``emitted`` chars is prefill, never
+        # re-emitted (the ResumeOffset event tells consumers so)
+        emitted = len(prior)
         stopped_at = -1
         ttft_ms = 0.0
         _EMIT_EVERY = 4  # re-decode cadence: bounds detokenize cost
         # the delivery's mesh deadline rides the same contextvar channel as
         # the trace: the node kernel set it from x-mesh-deadline, so the
         # engine enforces the caller's ABSOLUTE budget (reject expired at
-        # admission, reap on expiry) with no per-layer arithmetic
+        # admission, reap on expiry) with no per-layer arithmetic; the
+        # caller's liveness lease (ISSUE 10) rides the identical channel
+        # so the engine registers this run for the orphan reaper
+        from calfkit_tpu import leases
         from calfkit_tpu.cancellation import current_deadline
 
+        if resume_tokens:
+            yield ResumeOffset(len(prior))
         token_stream = self._engine.generate(
             prompt,
             max_new_tokens=max_new,
@@ -570,6 +640,7 @@ class JaxLocalModelClient(ModelClient):
             # ``ck timeline <correlation-id>`` works from any log line
             corr=trace_parent.trace_id if trace_parent is not None else None,
             deadline=current_deadline.get(),
+            lease=leases.current_lease.get(),
         )
         stream_exc: BaseException | None = None
         try:
@@ -592,7 +663,9 @@ class JaxLocalModelClient(ModelClient):
                     continue
                 # emit only the prefix that can't change: a trailing
                 # replacement char may be a multi-byte sequence completing
-                text = tokenizer.decode(generated).rstrip("�")
+                # (resume: the full text includes the prefilled prefix so
+                # stop sequences spanning the resume boundary still cut)
+                text = tokenizer.decode(resume_tokens + generated).rstrip("�")
                 if stops:
                     stopped_at = first_stop(text)
                     if stopped_at != -1:
@@ -635,26 +708,11 @@ class JaxLocalModelClient(ModelClient):
                 )
         elapsed = time.perf_counter() - started
 
-        full_text = tokenizer.decode(generated)
+        full_text = tokenizer.decode(resume_tokens + generated)
         if stops and stopped_at == -1:
             stopped_at = first_stop(full_text)
         if stopped_at != -1:
             full_text = full_text[:stopped_at]
         if len(full_text) > emitted:
             yield TextDelta(full_text[emitted:])  # flush the tail
-        remaining, calls = (
-            self._parser(full_text) if params.tool_defs or params.output_tool
-            else (full_text, [])
-        )
-        parts: list[Any] = []
-        if remaining:
-            parts.append(TextOutput(text=remaining))
-        parts.extend(calls)
-        response = ModelResponse(
-            parts=parts,
-            usage=Usage(
-                input_tokens=len(prompt), output_tokens=len(generated)
-            ),
-            model_name=self.model_name,
-        )
-        yield ResponseDone(response)
+        yield terminal(full_text, len(generated))

@@ -38,13 +38,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from calfkit_tpu import cancellation
+from calfkit_tpu import cancellation, leases
 from calfkit_tpu.inference import ragged as ragged_math
 from calfkit_tpu.exceptions import (
     DeadlineExceededError,
     EngineOverloadedError,
     EngineWedgedError,
     InferenceError,
+    RunOrphanedError,
 )
 from calfkit_tpu.inference import model as M
 from calfkit_tpu.inference.config import ModelConfig, RuntimeConfig
@@ -315,6 +316,17 @@ class GenRequest:
     deadline: "float | None" = None
     expired: bool = False
     stalled: bool = False
+    # caller liveness lease (ISSUE 10): the CALLER's process lease this
+    # run is registered against (None = un-leased, the pre-lease
+    # behavior).  ``orphaned`` marks a lease-lapse reap so _raise_terminal
+    # raises the typed non-retriable RunOrphanedError — published to the
+    # (dead) reply topic for the record, since nobody is listening.
+    lease_id: "str | None" = None
+    lease_ttl: float = 0.0
+    # back-pointer into _lease_heap, nulled at retirement like
+    # deadline_entry so the heap never pins a finished request's memory
+    lease_entry: "list | None" = None
+    orphaned: bool = False
     # the dispatch-progress watchdog faulted this request (ISSUE 9): the
     # consumer's _consume raises a typed RETRIABLE EngineWedgedError so
     # the caller fails over to another replica instead of timing out
@@ -377,6 +389,10 @@ class EngineStats:
     cancelled_requests: int = 0
     cancel_propagated: int = 0
     delivery_stalled: int = 0
+    # caller liveness (ISSUE 10): runs abandoned because their CALLER's
+    # lease lapsed (queued or active — the server-side orphan reaper),
+    # surfaced as the ORPHANS column of `ck stats`
+    orphaned_requests: int = 0
     # ragged unified waves (ISSUE 6): prefill chunk tokens absorbed into
     # decode dispatches (slack compute that would otherwise idle), and
     # how many dispatches actually carried both kinds of work.  The
@@ -390,6 +406,13 @@ class EngineStats:
     # failed over instead of burning their deadlines)
     watchdog_trips: int = 0
     watchdog_faulted: int = 0
+    # EWMA of decode-dispatch latency (ms) — the advert's tiebreak signal
+    # for many-router coherence (ISSUE 10 satellite): N independent
+    # routers seeing identical queue depths between heartbeat beats stop
+    # herding when ties break on which replica is actually dispatching
+    # faster.  A fold, not a counter: it never enters _COUNTER_FIELDS /
+    # window deltas.
+    dispatch_ewma_ms: float = 0.0
     # snapshot_and_delta state: the previous window's counter values +
     # timestamp.  Single-consumer by design (the heartbeat advert) — two
     # delta readers would steal each other's intervals.
@@ -402,10 +425,26 @@ class EngineStats:
         "prefix_reused_tokens", "spec_proposed", "spec_accepted",
         "spec_emitted", "spec_rows", "overlap_wasted_tokens",
         "shed_requests", "expired_requests", "cancelled_requests",
-        "cancel_propagated", "delivery_stalled",
+        "cancel_propagated", "delivery_stalled", "orphaned_requests",
         "prefill_absorbed_tokens", "unified_dispatches",
         "watchdog_trips", "watchdog_faulted",
     )
+
+    # EWMA smoothing for dispatch_ewma_ms: ~5-dispatch memory — fresh
+    # enough to react inside one heartbeat interval, smooth enough that
+    # one slow compile-bearing dispatch doesn't whipsaw the tiebreak
+    EWMA_ALPHA = 0.2
+
+    def note_dispatch_ewma(self, sample_ms: float) -> None:
+        """Fold one dispatch's wall latency into the EWMA (hot path: one
+        multiply-add).  The first sample primes the fold directly — a
+        zero start would under-report for the whole warm-up."""
+        prev = self.dispatch_ewma_ms
+        if prev == 0.0:
+            self.dispatch_ewma_ms = sample_ms
+        else:
+            a = self.EWMA_ALPHA
+            self.dispatch_ewma_ms = a * sample_ms + (1.0 - a) * prev
 
     def counters(self) -> dict:
         """Every cumulative counter as a plain dict (occupancy_hist as a
@@ -720,6 +759,17 @@ class InferenceEngine:
         # (liveness re-checked at pop time).
         self._deadline_heap: list[list] = []
         self._deadline_seq = itertools.count()
+        # caller liveness (ISSUE 10): min-heap of [lease_expiry_epoch,
+        # seq, request] — the orphan reaper's O(1)-peek sweep, shaped
+        # exactly like the deadline heap (event-loop-only, lazy pops).
+        # A popped entry whose lease was REFRESHED since registration is
+        # re-pushed at its new expiry, so sustained heartbeats cost one
+        # push per TTL per run, not per pass.
+        self._lease_heap: list[list] = []
+        self._lease_seq = itertools.count()
+        # released-lease sweep cursor: a clean caller close must reap
+        # NOW, not at the registered expiry — one int compare per pass
+        self._lease_release_gen = leases.release_generation()
         # chaos seam (tests/_chaos.py): when set, called with a point name
         # ("tick" per scheduler pass, "dispatch" per decode tick) — an
         # exception it raises crosses the dispatch loop like any real
@@ -823,17 +873,21 @@ class InferenceEngine:
             )
 
     # ------------------------------------------------------------ jit build
-    def _resolved_attn_impl(self, path: str = "decode") -> str:
+    def _resolved_attn_impl(
+        self, path: str = "decode", fallback: "str | None" = None
+    ) -> str:
         """Resolve ``attention_impl`` for one jit path (``prefill`` /
-        ``decode`` / ``paged_decode``).
+        ``decode`` / ``paged_decode`` / ``ragged`` / ``paged_ragged``).
 
         "auto" is EVIDENCE-BASED (VERDICT r3 item 8): it reads the profile
         artifact ``scripts/profile_attention.py --out`` writes on hardware
         and flips to the per-path winner, but only when the artifact's
         platform matches the live backend (a TPU verdict must not steer a
         CPU run and vice versa).  No artifact, or no verdict for this path
-        → XLA, the safe default.  "pallas"/"pallas_interpret" opt in
-        explicitly everywhere."""
+        → the ``fallback`` path's winner (the ragged multi-query paths
+        fall back to their legacy single-query twin, so a pre-ragged
+        artifact keeps steering), else XLA, the safe default.
+        "pallas"/"pallas_interpret" opt in explicitly everywhere."""
         impl = self.runtime.attention_impl
         if impl != "auto":
             return impl
@@ -846,7 +900,10 @@ class InferenceEngine:
             return "xla"
         if verdict.get("platform") != platform:
             return "xla"
-        winner = (verdict.get("winners") or {}).get(path)
+        winners = verdict.get("winners") or {}
+        winner = winners.get(path)
+        if winner is None and fallback is not None:
+            winner = winners.get(fallback)
         return winner if winner in ("xla", "pallas", "pallas_interpret") else "xla"
 
     def _window_bucket(self, needed: int) -> int:
@@ -1025,7 +1082,10 @@ class InferenceEngine:
         if fn is not None:
             return fn
         cfg = self.config
-        attn_impl = self._resolved_attn_impl("decode")
+        # the verify dispatch runs the RAGGED multi-query kernel (one
+        # window read for all S positions) — "auto" resolves it on the
+        # ragged profile rows, falling back to the legacy decode verdict
+        attn_impl = self._resolved_attn_impl("ragged", fallback="decode")
 
         def verify(params, k, v, last, lens, active, drafts, ndraft,
                    stop_table, hard_end, slot_keys, temp, top_k, top_p):
@@ -1068,7 +1128,9 @@ class InferenceEngine:
         if fn is not None:
             return fn
         cfg = self.config
-        attn_impl = self._resolved_attn_impl("paged_decode")
+        attn_impl = self._resolved_attn_impl(
+            "paged_ragged", fallback="paged_decode"
+        )
 
         def verify(params, k, v, tables, last, lens, active, drafts,
                    ndraft, stop_table, hard_end, slot_keys, temp, top_k,
@@ -1457,6 +1519,7 @@ class InferenceEngine:
         seed: int | None = None,
         corr: str | None = None,
         deadline: float | None = None,
+        lease: "tuple[str, float] | None" = None,
     ) -> AsyncIterator[int]:
         """Submit a prompt; yields generated token ids as they decode.
 
@@ -1475,6 +1538,14 @@ class InferenceEngine:
         the same typed error).  With ``RuntimeConfig.max_pending`` set, a
         submit that finds its lane's queue full is SHED with a typed
         :class:`EngineOverloadedError` — O(1), before any device work.
+
+        ``lease`` is the CALLER's liveness lease ``(lease_id, ttl_s)``
+        (ISSUE 10): the run registers against it, and the orphan reaper
+        abandons it — queued or active, slot/pages/prefix refs freed
+        through the ordinary retirement path — once the caller's
+        heartbeats lapse past the TTL (typed :class:`RunOrphanedError`
+        on the stream).  A lease already lapsed at submit is refused
+        before any device work, like an expired deadline.
         """
         if not self._running:
             raise InferenceError("engine not started")
@@ -1501,6 +1572,16 @@ class InferenceEngine:
                 raise DeadlineExceededError(
                     f"request expired {overdue:.3f}s before admission"
                 )
+        if lease is not None and leases.lease_lapsed(lease[0]):
+            # orphaned on arrival: the caller was already gone when this
+            # submit reached the engine — admitting it would burn a full
+            # prefill+decode for nobody (the EXPIRE-at-submit twin)
+            self.stats.orphaned_requests += 1
+            self._journal.append(flightrec.EV_ORPHAN, corr, -1, 0)
+            raise RunOrphanedError(
+                "caller lease lapsed before admission",
+                lease_id=lease[0],
+            )
         long_lane = len(prompt) >= self.runtime.max_seq_len
         if long_lane and not self.runtime.long_context:
             raise InferenceError(
@@ -1523,6 +1604,8 @@ class InferenceEngine:
             corr=corr,
             deadline=deadline,
         )
+        if lease is not None:
+            request.lease_id, request.lease_ttl = lease
         self._journal.append(
             flightrec.EV_SUBMIT, corr, -1, len(request.prompt), max_new_tokens
         )
@@ -1562,6 +1645,7 @@ class InferenceEngine:
             self._shed_if_full("long", len(self._long_pending), request)
             self._long_pending.append(request)
             self._submit_deadline(request)
+            self._submit_lease(request)
             self._wake.set()
             inner = self._consume(request)
             try:
@@ -1598,6 +1682,7 @@ class InferenceEngine:
         )
         self._pending.append(request)
         self._submit_deadline(request)
+        self._submit_lease(request)
         self._wake.set()
         inner = self._consume(request)
         try:
@@ -1692,6 +1777,96 @@ class InferenceEngine:
             self._journal.append(
                 flightrec.EV_EXPIRE, request.corr, request.slot,
                 int((now - request.deadline) * 1000),
+            )
+
+    # ------------------------------------------------- orphan reaper
+    # (ISSUE 10) The server-side half of failure recovery: a run whose
+    # CALLER's liveness lease lapsed is abandoned through the ordinary
+    # cancellation path — same reap, same one-dispatch-late retirement,
+    # same slot/page/prefix accounting — with a typed, NON-retriable
+    # ``mesh.orphaned`` terminal.  Precedence law (shared with
+    # _raise_terminal; pinned in tests): wedged > expired > orphaned >
+    # stalled > plain cancel — exactly ONE typed error per run, checked
+    # in the same order on both schedulers (ragged and bifurcated reap
+    # through the same _reap_cancelled/_consume pair).
+
+    def _submit_lease(self, request: GenRequest) -> None:
+        """Register a leased request for the orphan sweep (heap-shaped
+        like _submit_deadline; un-leased requests cost nothing)."""
+        if request.lease_id is None:
+            return
+        expiry = leases.lease_expiry(request.lease_id)
+        if expiry is None:
+            # never-beaten lease: grant a full TTL from now (the submit
+            # itself is proof of life — the kernel stamps admission, but
+            # direct engine callers may not)
+            expiry = cancellation.wall_clock() + request.lease_ttl
+        entry = [expiry, next(self._lease_seq), request]
+        request.lease_entry = entry
+        heapq.heappush(self._lease_heap, entry)
+
+    def _drop_lease(self, request: GenRequest) -> None:
+        """Null a finished request's lease entry (the heap entry itself
+        pops lazily) — mirrors _drop_deadline's memory law."""
+        entry = request.lease_entry
+        if entry is not None:
+            entry[2] = None
+            request.lease_entry = None
+
+    def _check_orphans(self) -> None:
+        """Reap queued AND active runs whose caller lease lapsed.  O(1)
+        per scheduler pass when no registered expiry has arrived: one
+        heap peek.  A popped entry whose lease was refreshed by a newer
+        beat is re-pushed at the new expiry — heartbeats keep a live
+        caller's runs off the reap for one push per TTL, not per pass."""
+        heap = self._lease_heap
+        if not heap:
+            return
+        now = cancellation.wall_clock()
+        gen = leases.release_generation()
+        if gen != self._lease_release_gen:
+            # a lease was RELEASED somewhere (clean caller close): its
+            # runs must orphan NOW, ahead of their registered expiry —
+            # one O(registered) sweep per release event, not per pass
+            self._lease_release_gen = gen
+            for entry in heap:
+                request = entry[2]
+                if (
+                    request is not None
+                    and not request.cancelled
+                    and leases.lease_lapsed(request.lease_id, now)
+                ):
+                    entry[0] = now  # surfaces in the pop loop below
+            heapq.heapify(heap)
+        if heap[0][0] > now:
+            return
+        while heap and heap[0][0] <= now:
+            entry = heapq.heappop(heap)
+            request = entry[2]
+            if (
+                request is None  # finished: _drop_lease nulled the entry
+                or request.cancelled
+                or not self._request_live(request)
+            ):
+                continue
+            expiry = leases.lease_expiry(request.lease_id)
+            if expiry is None:
+                expiry = entry[0] + request.lease_ttl
+            if expiry > now:
+                # the caller beat since registration: re-arm at the
+                # fresh expiry and keep serving
+                fresh = [expiry, next(self._lease_seq), request]
+                request.lease_entry = fresh
+                heapq.heappush(heap, fresh)
+                continue
+            request.orphaned = True
+            request.cancelled = True
+            self._cancel_dirty = True
+            self.stats.orphaned_requests += 1
+            # clamp: a RELEASED lease reads expiry -inf (lapsed forever)
+            self._journal.append(
+                flightrec.EV_ORPHAN, request.corr, request.slot,
+                int(min(now - expiry, 86400.0) * 1000),
             )
 
     def _check_stalls(self) -> None:
@@ -1832,8 +2007,13 @@ class InferenceEngine:
         the stall flag) and have their own counters — they ride the same
         drain but must not double-count as consumer cancels."""
         self._drop_deadline(request)
-        if request.expired or request.stalled or request.wedged:
-            # wedge-faulted requests were journaled/counted at the trip
+        self._drop_lease(request)
+        if (
+            request.expired or request.stalled or request.wedged
+            or request.orphaned
+        ):
+            # wedge-faulted requests were journaled/counted at the trip;
+            # orphans at the reaper's EV_ORPHAN
             return
         self._journal.append(flightrec.EV_CANCEL, request.corr, request.slot)
         self.stats.cancelled_requests += 1
@@ -1885,7 +2065,18 @@ class InferenceEngine:
 
     def _raise_terminal(self, request: GenRequest) -> None:
         """Typed stream endings: an engine-initiated cancel must surface
-        as a typed error at the consumer, not a silent short stream."""
+        as a typed error at the consumer, not a silent short stream.
+
+        THE precedence law (ISSUE 10 satellite; pinned for BOTH
+        schedulers in tests — the ragged and bifurcated lanes share this
+        one copy, so agreement is structural): **wedged > expired >
+        orphaned > stalled** — a run that is simultaneously several of
+        these faults with exactly ONE typed error.  Wedged first because
+        it is the only RETRIABLE code (a live caller must fail over, not
+        eat a dead-end fault); expired before orphaned because the
+        deadline is the caller's own contract while orphanhood is the
+        server's inference about the caller; stalled last — a stalled
+        consumer that also expired/orphaned already has a truer cause."""
         if request.wedged:
             # checked FIRST: a wedged request may also look expired by the
             # time its consumer resumes, but the watchdog faulted it so
@@ -1900,6 +2091,12 @@ class InferenceEngine:
             raise DeadlineExceededError(
                 f"request deadline passed after {request.generated} "
                 "generated tokens"
+            )
+        if request.orphaned:
+            raise RunOrphanedError(
+                "caller lease lapsed; the run was reaped after "
+                f"{request.generated} generated tokens",
+                lease_id=request.lease_id or "",
             )
         if request.stalled:
             raise EngineOverloadedError(
@@ -1944,6 +2141,7 @@ class InferenceEngine:
                     self._chaos("tick")
                 self._drain_deferred_cancels()
                 self._check_deadlines()
+                self._check_orphans()
                 self._check_stalls()
                 self._reap_cancelled()
                 if self._ragged:
@@ -2607,9 +2805,11 @@ class InferenceEngine:
                 # pre-launched follow-up block is all pad now
                 self.stats.overlap_wasted_tokens += inflight["steps"]
             self._drop_deadline(request)
+            self._drop_lease(request)
             self._long = None
         elif state["t"] >= state["cap"] and inflight is None:
             self._drop_deadline(request)
+            self._drop_lease(request)
             self._loop.call_soon_threadsafe(request.out.put_nowait, _DONE)
             self._long = None
 
@@ -3396,6 +3596,10 @@ class InferenceEngine:
         # loop (the hot-path allocation budget is zero)
         denom = tokens_per_row if tokens_per_row else clock_steps
         self._observe("decode_dispatch_ms", elapsed * 1000.0)
+        # the advert's many-router tiebreak signal (ISSUE 10 satellite):
+        # one multiply-add per dispatch, folded here so both lanes and
+        # the spec tick feed the same EWMA
+        self.stats.note_dispatch_ewma(elapsed * 1000.0)
         self._observe("inter_token_ms", elapsed * 1000.0 / max(1.0, denom))
         self._update_active_gauge()
         self._sync_metric_counters()
@@ -3579,6 +3783,7 @@ class InferenceEngine:
         shared prefix pages evicted while it still reads them.  Everything
         observable (``_active``, the retire heap, the gauge) updates now."""
         self._drop_deadline(request)
+        self._drop_lease(request)
         self._active.pop(request.slot, None)
         if self._drafter is not None and request.slot != -1:
             self._drafter.retire(request.slot)

@@ -1,0 +1,263 @@
+"""Caller liveness leases (ISSUE 10) — the server-side half of failure
+recovery.
+
+PR 8 made replica death survivable from the CLIENT side (the gateway
+supervises placements and fails over); this module makes CALLER death
+survivable from the SERVER side.  Without it an engine keeps decoding for
+a caller that died — burning TPU dispatches and HBM pages that live
+callers need — and fire-and-forget ``send()`` runs have NO supervisor at
+all.  Liveness must be symmetric (DeServe, arXiv:2501.14784: node death
+is the normal case, on both ends of a call).
+
+The pieces:
+
+- the **lease**: a caller-minted ``(lease_id, ttl_s)`` pair riding every
+  call as the ``x-mesh-lease`` header.  One lease per caller process,
+  NOT per run — a caller with 50 outstanding runs beats once, not 50
+  times.
+- **caller heartbeats**: while any run is outstanding, the client
+  publishes compact beats (key = lease id) to the compacted
+  ``mesh.caller_liveness`` table (``protocol.CALLER_LIVENESS_TOPIC``),
+  reusing the control plane's table machinery.  Stamps ride THE deadline
+  clock (:func:`calfkit_tpu.cancellation.wall_clock`), so the chaos
+  virtual clock drives lease lapse deterministically.
+- the **process-wide beat store** (this module): workers fold the
+  liveness table into it (``ControlPlane.attach`` starts the feed); the
+  node kernel records each leased call's admission as an implicit beat
+  (a delivered call is proof the caller was alive at publish); the
+  engine's orphan reaper asks :func:`lease_lapsed` per sweep.
+- :data:`current_lease` — a contextvar the node kernel sets from the
+  delivery's header, mirroring ``cancellation.current_deadline``, so the
+  in-process inference engine registers its runs against the caller's
+  lease with no per-layer plumbing.
+
+The lapse law (one copy, shared by the reaper and ``ck leases``):
+
+- a lease we have NEVER seen a beat for is **alive** — fail-safe: the
+  store may be cold (liveness feed catching up, no control plane), and
+  orphaning a live caller's run is strictly worse than burning a dead
+  caller's dispatches for one more TTL;
+- a lease is **lapsed** once ``now - last_beat > ttl`` (last_beat is the
+  freshest of table beats and admission stamps);
+- a **released** lease (the caller tombstoned it on clean close) is
+  lapsed immediately: a caller that deliberately left wants its
+  outstanding leased runs reaped NOW, not after a TTL of grace.
+
+Everything here is fail-open advisory state, like the cancel tombstones:
+a broken feed or an evicted entry only costs wasted work for a dead
+caller (or one TTL of grace for a live one), never correctness.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import OrderedDict
+from contextvars import ContextVar
+
+from calfkit_tpu import cancellation
+
+__all__ = [
+    "DEFAULT_LEASE_TTL",
+    "current_lease",
+    "note_beat",
+    "note_admission",
+    "release_lease",
+    "release_generation",
+    "lease_lapsed",
+    "lease_expiry",
+    "lease_age",
+    "active_leases",
+    "fold_liveness_record",
+    "beat_payload",
+]
+
+DEFAULT_LEASE_TTL = 15.0  # matches the fleet's heartbeat staleness scale
+
+# the current delivery's caller lease (lease_id, ttl_s), set by the node
+# kernel from the x-mesh-lease header for the duration of one delivery —
+# None outside any leased delivery (same channel shape as the deadline)
+current_lease: "ContextVar[tuple[str, float] | None]" = ContextVar(
+    "calfkit_caller_lease", default=None
+)
+
+# lease_id -> (last_beat_at, ttl_s), capped.  Eviction is NOT free: an
+# evicted lease reads as "never seen = alive" (the fail-safe default),
+# which would permanently disable reaping for its runs — so at the cap,
+# LONG-LAPSED entries are pruned first (their runs were reaped within a
+# TTL of the lapse; entries lapsed for many TTLs carry no live runs to
+# protect), and only then does LRU eviction touch entries that may still
+# matter.  Dead callers' final beats otherwise accumulate one entry
+# each forever — production clusters should also give the
+# mesh.caller_liveness topic compact+delete retention, like mesh.traces
+# (docs/robustness.md).
+_BEAT_CAP = 4096
+# a lease lapsed longer than PRUNE_TTLS × its ttl is historical record,
+# not live state: every run registered against it was reaped long ago
+_PRUNE_TTLS = 32.0
+_RELEASED = float("-inf")
+_beats: "OrderedDict[str, tuple[float, float]]" = OrderedDict()
+_LOCK = threading.Lock()
+# bumped on every release: a released lease must reap IMMEDIATELY, but
+# the engine's orphan heap only re-checks entries at their registered
+# expiry — a generation mismatch (one int compare per scheduler pass)
+# tells it to sweep registered runs against the lapse law now
+_release_gen = 0
+
+
+def note_beat(
+    lease_id: str, ttl_s: float, at: "float | None" = None
+) -> None:
+    """Record a caller heartbeat (table fold or admission stamp).  Beats
+    only move the lease FORWARD — a stale table record replayed behind a
+    fresh admission stamp must not age the lease backward — and a
+    RELEASED lease is terminal: the liveness feed is unordered, so the
+    caller's final heartbeat may fold AFTER its close() tombstone, and
+    resurrecting the lease would un-orphan a deliberately departed
+    caller's runs (lease ids are minted fresh per client, never
+    reused)."""
+    if not lease_id or ttl_s <= 0:
+        return
+    if at is None:
+        at = cancellation.wall_clock()
+    with _LOCK:
+        prev = _beats.get(lease_id)
+        if prev is not None:
+            if prev[0] == _RELEASED:
+                return  # released is terminal
+            if prev[0] > at:
+                at = prev[0]
+        _beats[lease_id] = (at, ttl_s)
+        _beats.move_to_end(lease_id)
+        if len(_beats) > _BEAT_CAP:
+            # prune the historical dead first (released, or lapsed many
+            # TTLs ago): evicting a FRESH entry would read as
+            # never-seen = alive and permanently un-reap its runs
+            now = cancellation.wall_clock()
+            stale = [
+                key
+                for key, (beat, ttl) in _beats.items()
+                if beat == _RELEASED or now - beat > ttl * _PRUNE_TTLS
+            ]
+            for key in stale:
+                if len(_beats) <= _BEAT_CAP:
+                    break
+                del _beats[key]
+        while len(_beats) > _BEAT_CAP:
+            _beats.popitem(last=False)
+
+
+def note_admission(lease_id: str, ttl_s: float) -> None:
+    """A leased call was just delivered: the caller was alive when it
+    PUBLISHED — an implicit beat, so a run admitted before the liveness
+    feed caught up still gets its full TTL of grace.  But delivery lags
+    publish by an unknown delay: a call surfacing from a backlog AFTER
+    its caller's lease already lapsed (or was released) must NOT
+    resurrect the lease — the publish was at least one TTL ago, which
+    is no evidence of life now."""
+    if lease_lapsed(lease_id):
+        return
+    note_beat(lease_id, ttl_s)
+
+
+def release_lease(lease_id: str) -> None:
+    """The caller tombstoned its lease (clean close): outstanding leased
+    runs are orphans NOW — no TTL of grace for a deliberate departure."""
+    global _release_gen
+    if not lease_id:
+        return
+    with _LOCK:
+        ttl = _beats.get(lease_id, (0.0, DEFAULT_LEASE_TTL))[1]
+        _beats[lease_id] = (_RELEASED, ttl)
+        _beats.move_to_end(lease_id)
+        _release_gen += 1
+
+
+def release_generation() -> int:
+    """Monotonic count of lease releases — the orphan reaper's
+    sweep-now signal (one bare int read per scheduler pass)."""
+    return _release_gen
+
+
+def lease_expiry(lease_id: "str | None") -> "float | None":
+    """Absolute epoch at which the lease lapses (last_beat + ttl), or
+    None for a lease the store has never seen (= alive, fail-safe).  The
+    engine's orphan heap keys on this."""
+    if not lease_id:
+        return None
+    with _LOCK:
+        entry = _beats.get(lease_id)
+    if entry is None:
+        return None
+    beat_at, ttl = entry
+    return beat_at + ttl
+
+
+def lease_lapsed(lease_id: "str | None", now: "float | None" = None) -> bool:
+    """THE lapse law (see module docstring): True only with positive
+    evidence — a known lease whose last beat is older than its TTL (or
+    was released).  Unknown leases are alive."""
+    expiry = lease_expiry(lease_id)
+    if expiry is None:
+        return False
+    if now is None:
+        now = cancellation.wall_clock()
+    return now > expiry
+
+
+def lease_age(lease_id: "str | None", now: "float | None" = None) -> "float | None":
+    """Seconds since the lease's last beat (None = never seen).  The
+    ``ck leases`` / ``ck stats`` rendering read."""
+    if not lease_id:
+        return None
+    with _LOCK:
+        entry = _beats.get(lease_id)
+    if entry is None:
+        return None
+    if now is None:
+        now = cancellation.wall_clock()
+    return max(0.0, now - entry[0])
+
+
+def active_leases() -> "dict[str, tuple[float, float]]":
+    """Snapshot of the beat store: lease_id -> (last_beat_at, ttl_s);
+    released leases carry beat_at = -inf."""
+    with _LOCK:
+        return dict(_beats)
+
+
+# ------------------------------------------------------------ wire fold
+# Beats travel as compact JSON table values keyed by lease id; the
+# liveness feed (ControlPlane.attach) folds every record through here.
+
+
+def beat_payload(lease_id: str, ttl_s: float) -> bytes:
+    """The wire form of one caller heartbeat (client side)."""
+    return json.dumps(
+        {
+            "lease_id": lease_id,
+            "ttl_s": round(ttl_s, 3),
+            "beat_at": cancellation.wall_clock(),
+        }
+    ).encode("utf-8")
+
+
+def fold_liveness_record(key: "bytes | str | None", value: bytes) -> None:
+    """Fold one ``mesh.caller_liveness`` record into the beat store.
+    Tombstones (empty value) release the lease; undecodable records are
+    dropped (fail-open — a corrupt beat must never fault the feed)."""
+    lease_id = (
+        key.decode("utf-8", "replace") if isinstance(key, bytes) else key
+    )
+    if not value:
+        if lease_id:
+            release_lease(lease_id)
+        return
+    try:
+        body = json.loads(value)
+        beat_at = float(body["beat_at"])
+        ttl_s = float(body["ttl_s"])
+        lease_id = str(body.get("lease_id") or lease_id or "")
+    except (ValueError, KeyError, TypeError):
+        return
+    note_beat(lease_id, ttl_s, at=beat_at)

@@ -60,6 +60,12 @@ class FaultTypes:
     # RETRIABLE by contract: nothing was delivered to the caller, and a
     # different replica can serve the same call (ISSUE 9)
     WEDGED = "mesh.wedged"
+    # the run's CALLER liveness lease lapsed (heartbeats stopped past the
+    # lease TTL, or the caller released the lease on clean close) and the
+    # server-side orphan reaper abandoned the run (ISSUE 10) — NOT
+    # retriable: there is nobody to answer; the fault is published to the
+    # (dead) reply topic for the record, not for a consumer
+    ORPHANED = "mesh.orphaned"
     UNHANDLED = "mesh.unhandled_exception"
 
     @classmethod

@@ -112,6 +112,15 @@ class EngineStatsRecord(BaseModel):
     cancelled_requests: int = 0
     cancel_propagated: int = 0
     delivery_stalled: int = 0
+    # caller liveness (ISSUE 10): runs the server-side orphan reaper
+    # abandoned because their CALLER's lease lapsed — `ck stats` ORPHANS.
+    # Default 0 so pre-lease records read as "no orphans", not unknown.
+    orphaned_requests: int = 0
+    # EWMA decode-dispatch latency (ms): the many-router tiebreak signal
+    # — PowerOfTwoChoices breaks queue-depth ties on it so N independent
+    # routers seeing identical depths between beats stop herding.
+    # Default 0.0 = "no signal" (pre-EWMA records tie-break on the key).
+    dispatch_ewma_ms: float = 0.0
     # failure recovery (ISSUE 9): whether the engine's dispatch-progress
     # watchdog currently declares it wedged (ready goes false with it —
     # routers route around, and outstanding placements are declared

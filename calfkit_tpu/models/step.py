@@ -63,12 +63,22 @@ class HandoffStep(BaseModel):
 
 
 class TokenStep(BaseModel):
-    """Incremental generated text from a streaming model turn."""
+    """Incremental generated text from a streaming model turn.
+
+    ``offset`` (ISSUE 10) is the absolute character offset of this chunk
+    within the run's delivered answer text, stamped ONLY by a turn that
+    RESUMED decode-from-offset (its first chunk starts at the
+    delivered-prefix length) — the caller-side
+    :class:`~calfkit_tpu.fleet.failover.StreamLedger` then dedupes
+    exactly, suppressing nothing.  ``None`` (non-resumed turns,
+    pre-ISSUE-10 emitters, internal output retries) rides the ledger's
+    cumulative law, which carries across an agent's tool-calling turns."""
 
     model_config = ConfigDict(frozen=True)
     kind: Literal["token"] = "token"
     author: str | None = None
     text: str = ""
+    offset: int | None = None
 
 
 class InferenceStep(BaseModel):

@@ -368,6 +368,33 @@ class BaseAgentNodeDef(BaseNodeDef):
                 attrs={"model": self.model.model_name},
             )
             turn_token = current_context.set(turn_span.context)
+        # decode-from-offset resume (ISSUE 10): a failover re-dispatch
+        # carries the already-delivered answer text in
+        # deps["calfkit.resume_text"]; this model turn CONSUMES it —
+        # backends that honor ModelSettings.resume_text prefill the
+        # delivered prefix (riding the survivor's prefix cache) and
+        # decode only the remainder, instead of silently re-generating
+        # the whole answer.  Only the RE-DISPATCHED call's first turn
+        # resumes — gated on the x-mesh-attempt: failover marker, which
+        # hops never forward: deps ride the whole run's envelope, and
+        # without the gate a downstream peer-agent call would consume
+        # the TOP agent's delivered prefix as its own answer.  Tool-
+        # return re-entries are later turns of a different answer.
+        settings = self.model_settings
+        resume_text = (
+            ctx.deps.get("calfkit.resume_text")
+            if (
+                ctx.delivery_kind == "call"
+                and ctx.headers.get(protocol.HDR_ATTEMPT) == "failover"
+            )
+            else None
+        )
+        if isinstance(resume_text, str) and resume_text:
+            from calfkit_tpu.engine.model_client import ModelSettings
+
+            settings = (settings or ModelSettings()).model_copy(
+                update={"resume_text": resume_text}
+            )
         started = time.perf_counter()
         try:
             outcome: TurnOutcome = await run_turn(
@@ -375,7 +402,7 @@ class BaseAgentNodeDef(BaseNodeDef):
                 messages,
                 tool_defs=[b.tool for b in bindings] + peer_defs,
                 output_type=self.output_type,
-                settings=self.model_settings,
+                settings=settings,
                 author=self.name,
                 max_output_retries=self.max_output_retries,
             )
@@ -759,6 +786,15 @@ class _TokenTap(ModelClient):
         self._node = node
         self._ctx = ctx
         self._attempts = 0
+        # absolute-offset stamping (ISSUE 10): ONLY a RESUMED turn (the
+        # backend yielded ResumeOffset) stamps its chunks — the ledger's
+        # offset space is run-wide, and a non-resumed turn stamping from
+        # 0 would make a multi-turn agent's SECOND turn read as a replay
+        # of the first (suppressed as duplicate).  Non-resumed turns
+        # emit offset=None and ride the ledger's cumulative law, which
+        # carries across turns — the pre-ISSUE-10 behavior.
+        self._offset = 0
+        self._stamp = False
 
     @property
     def model_name(self) -> str:
@@ -769,6 +805,9 @@ class _TokenTap(ModelClient):
             return
         text = "".join(buffer)
         buffer.clear()
+        offset = self._offset if self._stamp else None
+        if offset is not None:
+            self._offset += len(text)
         from calfkit_tpu.models.step import StepMessage, TokenStep
         from calfkit_tpu.nodes.steps import publish_step_message
 
@@ -777,7 +816,11 @@ class _TokenTap(ModelClient):
                 self._node.transport,
                 self._ctx.root_topic,
                 StepMessage(
-                    steps=[TokenStep(text=text, author=self._node.name)],
+                    steps=[
+                        TokenStep(
+                            text=text, author=self._node.name, offset=offset
+                        )
+                    ],
                     emitter=self._node.emitter,
                 ),
                 correlation_id=self._ctx.correlation_id,
@@ -787,15 +830,32 @@ class _TokenTap(ModelClient):
             pass
 
     async def request(self, messages, settings=None, params=None):
-        from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
+        from calfkit_tpu.engine.model_client import (
+            ResponseDone,
+            ResumeOffset,
+            TextDelta,
+        )
 
         self._attempts += 1
         buffer: list[str] = []
+        self._stamp = False
+        self._offset = 0
         if self._attempts > 1:
             await self._flush([self.RETRY_BOUNDARY])
         first = True
         async for event in self._inner.request_stream(messages, settings, params):
-            if isinstance(event, TextDelta):
+            if isinstance(event, ResumeOffset):
+                # the backend resumed decode-from-offset: this turn's
+                # deltas begin past the already-delivered prefix — only
+                # NOW does offset stamping engage (see __init__), and
+                # only on the FIRST attempt: an internal output-retry
+                # restarts the answer while the ledger already holds
+                # attempt 1's deltas, so a re-stamped retry would read
+                # as a partial replay and get suppressed mid-text
+                if self._attempts == 1:
+                    self._stamp = True
+                    self._offset = event.chars
+            elif isinstance(event, TextDelta):
                 buffer.append(event.text)
                 if first or sum(len(b) for b in buffer) >= self._FLUSH_CHARS:
                     first = False

@@ -37,7 +37,7 @@ from typing import Any, ClassVar, Sequence
 
 from pydantic import ValidationError
 
-from calfkit_tpu import cancellation, protocol
+from calfkit_tpu import cancellation, leases, protocol
 from calfkit_tpu.exceptions import NodeFaultError, error_type_for
 from calfkit_tpu.keying import partition_key
 from calfkit_tpu.mesh.transport import MeshTransport, Record
@@ -71,6 +71,12 @@ from calfkit_tpu.nodes.seams import (
 from calfkit_tpu.nodes.steps import HopStepLedger, Observed
 
 logger = logging.getLogger(__name__)
+
+# mirror of controlplane.plane.CALLER_LIVENESS_FEED_KEY (the
+# capability_view/agents_view mirrored-constant pattern — no import
+# cycle): truthy once the worker's caller-liveness feed is consuming;
+# the kernel only ENFORCES leases where beats can actually arrive
+CALLER_LIVENESS_FEED_KEY = "caller_liveness_feed"
 
 _REENTRY_KEY = "fanout_reentry"
 
@@ -328,6 +334,28 @@ class BaseNodeDef(RegistryMixin):
             else None
         )
 
+        # ---- caller liveness lease (ISSUE 10): recorded at admission —
+        # a CLIENT-emitted call is proof the caller was alive at publish,
+        # an implicit beat that grants a full TTL of grace even before
+        # the liveness feed catches up (forwarded calls prove only the
+        # forwarding NODE's liveness, so they don't beat).  The lease
+        # rides a contextvar like the deadline, so the in-process engine
+        # registers this delivery's runs for the orphan reaper.  Only
+        # ENFORCED where the worker's caller-liveness feed is consuming
+        # (the control plane sets the resource flag): a worker that
+        # cannot receive beats must not orphan a LIVE caller's run one
+        # TTL after admission — fail-safe, the pre-lease behavior.
+        lease = protocol.parse_lease(headers.get(protocol.HDR_LEASE))
+        lease_token = None
+        if lease is not None and self.resources.get(CALLER_LIVENESS_FEED_KEY):
+            if kind == "call":
+                emitter_kind, _ = protocol.parse_emitter(
+                    headers.get(protocol.HDR_EMITTER)
+                )
+                if emitter_kind == "client":
+                    leases.note_admission(*lease)
+            lease_token = leases.current_lease.set(lease)
+
         # ---- tracing: one HOP SPAN per traced delivery.  A missing trace
         # header is legal (pre-trace emitters, external producers) — the
         # hop simply runs untraced.  Everything here is fail-open.
@@ -416,6 +444,8 @@ class BaseNodeDef(RegistryMixin):
         finally:
             if deadline_token is not None:
                 cancellation.current_deadline.reset(deadline_token)
+            if lease_token is not None:
+                leases.current_lease.reset(lease_token)
             await self._flush_steps(ctx)
             if hop_span is not None:
                 if ctx.fault_error_type is not None:
@@ -1080,6 +1110,12 @@ class BaseNodeDef(RegistryMixin):
         incoming_deadline = ctx.headers.get(protocol.HDR_DEADLINE)
         if incoming_deadline:
             headers[protocol.HDR_DEADLINE] = incoming_deadline
+        # lease propagation (ISSUE 10): like the deadline — downstream
+        # work runs on the ORIGINAL caller's behalf; engines several
+        # hops deep still register against the one caller lease
+        incoming_lease = ctx.headers.get(protocol.HDR_LEASE)
+        if incoming_lease:
+            headers[protocol.HDR_LEASE] = incoming_lease
         if ctx.trace is not None:
             # downstream hops parent to THIS hop's span
             headers.update(ctx.trace.headers())

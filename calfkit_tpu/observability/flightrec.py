@@ -82,6 +82,7 @@ EV_SHED = 19  # bounded admission refused the submit  a=pending b=limit
 EV_EXPIRE = 20  # deadline passed (submit/queue/active) a=overdue_ms
 EV_RAGGED_WAVE = 21  # unified dispatch: decode+chunk  a=decode_rows b=chunk_rows
 EV_WEDGE = 22  # dispatch-progress watchdog tripped  a=stalled_ms b=pending
+EV_ORPHAN = 23  # caller lease lapsed; run reaped    a=lapsed_ms
 
 EVENT_NAMES: tuple[str, ...] = (
     "SUBMIT",
@@ -107,6 +108,7 @@ EVENT_NAMES: tuple[str, ...] = (
     "EXPIRE",
     "RAGGED_WAVE",
     "WEDGE",
+    "ORPHAN",
 )
 
 # per-event meaning of the two int payload fields (the dump stays compact
@@ -135,6 +137,7 @@ ARG_LABELS: dict[str, tuple[str, str]] = {
     "EXPIRE": ("overdue_ms", ""),
     "RAGGED_WAVE": ("decode_rows", "chunk_rows"),
     "WEDGE": ("stalled_ms", "pending"),
+    "ORPHAN": ("lapsed_ms", ""),
 }
 
 # batch-scoped events a request's timeline borrows from its active window

@@ -65,6 +65,15 @@ HDR_DEADLINE: Final = "x-mesh-deadline"
 # agent counts arrivals into its engine-stats advert (FAILOVER/HEDGE in
 # ``ck stats``).
 HDR_ATTEMPT: Final = "x-mesh-attempt"
+# caller liveness lease (ISSUE 10): "<lease_id>:<ttl_s>" — the caller's
+# process-level lease, minted once per client and forwarded by every hop
+# (like the deadline: downstream tool calls run on the original caller's
+# behalf).  While any leased run is outstanding the caller heartbeats the
+# compacted CALLER_LIVENESS_TOPIC; an engine whose run's lease lapses
+# past its TTL reaps the run as an orphan (typed ``mesh.orphaned``) —
+# the server-side half of failure recovery, covering fire-and-forget
+# ``send()`` that no client-side supervisor can.
+HDR_LEASE: Final = "x-mesh-lease"
 
 ALL_HEADERS: Final = (
     HDR_EMITTER,
@@ -78,6 +87,7 @@ ALL_HEADERS: Final = (
     HDR_SPAN,
     HDR_DEADLINE,
     HDR_ATTEMPT,
+    HDR_LEASE,
 )
 
 # --------------------------------------------------------------------------- #
@@ -139,6 +149,30 @@ def parse_deadline(value: "bytes | str | None") -> "float | None":
     if deadline != deadline or deadline in (float("inf"), float("-inf")):
         return None
     return deadline if deadline > 0 else None
+
+
+def format_lease(lease_id: str, ttl_s: float) -> str:
+    """Encode a caller lease for the wire: ``<lease_id>:<ttl_s>`` (lease
+    ids are hex — never contain the separator)."""
+    return f"{lease_id}:{ttl_s:.3f}"
+
+
+def parse_lease(value: "bytes | str | None") -> "tuple[str, float] | None":
+    """Decode an ``x-mesh-lease`` header to ``(lease_id, ttl_s)``; None
+    for a missing or malformed header (a corrupt lease degrades to
+    un-leased — the pre-lease behavior — and must never fault delivery)."""
+    s = decode_header_str(value)
+    if not s or ":" not in s:
+        return None
+    lease_id, _, raw_ttl = s.rpartition(":")
+    try:
+        ttl = float(raw_ttl)
+    except ValueError:
+        return None
+    # NaN/inf/non-positive TTLs are not leases
+    if ttl != ttl or ttl in (float("inf"), float("-inf")) or ttl <= 0:
+        return None
+    return (lease_id, ttl) if lease_id else None
 
 
 def emitter_header(node_kind: str, node_name: str) -> str:
@@ -259,6 +293,10 @@ ENGINE_STATS_TOPIC: Final = "mesh.engine_stats"
 # ALSO set time retention — cleanup.policy=compact,delete — to bound
 # total growth; see docs/observability.md)
 TRACES_TOPIC: Final = "mesh.traces"
+# compacted caller-liveness beats (ISSUE 10): key = lease id, value =
+# the compact beat JSON (calfkit_tpu.leases.beat_payload); tombstone =
+# clean caller departure (outstanding leased runs orphan immediately)
+CALLER_LIVENESS_TOPIC: Final = "mesh.caller_liveness"
 
 
 def fanout_state_topic(node_id: str) -> str:

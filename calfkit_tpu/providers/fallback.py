@@ -111,13 +111,30 @@ class FallbackModelClient(ModelClient):
         settings: ModelSettings | None = None,
         params: ModelRequestParameters | None = None,
     ) -> AsyncIterator[StreamEvent]:
+        from calfkit_tpu.engine.model_client import ResumeOffset
+
         exceptions: list[Exception] = []
         for model in self.models:
             yielded = False
+            # a ResumeOffset is HELD until the same backend produces a
+            # text-bearing event: it carries no text (a backend that
+            # announced a resume then failed delivered nothing, so
+            # fallback stays legal), and forwarding it eagerly would
+            # poison the consumer's offset space if the NEXT backend
+            # regenerates from zero — the held offset is simply dropped
+            # with the failed backend
+            pending_offset: "ResumeOffset | None" = None
             try:
                 async for event in model.request_stream(
                     messages, settings, params
                 ):
+                    if isinstance(event, ResumeOffset):
+                        pending_offset = event
+                        continue
+                    if pending_offset is not None:
+                        yielded = True
+                        yield pending_offset
+                        pending_offset = None
                     yielded = True
                     yield event
                 return

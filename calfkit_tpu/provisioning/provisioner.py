@@ -92,6 +92,7 @@ def framework_topics_for_nodes(nodes: Iterable[BaseNodeDef]) -> list[str]:
         protocol.CAPABILITIES_TOPIC,
         protocol.ENGINE_STATS_TOPIC,
         protocol.TRACES_TOPIC,
+        protocol.CALLER_LIVENESS_TOPIC,
     }
     for node in nodes:
         topics.add(protocol.fanout_state_topic(node.node_id))

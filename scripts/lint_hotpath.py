@@ -63,6 +63,16 @@ DISPATCH = Path(__file__).resolve().parent.parent / (
     "calfkit_tpu/mesh/dispatch.py"
 )
 FLEET_DIR = Path(__file__).resolve().parent.parent / "calfkit_tpu/fleet"
+LEASES = Path(__file__).resolve().parent.parent / "calfkit_tpu/leases.py"
+
+# caller-liveness reads on the reaper's sweep path (ISSUE 10): the
+# engine calls these per registered-expiry pop, between device
+# dispatches — no logging, no wall-clock syscall (they read the
+# cancellation.wall_clock seam), no blocking calls.  Loud-miss on
+# rename, like every other guarded set.
+LEASE_READ_FUNCTIONS = {
+    "note_beat", "note_admission", "lease_lapsed", "lease_expiry",
+}
 
 # the dispatch loop: every function that runs per decode tick (or inside
 # one) on the scheduler/decode threads
@@ -98,6 +108,14 @@ HOT_FUNCTIONS = {
     "_absorb_fits",
     "_ragged_wave_cap",
     "_form_wave",
+    # caller liveness (ISSUE 10): the orphan reaper's per-pass sweep and
+    # the lease-registration sites run on the serve loop between device
+    # dispatches — same no-logging/no-time.time/no-formatting contract
+    # as the deadline reaper they're shaped after
+    "_check_orphans",
+    "_check_deadlines",
+    "_submit_lease",
+    "_drop_lease",
 }
 
 # pure host-side metric/heap helpers: never handed a device array, so the
@@ -114,6 +132,11 @@ METRIC_HELPERS = {
     "_sync_metric_counters",
     "_retirement_near",
     "_retirement_bound",
+    # serve-loop heap sweeps: pure host state, never handed device arrays
+    "_check_orphans",
+    "_check_deadlines",
+    "_submit_lease",
+    "_drop_lease",
 }
 OVERLAP_FUNCTIONS = HOT_FUNCTIONS - METRIC_HELPERS
 
@@ -470,6 +493,55 @@ def _unbounded_queue_violations(
     return out
 
 
+def _leases_violations() -> "list[tuple[Path, int, str]]":
+    """The lease store's sweep-path reads (ISSUE 10): same no-blocking /
+    no-logging / no-time.time contract as the fleet selection path."""
+    out: list[tuple[Path, int, str]] = []
+    if not LEASES.exists():
+        return [(LEASES, 0, "leases module missing (update lint_hotpath)")]
+    tree = ast.parse(LEASES.read_text(), filename=str(LEASES))
+    found: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in LEASE_READ_FUNCTIONS:
+            continue
+        found.add(node.name)
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            fn = call.func
+            if isinstance(fn, ast.Name) and fn.id in _FLEET_BANNED_CALLS:
+                out.append(
+                    (LEASES, call.lineno,
+                     f"{node.name}: blocking/banned call {fn.id}()")
+                )
+            elif isinstance(fn, ast.Attribute) and isinstance(
+                fn.value, ast.Name
+            ):
+                pair = (fn.value.id, fn.attr)
+                if pair in _FLEET_BANNED_ATTR_CALLS:
+                    out.append(
+                        (LEASES, call.lineno,
+                         f"{node.name}: {pair[0]}.{pair[1]}() on the "
+                         "orphan-sweep path")
+                    )
+                elif fn.value.id in BANNED_RECEIVERS:
+                    out.append(
+                        (LEASES, call.lineno,
+                         f"{node.name}: {fn.value.id}.{fn.attr}() — no "
+                         "logging on the orphan-sweep path")
+                    )
+    missing = LEASE_READ_FUNCTIONS - found
+    if missing:
+        out.append(
+            (LEASES, 0,
+             f"guarded lease functions missing: {sorted(missing)} "
+             "(update LEASE_READ_FUNCTIONS)")
+        )
+    return out
+
+
 def main() -> int:
     source = ENGINE.read_text()
     tree = ast.parse(source, filename=str(ENGINE))
@@ -487,6 +559,7 @@ def main() -> int:
         dispatch_tree, dispatch_source, DISPATCH
     )
     queue_found += _fleet_violations()
+    queue_found += _leases_violations()
     if queue_found:
         for path, line, message in sorted(queue_found):
             print(f"{path}:{line}: {message}")
@@ -501,6 +574,7 @@ def main() -> int:
         "_decode_tick", "_record_token", "_note_dispatch",
         "_launch_decode", "_land_decode", "_sync_host",
         "_ragged_tick", "_launch_ragged", "_form_wave",
+        "_check_orphans", "_submit_lease",
     } - names
     if missing:
         print(f"lint_hotpath: guarded functions missing from engine.py: "

@@ -1,0 +1,198 @@
+"""Orphan-reap A/B microbench (ISSUE 10 acceptance artifact).
+
+Caller death mid fire-and-forget run, on the REAL mesh → worker → engine
+path: an in-memory mesh, one Worker hosting an agent over a REAL debug
+inference engine (control plane on — its caller-liveness feed folds
+``mesh.caller_liveness`` into the process lease store), and a LEASED
+client that ``send()``s runs nobody awaits, then dies hard (its
+heartbeat task is killed — beats stop, no tombstone, exactly a crashed
+process).
+
+Two arms, identical workload and death:
+
+- **leases on** — every call carried ``x-mesh-lease``; when the beats
+  stop, the engine's orphan reaper abandons the runs within ~one lease
+  TTL: slots/pages free, ORPHANS counts, the journal records
+  ORPHAN → … → SLOT_FREE.  The headline number is death → engine
+  drained.
+- **leases off** — the pre-ISSUE-10 behavior: nothing notices the death;
+  every run decodes its full token budget for a caller that no longer
+  exists, and death → drained is the whole remaining generation.
+
+Prints one JSON line (written to ORPHAN.json via --out); exits non-zero
+unless the leased arm reaps EVERY run (orphaned == offered, zero leaked
+slots/pages) in under half the baseline burn AND within a bounded
+multiple of the lease TTL.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from calfkit_tpu.client import Client  # noqa: E402
+from calfkit_tpu.controlplane import ControlPlaneConfig  # noqa: E402
+from calfkit_tpu.inference import model as M  # noqa: E402
+from calfkit_tpu.inference.client import JaxLocalModelClient  # noqa: E402
+from calfkit_tpu.inference.config import RuntimeConfig, preset  # noqa: E402
+from calfkit_tpu.inference.engine import InferenceEngine  # noqa: E402
+from calfkit_tpu.mesh import InMemoryMesh  # noqa: E402
+from calfkit_tpu.nodes import Agent  # noqa: E402
+from calfkit_tpu.worker import Worker  # noqa: E402
+
+from tests._chaos import assert_engine_drained  # noqa: E402 - the no-leak oracle
+
+AGENT = "svc"
+OFFERED = 3  # fire-and-forget runs in flight when the caller dies
+NEW_TOKENS = 320  # the budget an unreaped run burns whole
+DEADLINE_S = 60.0  # deliberately huge: the deadline reaper must NOT help
+LEASE_TTL_S = 0.6
+PACE_S = 0.02  # per-dispatch pacing: generation outlives the death
+REAP_BAR_FRACTION = 0.5  # leased reap must beat half the baseline burn
+REAP_TTL_MULT = 8.0  # ...and land within this many TTLs of the death
+
+CFG = preset("debug")
+PARAMS = M.init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def _engine():
+    runtime = RuntimeConfig(
+        max_batch_size=4, max_seq_len=512, prefill_chunk=16,
+        decode_steps_per_dispatch=4, page_size=16, kv_layout="paged",
+        flightrec_events=1 << 14,
+    )
+    engine = InferenceEngine(CFG, runtime, params=PARAMS)
+    model = JaxLocalModelClient(
+        config=CFG, runtime=runtime, engine=engine,
+        max_new_tokens=NEW_TOKENS,
+    )
+    return engine, model
+
+
+async def _until(condition, *, seconds: float = 60.0, what: str = "") -> None:
+    deadline = time.perf_counter() + seconds
+    while not condition():
+        if time.perf_counter() > deadline:
+            raise RuntimeError(f"never settled: {what}")
+        await asyncio.sleep(0.01)
+
+
+def _drained(engine) -> bool:
+    return (
+        not engine._active and engine._pend is None
+        and engine._inflight is None and not engine._admitting
+        and not engine._pending and not engine._carry
+        and len(engine._free) == engine.runtime.max_batch_size
+    )
+
+
+async def measure(leases_on: bool) -> dict:
+    engine, model = _engine()
+    total_free = engine._page_alloc.free_pages
+    mesh = InMemoryMesh()
+    agent = Agent(AGENT, model=model)
+    worker = Worker(
+        [agent], mesh=mesh,
+        control_plane=ControlPlaneConfig(heartbeat_interval=0.1),
+    )
+    async with worker:
+        def pace(point):
+            if point == "dispatch":
+                time.sleep(PACE_S)
+
+        client = Client.connect(
+            mesh, lease_ttl=LEASE_TTL_S if leases_on else None
+        )
+        # warm the engine (prefill+decode jits) OUTSIDE the measured
+        # window, so the baseline burn measures decoding, not XLA builds
+        warm = await client.agent(AGENT).start("warm up", timeout=DEADLINE_S)
+        await warm.result()
+        engine._chaos = pace
+
+        for i in range(OFFERED):
+            await client.agent(AGENT).send(f"fire and forget {i}")
+        await _until(
+            lambda: engine._active,
+            what="no fire-and-forget run ever reached the engine",
+        )
+        # the caller dies HARD: beats stop, no tombstone (a clean close
+        # would release the lease — a different, faster path)
+        t_death = time.perf_counter()
+        if client._lease_task is not None:
+            client._lease_task.cancel()
+        await _until(
+            lambda: _drained(engine),
+            what="the engine never drained after the caller died",
+        )
+        drained_s = round(time.perf_counter() - t_death, 3)
+        assert_engine_drained(engine, total_free)
+        out = {
+            "leases": leases_on,
+            "offered": OFFERED,
+            "death_to_drained_s": drained_s,
+            "orphaned_requests": engine.stats.orphaned_requests,
+            "decode_tokens": engine.stats.decode_tokens,
+            "free_pages": engine._page_alloc.free_pages,
+            "total_pages": total_free,
+        }
+        # the dead caller's mesh state must not leak either
+        await client.close()
+    await engine.stop()
+    await mesh.stop()
+    return out
+
+
+async def run() -> dict:
+    on = await measure(True)
+    off = await measure(False)
+    reap = on["death_to_drained_s"]
+    burn = off["death_to_drained_s"]
+    ok = (
+        on["orphaned_requests"] == OFFERED
+        and off["orphaned_requests"] == 0
+        and reap < burn * REAP_BAR_FRACTION
+        and reap < LEASE_TTL_S * REAP_TTL_MULT
+        and on["free_pages"] == on["total_pages"]
+    )
+    return {
+        "metric": "orphan_reap_ab[caller death mid fire-and-forget send(), "
+                  "real mesh->worker->engine path, real debug engine, "
+                  "leased vs unleased caller]",
+        "value": reap,
+        "unit": "s death->engine-drained with leases on (vs the full "
+                "generation burn the unleased baseline pays)",
+        "lease_ttl_s": LEASE_TTL_S,
+        "baseline_burn_s": burn,
+        "reclaimed_s": round(burn - reap, 3),
+        "reap_bar_s": round(burn * REAP_BAR_FRACTION, 3),
+        "ok": ok,
+        "on": on,
+        "off": off,
+    }
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None, help="also write JSON here")
+    ns = parser.parse_args()
+    result = asyncio.run(run())
+    line = json.dumps(result)
+    print(line)
+    if ns.out:
+        with open(ns.out, "w") as f:
+            f.write(line + "\n")
+    sys.exit(0 if result["ok"] else 1)

@@ -210,6 +210,203 @@ def profile_paged(preset_name: str, B: int, wpages: int, steps: int,
             rows.append(row)
 
 
+def _time_min(fn, *args) -> float:
+    """THE timing law shared by the ragged profilers: warm once (jit
+    build outside the window), then min of 5 synced reps, in ms — one
+    copy, so the cross-path comparison that steers
+    ``attention_impl="auto"`` cannot drift between paths."""
+    out = fn(*args)
+    out.block_until_ready()
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        out.block_until_ready()
+        times.append(time.perf_counter() - t0)
+    return min(times) * 1000.0
+
+
+def _ragged_rows(B: int, S: int, W: int):
+    """Mixed ragged row kinds at wave shape [B, S]: one third decode
+    (q_len=1, start=kv_len=lens), one third prefill-chunk (q_len=S,
+    start=offset, kv_len=offset+S), one third spec-verify (q_len=k+1,
+    start=kv_len=base_lens) — the three row kinds the unified wave and
+    the verify dispatch actually serve (calfkit_tpu/inference/ragged.py
+    descriptor vocabulary).  Queries past a row's true q_len are padding
+    the kernel computes-and-ignores, exactly as in production."""
+    import numpy as np
+
+    lens0 = W // 2
+    offset = W // 4
+    starts = np.zeros((B,), np.int32)
+    kv_lens = np.zeros((B,), np.int32)
+    for b in range(B):
+        kind = b % 3
+        if kind == 0:  # decode row
+            starts[b] = lens0
+            kv_lens[b] = lens0
+        elif kind == 1:  # prefill-chunk row
+            starts[b] = offset
+            kv_lens[b] = offset + S
+        else:  # verify row (k+1 queries against the settled cache)
+            starts[b] = lens0
+            kv_lens[b] = lens0
+    return starts, kv_lens
+
+
+def profile_ragged(preset_name: str, B: int, W: int, S: int, impls,
+                   rows=None) -> None:
+    """Time the ragged multi-query attention kernel (dense window) on a
+    mixed decode/chunk/verify wave — the shape ``attention_impl="auto"``
+    resolves the VERIFY dispatch (and any ragged consumer) with (path
+    ``ragged``)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from calfkit_tpu.inference import model as M
+    from calfkit_tpu.inference import pallas_attention as P
+    from calfkit_tpu.inference.config import preset
+
+    cfg = preset(preset_name)
+    dtype = jnp.bfloat16
+    K, hd = cfg.n_kv_heads, cfg.head_dim
+    H = cfg.n_heads
+    G = H // K
+    starts_np, kv_np = _ragged_rows(B, S, W)
+    q = jnp.ones((B, S, H, hd), dtype)
+    k = jnp.zeros((cfg.n_layers, B, K, W, hd), dtype)
+    v = jnp.zeros_like(k)
+    starts = jnp.asarray(starts_np)
+    kv_lens = jnp.asarray(kv_np)
+
+    for impl in impls:
+        # EVERY operand is a traced jit argument (q, caches, starts,
+        # kv_lens) in BOTH branches — a baked-in constant query would
+        # let XLA fold/specialize asymmetrically and skew the winner
+        # artifact that steers production attention_impl="auto"
+        if impl.startswith("pallas"):
+            interpret = impl == "pallas_interpret"
+
+            def dispatch(q_in, k, v, st, kv, interpret=interpret):
+                qg = q_in.reshape(B, S, K, G, hd).transpose(0, 2, 1, 3, 4)
+
+                def one_layer(_, kv_layer):
+                    lk, lv = kv_layer
+                    o, m, z = P.ragged_attention_pallas(
+                        qg, lk, lv, st, kv, interpret=interpret
+                    )
+                    out = o / jnp.maximum(z[..., None], 1e-30)
+                    return None, out.astype(qg.dtype)
+
+                _, outs = lax.scan(one_layer, None, (k, v))
+                return outs
+        else:
+
+            def dispatch(q_in, k, v, st, kv):
+                def one_layer(_, kv_layer):
+                    lk, lv = kv_layer
+                    return None, M.ragged_attention_xla(
+                        q_in, lk, lv, st, kv
+                    )
+
+                _, outs = lax.scan(one_layer, None, (k, v))
+                return outs
+
+        ms = _time_min(jax.jit(dispatch), q, k, v, starts, kv_lens)
+        row = {
+            "path": "ragged",
+            "config": f"{preset_name} ragged B={B} S={S} W={W}",
+            "impl": impl,
+            "ms_per_dispatch": round(ms, 2),
+            "ragged_q_tok_s": round(B * S / (ms / 1000.0), 1),
+        }
+        print(json.dumps(row))
+        if rows is not None:
+            rows.append(row)
+
+
+def profile_ragged_paged(preset_name: str, B: int, wpages: int, S: int,
+                         page: int, impls, n_layers: int | None = None,
+                         rows=None) -> None:
+    """Paged analog of :func:`profile_ragged`: the ragged kernel reading
+    through block tables (path ``paged_ragged`` — resolves the paged
+    verify dispatch under ``attention_impl="auto"``)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from calfkit_tpu.inference import model as M
+    from calfkit_tpu.inference import pallas_attention as P
+    from calfkit_tpu.inference.config import preset
+
+    cfg = preset(preset_name, **({"n_layers": n_layers} if n_layers else {}))
+    dtype = jnp.bfloat16
+    K, hd = cfg.n_kv_heads, cfg.head_dim
+    H = cfg.n_heads
+    G = H // K
+    W = wpages * page
+    N = B * wpages + 1
+    pool_k = jnp.zeros((cfg.n_layers, N, K, page, hd), dtype)
+    pool_v = jnp.zeros_like(pool_k)
+    tables = (jnp.arange(B * wpages, dtype=jnp.int32).reshape(B, wpages) + 1)
+    starts_np, kv_np = _ragged_rows(B, S, W)
+    q = jnp.ones((B, S, H, hd), dtype)
+    starts = jnp.asarray(starts_np)
+    kv_lens = jnp.asarray(kv_np)
+
+    for impl in impls:
+        # all operands traced, both branches (see profile_ragged)
+        if impl.startswith("pallas"):
+            interpret = impl == "pallas_interpret"
+
+            def dispatch(q_in, pool_k, pool_v, tb, st, kv,
+                         interpret=interpret):
+                qg = q_in.reshape(B, S, K, G, hd).transpose(0, 2, 1, 3, 4)
+
+                def one_layer(_, layer):
+                    o, m, z = P.ragged_attention_paged_pallas(
+                        qg, pool_k, pool_v, layer, tb, st, kv,
+                        wpages=wpages, interpret=interpret,
+                    )
+                    out = o / jnp.maximum(z[..., None], 1e-30)
+                    return None, out.astype(qg.dtype)
+
+                _, outs = lax.scan(
+                    one_layer, None,
+                    jnp.arange(pool_k.shape[0], dtype=jnp.int32),
+                )
+                return outs
+        else:
+
+            def dispatch(q_in, pool_k, pool_v, tb, st, kv):
+                def one_layer(_, kv_layer):
+                    lk, lv = kv_layer
+                    return None, M.ragged_attention_paged_xla(
+                        q_in, lk, lv, tb, st, kv, wpages=wpages,
+                    )
+
+                _, outs = lax.scan(one_layer, None, (pool_k, pool_v))
+                return outs
+
+        ms = _time_min(
+            jax.jit(dispatch), q, pool_k, pool_v, tables, starts, kv_lens
+        )
+        row = {
+            "path": "paged_ragged",
+            "config": (
+                f"{preset_name} paged-ragged B={B} S={S} "
+                f"wpages={wpages} page={page}"
+            ),
+            "impl": impl,
+            "ms_per_dispatch": round(ms, 2),
+            "ragged_q_tok_s": round(B * S / (ms / 1000.0), 1),
+        }
+        print(json.dumps(row))
+        if rows is not None:
+            rows.append(row)
+
+
 def compute_winners(rows: list[dict], margin: float = 0.97) -> dict:
     """Per-path winner for the auto-resolution artifact.
 
@@ -275,12 +472,25 @@ def main() -> None:
         profile_paged("tinyllama-1.1b", B=64, wpages=16, steps=32, page=64,
                       impls=impls, rows=rows)
         profile_prefill("tinyllama-1.1b", R=8, S=512, impls=impls, rows=rows)
+        # ragged multi-query shapes (ISSUE 10 satellite): mixed
+        # decode/chunk/verify waves, so attention_impl="auto" resolves
+        # the ragged kernels (verify dispatch, unified-wave consumers)
+        # from measured winners instead of riding the legacy paths
+        profile_ragged("tinyllama-1.1b", B=64, W=1024, S=16, impls=impls,
+                       rows=rows)
+        # spec-verify width (k+1 = 5): the other production ragged shape
+        profile_ragged("tinyllama-1.1b", B=64, W=1024, S=5, impls=impls,
+                       rows=rows)
+        profile_ragged_paged("tinyllama-1.1b", B=64, wpages=16, S=16,
+                             page=64, impls=impls, rows=rows)
     if args.config in ("llama8b", "both"):
         # bench llama8b ATTENTION shapes (bs=32, 4 pages/row reserve) on a
         # 4-layer slice: bf16 zero-params at full depth would not fit 16 GB
         # next to the pool, and the impl comparison is per-layer anyway
         profile_paged("llama-3-8b", B=32, wpages=4, steps=32, page=64,
                       impls=impls, n_layers=4, rows=rows)
+        profile_ragged_paged("llama-3-8b", B=32, wpages=4, S=5, page=64,
+                             impls=impls, n_layers=4, rows=rows)
 
     if args.out or args.install:
         verdict = {

@@ -350,6 +350,29 @@ class ReplicaTransport(MeshTransport):
         return _GatedTableWriter(self, self.inner.table_writer(topic))
 
 
+class BijectiveTokenizer:
+    """Token id ↔ character bijection for byte-exact resume tests
+    (ISSUE 10): generated id ``i`` decodes to ``chr(0x100 + i)`` and
+    encodes back to exactly ``i`` — so re-encoding a delivered prefix
+    reproduces the original token ids and greedy decode-from-offset
+    parity is literal byte equality (ByteTokenizer's UTF-8 replacement
+    chars break the round trip for arbitrary model outputs).  Prompt
+    characters below U+0100 encode to their ordinal, within the debug
+    preset's 512-token vocab."""
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+
+    def encode(self, text: str) -> "list[int]":
+        return [
+            ord(c) - 0x100 if ord(c) >= 0x100 else ord(c) for c in text
+        ]
+
+    def decode(self, ids: "list[int]") -> str:
+        return "".join(chr(0x100 + i) for i in ids if i >= 0)
+
+
 class StreamingStubModel(ServingStubModel):
     """A ServingStubModel whose ``request_stream`` yields word-sized
     deltas and PAUSES after ``pause_after`` of them until ``release`` is

@@ -26,7 +26,7 @@ jax = pytest.importorskip("jax")
 jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp  # noqa: E402
 
-from calfkit_tpu import cancellation, protocol  # noqa: E402
+from calfkit_tpu import cancellation, leases, protocol  # noqa: E402
 from calfkit_tpu.client import Client  # noqa: E402
 from calfkit_tpu.client.caller import RetryPolicy  # noqa: E402
 from calfkit_tpu.engine import TestModelClient  # noqa: E402
@@ -35,6 +35,7 @@ from calfkit_tpu.exceptions import (  # noqa: E402
     DeadlineExceededError,
     EngineOverloadedError,
     NodeFaultError,
+    RunOrphanedError,
     exception_for,
 )
 from calfkit_tpu.fleet import FleetRouter  # noqa: E402
@@ -1456,3 +1457,572 @@ class TestWedgeWatchdog:
             finally:
                 gate.set()
                 await engine.stop()
+
+
+class TestOrphanReaper:
+    """Caller liveness leases (ISSUE 10): the server-side orphan reaper.
+    A caller that dies — heartbeats stop past the lease TTL — has its
+    runs abandoned BY THE ENGINE, queued and active alike, slots/pages
+    freed through the ordinary retirement path, with a typed
+    non-retriable ``mesh.orphaned`` terminal.  This is what makes
+    fire-and-forget ``send()`` safe: no client-side supervisor exists
+    for a run nobody awaits."""
+
+    async def test_caller_death_reaps_queued_and_active(self, params):
+        """Beats stop; one TTL later the engine reaps BOTH the active
+        and the queued leased run: typed RunOrphanedError, zero leaked
+        slots/pages, journal timeline ending ORPHAN → … → SLOT_FREE."""
+        runtime = _rt(
+            max_batch_size=1, kv_layout="paged", overlap_dispatch=True,
+            flightrec_events=1 << 14,
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        total_free = engine._page_alloc.free_pages
+        with virtual_clock() as clock:
+            await engine.start()
+            try:
+                ttl = 5.0
+                leases.note_beat("lease-dead", ttl)
+                active = asyncio.create_task(
+                    _collect(
+                        engine, [1, 2, 3], 64, corr="orph-a",
+                        lease=("lease-dead", ttl),
+                    )
+                )
+                await settle(
+                    lambda: engine._active,
+                    message="the leased run never activated",
+                )
+                queued = asyncio.create_task(
+                    _collect(
+                        engine, [7, 8], 64, corr="orph-b",
+                        lease=("lease-dead", ttl),
+                    )
+                )
+                await settle(
+                    lambda: len(engine._pending) + len(engine._carry) == 1,
+                    message="the second leased run never queued",
+                )
+                # the caller dies: no more beats — one TTL later both
+                # runs are orphans
+                clock.advance(ttl + 0.5)
+                with pytest.raises(RunOrphanedError):
+                    await asyncio.wait_for(active, timeout=10)
+                with pytest.raises(RunOrphanedError):
+                    await asyncio.wait_for(queued, timeout=10)
+                await settle(
+                    lambda: _drained(engine, total_free),
+                    message="engine did not drain after the orphan reap",
+                )
+                assert_engine_drained(engine, total_free)
+                assert engine.stats.orphaned_requests == 2
+                # orphans are NOT consumer cancels: no double count
+                assert engine.stats.cancelled_requests == 0
+                assert engine.stats.expired_requests == 0
+                events = _journal_events(engine)
+                tl = flightrec.timeline_events(events, "orph-a")
+                names = [e["event"] for e in tl]
+                assert "ORPHAN" in names, names
+                assert "SLOT_FREE" in names, names
+                assert names.index("ORPHAN") < (
+                    len(names) - 1 - names[::-1].index("SLOT_FREE")
+                ), f"ORPHAN did not precede the final SLOT_FREE: {names}"
+                # the engine still serves live callers after the reap
+                leases.note_beat("lease-live", ttl)
+                tokens = await _collect(
+                    engine, [9], 8, corr="after",
+                    lease=("lease-live", ttl),
+                )
+                assert len(tokens) == 8
+            finally:
+                await engine.stop()
+
+    async def test_lease_lapsed_at_submit_refused_before_device_work(
+        self, params
+    ):
+        """A run arriving under an already-lapsed lease is refused at
+        the gate — the EXPIRE-at-submit twin, no prefill burned."""
+        engine = InferenceEngine(CFG, _rt(), params=params)
+        with virtual_clock() as clock:
+            await engine.start()
+            try:
+                leases.note_beat("lease-gone", 2.0)
+                clock.advance(3.0)
+                with pytest.raises(RunOrphanedError):
+                    await _collect(
+                        engine, [1, 2], 8, corr="late",
+                        lease=("lease-gone", 2.0),
+                    )
+                assert engine.stats.orphaned_requests == 1
+                assert engine.stats.prefill_tokens == 0
+            finally:
+                await engine.stop()
+
+    async def test_heartbeat_wedge_within_ttl_run_survives(self, params):
+        """A late beat WITHIN the TTL re-arms the reaper instead of
+        orphaning: the registered expiry pops, the store shows a fresh
+        beat, and the run completes normally."""
+        runtime = _rt(decode_steps_per_dispatch=2)
+        engine = InferenceEngine(CFG, runtime, params=params)
+        pace = ChaosScript()
+
+        def throttle(point):
+            pace(point)
+            if point == "dispatch":
+                time.sleep(0.01)
+
+        engine._chaos = throttle
+        with virtual_clock() as clock:
+            await engine.start()
+            try:
+                ttl = 10.0
+                leases.note_beat("lease-wedge", ttl)
+                run = asyncio.create_task(
+                    _collect(
+                        engine, [1, 2, 3], 48, corr="survivor",
+                        lease=("lease-wedge", ttl),
+                    )
+                )
+                await settle(
+                    lambda: engine._active,
+                    message="the leased run never activated",
+                )
+                # the caller's beat wedges for 0.6 TTL, then recovers:
+                # total elapsed passes the ORIGINAL expiry, but the late
+                # beat keeps the lease alive — the reaper must re-arm,
+                # not orphan
+                clock.advance(ttl * 0.6)
+                leases.note_beat("lease-wedge", ttl)
+                clock.advance(ttl * 0.6)
+                tokens = await asyncio.wait_for(run, timeout=30)
+                assert len(tokens) == 48
+                assert engine.stats.orphaned_requests == 0
+            finally:
+                await engine.stop()
+
+    @pytest.mark.parametrize("ragged", [True, False])
+    async def test_precedence_one_typed_error_both_schedulers(
+        self, params, ragged
+    ):
+        """THE precedence law (ISSUE 10 satellite), pinned on BOTH
+        schedulers: a run whose deadline AND lease lapse in the same
+        instant faults with exactly ONE typed error — the deadline's
+        (expired outranks orphaned; the deadline sweep also runs first
+        each pass) — and a lease-only lapse faults ``mesh.orphaned``.
+        The ragged and bifurcated lanes share one _raise_terminal and
+        one reap, so agreement is checked, not assumed."""
+        runtime = _rt(
+            chunked_prefill=True, overlap_dispatch=True,
+            ragged_waves=ragged, decode_steps_per_dispatch=2,
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        pace = ChaosScript()
+
+        def throttle(point):
+            pace(point)
+            if point == "dispatch":
+                time.sleep(0.01)
+
+        engine._chaos = throttle
+        with virtual_clock() as clock:
+            await engine.start()
+            try:
+                assert engine._ragged is ragged
+                now = cancellation.wall_clock()
+                ttl = 2.0
+                leases.note_beat("lease-both", ttl)
+                both = asyncio.create_task(
+                    _collect(
+                        engine, [1, 2, 3], 64, corr="both",
+                        deadline=now + ttl, lease=("lease-both", ttl),
+                    )
+                )
+                await settle(
+                    lambda: engine._active,
+                    message="the doubly-doomed run never activated",
+                )
+                # deadline AND lease lapse in one step: exactly one
+                # typed error, and it is the deadline's
+                clock.advance(ttl + 1.0)
+                with pytest.raises(DeadlineExceededError):
+                    await asyncio.wait_for(both, timeout=10)
+                await settle(lambda: _drained(engine))
+                assert engine.stats.expired_requests == 1
+                assert engine.stats.orphaned_requests == 0
+                assert engine.stats.cancelled_requests == 0
+                # lease-only lapse on the same scheduler: mesh.orphaned
+                leases.note_beat("lease-only", ttl)
+                orphan = asyncio.create_task(
+                    _collect(
+                        engine, [4, 5], 64, corr="only",
+                        lease=("lease-only", ttl),
+                    )
+                )
+                await settle(
+                    lambda: engine._active,
+                    message="the leased-only run never activated",
+                )
+                clock.advance(ttl + 1.0)
+                with pytest.raises(RunOrphanedError):
+                    await asyncio.wait_for(orphan, timeout=10)
+                await settle(lambda: _drained(engine))
+                assert_engine_drained(engine)
+                assert engine.stats.orphaned_requests == 1
+                assert engine.stats.expired_requests == 1
+            finally:
+                await engine.stop()
+
+    async def test_caller_death_mid_fire_and_forget_over_the_mesh(
+        self, params
+    ):
+        """THE acceptance drill: a LEASED client ``send()``s a run nobody
+        awaits through the real mesh → worker → engine path, then dies
+        hard (beat task killed, no tombstone).  One TTL later the engine
+        reaps the orphan — drained, zero leaks — and the typed
+        ``mesh.orphaned`` fault went to the (dead) reply topic."""
+        runtime = _rt(
+            max_batch_size=2, decode_steps_per_dispatch=1,
+            kv_layout="paged", overlap_dispatch=True,
+            flightrec_events=1 << 14,
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        total_free = engine._page_alloc.free_pages
+        throttle = ChaosScript()
+
+        def pace(point):
+            throttle(point)
+            if point == "dispatch":
+                time.sleep(0.01)
+
+        engine._chaos = pace
+        model = JaxLocalModelClient(
+            config=CFG, runtime=runtime, engine=engine, max_new_tokens=100
+        )
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            chaos = BrokerChaos()
+            mesh.chaos = chaos
+            agent = Agent("leased", model=model)
+            async with Worker([agent], mesh=mesh, owns_transport=True):
+                ttl = 1.0
+                client = Client.connect(mesh, lease_ttl=ttl)
+                corr = await client.agent("leased").send("fire and forget")
+                await settle(
+                    lambda: engine._active,
+                    message="the send() never reached the engine",
+                )
+                # hard caller death: beats stop, no tombstone
+                assert client._lease_task is not None
+                client._lease_task.cancel()
+                clock.advance(ttl + 0.5)
+                await settle(
+                    lambda: _drained(engine, total_free),
+                    message="the engine never reaped the orphan",
+                )
+                assert_engine_drained(engine, total_free)
+                assert engine.stats.orphaned_requests == 1
+                # the typed fault went out for the record (dead inbox)
+                await settle(
+                    lambda: chaos.kinds_seen("fault") >= 1,
+                    message="no mesh.orphaned fault was published",
+                )
+                events = _journal_events(engine)
+                tl = flightrec.timeline_events(events, corr)
+                names = [e["event"] for e in tl]
+                assert "ORPHAN" in names, names
+                await client.close()
+            await engine.stop()
+
+    async def test_clean_close_releases_lease_and_reaps_now(self, params):
+        """A clean ``close()`` tombstones the lease: outstanding leased
+        runs orphan IMMEDIATELY — no TTL of grace for a deliberate
+        departure (frozen clock proves no lapse was needed)."""
+        runtime = _rt(decode_steps_per_dispatch=1)
+        engine = InferenceEngine(CFG, runtime, params=params)
+        throttle = ChaosScript()
+
+        def pace(point):
+            throttle(point)
+            if point == "dispatch":
+                time.sleep(0.01)
+
+        engine._chaos = pace
+        model = JaxLocalModelClient(
+            config=CFG, runtime=runtime, engine=engine, max_new_tokens=100
+        )
+        with virtual_clock():
+            mesh = InMemoryMesh()
+            agent = Agent("leaving", model=model)
+            async with Worker([agent], mesh=mesh, owns_transport=True):
+                client = Client.connect(mesh, lease_ttl=30.0)
+                await client.agent("leaving").send("left behind")
+                await settle(
+                    lambda: engine._active,
+                    message="the send() never reached the engine",
+                )
+                await client.close()  # tombstones the lease
+                await settle(
+                    lambda: _drained(engine),
+                    message="a released lease never reaped the orphan",
+                )
+                assert engine.stats.orphaned_requests == 1
+                assert engine.stats.expired_requests == 0
+            await engine.stop()
+
+
+    async def test_no_liveness_feed_means_no_enforcement(self, params):
+        """Fail-safe wiring: a worker with NO control plane (no liveness
+        feed) must treat leased calls as un-leased — beats cannot reach
+        it, and orphaning a live caller's run one TTL after admission
+        would be worse than burning a dead one's.  The run completes
+        despite the clock passing the TTL."""
+        runtime = _rt(decode_steps_per_dispatch=2)
+        engine = InferenceEngine(CFG, runtime, params=params)
+        throttle = ChaosScript()
+
+        def pace(point):
+            throttle(point)
+            if point == "dispatch":
+                time.sleep(0.01)
+
+        engine._chaos = pace
+        model = JaxLocalModelClient(
+            config=CFG, runtime=runtime, engine=engine, max_new_tokens=24
+        )
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            agent = Agent("feedless", model=model)
+            async with Worker(
+                [agent], mesh=mesh, owns_transport=True,
+                control_plane=False,
+            ):
+                ttl = 0.5
+                client = Client.connect(mesh, lease_ttl=ttl)
+                handle = await client.agent("feedless").start(
+                    "still alive", timeout=600
+                )
+                await settle(
+                    lambda: engine._active,
+                    message="the call never reached the engine",
+                )
+                clock.advance(ttl * 10)  # far past the TTL
+                result = await handle.result()
+                assert result.output is not None
+                assert engine.stats.orphaned_requests == 0
+                await client.close()
+            await engine.stop()
+
+
+class TestDecodeFromOffsetResume:
+    """True decode-from-offset resume (ISSUE 10): the survivor of a
+    failover consumes ``deps["calfkit.resume_text"]`` — the delivered
+    prefix enters via PREFILL, decode produces only the remaining
+    tokens, and the caller observes one contiguous byte-exact stream
+    (greedy parity vs an unkilled run)."""
+
+    async def test_resume_generates_only_remaining_tokens(self, params):
+        """Engine-level accounting: a resumed request's prefix enters as
+        prefill (riding the prefix cache), decode counts ONLY the
+        remaining tokens, the deltas are exactly the continuation, and
+        the terminal response is byte-identical to the unresumed run."""
+        from calfkit_tpu.engine.model_client import (
+            ModelSettings,
+            ResponseDone,
+            ResumeOffset,
+            TextDelta,
+        )
+        from calfkit_tpu.models.messages import ModelRequest, UserPart
+
+        from tests._chaos import BijectiveTokenizer
+
+        runtime = _rt(
+            kv_layout="paged", chunked_prefill=True, prefix_cache=True,
+            overlap_dispatch=True,
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        model = JaxLocalModelClient(
+            config=CFG, runtime=runtime, engine=engine,
+            tokenizer=BijectiveTokenizer(), max_new_tokens=48,
+        )
+        messages = [ModelRequest(parts=[UserPart(content="tell a story")])]
+        try:
+            reference = await model.request(messages)
+            full = reference.text() or ""
+            assert len(full) >= 8, f"reference too short to resume: {full!r}"
+            k = len(full) // 2
+            p0 = engine.stats.prefill_tokens
+            d0 = engine.stats.decode_tokens
+            hits0 = engine.stats.prefix_hits
+
+            events = []
+            async for event in model.request_stream(
+                messages, ModelSettings(resume_text=full[:k])
+            ):
+                events.append(event)
+            # the resume protocol: offset first, then ONLY fresh deltas,
+            # then a terminal carrying the FULL answer
+            assert isinstance(events[0], ResumeOffset), events[0]
+            assert events[0].chars == k
+            deltas = "".join(
+                e.text for e in events if isinstance(e, TextDelta)
+            )
+            assert deltas == full[k:], (deltas, full)
+            done = events[-1]
+            assert isinstance(done, ResponseDone)
+            assert (done.response.text() or "") == full  # byte-exact
+            # token accounting: the prefix entered via prefill (k tokens
+            # on the bijective tokenizer), decode paid only the rest
+            assert engine.stats.decode_tokens - d0 == len(full) - k
+            prefill_delta = engine.stats.prefill_tokens - p0
+            assert prefill_delta > k  # prompt + the delivered prefix
+            # the shared prompt prefix rode the survivor-side cache
+            assert engine.stats.prefix_hits > hits0
+        finally:
+            await engine.stop()
+
+    async def test_resume_with_spent_budget_decodes_nothing(self, params):
+        """A delivered prefix that already spent the whole token budget
+        short-circuits: no engine work, just ResumeOffset + terminal."""
+        from calfkit_tpu.engine.model_client import (
+            ModelSettings,
+            ResponseDone,
+            ResumeOffset,
+        )
+        from calfkit_tpu.models.messages import ModelRequest, UserPart
+
+        from tests._chaos import BijectiveTokenizer
+
+        runtime = _rt()
+        engine = InferenceEngine(CFG, runtime, params=params)
+        model = JaxLocalModelClient(
+            config=CFG, runtime=runtime, engine=engine,
+            tokenizer=BijectiveTokenizer(), max_new_tokens=4,
+        )
+        messages = [ModelRequest(parts=[UserPart(content="hi")])]
+        try:
+            prior = "".join(chr(0x100 + i) for i in (9, 10, 11, 12))
+            events = [
+                e
+                async for e in model.request_stream(
+                    messages, ModelSettings(resume_text=prior)
+                )
+            ]
+            assert isinstance(events[0], ResumeOffset)
+            assert isinstance(events[-1], ResponseDone)
+            assert (events[-1].response.text() or "") == prior
+            assert engine.stats.decode_tokens == 0
+            assert engine.stats.prefill_tokens == 0
+        finally:
+            await engine.stop()
+
+    async def test_kill_mid_stream_resume_rides_survivor(self, params):
+        """THE acceptance scenario: kill a replica mid-stream; the
+        survivor RESUMES decode-from-offset — its prefill absorbed the
+        delivered prefix, its decode produced only the remainder — and
+        the caller observed one contiguous byte-exact stream, equal to
+        an unkilled run's answer (greedy parity)."""
+        from calfkit_tpu.models.node_result import InvocationResult
+
+        from tests._chaos import BijectiveTokenizer
+
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            engines, models = [], []
+            for _ in range(2):
+                runtime = _rt(max_seq_len=256)
+                engine = InferenceEngine(CFG, runtime, params=params)
+                engines.append(engine)
+                models.append(
+                    JaxLocalModelClient(
+                        config=CFG, runtime=runtime, engine=engine,
+                        tokenizer=BijectiveTokenizer(), max_new_tokens=48,
+                    )
+                )
+            async with FleetTopology(
+                mesh, models, agent_kwargs={"stream_tokens": True}
+            ) as fleet:
+                low = fleet.index_of_lowest_key()
+                router, client = TestFailoverChaos._failover_client(
+                    mesh, fleet
+                )
+                await TestFleetChaos._eligible(
+                    router, 2, "fleet never became routable"
+                )
+                # the unkilled reference (first call: EWMA ties at zero,
+                # so it lands on the lowest key and warms that replica)
+                ref = await client.agent("svc").execute(
+                    "tell a story", timeout=120
+                )
+                full = ref.output or ""
+                assert len(full) >= 24, f"answer too short: {full!r}"
+                prompt_len = engines[low].stats.prefill_tokens
+                assert prompt_len > 0
+                # pace BOTH engines — the victim is whichever replica
+                # the stream lands on (the EWMA tiebreak steers it away
+                # from the ref-warmed one; derive it, don't assume it)
+                slow = ChaosScript()
+
+                def pace(point):
+                    slow(point)
+                    if point == "dispatch":
+                        time.sleep(0.02)
+
+                for engine in engines:
+                    engine._chaos = pace
+                before_p = [e.stats.prefill_tokens for e in engines]
+                before_d = [e.stats.decode_tokens for e in engines]
+
+                token_texts: list = []
+                offsets: list = []
+                result = None
+                killed = False
+                delivered_at_kill = 0
+                victim = -1
+                async for item in client.agent("svc").stream(
+                    "tell a story", timeout=120
+                ):
+                    if isinstance(item, InvocationResult):
+                        result = item
+                        continue
+                    if getattr(item.step, "kind", "") != "token":
+                        continue
+                    token_texts.append(item.step.text)
+                    offsets.append(item.step.offset)
+                    if not killed and sum(len(t) for t in token_texts) >= 8:
+                        killed = True
+                        delivered_at_kill = sum(len(t) for t in token_texts)
+                        victim = 0 if engines[0]._active else 1
+                        assert engines[victim]._active
+                        fleet.kill(victim)
+                        clock.advance(fleet.config.stale_after + 1)
+                assert killed, "the stream never delivered enough to kill"
+                assert result is not None
+                streamed = "".join(token_texts)
+                # one contiguous stream, byte-exact greedy parity with
+                # the unkilled reference
+                assert result.output == full
+                assert streamed == full
+                # the survivor resumed from offset: its prefill absorbed
+                # prompt + delivered prefix, its decode paid ONLY the
+                # remainder — nothing was re-generated (and nothing
+                # needed deduping)
+                survivor = 1 - victim
+                resume_len = (
+                    engines[survivor].stats.prefill_tokens
+                    - before_p[survivor]
+                    - prompt_len
+                )
+                assert resume_len >= delivered_at_kill > 0
+                decode_delta = (
+                    engines[survivor].stats.decode_tokens
+                    - before_d[survivor]
+                )
+                assert decode_delta == len(full) - resume_len
+                # the resumed attempt's first chunk was offset-stamped at
+                # the delivered-prefix length
+                assert resume_len in offsets, (resume_len, offsets)
+                assert fleet.agents[survivor]._failover_requests == 1
+                await client.close()
+            for engine in engines:
+                await engine.stop()
+            await mesh.stop()

@@ -57,6 +57,7 @@ def _replica(
     ready: bool = True,
     draining: bool = False,
     topic: "str | None" = None,
+    ewma: float = 0.0,
 ) -> Replica:
     node_id = f"agent.{agent}"
     stats = EngineStatsRecord(
@@ -72,6 +73,7 @@ def _replica(
         draining=draining,
         active_requests=active,
         pending_requests=pending,
+        dispatch_ewma_ms=ewma,
     )
     return Replica(
         key=f"{node_id}@{instance}",
@@ -803,3 +805,138 @@ class TestFailureRecoveryLaws:
                 assert fleet.agents[survivor]._failover_requests == 1
                 await client.close()
             await mesh.stop()
+
+
+class TestCallerLivenessLaws:
+    """Pure-law units for ISSUE 10: the EWMA dispatch-latency fold and
+    its many-router tiebreak, the offset-exact stream-ledger law that
+    decode-from-offset resume rides, the lease header wire forms, and
+    the typed ``mesh.orphaned`` fault classification."""
+
+    def test_ewma_fold(self):
+        from calfkit_tpu.inference.engine import EngineStats
+
+        stats = EngineStats()
+        # first sample primes the fold directly (no zero-start bias)
+        stats.note_dispatch_ewma(10.0)
+        assert stats.dispatch_ewma_ms == 10.0
+        # the fold: alpha * sample + (1 - alpha) * prev
+        a = EngineStats.EWMA_ALPHA
+        stats.note_dispatch_ewma(20.0)
+        assert stats.dispatch_ewma_ms == pytest.approx(
+            a * 20.0 + (1 - a) * 10.0
+        )
+        prev = stats.dispatch_ewma_ms
+        stats.note_dispatch_ewma(20.0)
+        assert stats.dispatch_ewma_ms == pytest.approx(
+            a * 20.0 + (1 - a) * prev
+        )
+        # a constant stream converges toward the constant
+        for _ in range(200):
+            stats.note_dispatch_ewma(20.0)
+        assert stats.dispatch_ewma_ms == pytest.approx(20.0, abs=1e-6)
+        # the EWMA is a fold, NOT a window counter: it must never enter
+        # the delta machinery (a windowed EWMA delta is meaningless)
+        assert "dispatch_ewma_ms" not in EngineStats._COUNTER_FIELDS
+
+    def test_ewma_breaks_depth_ties(self):
+        """Depth-tied candidates rank by EWMA latency; depth still
+        dominates (a slow-but-empty replica beats a fast-but-deep one);
+        EWMA ties fall through to the stable key."""
+        fast = _replica("b-fast", active=2, ewma=3.0)
+        slow = _replica("a-slow", active=2, ewma=9.0)
+        assert LeastLoaded().select([slow, fast], REQ) is fast
+        # two candidates: PowerOfTwoChoices degenerates to the same law
+        assert PowerOfTwoChoices().select([slow, fast], REQ) is fast
+        # depth dominates the tiebreak
+        deep_fast = _replica("c-deep", active=5, ewma=0.5)
+        assert LeastLoaded().select([deep_fast, slow], REQ) is slow
+        # EWMA tie (e.g. two pre-EWMA adverts at 0.0) → stable key
+        x = _replica("x1", active=1)
+        y = _replica("y1", active=1)
+        assert LeastLoaded().select([y, x], REQ) is x
+        # 0.0 = NO SIGNAL and ranks LAST among ties: a mixed fleet
+        # (rolling upgrade, never-dispatched engine) must not herd all
+        # tied traffic onto the one replica with no latency evidence
+        unknown = _replica("a-unknown", active=2, ewma=0.0)
+        assert LeastLoaded().select([unknown, slow], REQ) is slow
+        # p2c over n>2 with a scripted rng: samples 0 and 1, keeps the
+        # lower-EWMA one of the pair
+        draws = iter([0.0, 0.0])  # i=0; j=0 -> bumped to 1
+        policy = PowerOfTwoChoices(rng=lambda: next(draws))
+        third = _replica("z9", active=2, ewma=1.0)
+        picked = policy.select([slow, fast, third], REQ)
+        assert picked is fast
+
+    def test_stream_ledger_offsets(self):
+        """The offset-exact dedupe law (ISSUE 10): a decode-from-offset
+        RESUME stamps its first chunk at the delivered-prefix length and
+        nothing is suppressed; a re-generating attempt stamping from 0
+        has exactly the replayed prefix trimmed; unstamped chunks fall
+        back to the cumulative law."""
+        from calfkit_tpu.fleet import StreamLedger
+
+        # resumed attempt: offset picks up where delivery stopped
+        ledger = StreamLedger()
+        assert ledger.filter("alpha ", 0) == "alpha "
+        ledger.begin_attempt()
+        assert ledger.filter("beta", len("alpha ")) == "beta"
+        assert ledger.text == "alpha beta"
+        # follow-up chunks of the resumed attempt keep flowing, stamped
+        # or not (the cumulative cursor advanced with the offset)
+        assert ledger.filter(" gamma") == " gamma"
+        # re-generating attempt: stamped from zero, prefix suppressed
+        ledger2 = StreamLedger()
+        assert ledger2.filter("one two ", 0) == "one two "
+        ledger2.begin_attempt()
+        assert ledger2.filter("one ", 0) == ""
+        assert ledger2.filter("two three", 4) == "three"
+        assert ledger2.text == "one two three"
+
+    def test_lease_header_wire_forms(self):
+        lease = protocol.format_lease("abcd1234", 12.5)
+        assert protocol.parse_lease(lease) == ("abcd1234", 12.5)
+        assert protocol.parse_lease(lease.encode()) == ("abcd1234", 12.5)
+        # malformed degrades to un-leased, never faults
+        for bad in (None, "", "noseparator", ":5.0", "x:", "x:nan",
+                    "x:inf", "x:-1", "x:0", b"\xff\xfe"):
+            assert protocol.parse_lease(bad) is None
+
+    def test_orphaned_fault_is_typed_and_not_retriable(self):
+        from calfkit_tpu.exceptions import (
+            RETRIABLE_FAULT_TYPES,
+            RunOrphanedError,
+            error_type_for,
+            exception_for,
+        )
+
+        assert error_type_for(RunOrphanedError("x")) == "mesh.orphaned"
+        assert exception_for("mesh.orphaned") is RunOrphanedError
+        # NOT retriable: there is nobody left to answer
+        assert "mesh.orphaned" not in RETRIABLE_FAULT_TYPES
+
+    def test_render_leases_table(self):
+        import json
+
+        from calfkit_tpu.cli.obs import render_leases_table
+
+        items = {
+            "lease-live": json.dumps(
+                {"lease_id": "lease-live", "ttl_s": 10.0,
+                 "beat_at": NOW - 3.0}
+            ).encode(),
+            "lease-dead": json.dumps(
+                {"lease_id": "lease-dead", "ttl_s": 5.0,
+                 "beat_at": NOW - 60.0}
+            ).encode(),
+            "lease-bad": b"not json",
+        }
+        table = render_leases_table(items, now=NOW)
+        lines = table.splitlines()
+        assert lines[0].split() == ["LEASE", "BEAT", "AGE", "S", "TTL",
+                                    "S", "VERDICT"]
+        by_lease = {line.split()[0]: line for line in lines[1:]}
+        assert "live" in by_lease["lease-live"]
+        assert "lapsed" in by_lease["lease-dead"]
+        assert "undecodable" in by_lease["lease-bad"]
+        assert "no caller leases" in render_leases_table({})

@@ -234,3 +234,101 @@ class TestFleetSoakOverKafka:
                 models[low].release.set()  # clean teardown
                 await client.close()
             await client_mesh.stop()
+
+
+class TestOrphanReapOverKafka:
+    async def test_orphan_reap_soak(self, broker_port):
+        """Orphan-reap soak over the REAL wire (ISSUE 10): a LEASED
+        client fire-and-forgets runs into a REAL engine through kafkad —
+        beats on the real compacted ``mesh.caller_liveness`` table, the
+        worker's liveness feed folding them back — then dies hard (beat
+        task killed, no tombstone).  One virtual TTL later the engine
+        has reaped every orphan: drained, zero leaked slots/pages,
+        ORPHANS counted."""
+        import time as _time
+
+        jax = pytest.importorskip("jax")
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+
+        from calfkit_tpu.inference import model as M
+        from calfkit_tpu.inference.client import JaxLocalModelClient
+        from calfkit_tpu.inference.config import RuntimeConfig, preset
+        from calfkit_tpu.inference.engine import InferenceEngine
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+
+        from tests._chaos import assert_engine_drained
+
+        cfg = preset("debug")
+        params = M.init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+        runtime = RuntimeConfig(
+            max_batch_size=4, max_seq_len=128, prefill_chunk=16,
+            decode_steps_per_dispatch=1, page_size=16, kv_layout="paged",
+        )
+        engine = InferenceEngine(cfg, runtime, params=params)
+        total_free = engine._page_alloc.free_pages
+
+        def pace(point):
+            if point == "dispatch":
+                _time.sleep(0.01)
+
+        engine._chaos = pace
+        model = JaxLocalModelClient(
+            config=cfg, runtime=runtime, engine=engine, max_new_tokens=100
+        )
+        with virtual_clock() as clock:
+            worker_mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+            client_mesh = KafkaWireMesh(f"127.0.0.1:{broker_port}")
+            await client_mesh.start()
+            agent = Agent("leased", model=model)
+            async with Worker(
+                [agent], mesh=worker_mesh, owns_transport=True
+            ):
+                ttl = 1.0
+                client = Client.connect(client_mesh, lease_ttl=ttl)
+                for i in range(3):
+                    await client.agent("leased").send(f"orphan soak {i}")
+
+                def submitted() -> int:
+                    wave = (
+                        len(engine._inflight["wave"])
+                        if engine._inflight is not None else 0
+                    )
+                    return (
+                        len(engine._active) + len(engine._pending)
+                        + len(engine._carry) + len(engine._admitting)
+                        + wave
+                    )
+
+                # ALL three sends must reach the engine before the
+                # caller dies: a slow broker delivery arriving after the
+                # reap would otherwise be counted (or not) by race
+                await settle(
+                    lambda: submitted() == 3,
+                    message="the sends never all reached the engine",
+                    ticks=3000, interval=0.01,  # first-use XLA compiles
+                )
+                # hard caller death over the real wire
+                assert client._lease_task is not None
+                client._lease_task.cancel()
+                clock.advance(ttl + 0.5)
+                await settle(
+                    lambda: (
+                        not engine._active
+                        and not engine._pending
+                        and not engine._carry
+                        and engine._pend is None
+                        and engine._inflight is None
+                        and not engine._admitting
+                        and len(engine._free) == runtime.max_batch_size
+                        and engine._page_alloc.free_pages == total_free
+                    ),
+                    message="the engine never reaped the orphans",
+                    **SETTLE,
+                )
+                assert_engine_drained(engine, total_free)
+                assert engine.stats.orphaned_requests == 3
+                await client.close()
+            await engine.stop()
+            await client_mesh.stop()
