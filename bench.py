@@ -798,7 +798,13 @@ def main() -> None:
     if not explicit_cpu:
         cached = _load_tpu_cache()
         if cached is not None:
-            stale = _cache_is_stale_code(cached)
+            # a cache file may carry a machine-readable stale stamp
+            # (ISSUE 11 satellite): once a capture is KNOWN bad — taken
+            # against a wedged chip, or preceding a code change the sha
+            # diff cannot see — the stamp forces the stale path forever,
+            # so bench.py can never again report the number as current
+            stamped = cached.get("stale_reason")
+            stale = bool(stamped) or _cache_is_stale_code(cached)
             label = f" cached@{cached.get('captured_at', '?')}"
             if stale:
                 label += " stale-code"
@@ -812,8 +818,12 @@ def main() -> None:
                 f"accelerator unavailable at capture; value is the last "
                 f"successful on-TPU run"
                 + (
-                    " (STALE: calfkit_tpu/inference or bench.py changed "
-                    "since capture)" if stale else ""
+                    f" (STALE: {stamped.get('detail', stamped.get('code', 'stamped stale'))})"
+                    if isinstance(stamped, dict)
+                    else (
+                        " (STALE: calfkit_tpu/inference or bench.py "
+                        "changed since capture)" if stale else ""
+                    )
                 )
                 + f" | {error}"
             ).strip()

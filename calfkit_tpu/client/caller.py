@@ -767,10 +767,22 @@ class AgentGateway(Generic[OutputT]):
     ) -> "InvocationHandle | None":
         """Park until the FIRST of ``handles`` lands a terminal, or
         ``timeout`` (one probe tick) elapses — whichever is sooner.
-        Returns the finished handle, or None on a quiet tick."""
+        Returns the finished handle, or None on a quiet tick.
+
+        ``timeout <= 0`` is the busy-poll mode (the fleet simulator's
+        deterministic probing: a yield, not a timer) — one bare
+        event-loop yield instead of waiter-task churn, because hundreds
+        of outstanding supervised calls each allocating tasks per tick
+        is the difference between a simulation step and a stall."""
         for handle in handles:
             if handle.terminal_arrived:
                 return handle
+        if timeout is not None and timeout <= 0:
+            await asyncio.sleep(0)
+            for handle in handles:
+                if handle.terminal_arrived:
+                    return handle
+            return None
         waiters = [
             asyncio.ensure_future(h.wait(timeout)) for h in handles
         ]
@@ -946,6 +958,14 @@ class AgentGateway(Generic[OutputT]):
                     if hedge.routed_replica is not None:
                         exclude.add(hedge.routed_replica)
                     await hedge.cancel()
+                    # uncharge the corpse NOW: its terminal can never
+                    # arrive, so the done-callback that normally clears
+                    # the router's least-request entry never fires — the
+                    # phantom in-flight would bias placement away from
+                    # the replica for the whole TTL after it heals
+                    router.note_done(
+                        hedge.routed_replica_key, hedge.correlation_id
+                    )
                     hedge = None
             if primary.routed_replica_key is not None:
                 verdict = router.placement_verdict(primary.routed_replica_key)
@@ -957,6 +977,14 @@ class AgentGateway(Generic[OutputT]):
                     if primary.routed_replica is not None:
                         exclude.add(primary.routed_replica)
                     await primary.cancel()
+                    # uncharge the corpse (see the dead-hedge branch):
+                    # no terminal will ever clear this entry, and a
+                    # healed replica must not carry phantom load.
+                    # note_done is pop-idempotent, so a zombie that DOES
+                    # later publish a terminal double-clears harmlessly.
+                    router.note_done(
+                        primary.routed_replica_key, primary.correlation_id
+                    )
                     if hedge is not None:
                         # the duplicate is already running elsewhere:
                         # promote it instead of spending a failover

@@ -95,6 +95,14 @@ _BEAT_CAP = 4096
 # a lease lapsed longer than PRUNE_TTLS × its ttl is historical record,
 # not live state: every run registered against it was reaped long ago
 _PRUNE_TTLS = 32.0
+# the stale-prune scan is O(store); running it on EVERY insert while the
+# store sits at its cap is O(store²) under fleet-scale caller churn (the
+# sim's lease_churn scenario spends 80% of its time there).  Amortize:
+# scan at most once per this many over-cap inserts; between scans the
+# cap is held by O(1) LRU pops — beats move entries to the end, so the
+# LRU front is the oldest-beat (≈ most-lapsed) entry anyway.
+_PRUNE_SCAN_EVERY = 256
+_scan_countdown = 0
 _RELEASED = float("-inf")
 _beats: "OrderedDict[str, tuple[float, float]]" = OrderedDict()
 _LOCK = threading.Lock()
@@ -132,17 +140,24 @@ def note_beat(
         if len(_beats) > _BEAT_CAP:
             # prune the historical dead first (released, or lapsed many
             # TTLs ago): evicting a FRESH entry would read as
-            # never-seen = alive and permanently un-reap its runs
-            now = cancellation.wall_clock()
-            stale = [
-                key
-                for key, (beat, ttl) in _beats.items()
-                if beat == _RELEASED or now - beat > ttl * _PRUNE_TTLS
-            ]
-            for key in stale:
-                if len(_beats) <= _BEAT_CAP:
-                    break
-                del _beats[key]
+            # never-seen = alive and permanently un-reap its runs.  The
+            # scan is amortized (see _PRUNE_SCAN_EVERY): between scans
+            # the O(1) LRU pop below holds the cap.
+            global _scan_countdown
+            if _scan_countdown <= 0:
+                _scan_countdown = _PRUNE_SCAN_EVERY
+                now = cancellation.wall_clock()
+                stale = [
+                    key
+                    for key, (beat, ttl) in _beats.items()
+                    if beat == _RELEASED or now - beat > ttl * _PRUNE_TTLS
+                ]
+                for key in stale:
+                    if len(_beats) <= _BEAT_CAP:
+                        break
+                    del _beats[key]
+            else:
+                _scan_countdown -= 1
         while len(_beats) > _BEAT_CAP:
             _beats.popitem(last=False)
 
@@ -169,7 +184,11 @@ def release_lease(lease_id: str) -> None:
     with _LOCK:
         ttl = _beats.get(lease_id, (0.0, DEFAULT_LEASE_TTL))[1]
         _beats[lease_id] = (_RELEASED, ttl)
-        _beats.move_to_end(lease_id)
+        # released = historical record: park it at the LRU FRONT so the
+        # cap's O(1) eviction backstop consumes corpses before it can
+        # ever touch a live lease (an evicted LIVE lease reads
+        # never-seen = alive forever and permanently un-reaps its runs)
+        _beats.move_to_end(lease_id, last=False)
         _release_gen += 1
 
 

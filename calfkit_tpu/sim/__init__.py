@@ -1,0 +1,86 @@
+"""calfkit_tpu.sim — the deterministic fleet simulator (ISSUE 11).
+
+One package, four layers:
+
+- **seams** (`clock`, `ids`, `chaos`, `transport`): the virtual clock +
+  event heap, deterministic id minting, scripted fault injectors, and
+  the per-replica death/partition transport — promoted from
+  ``tests/_chaos.py`` so the simulator and the chaos tests share one
+  implementation (``tests/_chaos.py`` remains as an import shim).
+- **fleet shape** (`topology`, `stubs`): N real Workers of one agent
+  name on a shared mesh, engines replaced by virtual-latency stubs.
+- **scenarios** (`scenario`, `runner`, `report`): the declarative DSL
+  (arrival curves, tenants, scripted death/partition/heal, lease
+  churn), the discrete-event runner over the REAL
+  mesh→worker→router path, and the SIM.json report shape.
+- **the pinned suite** (`suite`): the scenarios ``scripts/perf_gate.py``
+  runs and gates against SIM_BASELINE.json on every PR.
+
+See docs/simulation.md for the scenario DSL, the metric definitions,
+the determinism contract, and the tolerance policy.
+"""
+
+from calfkit_tpu.sim.chaos import (
+    BrokerChaos,
+    ChaosScript,
+    assert_engine_drained,
+    settle,
+)
+from calfkit_tpu.sim.clock import DEFAULT_EPOCH, VirtualClock, virtual_clock
+from calfkit_tpu.sim.ids import deterministic_ids
+from calfkit_tpu.sim.report import (
+    CheckResult,
+    ScenarioReport,
+    SimReport,
+    strip_capture,
+)
+from calfkit_tpu.sim.runner import SimRunner, run_scenario
+from calfkit_tpu.sim.scenario import (
+    Check,
+    LeaseChurn,
+    LoadPhase,
+    ReplicaEvent,
+    Scenario,
+    ServiceSpec,
+    TenantSpec,
+    diurnal_phases,
+)
+from calfkit_tpu.sim.stubs import (
+    BijectiveTokenizer,
+    ServingStubModel,
+    SimEngineModel,
+    StreamingStubModel,
+)
+from calfkit_tpu.sim.topology import FleetTopology
+from calfkit_tpu.sim.transport import ReplicaTransport
+
+__all__ = [
+    "BrokerChaos",
+    "ChaosScript",
+    "assert_engine_drained",
+    "settle",
+    "DEFAULT_EPOCH",
+    "VirtualClock",
+    "virtual_clock",
+    "deterministic_ids",
+    "CheckResult",
+    "ScenarioReport",
+    "SimReport",
+    "strip_capture",
+    "SimRunner",
+    "run_scenario",
+    "Check",
+    "LeaseChurn",
+    "LoadPhase",
+    "ReplicaEvent",
+    "Scenario",
+    "ServiceSpec",
+    "TenantSpec",
+    "diurnal_phases",
+    "BijectiveTokenizer",
+    "ServingStubModel",
+    "SimEngineModel",
+    "StreamingStubModel",
+    "FleetTopology",
+    "ReplicaTransport",
+]

@@ -1,0 +1,325 @@
+"""Engine stand-ins for fleet scenarios (promoted from ``tests/_chaos.py``
+plus the simulator's virtual-latency model — ISSUE 11).
+
+Everything above the model-client seam is REAL in a simulated fleet: the
+mesh, the workers, the node kernels, the control-plane heartbeats, the
+router, the client.  Only the inference engine is replaced, because a
+real engine's decode thread makes completion ordering a property of the
+host scheduler — and the simulator promises byte-identical reports.
+
+- :class:`ServingStubModel` — instant replies; LOOKS engine-backed to
+  the fleet machinery (``stats_snapshot`` makes its agent advertise on
+  ``mesh.engine_stats`` and subscribe its replica-addressed topic).
+- :class:`StreamingStubModel` — word-sized deltas with a deterministic
+  mid-stream pause seam (the kill-mid-stream scenarios).
+- :class:`BijectiveTokenizer` — token id ↔ character bijection for
+  byte-exact resume tests.
+- :class:`SimEngineModel` — the simulator's fixed-latency device stub:
+  requests occupy one of ``slots`` virtual servers for a service time
+  computed purely from the scenario's :class:`~calfkit_tpu.sim.scenario.
+  ServiceSpec` and complete on virtual-clock events — hours of fleet
+  time cost no host time, and identical seeds replay identical
+  timelines.  It sheds with the real typed ``EngineOverloadedError``,
+  models a page-aligned prefix cache (hits skip the prefill term), and
+  advertises the same counters a real engine heartbeats
+  (depth, EWMA dispatch latency, prefix hits, tokens/dispatch).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any
+
+from calfkit_tpu.exceptions import EngineOverloadedError
+from calfkit_tpu.fleet.selection import page_aligned_prefix
+from calfkit_tpu.sim.clock import VirtualClock
+from calfkit_tpu.sim.scenario import ServiceSpec
+
+__all__ = [
+    "ServingStubModel",
+    "StreamingStubModel",
+    "BijectiveTokenizer",
+    "SimEngineModel",
+]
+
+
+def _estimate_tokens(messages: Any) -> int:
+    return sum(len(str(m)) // 4 for m in messages)
+
+
+def _prompt_text(messages: Any) -> str:
+    """The latest user-authored text in the turn — what the router's
+    affinity key and the stub's prefix model both derive from."""
+    for message in reversed(list(messages)):
+        for part in reversed(getattr(message, "parts", []) or []):
+            content = getattr(part, "content", None)
+            if isinstance(content, str) and content:
+                return content
+    return ""
+
+
+class ServingStubModel:
+    """A scripted model that LOOKS engine-backed to the fleet machinery:
+    ``stats_snapshot`` makes its agent advertise on ``mesh.engine_stats``
+    (and subscribe its replica-addressed topic) without paying for a real
+    inference engine.  ``load`` feeds the queue-depth signal policies
+    rank on; ``replies`` counts turns served by THIS replica."""
+
+    def __init__(self, *, text: str = "ok", load: int = 0):
+        self.text = text
+        self.load = load
+        self.replies = 0
+
+    @property
+    def model_name(self) -> str:
+        return "serving-stub"
+
+    def stats_snapshot(self, *, window: bool = False) -> dict:
+        return {
+            "model_name": self.model_name,
+            "active_requests": self.load,
+            "pending_requests": 0,
+        }
+
+    async def request(
+        self, messages: Any, settings: Any = None, params: Any = None
+    ) -> Any:
+        from calfkit_tpu.models.messages import (
+            ModelResponse,
+            TextOutput,
+            Usage,
+        )
+
+        self.replies += 1
+        return ModelResponse(
+            parts=[TextOutput(text=self.text)],
+            usage=Usage(
+                input_tokens=_estimate_tokens(messages), output_tokens=1
+            ),
+            model_name=self.model_name,
+        )
+
+
+class BijectiveTokenizer:
+    """Token id ↔ character bijection for byte-exact resume tests
+    (ISSUE 10): generated id ``i`` decodes to ``chr(0x100 + i)`` and
+    encodes back to exactly ``i`` — so re-encoding a delivered prefix
+    reproduces the original token ids and greedy decode-from-offset
+    parity is literal byte equality (ByteTokenizer's UTF-8 replacement
+    chars break the round trip for arbitrary model outputs).  Prompt
+    characters below U+0100 encode to their ordinal, within the debug
+    preset's 512-token vocab."""
+
+    pad_id = 0
+    bos_id = 1
+    eos_id = 2
+
+    def encode(self, text: str) -> "list[int]":
+        return [
+            ord(c) - 0x100 if ord(c) >= 0x100 else ord(c) for c in text
+        ]
+
+    def decode(self, ids: "list[int]") -> str:
+        return "".join(chr(0x100 + i) for i in ids if i >= 0)
+
+
+class StreamingStubModel(ServingStubModel):
+    """A ServingStubModel whose ``request_stream`` yields word-sized
+    deltas and PAUSES after ``pause_after`` of them until ``release`` is
+    set — the deterministic mid-stream seam: a scenario observes the
+    first delivered tokens, kills the replica, and knows exactly how
+    much text the caller saw.  The stream keeps yielding after the kill
+    (a dead replica's compute keeps burning); the transport seam drops
+    the output."""
+
+    def __init__(
+        self,
+        *,
+        text: str = "alpha beta gamma delta",
+        pause_after: int = 1,
+        load: int = 0,
+    ):
+        super().__init__(text=text, load=load)
+        self.pause_after = pause_after
+        self.release = asyncio.Event()
+        self.streamed: list[str] = []
+
+    async def request_stream(
+        self, messages: Any, settings: Any = None, params: Any = None
+    ) -> Any:
+        from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
+
+        words = self.text.split(" ")
+        deltas = [
+            w + (" " if i < len(words) - 1 else "")
+            for i, w in enumerate(words)
+        ]
+        for i, delta in enumerate(deltas):
+            if i == self.pause_after:
+                await self.release.wait()
+            self.streamed.append(delta)
+            yield TextDelta(delta)
+            await asyncio.sleep(0)
+        response = await super().request(messages, settings, params)
+        yield ResponseDone(response)
+
+
+class SimEngineModel:
+    """The simulator's deterministic fixed-latency engine (see module
+    docstring).  All time below is VIRTUAL: a request reserves the
+    earliest-free of ``service.slots`` virtual servers, computes its
+    service span from the :class:`ServiceSpec`, and awaits a completion
+    event the clock fires when an advance crosses it.  The host never
+    sleeps; the scenario's discrete-event loop is the only scheduler."""
+
+    def __init__(
+        self,
+        clock: VirtualClock,
+        *,
+        index: int = 0,
+        service: "ServiceSpec | None" = None,
+        prefix_page_chars: int = 64,
+    ):
+        self.clock = clock
+        self.index = index
+        self.service = service or ServiceSpec()
+        self.prefix_page_chars = prefix_page_chars
+        self._mult = self.service.multiplier(index)
+        # per-virtual-server busy-until horizon (absolute virtual time)
+        self._busy: "list[float]" = [0.0] * max(1, self.service.slots)
+        # (start_at, done_at) of admitted-unfinished requests, for the
+        # pending-vs-active split the heartbeat advertises
+        self._inflight: "dict[int, tuple[float, float]]" = {}
+        self._next_run = 0
+        # prefix model: page-aligned prefixes this replica has served
+        self._prefix_seen: "set[bytes]" = set()
+        # counters (everything the heartbeat / report harvests)
+        self.replies = 0
+        self.sheds = 0
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_reused_tokens = 0
+        self.decode_tokens = 0
+        self.decode_dispatches = 0
+        self.busy_virtual_s = 0.0
+        self.dispatch_ewma_ms = 0.0
+        # virtual timestamp of the last completion this replica served
+        # (the report's makespan reads the fleet max — the horizon
+        # no-op event must not inflate it)
+        self.last_done_at = 0.0
+
+    @property
+    def model_name(self) -> str:
+        return "sim-engine"
+
+    # ------------------------------------------------------------ signals
+    @property
+    def active(self) -> int:
+        """Admitted-but-unfinished depth (the shed law's input)."""
+        return len(self._inflight)
+
+    def _in_service(self) -> int:
+        now = self.clock.now
+        return sum(1 for start, _ in self._inflight.values() if start <= now)
+
+    def stats_snapshot(self, *, window: bool = False) -> dict:
+        in_service = self._in_service()
+        return {
+            "model_name": self.model_name,
+            "platform": "sim",
+            "active_requests": in_service,
+            "pending_requests": len(self._inflight) - in_service,
+            "max_batch_size": self.service.slots,
+            "decode_tokens": self.decode_tokens,
+            "decode_dispatches": self.decode_dispatches,
+            "shed_requests": self.sheds,
+            "dispatch_ewma_ms": round(self.dispatch_ewma_ms, 6),
+            "prefix_hits": self.prefix_hits,
+            "prefix_reused_tokens": self.prefix_reused_tokens,
+            "prefix_cached_pages": len(self._prefix_seen),
+            "tokens_per_dispatch": (
+                round(self.decode_tokens / self.decode_dispatches, 6)
+                if self.decode_dispatches
+                else 0.0
+            ),
+        }
+
+    # ------------------------------------------------------------ serving
+    async def request(
+        self, messages: Any, settings: Any = None, params: Any = None
+    ) -> Any:
+        from calfkit_tpu.models.messages import (
+            ModelResponse,
+            TextOutput,
+            Usage,
+        )
+
+        spec = self.service
+        if (
+            spec.shed_above is not None
+            and len(self._inflight) >= spec.shed_above
+        ):
+            self.sheds += 1
+            raise EngineOverloadedError(
+                "sim engine overloaded",
+                lane="sim",
+                pending=len(self._inflight),
+                limit=spec.shed_above,
+            )
+
+        prompt = _prompt_text(messages)
+        input_tokens = max(1, len(prompt) // 4)
+        prefix_hit = False
+        key = page_aligned_prefix(prompt, self.prefix_page_chars)
+        if key is not None:
+            self.prefix_lookups += 1
+            if key in self._prefix_seen:
+                prefix_hit = True
+                self.prefix_hits += 1
+                self.prefix_reused_tokens += len(key) // 4
+            else:
+                self._prefix_seen.add(key)
+
+        prefill_s = (
+            0.0 if prefix_hit else spec.prefill_per_token_s * input_tokens
+        )
+        service_s = (
+            spec.base_s + prefill_s + spec.per_token_s * spec.new_tokens
+        ) * self._mult
+        now = self.clock.now
+        slot = min(range(len(self._busy)), key=lambda i: (self._busy[i], i))
+        start_at = max(now, self._busy[slot])
+        done_at = start_at + service_s
+        self._busy[slot] = done_at
+        run_id = self._next_run
+        self._next_run += 1
+        self._inflight[run_id] = (start_at, done_at)
+
+        done = asyncio.Event()
+        self.clock.schedule(done_at, done.set)
+        await done.wait()
+
+        self._inflight.pop(run_id, None)
+        self.replies += 1
+        self.last_done_at = max(self.last_done_at, done_at)
+        dispatches = max(
+            1,
+            -(-spec.new_tokens // max(1, spec.steps_per_dispatch)),
+        )
+        self.decode_tokens += spec.new_tokens
+        self.decode_dispatches += dispatches
+        self.busy_virtual_s += service_s
+        per_dispatch_ms = service_s * 1000.0 / dispatches
+        self.dispatch_ewma_ms = (
+            per_dispatch_ms
+            if self.dispatch_ewma_ms == 0.0
+            else 0.8 * self.dispatch_ewma_ms + 0.2 * per_dispatch_ms
+        )
+        return ModelResponse(
+            parts=[TextOutput(text=f"sim:r{self.index}:{self.replies}")],
+            usage=Usage(
+                input_tokens=input_tokens,
+                output_tokens=spec.new_tokens,
+            ),
+            model_name=self.model_name,
+        )

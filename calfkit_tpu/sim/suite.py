@@ -1,0 +1,259 @@
+"""The pinned scenario suite (ISSUE 11): what ``scripts/perf_gate.py``
+runs on every PR and what SIM.json / SIM_BASELINE.json are captured
+from.
+
+Six geometries, each exercising a different fleet claim through the
+real mesh → worker → router path (see docs/simulation.md for the full
+metric definitions and the reasoning behind every bound):
+
+- **steady_state_120** — 120 replicas under uniform Poisson load: the
+  width claim.  Gates routing skew and completion.
+- **diurnal_ramp** — a compressed two-hour day curve over 12 replicas
+  with bounded admission: peak traffic sheds and retries onto siblings
+  instead of failing.  Gates sheds, completion, and peak depth.
+- **hotspot_tenant** — one tenant dwarfs the rest under
+  prefix-affinity routing: repeat sessions stay home.  Gates the
+  prefix-cache hit rate.
+- **cascading_failure** — three replicas die in sequence mid-traffic
+  with failover supervision on: every blackholed call recovers.
+  Order-invariant aggregates only (``per_replica_report=False`` —
+  racing supervisors make per-replica counts order-sensitive; see
+  docs/simulation.md "Determinism").
+- **partition_heal** — two replicas partition away and heal: traffic
+  completes throughout, the healed replicas serve again.
+- **lease_churn** — 20k synthetic caller leases churn against the real
+  compacted liveness table while traffic flows: the lapse law and the
+  store cap hold at fleet scale.
+
+Scenario *definitions* are data: the tier-1 tests run
+``scaled_suite(0.1)`` for speed; the perf gate runs ``PINNED_SUITE``
+full-size.  Changing anything here invalidates SIM_BASELINE.json —
+regenerate with ``python scripts/perf_gate.py --write-baseline``.
+"""
+
+from __future__ import annotations
+
+from calfkit_tpu.sim.scenario import (
+    Check,
+    LeaseChurn,
+    LoadPhase,
+    ReplicaEvent,
+    Scenario,
+    ServiceSpec,
+    TenantSpec,
+    diurnal_phases,
+)
+
+__all__ = ["PINNED_SUITE", "SUITE_NAME", "scaled_suite", "scenario_named"]
+
+SUITE_NAME = "fleet-pinned-v1"
+
+
+STEADY_STATE = Scenario(
+    name="steady_state_120",
+    replicas=120,
+    seed=11,
+    phases=(LoadPhase(duration_s=300.0, rate_rps=16.0),),
+    policy="p2c",
+    service=ServiceSpec(base_s=0.6, per_token_s=0.04, slots=1),
+    heartbeat_every_s=5.0,
+    stale_after_s=15.0,
+    checks=(
+        Check("all_complete", "requests.completion_ratio", "==", 1.0),
+        Check("no_faults", "requests.failed", "==", 0.0),
+        Check("skew_bounded", "routing.skew_p95_over_mean", "<=", 1.9),
+        Check("fleet_used", "routing.skew_max_over_mean", ">", 0.0),
+    ),
+    gated=(
+        "requests.completed",
+        "routing.skew_p95_over_mean",
+        "tokens.tokens_per_dispatch",
+        "time.makespan_s",
+    ),
+)
+
+
+DIURNAL = Scenario(
+    name="diurnal_ramp",
+    replicas=12,
+    seed=23,
+    phases=diurnal_phases(
+        hours=2.0, trough_rps=0.1, peak_rps=2.2, steps=16
+    ),
+    policy="p2c",
+    # peak sits just under fleet capacity (12×2 slots / ~10s service =
+    # 2.4 rps) with a shed cap LOW enough that Poisson clumps at peak
+    # actually trip bounded admission — the retry-onto-siblings path is
+    # part of what this scenario proves
+    service=ServiceSpec(
+        base_s=4.0, per_token_s=0.19, slots=2, shed_above=5
+    ),
+    retry_attempts=4,
+    heartbeat_every_s=15.0,
+    stale_after_s=45.0,
+    checks=(
+        Check("all_complete", "requests.completion_ratio", "==", 1.0),
+        Check("no_faults", "requests.failed", "==", 0.0),
+        Check("peak_depth_visible", "depth.p95", ">=", 2.0),
+        Check("depth_bounded", "depth.max", "<=", 24.0),
+        Check("admission_exercised", "shed.sheds", ">=", 1.0),
+    ),
+    gated=(
+        "requests.completed",
+        "shed.sheds",
+        "depth.p95",
+        "time.makespan_s",
+    ),
+)
+
+
+HOTSPOT = Scenario(
+    name="hotspot_tenant",
+    replicas=16,
+    seed=37,
+    phases=(LoadPhase(duration_s=600.0, rate_rps=4.0),),
+    policy="prefix-affinity",
+    tenants=(
+        TenantSpec("hot", weight=6.0, sessions=24),
+        TenantSpec("t1", weight=1.0, sessions=16),
+        TenantSpec("t2", weight=1.0, sessions=16),
+        TenantSpec("t3", weight=1.0, sessions=16),
+    ),
+    service=ServiceSpec(
+        base_s=0.4, per_token_s=0.02, prefill_per_token_s=0.01, slots=2
+    ),
+    heartbeat_every_s=5.0,
+    stale_after_s=15.0,
+    checks=(
+        Check("all_complete", "requests.completion_ratio", "==", 1.0),
+        Check("no_faults", "requests.failed", "==", 0.0),
+        Check("sessions_stay_home", "prefix.hit_rate", ">=", 0.9),
+    ),
+    gated=(
+        "requests.completed",
+        "prefix.hit_rate",
+        "prefix.reused_tokens",
+        "time.makespan_s",
+    ),
+)
+
+
+CASCADE = Scenario(
+    name="cascading_failure",
+    replicas=12,
+    seed=41,
+    phases=(LoadPhase(duration_s=240.0, rate_rps=3.0),),
+    policy="least-loaded",
+    service=ServiceSpec(base_s=1.5, per_token_s=0.05, slots=2),
+    failover=True,
+    max_failovers=4,
+    retry_attempts=4,
+    heartbeat_every_s=5.0,
+    stale_after_s=15.0,
+    events=(
+        ReplicaEvent(at_s=60.0, action="kill", replica=2),
+        ReplicaEvent(at_s=90.0, action="kill", replica=5),
+        ReplicaEvent(at_s=120.0, action="kill", replica=8),
+    ),
+    per_replica_report=False,
+    checks=(
+        Check("all_complete", "requests.completion_ratio", "==", 1.0),
+        Check("no_faults", "requests.failed", "==", 0.0),
+        Check("corpses_get_nothing", "routing.delivered_while_dead", "==", 0.0),
+        Check("failover_fired", "routing.failover_arrivals", ">=", 1.0),
+    ),
+    gated=(
+        "requests.completed",
+        "routing.delivered_while_dead",
+    ),
+)
+
+
+PARTITION_HEAL = Scenario(
+    name="partition_heal",
+    replicas=10,
+    seed=53,
+    phases=(LoadPhase(duration_s=300.0, rate_rps=3.0),),
+    policy="least-loaded",
+    service=ServiceSpec(base_s=1.0, per_token_s=0.03, slots=2),
+    failover=True,
+    max_failovers=4,
+    retry_attempts=4,
+    heartbeat_every_s=5.0,
+    stale_after_s=15.0,
+    events=(
+        ReplicaEvent(at_s=60.0, action="kill", replica=3),
+        ReplicaEvent(at_s=60.0, action="kill", replica=4),
+        ReplicaEvent(at_s=180.0, action="resume", replica=3),
+        ReplicaEvent(at_s=180.0, action="resume", replica=4),
+    ),
+    per_replica_report=False,
+    checks=(
+        Check("all_complete", "requests.completion_ratio", "==", 1.0),
+        Check("no_faults", "requests.failed", "==", 0.0),
+        Check("partitioned_get_nothing", "routing.delivered_while_dead", "==", 0.0),
+        Check("healed_serve_again", "routing.delivered_after_heal", ">=", 1.0),
+    ),
+    gated=(
+        "requests.completed",
+        "routing.delivered_after_heal",
+    ),
+)
+
+
+LEASE_CHURN = Scenario(
+    name="lease_churn",
+    replicas=6,
+    seed=67,
+    phases=(LoadPhase(duration_s=600.0, rate_rps=1.0),),
+    policy="p2c",
+    service=ServiceSpec(base_s=0.5, per_token_s=0.02, slots=2),
+    leases=LeaseChurn(
+        callers=20_000,
+        ttl_s=90.0,
+        beat_every_s=45.0,
+        min_life_s=60.0,
+        max_life_s=240.0,
+        clean_release_ratio=0.25,
+    ),
+    heartbeat_every_s=15.0,
+    stale_after_s=45.0,
+    checks=(
+        Check("all_complete", "requests.completion_ratio", "==", 1.0),
+        Check("no_faults", "requests.failed", "==", 0.0),
+        Check("fleet_scale_leases", "leases.minted", ">=", 10_000.0),
+        Check("lapse_law_bites", "leases.lapsed", ">=", 1.0),
+        # the store cap must hold no matter how many callers churned
+        Check("store_capped", "leases.store_size", "<=", 4096.0),
+    ),
+    gated=(
+        "requests.completed",
+        "leases.lapsed",
+        "leases.store_size",
+    ),
+)
+
+
+PINNED_SUITE: "tuple[Scenario, ...]" = (
+    STEADY_STATE,
+    DIURNAL,
+    HOTSPOT,
+    CASCADE,
+    PARTITION_HEAL,
+    LEASE_CHURN,
+)
+
+
+
+def scaled_suite(factor: float) -> "tuple[Scenario, ...]":
+    """The same six geometries, proportionally smaller — the tier-1
+    determinism tests' fast path (arrival rates scale with the fleet so
+    per-replica load, and therefore every verdict, is preserved)."""
+    return tuple(s.scaled(factor) for s in PINNED_SUITE)
+
+
+def scenario_named(name: str) -> Scenario:
+    for scenario in PINNED_SUITE:
+        if scenario.name == name:
+            return scenario
+    raise KeyError(name)

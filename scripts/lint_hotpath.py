@@ -43,6 +43,15 @@ constructs the telemetry PR explicitly bans there (ISSUE 2):
   ``def``-not-``async def`` shape), must not log or call ``time.time``,
   and the fleet modules may not construct unbounded queues/deques
   without the same ``# unbounded-ok:`` justification.
+- the fleet simulator (ISSUE 11): NO wall-clock read anywhere in
+  ``calfkit_tpu/sim/`` — ``time.time``/``time.monotonic``/
+  ``time.perf_counter``/``datetime.now``/``datetime.utcnow`` are all
+  banned.  The simulator's determinism contract (byte-identical
+  SIM.json per seed) holds only while every timestamp flows through the
+  ``cancellation.wall_clock`` seam; one stray host-clock read silently
+  turns a reproducible report into a flaky one.  A genuinely needed
+  host-time read (none exist today) must carry ``# wallclock-ok:``
+  with a reason, mirroring the unbounded-queue rule.
 
 Exit 0 when clean; exit 1 with a file:line listing otherwise.
 """
@@ -64,6 +73,7 @@ DISPATCH = Path(__file__).resolve().parent.parent / (
 )
 FLEET_DIR = Path(__file__).resolve().parent.parent / "calfkit_tpu/fleet"
 LEASES = Path(__file__).resolve().parent.parent / "calfkit_tpu/leases.py"
+SIM_DIR = Path(__file__).resolve().parent.parent / "calfkit_tpu/sim"
 
 # caller-liveness reads on the reaper's sweep path (ISSUE 10): the
 # engine calls these per registered-expiry pop, between device
@@ -493,6 +503,118 @@ def _unbounded_queue_violations(
     return out
 
 
+# ------------------------------------------------- simulator wall clock
+# (ISSUE 11) the determinism contract: every timestamp in the sim
+# package flows through the cancellation.wall_clock seam.  Any direct
+# host-clock read would leak real time into SIM.json and break the
+# byte-identical repeat-run guarantee the perf gate stands on.
+
+_SIM_BANNED_CLOCK_NAMES = {
+    "time", "monotonic", "perf_counter",
+    "time_ns", "monotonic_ns", "perf_counter_ns",
+    "now", "utcnow", "today",
+}
+# dotted suffixes: matches `time.time()`, `datetime.datetime.now()`,
+# `datetime.date.today()` — any attribute-chain call whose LAST segment
+# is a clock read and whose chain starts at the time/datetime modules
+_SIM_BANNED_CLOCK_ROOTS = {"time", "datetime", "date"}
+_SIM_OK_MARK = "wallclock-ok:"
+# the promoted chaos-test helpers that predate the simulator and run
+# only in REAL-time chaos tests (never inside a scenario's event loop):
+# resume_heartbeat re-arms the real tick loop's monotonic stamp
+_SIM_ALLOWED_FUNCTIONS = {"resume_heartbeat"}
+
+
+def _sim_violations() -> "list[tuple[Path, int, str]]":
+    out: list[tuple[Path, int, str]] = []
+    if not SIM_DIR.exists():
+        return [(SIM_DIR, 0, "sim package missing (update lint_hotpath)")]
+    checked = 0
+    for path in sorted(SIM_DIR.glob("*.py")):
+        source = path.read_text()
+        lines = source.splitlines()
+        tree = ast.parse(source, filename=str(path))
+        checked += 1
+        # map every call to its enclosing function name (for allowlist)
+        enclosing: dict[int, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        enclosing.setdefault(id(sub), node.name)
+        # from-imported clock names ("from time import monotonic") make
+        # bare-name calls bannable; without the import a local helper
+        # coincidentally named `time` stays legal
+        from_imported: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module in (
+                "time", "datetime"
+            ):
+                for alias in node.names:
+                    from_imported.add(alias.asname or alias.name)
+        for call in ast.walk(tree):
+            if not isinstance(call, ast.Call):
+                continue
+            dotted = _dotted_name(call.func)
+            banned = False
+            if dotted is not None:
+                parts = dotted.split(".")
+                if len(parts) == 1:
+                    # bare call: banned only when the name arrived via a
+                    # from-import of the time/datetime modules
+                    banned = (
+                        parts[0] in _SIM_BANNED_CLOCK_NAMES
+                        and parts[0] in from_imported
+                    )
+                else:
+                    banned = (
+                        parts[-1] in _SIM_BANNED_CLOCK_NAMES
+                        and parts[0] in _SIM_BANNED_CLOCK_ROOTS
+                    )
+            if not banned:
+                continue
+            if enclosing.get(id(call)) in _SIM_ALLOWED_FUNCTIONS:
+                continue
+            if _sim_justified(lines, call.lineno):
+                continue
+            out.append(
+                (path, call.lineno,
+                 f"sim wall-clock read {dotted}() — all "
+                 "timestamps must flow through cancellation.wall_clock "
+                 f"(or carry '# {_SIM_OK_MARK} <why>')")
+            )
+        out.extend(_unbounded_queue_violations(tree, source, path))
+    if checked == 0:
+        out.append(
+            (SIM_DIR, 0, "sim package empty (update lint_hotpath)")
+        )
+    return out
+
+
+def _dotted_name(node: ast.AST) -> "str | None":
+    """``a.b.c`` for a Name/Attribute chain; None for computed bases
+    (subscripts, calls) the lint cannot resolve statically."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _sim_justified(lines: "list[str]", lineno: int) -> bool:
+    if 1 <= lineno <= len(lines) and _SIM_OK_MARK in lines[lineno - 1]:
+        return True
+    n = lineno - 1
+    while 1 <= n <= len(lines) and lines[n - 1].lstrip().startswith("#"):
+        if _SIM_OK_MARK in lines[n - 1]:
+            return True
+        n -= 1
+    return False
+
+
 def _leases_violations() -> "list[tuple[Path, int, str]]":
     """The lease store's sweep-path reads (ISSUE 10): same no-blocking /
     no-logging / no-time.time contract as the fleet selection path."""
@@ -560,6 +682,7 @@ def main() -> int:
     )
     queue_found += _fleet_violations()
     queue_found += _leases_violations()
+    queue_found += _sim_violations()
     if queue_found:
         for path, line, message in sorted(queue_found):
             print(f"{path}:{line}: {message}")
@@ -593,10 +716,12 @@ def main() -> int:
         for c in ast.walk(tree)
     )
     fleet_guarded = sum(len(v) for v in FLEET_SELECT_FUNCTIONS.values())
+    sim_files = len(list(SIM_DIR.glob("*.py"))) if SIM_DIR.exists() else 0
     print(
         f"lint_hotpath: clean ({len(HOT_FUNCTIONS & names)} dispatch-loop "
         f"functions, {journal_sites} journal-append sites, "
         f"{fleet_guarded} fleet selection-path functions checked, "
+        f"{sim_files} sim modules wall-clock-free, "
         "unbounded-queue rule enforced)"
     )
     return 0

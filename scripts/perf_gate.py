@@ -1,0 +1,354 @@
+"""The deterministic CI perf gate (ISSUE 11 acceptance artifact).
+
+Runs the pinned fleet-simulation suite (``calfkit_tpu/sim/suite.py``)
+through the REAL mesh → worker → router path on virtual time, writes the
+structured ``SIM.json`` report, and gates two things:
+
+1. **scenario verdicts** — every pinned scenario's checks must pass
+   (completion, zero faults, skew/depth bounds, prefix hit rate,
+   corpse isolation, lease lapse law);
+2. **baseline regression** — every gated metric is compared against the
+   checked-in ``SIM_BASELINE.json`` within its per-metric tolerance.
+   Only deterministic virtual-clock and counter metrics are gated —
+   NEVER host wall-clock (the CI hosts vary ~6x between sessions; wall
+   time appears only in the report's ``capture`` block, as provenance).
+
+Tolerances (docs/simulation.md "Tolerance policy"): the suite is
+byte-deterministic for a fixed seed, so in principle tolerance could be
+zero — but legitimate changes (a new rng consumer, a scheduling-order
+refactor) shift exact values without regressing behavior.  Each gated
+metric therefore carries a relative band (default ±10%) plus an
+absolute slack for near-zero values; metrics where ANY movement is a
+bug (``delivered_while_dead``) get tolerance 0 in the baseline.
+
+Usage:
+    python scripts/perf_gate.py                  # gate against baseline
+    python scripts/perf_gate.py --out SIM.json   # also write the report
+    python scripts/perf_gate.py --write-baseline # regenerate baseline
+    python scripts/perf_gate.py --scale 0.15     # scaled run (no gate)
+    python scripts/perf_gate.py --degrade routing  # seeded-regression
+        seam: replaces every scenario's policy with a worst-loaded
+        router; the gate MUST fail (tested in tests/test_sim.py)
+
+Exit codes: 0 = all verdicts + baseline pass; 1 = regression or failed
+verdict; 2 = harness error (missing baseline, bad flags).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+from typing import Any, Sequence
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# determinism requires a pinned hash seed (str-keyed set iteration order
+# feeds nothing load-bearing today, but "today" is not a contract):
+# re-exec once with PYTHONHASHSEED=0 so SIM.json is comparable across
+# hosts and sessions
+if os.environ.get("PYTHONHASHSEED") != "0":
+    env = dict(os.environ, PYTHONHASHSEED="0")
+    os.execve(sys.executable, [sys.executable, *sys.argv], env)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from calfkit_tpu.fleet.registry import Replica  # noqa: E402
+from calfkit_tpu.sim import SimReport, SimRunner  # noqa: E402
+from calfkit_tpu.sim.report import strip_capture  # noqa: E402
+from calfkit_tpu.sim.suite import (  # noqa: E402
+    PINNED_SUITE,
+    SUITE_NAME,
+    scaled_suite,
+)
+
+BASELINE_PATH = os.path.join(REPO, "SIM_BASELINE.json")
+DEFAULT_REL_TOL = 0.10
+DEFAULT_ABS_TOL = 2.0
+# metrics where ANY movement is a regression, not drift
+EXACT_METRICS = {
+    "requests.completed",
+    "routing.delivered_while_dead",
+}
+
+
+class _WorstLoaded:
+    """The seeded-regression policy (--degrade routing): deliberately
+    picks the DEEPEST queue — the exact inversion of least-loaded.  A
+    gate that cannot catch this is not a gate."""
+
+    def select(
+        self, candidates: "Sequence[Replica]", request: Any
+    ) -> "Replica | None":
+        return max(
+            candidates,
+            key=lambda r: (r.queue_depth, r.key),
+            default=None,
+        )
+
+
+def _git(*args: str) -> "str | None":
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            ["git", "-C", REPO, *args],
+            capture_output=True, text=True, timeout=20,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return proc.stdout.strip() if proc.returncode == 0 else None
+
+
+async def run_suite(
+    *, scale: float = 1.0, degrade: "str | None" = None
+) -> SimReport:
+    """Run the pinned suite (optionally scaled / degraded) and return
+    the report.  Scenarios run sequentially on one loop — each run
+    installs its own virtual clock and id seam, so isolation holds."""
+    scenarios = (
+        PINNED_SUITE if scale == 1.0 else scaled_suite(scale)
+    )
+    policy = _WorstLoaded() if degrade == "routing" else None
+    if policy is not None:
+        # the degrade seam exists to prove the gate goes red, and the
+        # load-balancing scenarios prove it decisively (skew explodes,
+        # sheds cascade into fault storms).  On the failover-supervised
+        # scenarios a worst-loaded policy additionally herds every
+        # re-dispatch onto one replica's hours-deep virtual backlog —
+        # minutes of host time to learn nothing new — so they are
+        # skipped here (their own regression coverage is the baseline
+        # gate on the UNdegraded run).
+        scenarios = tuple(s for s in scenarios if not s.failover)
+    report = SimReport(suite=SUITE_NAME)
+    for scenario in scenarios:
+        t0 = time.perf_counter()
+        try:
+            result = await SimRunner(scenario, policy=policy).run()
+        except Exception as exc:  # noqa: BLE001 - a crash IS a gate fail
+            from calfkit_tpu.sim.report import CheckResult, ScenarioReport
+
+            result = ScenarioReport(
+                name=scenario.name,
+                seed=scenario.seed,
+                replicas=scenario.replicas,
+                metrics={"error": f"{type(exc).__name__}: {exc}"},
+                checks=[
+                    CheckResult(
+                        name="scenario_ran",
+                        metric="error",
+                        op="==",
+                        bound=0.0,
+                        value=None,
+                        passed=False,
+                    )
+                ],
+                gated=scenario.gated,
+            )
+        wall = time.perf_counter() - t0
+        verdict = "PASS" if result.passed else "FAIL"
+        offered = result.metric("requests.offered")
+        print(
+            f"[perf_gate] {scenario.name}: {verdict} "
+            # a crashed scenario has no metrics tree — the status line
+            # must not crash the crash-reporting path
+            f"offered={'?' if offered is None else int(offered)} "
+            f"wall={wall:.1f}s",
+            file=sys.stderr,
+        )
+        for check in result.checks:
+            if not check.passed:
+                print(
+                    f"[perf_gate]   check {check.name}: {check.metric} "
+                    f"{check.op} {check.bound} got {check.value}",
+                    file=sys.stderr,
+                )
+        report.scenarios.append(result)
+    return report
+
+
+def compare_to_baseline(
+    report: SimReport, baseline: "dict[str, Any]"
+) -> "list[str]":
+    """Regressions (empty = gate passes).  Baseline shape:
+    ``{"scenarios": {name: {metric: {"value": v, "rel_tol": r,
+    "abs_tol": a}}}}``.  A gated metric missing from the run or from
+    the baseline is itself a regression — silence must not pass."""
+    problems: list[str] = []
+    base_scenarios = baseline.get("scenarios", {})
+    for scenario in report.scenarios:
+        base = base_scenarios.get(scenario.name)
+        if base is None:
+            problems.append(
+                f"{scenario.name}: no baseline entry "
+                "(regenerate with --write-baseline)"
+            )
+            continue
+        gated = scenario.gated_metrics()
+        for metric in scenario.gated:
+            entry = base.get(metric)
+            value = gated.get(metric)
+            if entry is None:
+                problems.append(
+                    f"{scenario.name}.{metric}: gated but not in baseline"
+                )
+                continue
+            if value is None:
+                problems.append(
+                    f"{scenario.name}.{metric}: missing from this run "
+                    f"(baseline {entry['value']})"
+                )
+                continue
+            expected = float(entry["value"])
+            rel = float(entry.get("rel_tol", DEFAULT_REL_TOL))
+            abs_tol = float(entry.get("abs_tol", DEFAULT_ABS_TOL))
+            band = max(abs(expected) * rel, abs_tol)
+            if abs(value - expected) > band:
+                problems.append(
+                    f"{scenario.name}.{metric}: {value} vs baseline "
+                    f"{expected} (band ±{band:.4g})"
+                )
+        if not scenario.passed:
+            failed = [c.name for c in scenario.checks if not c.passed]
+            problems.append(
+                f"{scenario.name}: scenario verdict FAILED ({failed})"
+            )
+    return problems
+
+
+def baseline_from(report: SimReport) -> "dict[str, Any]":
+    scenarios: dict[str, Any] = {}
+    for scenario in report.scenarios:
+        entry: dict[str, Any] = {}
+        for metric, value in scenario.gated_metrics().items():
+            if metric in EXACT_METRICS:
+                entry[metric] = {"value": value, "rel_tol": 0.0, "abs_tol": 0.0}
+            else:
+                entry[metric] = {
+                    "value": value,
+                    "rel_tol": DEFAULT_REL_TOL,
+                    "abs_tol": DEFAULT_ABS_TOL,
+                }
+        scenarios[scenario.name] = entry
+    return {
+        "suite": SUITE_NAME,
+        "tolerance_policy": (
+            f"per-metric band = max(|value| * rel_tol, abs_tol); "
+            f"defaults rel={DEFAULT_REL_TOL} abs={DEFAULT_ABS_TOL}; "
+            "exact metrics carry 0/0 (see docs/simulation.md)"
+        ),
+        "scenarios": scenarios,
+    }
+
+
+def capture_block(*, wall_s: float, scale: float) -> "dict[str, Any]":
+    """Host-varying provenance ONLY — everything deterministic lives in
+    the scenarios tree (see sim/report.py)."""
+    return {
+        "captured_at": time.strftime(
+            "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+        ),
+        "git_sha": _git("rev-parse", "HEAD"),
+        "wall_s": round(wall_s, 1),
+        "scale": scale,
+        "python_hash_seed": os.environ.get("PYTHONHASHSEED"),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default=None, help="write SIM.json here")
+    parser.add_argument(
+        "--baseline", default=BASELINE_PATH,
+        help="baseline to gate against (default SIM_BASELINE.json)",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="regenerate the baseline from this run instead of gating",
+    )
+    parser.add_argument(
+        "--scale", type=float, default=1.0,
+        help="scaled suite factor (1.0 = pinned full size)",
+    )
+    parser.add_argument(
+        "--degrade", choices=("routing",), default=None,
+        help="seeded-regression seam: run with a deliberately bad "
+             "policy; the gate must fail",
+    )
+    ns = parser.parse_args()
+
+    t0 = time.perf_counter()
+    report = asyncio.run(run_suite(scale=ns.scale, degrade=ns.degrade))
+    wall = time.perf_counter() - t0
+    document = report.to_dict(
+        capture=capture_block(wall_s=wall, scale=ns.scale)
+    )
+    if ns.out:
+        with open(ns.out, "w") as f:
+            json.dump(document, f, sort_keys=True, indent=1)
+            f.write("\n")
+        print(f"[perf_gate] wrote {ns.out}", file=sys.stderr)
+
+    if ns.write_baseline:
+        if ns.scale != 1.0 or ns.degrade:
+            print(
+                "[perf_gate] refusing to write a baseline from a scaled "
+                "or degraded run", file=sys.stderr,
+            )
+            return 2
+        with open(ns.baseline, "w") as f:
+            json.dump(baseline_from(report), f, sort_keys=True, indent=1)
+            f.write("\n")
+        print(f"[perf_gate] wrote {ns.baseline}", file=sys.stderr)
+        return 0 if report.passed else 1
+
+    if ns.scale != 1.0:
+        # scaled runs have no baseline: verdicts only
+        print(
+            f"[perf_gate] scaled run ({ns.scale}): verdicts "
+            f"{'PASS' if report.passed else 'FAIL'}, no baseline gate",
+            file=sys.stderr,
+        )
+        return 0 if report.passed else 1
+
+    try:
+        with open(ns.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, json.JSONDecodeError) as exc:
+        print(f"[perf_gate] baseline unreadable: {exc}", file=sys.stderr)
+        return 2
+
+    problems = compare_to_baseline(report, baseline)
+    if problems:
+        for problem in problems:
+            print(f"[perf_gate] REGRESSION: {problem}", file=sys.stderr)
+        print(
+            f"[perf_gate] FAILED: {len(problems)} problem(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"[perf_gate] PASS: {len(report.scenarios)} scenarios, all "
+        "verdicts + baseline bands hold "
+        f"(wall {wall:.1f}s — not gated)",
+        file=sys.stderr,
+    )
+    return 0
+
+
+# re-exported for tests (the determinism test compares stripped docs)
+__all__ = [
+    "run_suite",
+    "compare_to_baseline",
+    "baseline_from",
+    "strip_capture",
+    "_WorstLoaded",
+]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,575 +1,53 @@
-"""Deterministic chaos harness (ISSUE 5).
+"""DEPRECATED import shim — the chaos harness is now ``calfkit_tpu.sim``.
 
-Small, composable fault-injection pieces the chaos scenarios in
-``tests/test_chaos.py`` (and the shed/expire parity matrix in
-``tests/test_overlap_dispatch.py``) script against:
+ISSUE 11 promoted the deterministic chaos harness (virtual clock,
+scripted fault injectors, the replica death/partition transport, the
+fleet topology, the serving stubs) out of this test-support module into
+the first-class, mypy-gated ``calfkit_tpu/sim/`` package, where the
+fleet simulator and the perf gate build on it.  Every name that ever
+lived here is re-exported below so existing chaos scenarios keep
+importing ``tests._chaos`` unchanged.
 
-- :class:`VirtualClock` / :func:`virtual_clock` — drives EVERY deadline
-  comparison in the package (client mint, hop expiry, engine
-  admission/reap) through the single ``calfkit_tpu.cancellation.
-  wall_clock`` seam.  Scenarios advance time explicitly; nothing sleeps
-  to make a deadline pass.
-- :class:`ChaosScript` — the engine's ``_chaos`` seam: fires a scripted
-  exception at the Nth visit of a named point ("tick" per scheduler
-  pass, "dispatch" per decode tick), so a mid-stream engine fault lands
-  on an exact, reproducible dispatch.
-- :class:`BrokerChaos` — the in-memory mesh's publish hook
-  (``InMemoryMesh.chaos``): drops the Nth record matching a
-  topic/kind predicate ("broker loses the return"), counts everything
-  it sees, and can run scripted side effects at publish time (e.g.
-  advance the virtual clock between the client's mint and the node's
-  delivery — the expired-on-arrival scenario).
-- :func:`settle` — await a condition within a BOUNDED number of
-  event-loop ticks; the harness's only waiting primitive.
-- :func:`assert_engine_drained` — the no-leak oracle: no active slots,
-  no in-flight dispatch, every slot on the free list, every page back
-  in the pool.
-- :class:`FleetTopology` (ISSUE 7) — spawns MULTI-WORKER topologies: N
-  workers on one shared mesh, each hosting a replica of the same agent
-  name, with fast heartbeats and per-replica delivery ledgers, so
-  replica failover, drain handoff, and shed-retry storms run
-  deterministically under the virtual clock.  Includes the
-  heartbeat-wedge/resume seam for stale-replica scenarios (a wedged
-  publisher stops re-stamping; everything else keeps serving).
+New code should import from ``calfkit_tpu.sim`` directly:
 
-Everything is plain deterministic state — no randomness, no wall-clock
-dependence beyond the event loop needing to actually run.
+    from calfkit_tpu.sim import VirtualClock, virtual_clock
+    from calfkit_tpu.sim import ChaosScript, BrokerChaos, settle
+    from calfkit_tpu.sim import FleetTopology, ReplicaTransport
+    from calfkit_tpu.sim import ServingStubModel, StreamingStubModel
+
+This shim will stay until the chaos suites migrate their imports; do
+not add new names here.
 """
 
-from __future__ import annotations
-
-import asyncio
-import contextlib
-import threading
-import time
-from typing import Any, Callable, Iterator
-
-from calfkit_tpu import cancellation
-from calfkit_tpu import protocol
-from calfkit_tpu.mesh.tables import TableWriter
-from calfkit_tpu.mesh.transport import MeshTransport
-
-
-class VirtualClock:
-    """A controllable stand-in for ``cancellation.wall_clock``."""
-
-    def __init__(self, start: float = 1_700_000_000.0):
-        self.now = float(start)
-
-    def __call__(self) -> float:
-        return self.now
-
-    def advance(self, seconds: float) -> float:
-        self.now += seconds
-        return self.now
-
-
-@contextlib.contextmanager
-def virtual_clock(start: float = 1_700_000_000.0) -> "Iterator[VirtualClock]":
-    """Install a :class:`VirtualClock` as THE package deadline clock for
-    the duration of the block (every caller reads it through the module
-    attribute, so one swap moves all layers in lockstep)."""
-    clock = VirtualClock(start)
-    previous = cancellation.wall_clock
-    cancellation.wall_clock = clock
-    try:
-        yield clock
-    finally:
-        cancellation.wall_clock = previous
-
-
-class ChaosScript:
-    """Scripted failure points for the engine's ``_chaos`` seam.
-
-    >>> engine._chaos = ChaosScript().fail_at("dispatch", 3, RuntimeError("x"))
-
-    raises on the 3rd decode tick exactly; every other visit is a no-op.
-    ``calls`` keeps per-point visit counts for assertions.
-    """
-
-    def __init__(self) -> None:
-        self.calls: dict[str, int] = {}
-        self._plan: dict[tuple[str, int], BaseException] = {}
-        self._blocks: dict[tuple[str, int], "threading.Event"] = {}
-
-    def fail_at(
-        self, point: str, nth: int, exc: BaseException
-    ) -> "ChaosScript":
-        self._plan[(point, nth)] = exc
-        return self
-
-    def block_at(
-        self, point: str, nth: int, gate: "threading.Event"
-    ) -> "ChaosScript":
-        """On the Nth visit of ``point``, BLOCK until ``gate`` is set —
-        the wedged-device-grant simulator (ISSUE 9): the decode thread
-        (and with it the whole serve loop, stuck in its to_thread) hangs
-        exactly like a hung device sync, and only the watchdog's own
-        task can observe it.  ``gate.set()`` releases the dispatch, which
-        then lands normally (the recovery path)."""
-        self._blocks[(point, nth)] = gate
-        return self
-
-    def __call__(self, point: str) -> None:
-        count = self.calls.get(point, 0) + 1
-        self.calls[point] = count
-        gate = self._blocks.pop((point, count), None)
-        if gate is not None:
-            gate.wait()
-        exc = self._plan.pop((point, count), None)
-        if exc is not None:
-            raise exc
-
-
-class BrokerChaos:
-    """Scripted broker misbehavior for ``InMemoryMesh.chaos``.
-
-    Rules match on message kind (the ``x-mesh-kind`` header) and/or a
-    topic substring; each drops up to ``count`` matching records.  All
-    publishes are recorded in ``seen`` as ``(topic, kind)`` so scenarios
-    can assert what crossed the broker (e.g. "a cancel record WAS
-    published after the timeout").  ``on_publish`` hooks run for every
-    record — the deterministic place to advance a virtual clock between
-    a client's deadline mint and the node's delivery.
-    """
-
-    def __init__(self) -> None:
-        self.seen: list[tuple[str, str]] = []
-        self.dropped: list[tuple[str, str]] = []
-        self._rules: list[dict[str, Any]] = []
-        self.on_publish: "Callable[[str, dict[str, str]], None] | None" = None
-
-    def drop(
-        self,
-        *,
-        kind: "str | None" = None,
-        topic_contains: "str | None" = None,
-        count: int = 1,
-    ) -> "BrokerChaos":
-        self._rules.append(
-            {"kind": kind, "topic": topic_contains, "count": count}
-        )
-        return self
-
-    def kinds_seen(self, kind: str) -> int:
-        return sum(1 for _, k in self.seen if k == kind)
-
-    def __call__(self, topic: str, headers: dict[str, str]) -> "str | None":
-        kind = headers.get(protocol.HDR_KIND, "")
-        self.seen.append((topic, kind))
-        if self.on_publish is not None:
-            self.on_publish(topic, headers)
-        for rule in self._rules:
-            if rule["count"] <= 0:
-                continue
-            if rule["kind"] is not None and kind != rule["kind"]:
-                continue
-            if rule["topic"] is not None and rule["topic"] not in topic:
-                continue
-            rule["count"] -= 1
-            self.dropped.append((topic, kind))
-            return "drop"
-        return None
-
-
-async def settle(
-    condition: Callable[[], bool],
-    *,
-    ticks: int = 400,
-    interval: float = 0.01,
-    message: str = "",
-) -> int:
-    """Await ``condition`` within a bounded number of event-loop ticks;
-    returns the tick count it took.  The ONLY waiting primitive chaos
-    scenarios use — an unmet condition is a bounded, attributable
-    failure, never a hang."""
-    for tick in range(ticks):
-        if condition():
-            return tick
-        await asyncio.sleep(interval)
-    raise AssertionError(
-        message or f"condition not met within {ticks} bounded ticks"
-    )
-
-
-class ServingStubModel:
-    """A scripted model that LOOKS engine-backed to the fleet machinery:
-    ``stats_snapshot`` makes its agent advertise on ``mesh.engine_stats``
-    (and subscribe its replica-addressed topic) without paying for a real
-    inference engine.  ``load`` feeds the queue-depth signal policies
-    rank on; ``replies`` counts turns served by THIS replica."""
-
-    def __init__(self, *, text: str = "ok", load: int = 0):
-        self.text = text
-        self.load = load
-        self.replies = 0
-
-    @property
-    def model_name(self) -> str:
-        return "serving-stub"
-
-    def stats_snapshot(self, *, window: bool = False) -> dict:
-        return {
-            "model_name": self.model_name,
-            "active_requests": self.load,
-            "pending_requests": 0,
-        }
-
-    async def request(self, messages, settings=None, params=None):
-        from calfkit_tpu.engine.testing import _estimate_tokens
-        from calfkit_tpu.models.messages import (
-            ModelResponse,
-            TextOutput,
-            Usage,
-        )
-
-        self.replies += 1
-        return ModelResponse(
-            parts=[TextOutput(text=self.text)],
-            usage=Usage(
-                input_tokens=_estimate_tokens(messages), output_tokens=1
-            ),
-            model_name=self.model_name,
-        )
-
-
-class _GatedTableWriter(TableWriter):
-    """A dead replica's heartbeat puts/tombstones never reach the table —
-    its last stamp stays frozen there, exactly what a killed process
-    leaves behind (no tombstone: that would be a CLEAN shutdown)."""
-
-    def __init__(self, owner: "ReplicaTransport", inner: TableWriter):
-        self._owner = owner
-        self._inner = inner
-
-    async def put(self, key: str, value: bytes) -> None:
-        if self._owner.dead:
-            self._owner.dropped.append(("<table-put>", key))
-            return
-        await self._inner.put(key, value)
-
-    async def tombstone(self, key: str) -> None:
-        if self._owner.dead:
-            self._owner.dropped.append(("<table-tombstone>", key))
-            return
-        await self._inner.tombstone(key)
-
-
-class _DeliveryGate:
-    """The consumption half of a process death: while dead, deliveries
-    buffer (the dead process's partition backlog) instead of reaching
-    the node handler; ``replay()`` on resume drains the backlog with
-    cancel records FIRST — mirroring the dispatcher's express intake,
-    where a cancel skips the ordered lanes and therefore lands before
-    the queued work it abandons gets to execute."""
-
-    def __init__(self, owner: "ReplicaTransport", inner: Any):
-        self._owner = owner
-        self._inner = inner
-        self.buffered: list[Any] = []
-
-    async def __call__(self, record: Any) -> None:
-        if self._owner.dead:
-            self.buffered.append(record)
-            return
-        await self._inner(record)
-
-    async def replay(self) -> None:
-        backlog, self.buffered = self.buffered, []
-        cancels = [
-            r for r in backlog
-            if r.headers.get(protocol.HDR_KIND) == "cancel"
-        ]
-        rest = [
-            r for r in backlog
-            if r.headers.get(protocol.HDR_KIND) != "cancel"
-        ]
-        for record in cancels + rest:
-            await self._inner(record)
-
-
-class ReplicaTransport(MeshTransport):
-    """One replica's I/O boundary over the (shared) mesh — the
-    process-death seam (ISSUE 9).
-
-    ``kill()`` models a hard kill: NOTHING the replica publishes reaches
-    the mesh (heartbeats stop landing with the last stamp frozen on the
-    table, a half-delivered stream just stops, terminal replies vanish)
-    and nothing is consumed (deliveries buffer like the dead consumer's
-    backlog).  Compute the replica had in flight keeps burning — exactly
-    the zombie the cancel-tombstone law exists for.  ``resume()`` models
-    that zombie coming back: publishes flow again, the backlog replays
-    (cancels first, per the dispatcher's express law), and the next
-    heartbeat re-stamps the advert."""
-
-    def __init__(self, inner: MeshTransport):
-        self.inner = inner
-        self.dead = False
-        self.dropped: list[tuple[str, str]] = []  # publishes lost while dead
-        self._gates: list[_DeliveryGate] = []
-
-    def kill(self) -> None:
-        self.dead = True
-
-    async def resume(self) -> None:
-        self.dead = False
-        for gate in self._gates:
-            await gate.replay()
-
-    # ------------------------------------------------------- transport
-    async def start(self) -> None:
-        await self.inner.start()
-
-    async def stop(self) -> None:
-        await self.inner.stop()
-
-    @property
-    def max_message_bytes(self) -> int:
-        return self.inner.max_message_bytes
-
-    async def publish(self, topic, value, *, key=None, headers=None):
-        if self.dead:
-            self.dropped.append(
-                (topic, (headers or {}).get(protocol.HDR_KIND, ""))
-            )
-            return
-        await self.inner.publish(topic, value, key=key, headers=headers)
-
-    async def subscribe(self, topics, handler, **kwargs):
-        gate = _DeliveryGate(self, handler)
-        self._gates.append(gate)
-        return await self.inner.subscribe(topics, gate, **kwargs)
-
-    async def ensure_topics(self, names, *, compacted=False):
-        await self.inner.ensure_topics(names, compacted=compacted)
-
-    def table_reader(self, topic):
-        return self.inner.table_reader(topic)
-
-    def table_writer(self, topic):
-        return _GatedTableWriter(self, self.inner.table_writer(topic))
-
-
-class BijectiveTokenizer:
-    """Token id ↔ character bijection for byte-exact resume tests
-    (ISSUE 10): generated id ``i`` decodes to ``chr(0x100 + i)`` and
-    encodes back to exactly ``i`` — so re-encoding a delivered prefix
-    reproduces the original token ids and greedy decode-from-offset
-    parity is literal byte equality (ByteTokenizer's UTF-8 replacement
-    chars break the round trip for arbitrary model outputs).  Prompt
-    characters below U+0100 encode to their ordinal, within the debug
-    preset's 512-token vocab."""
-
-    pad_id = 0
-    bos_id = 1
-    eos_id = 2
-
-    def encode(self, text: str) -> "list[int]":
-        return [
-            ord(c) - 0x100 if ord(c) >= 0x100 else ord(c) for c in text
-        ]
-
-    def decode(self, ids: "list[int]") -> str:
-        return "".join(chr(0x100 + i) for i in ids if i >= 0)
-
-
-class StreamingStubModel(ServingStubModel):
-    """A ServingStubModel whose ``request_stream`` yields word-sized
-    deltas and PAUSES after ``pause_after`` of them until ``release`` is
-    set — the deterministic mid-stream seam: a scenario observes the
-    first delivered tokens, kills the replica, and knows exactly how
-    much text the caller saw.  The stream keeps yielding after the kill
-    (a dead replica's compute keeps burning); the transport seam drops
-    the output."""
-
-    def __init__(
-        self,
-        *,
-        text: str = "alpha beta gamma delta",
-        pause_after: int = 1,
-        load: int = 0,
-    ):
-        super().__init__(text=text, load=load)
-        self.pause_after = pause_after
-        self.release = asyncio.Event()
-        self.streamed: list[str] = []
-
-    async def request_stream(self, messages, settings=None, params=None):
-        from calfkit_tpu.engine.model_client import ResponseDone, TextDelta
-
-        words = self.text.split(" ")
-        deltas = [
-            w + (" " if i < len(words) - 1 else "")
-            for i, w in enumerate(words)
-        ]
-        for i, delta in enumerate(deltas):
-            if i == self.pause_after:
-                await self.release.wait()
-            self.streamed.append(delta)
-            yield TextDelta(delta)
-            await asyncio.sleep(0)
-        response = await super().request(messages, settings, params)
-        yield ResponseDone(response)
-
-
-class FleetTopology:
-    """N workers hosting replicas of ONE agent name on a shared mesh.
-
-    Each replica is its own :class:`~calfkit_tpu.worker.Worker` (own
-    dispatch lanes, own control-plane publisher, own drain state) —
-    exactly the multi-process fleet shape, collapsed into one event loop
-    so scenarios stay deterministic.  ``delivered[i]`` ledgers the
-    correlation ids whose CALLS were admitted by replica ``i`` (the
-    drain/stale scenarios' "zero new calls" oracle).
-
-    Heartbeats tick fast on the REAL event loop; liveness stamps ride
-    the virtual clock (the ``wall_clock`` seam), so staleness is driven
-    by ``clock.advance``, never by sleeping.
-    """
-
-    def __init__(
-        self,
-        mesh: Any,
-        models: "list[Any]",
-        *,
-        name: str = "svc",
-        heartbeat_interval: float = 0.05,
-        stale_multiplier: float = 100.0,
-        agent_kwargs: "dict | None" = None,
-        meshes: "list[Any] | None" = None,
-    ):
-        from calfkit_tpu.controlplane import ControlPlaneConfig
-        from calfkit_tpu.nodes import Agent
-        from calfkit_tpu.worker import Worker
-
-        self.mesh = mesh
-        self.name = name
-        self.config = ControlPlaneConfig(
-            heartbeat_interval=heartbeat_interval,
-            stale_multiplier=stale_multiplier,
-        )
-        self.delivered: "list[list[str]]" = [[] for _ in models]
-        self.agents = []
-        self.workers = []
-        # every replica's I/O rides its own ReplicaTransport proxy — the
-        # process-death seam (kill/resume).  ``meshes`` supplies a
-        # per-replica INNER transport (e.g. one KafkaWireMesh connection
-        # each, the real multi-process shape); default = the shared mesh.
-        self.transports = [
-            ReplicaTransport(inner)
-            for inner in (meshes if meshes is not None else [mesh] * len(models))
-        ]
-        for i, model in enumerate(models):
-            agent = Agent(
-                name,
-                model=model,
-                before_node=[self._ledger(i)],
-                **(agent_kwargs or {}),
-            )
-            self.agents.append(agent)
-            self.workers.append(
-                Worker(
-                    [agent],
-                    mesh=self.transports[i],
-                    control_plane=self.config,
-                    owns_transport=meshes is not None,
-                )
-            )
-
-    def _ledger(self, i: int) -> Callable[[Any], None]:
-        def note(ctx: Any) -> None:
-            if ctx.delivery_kind == "call":
-                self.delivered[i].append(ctx.correlation_id or "")
-            return None
-
-        return note
-
-    # ------------------------------------------------------------ lifecycle
-    async def __aenter__(self) -> "FleetTopology":
-        for worker in self.workers:
-            await worker.start()
-        return self
-
-    async def __aexit__(self, *exc: Any) -> None:
-        for worker in self.workers:
-            with contextlib.suppress(Exception):
-                await worker.stop()
-
-    # ------------------------------------------------------------- identity
-    def instance_id(self, i: int) -> str:
-        return self.agents[i].instance_id
-
-    def replica_key(self, i: int) -> str:
-        return f"{self.agents[i].node_id}@{self.instance_id(i)}"
-
-    def index_of_lowest_key(self) -> int:
-        """The replica a depth-tied least-loaded pick lands on (policies
-        tie-break on the lexicographic replica key)."""
-        return min(range(len(self.agents)), key=self.replica_key)
-
-    def calls_delivered(self, i: int) -> int:
-        return len(self.delivered[i])
-
-    # ------------------------------------------------------ process death
-    def kill(self, i: int) -> None:
-        """Hard-kill replica ``i`` (ISSUE 9): stop consuming AND stop
-        heartbeating, without drain — its advert stays on the table with
-        the last stamp (staleness is then driven by ``clock.advance``),
-        its in-flight output vanishes, its backlog buffers."""
-        self.transports[i].kill()
-
-    async def resume(self, i: int) -> None:
-        """The killed replica returns as a ZOMBIE: backlog replays
-        (cancels first, the express law), publishes flow, the next
-        heartbeat re-stamps the advert fresh."""
-        await self.transports[i].resume()
-
-    # ---------------------------------------------------- heartbeat chaos
-    def _publisher(self, i: int) -> Any:
-        attached = self.workers[i]._advertiser
-        assert attached is not None, "control plane not attached"
-        return attached._publisher
-
-    def wedge_heartbeat(self, i: int) -> None:
-        """Simulate a wedged worker: the heartbeat loop dies, the record
-        stays on the table with its last stamp (no tombstone — that
-        would be a clean shutdown, a DIFFERENT scenario), and serving
-        continues.  Advancing the virtual clock past ``stale_after``
-        then makes the replica ineligible."""
-        publisher = self._publisher(i)
-        if publisher._task is not None:
-            publisher._task.cancel()
-            publisher._task = None
-
-    async def resume_heartbeat(self, i: int) -> None:
-        """The wedged worker recovers: one immediate re-advert (fresh
-        stamp on the current virtual clock) and the tick loop restarts."""
-        publisher = self._publisher(i)
-        for advert in publisher._adverts:
-            await publisher._writers[advert.topic].put(
-                advert.key, publisher._record(advert).to_wire()
-            )
-        publisher._last_beat_at = time.monotonic()
-        publisher._task = asyncio.get_running_loop().create_task(
-            publisher._beat(), name=f"chaos-resumed-heartbeat-{i}"
-        )
-
-
-def assert_engine_drained(engine: Any, total_free_pages: "int | None" = None) -> None:
-    """The no-leak oracle: every slot free, no in-flight dispatch, no
-    queued entries, and (paged) every page back in the pool."""
-    assert not engine._active, f"leaked active slots: {dict(engine._active)}"
-    assert engine._pend is None, "a dispatch is still marked in flight"
-    assert engine._inflight is None, "a chunked admission wave leaked"
-    assert not engine._admitting, "an admission prefill is still in flight"
-    assert not engine._pending and not engine._carry, "queued entries leaked"
-    assert not engine._long_pending and engine._long is None
-    assert len(engine._free) == engine.runtime.max_batch_size, (
-        f"free list has {len(engine._free)} of "
-        f"{engine.runtime.max_batch_size} slots"
-    )
-    if total_free_pages is not None and engine._page_alloc is not None:
-        assert engine._page_alloc.free_pages == total_free_pages, (
-            f"leaked pages: {engine._page_alloc.free_pages} free of "
-            f"{total_free_pages}"
-        )
+from calfkit_tpu.sim.chaos import (  # noqa: F401
+    BrokerChaos,
+    ChaosScript,
+    assert_engine_drained,
+    settle,
+)
+from calfkit_tpu.sim.clock import VirtualClock, virtual_clock  # noqa: F401
+from calfkit_tpu.sim.stubs import (  # noqa: F401
+    BijectiveTokenizer,
+    ServingStubModel,
+    StreamingStubModel,
+)
+from calfkit_tpu.sim.topology import FleetTopology  # noqa: F401
+from calfkit_tpu.sim.transport import (  # noqa: F401
+    ReplicaTransport,
+    _DeliveryGate,
+    _GatedTableWriter,
+)
+
+__all__ = [
+    "BrokerChaos",
+    "ChaosScript",
+    "assert_engine_drained",
+    "settle",
+    "VirtualClock",
+    "virtual_clock",
+    "BijectiveTokenizer",
+    "ServingStubModel",
+    "StreamingStubModel",
+    "FleetTopology",
+    "ReplicaTransport",
+]

@@ -18,6 +18,7 @@ worker drain + bounded retry, and the max_out_blocks delivery stall.
 """
 
 import asyncio
+import threading
 import time
 
 import pytest
@@ -128,27 +129,44 @@ class TestCallerTimeoutStorm:
             for run in range(100):
                 corr_a = f"storm-{run}-active"
                 corr_b = f"storm-{run}-queued"
-                task_a = asyncio.create_task(
-                    _collect(engine, [1, 2, 3 + run % 5], 64, corr=corr_a)
-                )
-                await settle(
-                    lambda: engine._active,
-                    message=f"run {run}: request never admitted",
-                )
-                task_b = asyncio.create_task(
-                    _collect(engine, [7, 8], 64, corr=corr_b)
-                )
-                await settle(
-                    lambda: len(engine._pending) + len(engine._carry) == 1,
-                    message=f"run {run}: second request never queued",
-                )
-                # the caller timed out: the mesh cancel fans out to every
-                # registered engine.  Both propagations run in ONE loop
-                # step — the queued entry cannot slip into admission
-                # between them.
-                flagged = cancellation.propagate_cancel(corr_a)
-                flagged += cancellation.propagate_cancel(corr_b)
-                assert flagged == 2, f"run {run}: fan-out flagged {flagged}"
+                # gate the 2nd decode dispatch (ISSUE 11 flake fix): the
+                # real decode thread races the cancel in host time, and
+                # on a fast host a 64-token run could RETIRE before the
+                # cancel landed — the scripted block pins every run
+                # mid-generation until both cancels are flagged, so the
+                # reap (not completion) is the only way out, every run
+                gate = threading.Event()
+                engine._chaos = ChaosScript().block_at("dispatch", 2, gate)
+                try:
+                    task_a = asyncio.create_task(
+                        _collect(engine, [1, 2, 3 + run % 5], 64, corr=corr_a)
+                    )
+                    await settle(
+                        lambda: engine._active,
+                        message=f"run {run}: request never admitted",
+                    )
+                    task_b = asyncio.create_task(
+                        _collect(engine, [7, 8], 64, corr=corr_b)
+                    )
+                    await settle(
+                        lambda: len(engine._pending) + len(engine._carry)
+                        == 1,
+                        message=f"run {run}: second request never queued",
+                    )
+                    # the caller timed out: the mesh cancel fans out to
+                    # every registered engine.  Both propagations run in
+                    # ONE loop step — the queued entry cannot slip into
+                    # admission between them.
+                    flagged = cancellation.propagate_cancel(corr_a)
+                    flagged += cancellation.propagate_cancel(corr_b)
+                    assert flagged == 2, (
+                        f"run {run}: fan-out flagged {flagged}"
+                    )
+                finally:
+                    # ALWAYS release the pinned dispatch: a failed assert
+                    # above must surface as the assert, not as a decode
+                    # thread parked on gate.wait() hanging the whole run
+                    gate.set()
                 ticks = await settle(
                     lambda: _drained(engine, total_free),
                     message=f"run {run}: engine not drained after cancel",

@@ -358,7 +358,13 @@ class TestCancellationMidFlight:
             # run, NOTHING more may reach the closed queue
             while not request.out.empty():
                 request.out.get_nowait()
-            await asyncio.sleep(0.2)
+            # bounded soak for a late thread-side delivery: the engine is
+            # already drained above (pend None, active empty), so any
+            # illegal delivery would have to land within a few ticks of
+            # the reap — a long real-clock nap here was pure tax (ISSUE
+            # 11 drive-by: residual real-sleep waits on tier-1)
+            for _ in range(25):
+                await asyncio.sleep(0.002)
             assert request.out.empty(), (
                 "delivery to a cancelled consumer after the reap"
             )
