@@ -15,6 +15,8 @@ compiles, later runs start hot.
 
 from __future__ import annotations
 
+from calfkit_tpu.effects import no_wallclock
+
 import asyncio
 import contextlib
 import json
@@ -126,6 +128,7 @@ def _device_peaks() -> "tuple[float, float] | None":
     return next((v for k, v in _TPU_PEAKS.items() if k in kind), None)
 
 
+@no_wallclock
 def _perf_model(
     model, cfg, wall_tps: float, occupancy: float,
     wave_stats: "dict | None" = None,

@@ -221,6 +221,9 @@ class Client:
                 from_latest=False,
                 ordered=False,
             )
+            # atomicity-ok: double-checked under _start_lock — the flag is
+            # re-read inside the lock, so the stale outer read only costs
+            # a lock acquire, never a double start
             self._started = True
 
     # ------------------------------------------------- caller liveness

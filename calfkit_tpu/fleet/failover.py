@@ -38,6 +38,8 @@ faults the orphaned call at its admission gate instead of executing it).
 
 from __future__ import annotations
 
+from calfkit_tpu.effects import hotpath
+
 from dataclasses import dataclass
 from typing import TYPE_CHECKING
 
@@ -81,6 +83,7 @@ class FailoverPolicy:
     hedge_after: "float | None" = None
 
 
+@hotpath
 def placement_verdict(
     replica: "Replica | None", *, stale_after: float,
     now: "float | None" = None,
@@ -144,6 +147,7 @@ class StreamLedger:
     def begin_attempt(self) -> None:
         self._attempt_seen = 0
 
+    @hotpath
     def filter(self, chunk: str, offset: "int | None" = None) -> str:
         """The not-yet-observed suffix of ``chunk`` (empty while the
         replay is still inside the already-delivered prefix).

@@ -31,6 +31,8 @@ chaos harness and the distribution tests inject determinism.
 
 from __future__ import annotations
 
+from calfkit_tpu.effects import hotpath
+
 import random
 from dataclasses import dataclass, field
 from typing import Callable, Protocol, Sequence
@@ -71,6 +73,7 @@ class RoutingPolicy(Protocol):
     ) -> "Replica | None": ...
 
 
+@hotpath
 def _least(candidates: Sequence[Replica]) -> "Replica | None":
     # ties break FIRST on the advert's EWMA dispatch latency (ISSUE 10:
     # between heartbeat beats N routers see identical depths — breaking
@@ -96,6 +99,7 @@ def _least(candidates: Sequence[Replica]) -> "Replica | None":
 class LeastLoaded:
     """Global minimum queue depth (ties → lexicographic replica key)."""
 
+    @hotpath
     def select(
         self, candidates: Sequence[Replica], request: RouteRequest
     ) -> "Replica | None":
@@ -108,6 +112,7 @@ class RandomChoice:
 
     rng: "Callable[[], float] | None" = None
 
+    @hotpath
     def select(
         self, candidates: Sequence[Replica], request: RouteRequest
     ) -> "Replica | None":
@@ -123,6 +128,7 @@ class PowerOfTwoChoices:
 
     rng: "Callable[[], float] | None" = None
 
+    @hotpath
     def select(
         self, candidates: Sequence[Replica], request: RouteRequest
     ) -> "Replica | None":
@@ -147,6 +153,7 @@ class PrefixAffinity:
 
     fallback: RoutingPolicy = field(default_factory=PowerOfTwoChoices)
 
+    @hotpath
     def select(
         self, candidates: Sequence[Replica], request: RouteRequest
     ) -> "Replica | None":
@@ -171,6 +178,7 @@ class PrefixAffinity:
         )
 
 
+@hotpath
 def affinity_key_for(
     prompt: "Sequence[int] | str",
     *,

@@ -26,6 +26,8 @@ Everything here is a read path: the registry never publishes.
 
 from __future__ import annotations
 
+from calfkit_tpu.effects import hotpath
+
 import logging
 import zlib
 from dataclasses import dataclass
@@ -113,6 +115,7 @@ class Replica:
         return max(0.0, now - self.heartbeat_at)
 
 
+@hotpath
 def eligibility_verdict(
     replica: Replica, *, stale_after: float, now: "float | None" = None
 ) -> str:
@@ -151,6 +154,10 @@ def parse_replicas(items: "dict[str, bytes]") -> "list[Replica]":
                 continue
             stats = EngineStatsRecord.model_validate(wrapped.record)
         except (ValidationError, ValueError):
+            # blocking-ok: the undecodable-record debug floor — fires only
+            # for a CORRUPT advert (never per healthy parse), lazily
+            # %-formatted, and _parsed's version fast path means a stable
+            # corrupt record is logged once per table change, not per call
             logger.debug("undecodable engine-stats record %s", key)
             continue
         out.append(
@@ -199,6 +206,8 @@ class ReplicaRegistry:
         if self._started:
             return
         await self._reader.start(timeout=self._catchup_timeout)
+        # atomicity-ok: single-flight via FleetRouter.start's lock (the
+        # only caller); a double reader catch-up is idempotent regardless
         self._started = True
 
     async def stop(self) -> None:
@@ -215,6 +224,7 @@ class ReplicaRegistry:
         return self._started and self._reader.is_caught_up
 
     # --------------------------------------------------------------- reads
+    @hotpath
     def _parsed(self) -> "list[Replica]":
         version = self._reader.version
         if version is not None:
@@ -238,6 +248,7 @@ class ReplicaRegistry:
             self._cache_fp = fp
         return self._cache
 
+    @hotpath
     def replicas(
         self,
         *,
@@ -256,6 +267,7 @@ class ReplicaRegistry:
         # would poison every later read
         return list(out) if out is self._cache else out
 
+    @hotpath
     def replica(self, key: str) -> "Replica | None":
         """One replica by its full ``<node_id>@<instance>`` key, or None
         when its record left the table (tombstoned, compacted away).  The
@@ -264,6 +276,7 @@ class ReplicaRegistry:
         self._parsed()
         return self._cache_by_key.get(key)
 
+    @hotpath
     def eligible(
         self,
         agent: str,

@@ -32,6 +32,9 @@ Design rules:
 
 from __future__ import annotations
 
+from calfkit_tpu.effects import hotpath
+
+
 import asyncio
 import logging
 import time
@@ -102,12 +105,14 @@ class FleetRouter:
         self.inflight_ttl = 600.0
 
     # ------------------------------------------------ in-flight accounting
+    @hotpath
     def note_dispatch(self, replica_key: str, correlation_id: str) -> None:
         """A run was just placed on the replica (gateway-called)."""
         self._inflight.setdefault(replica_key, {})[correlation_id] = (
             time.monotonic()
         )
 
+    @hotpath
     def note_done(self, replica_key: str, correlation_id: str) -> None:
         """The run's terminal reply landed (any outcome)."""
         entries = self._inflight.get(replica_key)
@@ -116,6 +121,7 @@ class FleetRouter:
             if not entries:
                 self._inflight.pop(replica_key, None)
 
+    @hotpath
     def _sweep_inflight(self, now_m: float) -> None:
         """Drop TTL-expired entries and emptied per-instance dicts for
         EVERY instance — including replicas that have left the fleet
@@ -132,6 +138,7 @@ class FleetRouter:
             if not entries:
                 self._inflight.pop(replica_key, None)
 
+    @hotpath
     def _outstanding(self, replica_key: str) -> int:
         entries = self._inflight.get(replica_key)
         return len(entries) if entries else 0
@@ -164,6 +171,8 @@ class FleetRouter:
             if self._started:
                 return
             await self.registry.start()
+            # atomicity-ok: double-checked under _start_lock (re-read
+            # inside the lock above)
             self._started = True
             self._start_failed_at = None
 
@@ -201,6 +210,9 @@ class FleetRouter:
             try:
                 await self.start()
             except Exception:  # noqa: BLE001 - fail-open to shared topic
+                # atomicity-ok: _start_failed_at is a rate-limit stamp —
+                # concurrent failed routes both stamping is last-wins and
+                # only widens the retry backoff by one interval
                 self._start_failed_at = time.monotonic()
                 logger.warning(
                     "fleet registry unavailable; routing %s via the "
@@ -228,6 +240,7 @@ class FleetRouter:
             return shared
         return Route(topic=replica.topic, replica=replica)
 
+    @hotpath
     def select(
         self,
         agent: str,

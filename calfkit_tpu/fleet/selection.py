@@ -31,6 +31,8 @@ imports it, and the mesh layer must not pull in control-plane models.
 
 from __future__ import annotations
 
+from calfkit_tpu.effects import hotpath
+
 import hashlib
 import zlib
 from typing import Iterable, Sequence
@@ -43,6 +45,7 @@ __all__ = [
 ]
 
 
+@hotpath
 def lane_of(key: "bytes | None", lanes: int) -> int:
     """The key-ordered dispatcher's lane law (unchanged semantics:
     ``crc32(key) % lanes``; keyless records serialize on lane 0)."""
@@ -51,6 +54,7 @@ def lane_of(key: "bytes | None", lanes: int) -> int:
     return zlib.crc32(key) % lanes
 
 
+@hotpath
 def stable_hash(data: bytes, *, salt: bytes = b"") -> int:
     """Process- and host-stable 64-bit hash (blake2b).
 
@@ -68,6 +72,7 @@ def stable_hash(data: bytes, *, salt: bytes = b"") -> int:
     return int.from_bytes(h.digest(), "big")
 
 
+@hotpath
 def rendezvous_rank(key: bytes, candidates: Iterable[str]) -> "list[str]":
     """Candidate ids ordered by highest-random-weight for ``key``.
 
@@ -84,6 +89,7 @@ def rendezvous_rank(key: bytes, candidates: Iterable[str]) -> "list[str]":
     )
 
 
+@hotpath
 def page_aligned_prefix(
     tokens: "Sequence[int] | str", page: int, *, max_pages: int = 4
 ) -> "bytes | None":

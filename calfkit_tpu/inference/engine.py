@@ -39,6 +39,7 @@ import numpy as np
 from jax import lax
 
 from calfkit_tpu import cancellation, leases
+from calfkit_tpu.effects import hotpath
 from calfkit_tpu.inference import ragged as ragged_math
 from calfkit_tpu.exceptions import (
     DeadlineExceededError,
@@ -215,6 +216,9 @@ def _load_attn_profile() -> dict | None:
     if _ATTN_PROFILE_CACHE is not None and _ATTN_PROFILE_CACHE[0] == key:
         return _ATTN_PROFILE_CACHE[1]
     try:
+        # blocking-ok: jit-specialization build path — runs once per shape
+        # bucket when a new jit is traced (result cached by path+mtime),
+        # never per decode tick
         with open(path) as f:
             verdict = json.load(f)
         if not isinstance(verdict, dict):
@@ -225,6 +229,7 @@ def _load_attn_profile() -> dict | None:
     return verdict
 
 
+@hotpath
 def _deliver_batch(deliveries: "list[tuple[asyncio.Queue, list]]") -> None:
     """Event-loop side of the batched cross-thread token fan-out.
 
@@ -1751,6 +1756,7 @@ class InferenceEngine:
             )
         )
 
+    @hotpath
     def _check_deadlines(self) -> None:
         """Reap queued AND active requests whose deadline passed, through
         the ordinary cancellation path (so overlap's one-dispatch-late
@@ -1790,6 +1796,7 @@ class InferenceEngine:
     # in the same order on both schedulers (ragged and bifurcated reap
     # through the same _reap_cancelled/_consume pair).
 
+    @hotpath
     def _submit_lease(self, request: GenRequest) -> None:
         """Register a leased request for the orphan sweep (heap-shaped
         like _submit_deadline; un-leased requests cost nothing)."""
@@ -1805,6 +1812,7 @@ class InferenceEngine:
         request.lease_entry = entry
         heapq.heappush(self._lease_heap, entry)
 
+    @hotpath
     def _drop_lease(self, request: GenRequest) -> None:
         """Null a finished request's lease entry (the heap entry itself
         pops lazily) — mirrors _drop_deadline's memory law."""
@@ -1813,6 +1821,7 @@ class InferenceEngine:
             entry[2] = None
             request.lease_entry = None
 
+    @hotpath
     def _check_orphans(self) -> None:
         """Reap queued AND active runs whose caller lease lapsed.  O(1)
         per scheduler pass when no registered expiry has arrived: one
@@ -2183,6 +2192,8 @@ class InferenceEngine:
                         await self._wake.wait()
         except Exception as exc:  # noqa: BLE001
             logger.exception("inference engine scheduler crashed")
+            # atomicity-ok: the crash rail parks the loop's own run flag —
+            # stop() writing False concurrently is the same terminal state
             self._running = False
             # fault postmortem: the ring holds the exact decision sequence
             # that led here — dump it next to the traceback.  Strictly
@@ -2387,6 +2398,7 @@ class InferenceEngine:
             rt.max_seq_len,
         )
 
+    @hotpath
     def _form_wave(self) -> "tuple[list[GenRequest], int] | None":
         """Scheduling only (no device work): pop a same-bucket wave, assign
         slots (and, when paged, reserve each request's full page footprint —
@@ -2568,6 +2580,8 @@ class InferenceEngine:
         if self._sp_mesh_cache is None:
             from jax.sharding import Mesh
 
+            # blocking-ok: host-side Device-object list (mesh topology),
+            # not a device array — nothing syncs; cached after first call
             devices = np.asarray(self.mesh.devices).reshape(-1)
             self._sp_mesh_cache = Mesh(devices, ("sp",))
         return self._sp_mesh_cache
@@ -2742,6 +2756,7 @@ class InferenceEngine:
             request, (sk, sv), n, first, inf["started"]
         )
 
+    @hotpath
     def _long_decode_tick(self) -> None:
         """One long-lane pass.  Overlap mode gives the sp lane the same
         launch-next-then-sync-previous treatment as the short lane: the
@@ -3070,6 +3085,9 @@ class InferenceEngine:
         ) = fn(*args)
         if self._paged:
             self._tables = tables
+        # blocking-ok: the prefill wave's designated LANDING sync — first
+        # tokens must reach the host here for delivery and real TTFT
+        # attribution; this is the admission lane's _sync_host analog
         firsts = np.asarray(firsts)  # sync before timing (real latency)
         elapsed_ms = (time.perf_counter() - inf["started"]) * 1000.0
         self._land_wave(wave, arrays["true_lens"], firsts, elapsed_ms)
@@ -3109,6 +3127,7 @@ class InferenceEngine:
             progressed = True
         return progressed
 
+    @hotpath
     def _ragged_tick(self) -> bool:
         """One tick of the unified lane (decode-thread context): launch
         the fused (or decode-only) dispatch, then land the previous one —
@@ -3258,6 +3277,7 @@ class InferenceEngine:
             self._prefix.acquire(fresh)
             request.shared_pages = request.shared_pages + fresh
 
+    @hotpath
     def _decode_tick(self) -> None:
         """One scheduler tick of the short decode lane.
 
@@ -3304,7 +3324,9 @@ class InferenceEngine:
         overlap-critical functions, so the double-buffering can't silently
         regress to one-sync-per-launch."""
         if isinstance(arrays, tuple):
+            # blocking-ok: THE designated sync point (see docstring)
             return tuple(np.asarray(a) for a in arrays)
+        # blocking-ok: THE designated sync point (see docstring)
         return np.asarray(arrays)
 
     def _decode_args(self) -> "tuple[list, int, int, bool]":
@@ -3501,6 +3523,7 @@ class InferenceEngine:
             self._free.append(slot)
             self._journal.append(flightrec.EV_SLOT_FREE, None, slot)
 
+    @hotpath
     def _decode_tick_lockstep(self) -> None:
         """The lockstep reference path: launch, sync, fan out — with the
         HOST as the retirement authority (arbitrary-size stop sets).  The
@@ -3641,6 +3664,7 @@ class InferenceEngine:
                     m[key].inc(value - counted[key])
                     counted[key] = value
 
+    @hotpath
     def _spec_decode_tick(self) -> None:
         """One speculative wave: draft up to k tokens per active request
         (host-side n-gram lookup or the draft model), verify all of them

@@ -103,6 +103,8 @@ def chain_hashes(prompt: "list[int]", page_size: int) -> "list[bytes]":
     for i in range(len(prompt) // page_size):
         h = hashlib.blake2b(digest_size=16)
         h.update(prev)
+        # blocking-ok: host token LIST → bytes for hashing, never a
+        # device array — nothing syncs
         h.update(np.asarray(
             prompt[i * page_size:(i + 1) * page_size], np.int32
         ).tobytes())

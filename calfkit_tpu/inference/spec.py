@@ -105,6 +105,8 @@ class NgramDrafter:
             buf.clear()
             synced = 0
         if synced < len(history):
+            # blocking-ok: host token LIST → bytes (incremental n-gram
+            # buffer), never a device array — nothing syncs
             buf += np.asarray(history[synced:], np.int32).tobytes()
         return buf
 
@@ -304,6 +306,9 @@ class DraftModelDrafter:
             self.params, self._kc, self._vc,
             jnp.asarray(catchup), jnp.asarray(base), jnp.asarray(cat_len),
         )
+        # blocking-ok: the spec tick is lockstep BY DESIGN — the host
+        # drafter must read the draft model's tokens before the verify
+        # dispatch can be formed (see ISSUE 3: spec stays lockstep)
         drafts = np.asarray(drafts)
         by_slot = {
             slot: [int(t) for t in drafts[slot, : max(0, min(self.k, room))]]

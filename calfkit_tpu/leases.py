@@ -50,6 +50,8 @@ caller (or one TTL of grace for a live one), never correctness.
 
 from __future__ import annotations
 
+from calfkit_tpu.effects import hotpath
+
 import json
 import threading
 from collections import OrderedDict
@@ -113,6 +115,7 @@ _LOCK = threading.Lock()
 _release_gen = 0
 
 
+@hotpath
 def note_beat(
     lease_id: str, ttl_s: float, at: "float | None" = None
 ) -> None:
@@ -162,6 +165,7 @@ def note_beat(
             _beats.popitem(last=False)
 
 
+@hotpath
 def note_admission(lease_id: str, ttl_s: float) -> None:
     """A leased call was just delivered: the caller was alive when it
     PUBLISHED — an implicit beat, so a run admitted before the liveness
@@ -198,6 +202,7 @@ def release_generation() -> int:
     return _release_gen
 
 
+@hotpath
 def lease_expiry(lease_id: "str | None") -> "float | None":
     """Absolute epoch at which the lease lapses (last_beat + ttl), or
     None for a lease the store has never seen (= alive, fail-safe).  The
@@ -212,6 +217,7 @@ def lease_expiry(lease_id: "str | None") -> "float | None":
     return beat_at + ttl
 
 
+@hotpath
 def lease_lapsed(lease_id: "str | None", now: "float | None" = None) -> bool:
     """THE lapse law (see module docstring): True only with positive
     evidence — a known lease whose last beat is older than its TTL (or

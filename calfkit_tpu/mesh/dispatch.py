@@ -20,6 +20,8 @@ Invariants (reference: calfkit/_faststream_ext/_subscriber.py:102-350):
 
 from __future__ import annotations
 
+from calfkit_tpu.effects import hotpath
+
 import asyncio
 import logging
 import threading
@@ -78,6 +80,7 @@ _DEPTH_LOCK = threading.Lock()
 _DEPTH_BY_DISPATCHER: "dict[int, tuple[int, int, int]]" = {}
 
 
+@hotpath
 def _publish_depth(key: int, total: int, deepest: int, in_flight: int) -> None:
     with _DEPTH_LOCK:
         _DEPTH_BY_DISPATCHER[key] = (total, deepest, in_flight)
@@ -177,6 +180,7 @@ class KeyOrderedDispatcher:
         # depth/in-flight counts into the process gauges
         weakref.finalize(self, _drop_depth, id(self))
 
+    @hotpath
     def _update_depth_gauges(self) -> None:
         """Recompute this dispatcher's saturation signals (O(lanes)) and
         fold them into the process gauges.  Called per submit and per lane
@@ -227,19 +231,23 @@ class KeyOrderedDispatcher:
             )
         for q in self._queues:
             q.put_nowait(None)
+        # swap-then-iterate (meshlint await-atomicity): detach before the
+        # awaits — _stopping is already set, so no new lane task can spawn
+        # into a snapshot we already walked
+        workers, self._workers = self._workers, []
         if not drained:
-            for w in self._workers:
+            for w in workers:
                 w.cancel()
-        for w in self._workers:
+        for w in workers:
             try:
                 await asyncio.wait_for(w, timeout=1)
             except (asyncio.TimeoutError, asyncio.CancelledError):
                 w.cancel()
-        self._workers = []
         self._started = False
         _drop_depth(id(self))
 
     # -------------------------------------------------------------- intake
+    @hotpath
     def lane_of(self, key: bytes | None) -> int:
         # the lane law lives in the fleet selection seam (ISSUE 7) so
         # lane assignment and replica placement share one set of

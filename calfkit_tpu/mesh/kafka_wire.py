@@ -1733,28 +1733,33 @@ class KafkaWireMesh(MeshTransport):
             security=self._security,
         )
         await self._producer.conn.connect()
+        # atomicity-ok: callers serialize start() (Client._ensure_started's
+        # single-flight lock / worker boot); double start only re-dials the
+        # producer conn
         self._started = True
 
     async def stop(self) -> None:
         self._started = False
-        for reader in list(self._readers):
+        # swap-then-iterate (meshlint await-atomicity): detach before
+        # the first await so a racing subscribe can't be silently dropped
+        readers, self._readers = self._readers, []
+        for reader in readers:
             try:
                 await reader.stop()
             except Exception:  # noqa: BLE001
                 logger.exception("table reader stop failed")
-        self._readers = []
-        for consumer in list(self._consumers):
+        consumers, self._consumers = self._consumers, []
+        for consumer in consumers:
             try:
                 await consumer.stop()
             except Exception:  # noqa: BLE001
                 logger.exception("consumer stop failed")
-        self._consumers = []
-        for dispatcher in self._dispatchers:
+        dispatchers, self._dispatchers = self._dispatchers, []
+        for dispatcher in dispatchers:
             try:
                 await dispatcher.stop()
             except Exception:  # noqa: BLE001
                 logger.exception("dispatcher drain failed")
-        self._dispatchers = []
         if self._producer is not None:
             await self._producer.close()
             self._producer = None

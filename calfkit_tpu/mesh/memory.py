@@ -159,20 +159,22 @@ class InMemoryMesh(MeshTransport):
 
     async def stop(self) -> None:
         self._started = False
-        for pump in self._pumps:
+        # swap-then-iterate (meshlint await-atomicity): detach before
+        # the first await so a racing subscribe can't be silently dropped
+        pumps, self._pumps = self._pumps, []
+        for pump in pumps:
             pump.cancel()
-        for pump in self._pumps:
+        for pump in pumps:
             try:
                 await pump
             except (asyncio.CancelledError, Exception):  # noqa: BLE001
                 pass
-        self._pumps = []
-        for d in self._dispatchers:
+        dispatchers, self._dispatchers = self._dispatchers, []
+        for d in dispatchers:
             try:
                 await d.stop()
             except Exception:  # noqa: BLE001
                 logger.exception("dispatcher drain failed")
-        self._dispatchers = []
 
     @property
     def max_message_bytes(self) -> int:

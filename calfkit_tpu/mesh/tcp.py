@@ -147,32 +147,38 @@ class TcpMesh(MeshTransport):
         await self._control.open()
         if await self._control.request("PING") != "PONG":
             raise ConnectionError("meshd did not answer PING")
+        # atomicity-ok: callers serialize start() (Client._ensure_started's
+        # single-flight lock / worker boot); a double start re-opens the
+        # control conn, it never corrupts state
         self._started = True
 
     async def stop(self) -> None:
         self._started = False
         # table readers own their conn + pump; stopping the mesh must not
-        # leak them (same discipline as KafkaWireMesh)
-        for reader in list(self._readers):
+        # leak them (same discipline as KafkaWireMesh).  Swap-then-iterate:
+        # the lists are detached BEFORE the first await, so a subscribe()
+        # racing stop() can never append into a snapshot we already walked
+        # (the meshlint await-atomicity rule pins this shape)
+        readers, self._readers = self._readers, []
+        for reader in readers:
             with contextlib.suppress(Exception):
                 await reader.stop()
-        self._readers = []
-        for pump in self._pumps:
+        pumps, self._pumps = self._pumps, []
+        for pump in pumps:
             pump.cancel()
-        for pump in self._pumps:
+        for pump in pumps:
             with contextlib.suppress(asyncio.CancelledError, Exception):
                 await pump
-        self._pumps = []
-        for d in self._dispatchers:
+        dispatchers, self._dispatchers = self._dispatchers, []
+        for d in dispatchers:
             with contextlib.suppress(Exception):
                 await d.stop()
-        self._dispatchers = []
         # close subscription connections so the broker rebalances away from
         # this (now dead) member immediately
-        for conn in self._sub_conns:
+        sub_conns, self._sub_conns = self._sub_conns, []
+        for conn in sub_conns:
             with contextlib.suppress(Exception):
                 await conn.close()
-        self._sub_conns = []
         if self._control is not None:
             await self._control.close()
             self._control = None

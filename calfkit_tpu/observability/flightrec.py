@@ -36,6 +36,8 @@ trigger guards itself.
 
 from __future__ import annotations
 
+from calfkit_tpu.effects import hotpath
+
 import itertools
 import json
 import os
@@ -205,6 +207,7 @@ class FlightRecorder:
                 _JOURNALS.add(self)
 
     # ------------------------------------------------------------- record
+    @hotpath
     def append(
         self,
         code: int,
@@ -304,6 +307,9 @@ class FlightRecorder:
                 f"flightrec-{name}-{os.getpid()}-{stamp}-{id(self):x}.jsonl",
             )
         lines = self.dump_lines(reason=reason)
+        # blocking-ok: the dump rails are fault/operator paths (dispatch
+        # fault rail, SIGUSR2, /flightrec) — the process is already
+        # failing or a human asked; stalling the loop here is accepted
         with open(path, "w") as f:
             f.write("\n".join(lines) + "\n")
         self.dumped += 1

@@ -18,6 +18,7 @@ never reads).
 
 from __future__ import annotations
 
+from calfkit_tpu.effects import no_wallclock
 import json
 from dataclasses import dataclass, field
 from typing import Any
@@ -34,6 +35,7 @@ __all__ = [
 SIM_SCHEMA_VERSION = 1
 
 
+@no_wallclock
 def percentile(values: "list[float]", q: float) -> float:
     """Deterministic nearest-rank percentile (no interpolation jitter);
     0.0 on empty input."""
@@ -44,6 +46,7 @@ def percentile(values: "list[float]", q: float) -> float:
     return float(ordered[rank])
 
 
+@no_wallclock
 def metric_at(tree: "dict[str, Any]", path: str) -> "float | None":
     """Resolve a dotted metric path (``"requests.completed"``) to a
     number; None when the path is missing or non-numeric — callers treat
@@ -58,6 +61,7 @@ def metric_at(tree: "dict[str, Any]", path: str) -> "float | None":
     return float(node)
 
 
+@no_wallclock
 def flatten_metrics(
     tree: "dict[str, Any]", prefix: str = ""
 ) -> "dict[str, float]":
@@ -173,6 +177,7 @@ class SimReport:
         return json.dumps(self.to_dict(capture=capture), sort_keys=True)
 
 
+@no_wallclock
 def strip_capture(document: "dict[str, Any]") -> "dict[str, Any]":
     """The determinism-comparable view of a SIM.json document (drops the
     host-varying ``capture`` block)."""

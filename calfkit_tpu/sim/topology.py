@@ -196,6 +196,9 @@ class FleetTopology:
         self._wedged.discard(i)
         publisher = self._publisher(i)
         await self.beat(i)
+        # wallclock-ok: real-time chaos-test helper predating the
+        # simulator — re-arms the REAL tick loop's monotonic stamp; never
+        # runs inside a scenario's virtual event loop
         publisher._last_beat_at = time.monotonic()
         publisher._task = asyncio.get_running_loop().create_task(
             publisher._beat(), name=f"chaos-resumed-heartbeat-{i}"

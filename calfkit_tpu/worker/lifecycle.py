@@ -85,7 +85,10 @@ class LifecycleHookMixin:
                 bag[key] = value
 
     async def _exit_resources(self) -> None:
-        for key, gen in reversed(self._live_resources):
+        # swap-then-iterate (meshlint await-atomicity): detach before the
+        # first await so enter/exit can never race a stale snapshot
+        live, self._live_resources = self._live_resources, []
+        for key, gen in reversed(live):
             try:
                 await gen.__anext__()
             except StopAsyncIteration:
@@ -94,4 +97,3 @@ class LifecycleHookMixin:
                 logger.exception("resource %r teardown failed", key)
             else:
                 logger.warning("resource %r yielded more than once", key)
-        self._live_resources = []

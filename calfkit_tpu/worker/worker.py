@@ -114,6 +114,8 @@ class Worker(LifecycleHookMixin):
             logger.exception("worker boot failed; rolling back")
             await self._teardown(rollback=True)
             raise
+        # atomicity-ok: workers are single-use single-owner (the guard
+        # above raises on re-entry); nothing else writes _state during boot
         self._state = "serving"
 
     def ready(self) -> "tuple[bool, str]":
@@ -216,14 +218,17 @@ class Worker(LifecycleHookMixin):
             with contextlib.suppress(Exception):
                 await self._advertiser.stop()  # tombstones before drain
             self._advertiser = None
-        for subscription in self._subscriptions:
+        # swap-then-iterate (meshlint await-atomicity): detach before
+        # the first await so a subscription registered mid-teardown can
+        # never be dropped from a snapshot already walked
+        subscriptions, self._subscriptions = self._subscriptions, []
+        for subscription in subscriptions:
             with contextlib.suppress(Exception):
                 await subscription.stop()
-        self._subscriptions = []
-        for store in self._stores:
+        stores, self._stores = self._stores, []
+        for store in stores:
             with contextlib.suppress(Exception):
                 await store.stop()
-        self._stores = []
         for node in self.nodes:
             if hasattr(node, "stop_session"):
                 with contextlib.suppress(Exception):

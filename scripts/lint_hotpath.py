@@ -1,731 +1,30 @@
-"""CI lint: the decode hot path must stay free of per-token overhead.
+"""CI lint: the hot-path effect rules — now a thin shim over meshlint.
 
-Parses ``calfkit_tpu/inference/engine.py`` and checks the dispatch-loop
-functions (the per-tick code that runs between device dispatches) for
-constructs the telemetry PR explicitly bans there (ISSUE 2):
+Every rule this script historically enforced by hand-curated name lists
+(ISSUE 2/3/4/5/6/7/10/11: no logging/wall-clock/blocking-sync in the
+dispatch loop, O(1) flight-recorder appends, unbounded-queue
+justification, the fleet selection path, the lease sweep, the simulator
+wall-clock ban) now lives in ``scripts/meshlint/`` — an AST call-graph
+analyzer that propagates constraints declared at the definition site
+(``calfkit_tpu/effects.py`` markers) through the transitive call
+closure, so a hot function calling a helper two modules away that logs
+or blocks is caught, and a rename can never silently drop coverage.
 
-- ``time.time()`` — the wall clock syscall is slower than
-  ``time.perf_counter()`` and wrong for durations; latency attribution in
-  the dispatch loop must use perf_counter.
-- logging calls (``logger.*``, ``logging.*``, ``print``) — a log line per
-  dispatch (let alone per token) is an I/O stall on the serving path;
-  telemetry goes through the O(1) metrics instruments instead.
-- blocking device→host syncs (``np.asarray``/``np.array``/
-  ``jax.device_get``/``.block_until_ready()``/``.item()`` on device
-  arrays) anywhere in the OVERLAP-critical functions except the single
-  designated sync point ``_sync_host`` (ISSUE 3): double-buffered
-  dispatch only reclaims the inter-dispatch bubble if the launch path
-  never stalls on the device, and a stray ``np.asarray`` silently turns
-  overlap back into lockstep.  ``jnp.asarray`` (host→device) stays legal.
-- flight-recorder appends (ISSUE 4): EVERY ``*._journal.append(...)``
-  call site in engine.py — hot function or not — must pass precomputed
-  values only: no f-strings, no ``%``/``.format`` formatting, no
-  dict/set/comprehension construction in the arguments.  The same bans
-  (plus logging and ``time.time``) apply to the body of
-  ``FlightRecorder.append`` itself in observability/flightrec.py: the
-  journal's O(1)-per-event promise is the whole reason it may stay on
-  in production.
-- unbounded queues (ISSUE 5): every ``asyncio.Queue()`` / ``deque()``
-  construction (including ``default_factory=asyncio.Queue`` /
-  ``default_factory=deque``) in engine.py and mesh/dispatch.py must
-  either pass an explicit bound (``maxsize=``/``maxlen=``) or carry an
-  ``# unbounded-ok: <why>`` justification on its own line or the line
-  above.  The overload-protection PR exists because two silent unbounded
-  deques turned saturation into invisible queue-wait growth — a new one
-  must state which admission bound, permit, or reaper makes it safe.
-- the fleet router's per-dispatch selection path (ISSUE 7): the
-  functions every routed call runs through — ``FleetRouter.select`` /
-  ``_outstanding``, every policy ``select`` body, the registry's
-  ``eligible``/``replicas``/``parse_replicas`` reads, and the pure
-  selection primitives — must not block (no ``time.sleep``, no
-  ``open``/``input``/``subprocess``, no ``await``-bearing broker
-  round-trips: these are sync functions by contract, enforced by their
-  ``def``-not-``async def`` shape), must not log or call ``time.time``,
-  and the fleet modules may not construct unbounded queues/deques
-  without the same ``# unbounded-ok:`` justification.
-- the fleet simulator (ISSUE 11): NO wall-clock read anywhere in
-  ``calfkit_tpu/sim/`` — ``time.time``/``time.monotonic``/
-  ``time.perf_counter``/``datetime.now``/``datetime.utcnow`` are all
-  banned.  The simulator's determinism contract (byte-identical
-  SIM.json per seed) holds only while every timestamp flows through the
-  ``cancellation.wall_clock`` seam; one stray host-clock read silently
-  turns a reproducible report into a flaky one.  A genuinely needed
-  host-time read (none exist today) must carry ``# wallclock-ok:``
-  with a reason, mirroring the unbounded-queue rule.
-
-Exit 0 when clean; exit 1 with a file:line listing otherwise.
+This shim keeps CI wiring and muscle memory working:
+``python scripts/lint_hotpath.py`` == ``python -m meshlint --chains``.
+See docs/static-analysis.md for the rule and vocabulary reference.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
 
-ENGINE = Path(__file__).resolve().parent.parent / (
-    "calfkit_tpu/inference/engine.py"
-)
-FLIGHTREC = Path(__file__).resolve().parent.parent / (
-    "calfkit_tpu/observability/flightrec.py"
-)
-DISPATCH = Path(__file__).resolve().parent.parent / (
-    "calfkit_tpu/mesh/dispatch.py"
-)
-FLEET_DIR = Path(__file__).resolve().parent.parent / "calfkit_tpu/fleet"
-LEASES = Path(__file__).resolve().parent.parent / "calfkit_tpu/leases.py"
-SIM_DIR = Path(__file__).resolve().parent.parent / "calfkit_tpu/sim"
+_SCRIPTS = Path(__file__).resolve().parent
+if str(_SCRIPTS) not in sys.path:
+    sys.path.insert(0, str(_SCRIPTS))
 
-# caller-liveness reads on the reaper's sweep path (ISSUE 10): the
-# engine calls these per registered-expiry pop, between device
-# dispatches — no logging, no wall-clock syscall (they read the
-# cancellation.wall_clock seam), no blocking calls.  Loud-miss on
-# rename, like every other guarded set.
-LEASE_READ_FUNCTIONS = {
-    "note_beat", "note_admission", "lease_lapsed", "lease_expiry",
-}
-
-# the dispatch loop: every function that runs per decode tick (or inside
-# one) on the scheduler/decode threads
-HOT_FUNCTIONS = {
-    "_decode_tick",
-    "_decode_tick_lockstep",
-    "_launch_decode",
-    "_land_decode",
-    "_drain_decode",
-    "_decode_args",
-    "_retire_args",
-    "_free_deferred",
-    "_observe_gap",
-    "_spec_decode_tick",
-    "_long_decode_tick",
-    "_note_dispatch",
-    "_observe",
-    "_update_active_gauge",
-    "_sync_metric_counters",
-    "_record_token",
-    "_retire_slot",
-    "_retirement_near",
-    "_retirement_bound",
-    "_deliver_batch",
-    # ragged unified waves (ISSUE 6): the fused-lane tick/launch, the
-    # budget/absorption math, and the wave-formation packing loop — the
-    # descriptor build and packing must stay sync-free and never format
-    # or journal-format on the lane (the fused launch is the overlap
-    # launch; a stray host sync would serialize the unified dispatch)
-    "_ragged_tick",
-    "_launch_ragged",
-    "_stage_pend",
-    "_absorb_fits",
-    "_ragged_wave_cap",
-    "_form_wave",
-    # caller liveness (ISSUE 10): the orphan reaper's per-pass sweep and
-    # the lease-registration sites run on the serve loop between device
-    # dispatches — same no-logging/no-time.time/no-formatting contract
-    # as the deadline reaper they're shaped after
-    "_check_orphans",
-    "_check_deadlines",
-    "_submit_lease",
-    "_drop_lease",
-}
-
-# pure host-side metric/heap helpers: never handed a device array, so the
-# blocking-sync ban would be noise there.  Everything ELSE in the dispatch
-# loop is overlap-critical — a blocking device→host sync reopens the
-# serialization bubble the double buffering exists to close.  Deriving the
-# overlap set by subtraction (instead of a second hand-maintained list)
-# means a future dispatch-loop function added to HOT_FUNCTIONS gets the
-# sync ban automatically.  The single legal sync point is ``_sync_host``
-# (checked to exist below).
-METRIC_HELPERS = {
-    "_observe",
-    "_update_active_gauge",
-    "_sync_metric_counters",
-    "_retirement_near",
-    "_retirement_bound",
-    # serve-loop heap sweeps: pure host state, never handed device arrays
-    "_check_orphans",
-    "_check_deadlines",
-    "_submit_lease",
-    "_drop_lease",
-}
-OVERLAP_FUNCTIONS = HOT_FUNCTIONS - METRIC_HELPERS
-
-BANNED_CALL_NAMES = {"print"}
-BANNED_ATTR_CALLS = {
-    ("time", "time"),  # wall clock on the hot path
-}
-BANNED_RECEIVERS = {"logger", "logging"}  # any logging call
-
-# blocking device→host syncs, banned in OVERLAP_FUNCTIONS (jnp.asarray is
-# host→device and stays legal; the host-side numpy constructors np.zeros/
-# np.full/np.ascontiguousarray never block on the device)
-BANNED_SYNC_ATTRS = {
-    ("np", "asarray"),
-    ("np", "array"),
-    ("numpy", "asarray"),
-    ("numpy", "array"),
-    ("jax", "device_get"),
-}
-BANNED_SYNC_METHODS = {"block_until_ready", "item"}  # any receiver
-
-
-def _violations(tree: ast.AST) -> list[tuple[int, str]]:
-    out: list[tuple[int, str]] = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if node.name not in HOT_FUNCTIONS:
-            continue
-        overlap = node.name in OVERLAP_FUNCTIONS
-        for call in ast.walk(node):
-            if not isinstance(call, ast.Call):
-                continue
-            fn = call.func
-            if isinstance(fn, ast.Name) and fn.id in BANNED_CALL_NAMES:
-                out.append((call.lineno, f"{node.name}: call to {fn.id}()"))
-            elif isinstance(fn, ast.Attribute):
-                if overlap and fn.attr in BANNED_SYNC_METHODS:
-                    out.append(
-                        (call.lineno,
-                         f"{node.name}: .{fn.attr}() — blocking device "
-                         "sync outside _sync_host")
-                    )
-                if not isinstance(fn.value, ast.Name):
-                    continue
-                pair = (fn.value.id, fn.attr)
-                if pair in BANNED_ATTR_CALLS:
-                    out.append(
-                        (call.lineno,
-                         f"{node.name}: {pair[0]}.{pair[1]}() (use "
-                         "time.perf_counter)")
-                    )
-                elif fn.value.id in BANNED_RECEIVERS:
-                    out.append(
-                        (call.lineno,
-                         f"{node.name}: {fn.value.id}.{fn.attr}() — no "
-                         "logging on the dispatch loop")
-                    )
-                elif overlap and pair in BANNED_SYNC_ATTRS:
-                    out.append(
-                        (call.lineno,
-                         f"{node.name}: {pair[0]}.{pair[1]}() — blocking "
-                         "host sync outside the designated _sync_host "
-                         "point")
-                    )
-    return sorted(out)
-
-
-def _is_journal_append(call: ast.Call) -> bool:
-    """``<anything>._journal.append(...)``."""
-    fn = call.func
-    return (
-        isinstance(fn, ast.Attribute)
-        and fn.attr == "append"
-        and isinstance(fn.value, ast.Attribute)
-        and fn.value.attr == "_journal"
-    )
-
-
-def _formatting_violations(
-    root: ast.AST, where: str
-) -> "list[tuple[int, str]]":
-    """The allocation/formatting bans shared by journal-append call sites
-    and the append body: f-strings, %%-on-a-literal, ``.format()``, and
-    dict/set/comprehension construction."""
-    out: list[tuple[int, str]] = []
-    for node in ast.walk(root):
-        if isinstance(node, ast.JoinedStr):
-            out.append((node.lineno, f"{where}: f-string"))
-        elif isinstance(node, (ast.Dict, ast.DictComp, ast.SetComp,
-                               ast.ListComp, ast.GeneratorExp)):
-            out.append(
-                (node.lineno,
-                 f"{where}: {type(node).__name__} construction")
-            )
-        elif (
-            isinstance(node, ast.BinOp)
-            and isinstance(node.op, ast.Mod)
-            and isinstance(node.left, ast.Constant)
-            and isinstance(node.left.value, str)
-        ):
-            out.append((node.lineno, f"{where}: %-formatting"))
-        elif (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Attribute)
-            and node.func.attr == "format"
-        ):
-            out.append((node.lineno, f"{where}: .format() call"))
-    return out
-
-
-def _journal_site_violations(tree: ast.AST) -> "list[tuple[int, str]]":
-    """Every journal-append call site in engine.py, in ANY function (the
-    event-loop admission path must stay as dict-churn-free as the decode
-    thread — the journal is on by default in production)."""
-    out: list[tuple[int, str]] = []
-    for call in ast.walk(tree):
-        if isinstance(call, ast.Call) and _is_journal_append(call):
-            for arg in [*call.args, *call.keywords]:
-                out.extend(
-                    _formatting_violations(arg, "journal append site")
-                )
-    return out
-
-
-def _append_body_violations(tree: ast.AST) -> "list[tuple[int, str]]":
-    """The FlightRecorder.append body itself: the O(1) lock-free promise.
-    Returns a sentinel violation when the method cannot be found — a
-    rename must break this lint loudly, not silently lint nothing."""
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ClassDef) and node.name == "FlightRecorder":
-            for fn in node.body:
-                if (
-                    isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef))
-                    and fn.name == "append"
-                ):
-                    out = _formatting_violations(fn, "FlightRecorder.append")
-                    for call in ast.walk(fn):
-                        if not isinstance(call, ast.Call):
-                            continue
-                        f = call.func
-                        if isinstance(f, ast.Name) and f.id in BANNED_CALL_NAMES:
-                            out.append(
-                                (call.lineno,
-                                 f"FlightRecorder.append: {f.id}()")
-                            )
-                        elif isinstance(f, ast.Attribute) and isinstance(
-                            f.value, ast.Name
-                        ):
-                            pair = (f.value.id, f.attr)
-                            if pair in BANNED_ATTR_CALLS:
-                                out.append(
-                                    (call.lineno,
-                                     "FlightRecorder.append: time.time()")
-                                )
-                            elif f.value.id in BANNED_RECEIVERS:
-                                out.append(
-                                    (call.lineno,
-                                     f"FlightRecorder.append: "
-                                     f"{f.value.id}.{f.attr}() — no logging")
-                                )
-                    return out
-    return [(0, "FlightRecorder.append not found in flightrec.py "
-               "(update lint_hotpath)")]
-
-
-# ------------------------------------------------- fleet selection path
-# (ISSUE 7) every routed call runs these synchronously between "the
-# caller wants a topic" and "the publish happens": a blocking call or a
-# log line here is a per-request stall multiplied across the fleet.
-# parse_replicas is deliberately NOT guarded: it is the shared
-# render/CLI read helper and owns the undecodable-record debug floor
-# (lazily formatted); the per-dispatch functions below must stay clean.
-FLEET_SELECT_FUNCTIONS = {
-    "router.py": {"select", "_outstanding", "_sweep_inflight"},
-    "policy.py": {"select", "_least", "affinity_key_for"},
-    "registry.py": {
-        "eligible", "replicas", "_parsed", "eligibility_verdict", "replica",
-    },
-    "selection.py": {
-        "lane_of", "stable_hash", "rendezvous_rank", "page_aligned_prefix",
-    },
-    # failure recovery (ISSUE 9): the dead-placement probe runs every
-    # probe_interval per OUTSTANDING call, and the stream dedupe filter
-    # runs per token-step event — same no-blocking/no-logging contract
-    "failover.py": {"placement_verdict", "filter"},
-}
-
-_FLEET_BANNED_CALLS = {"print", "open", "input", "exec", "eval"}
-_FLEET_BANNED_ATTR_CALLS = {
-    ("time", "time"),
-    ("time", "sleep"),
-    ("os", "system"),
-    ("subprocess", "run"),
-    ("subprocess", "Popen"),
-    ("socket", "socket"),
-}
-
-
-def _fleet_violations() -> "list[tuple[Path, int, str]]":
-    out: list[tuple[Path, int, str]] = []
-    for filename, wanted in sorted(FLEET_SELECT_FUNCTIONS.items()):
-        path = FLEET_DIR / filename
-        if not path.exists():
-            out.append((path, 0, "fleet module missing (update lint_hotpath)"))
-            continue
-        source = path.read_text()
-        tree = ast.parse(source, filename=str(path))
-        found_names: set[str] = set()
-        for node in ast.walk(tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            if node.name not in wanted:
-                continue
-            found_names.add(node.name)
-            if isinstance(node, ast.AsyncFunctionDef):
-                # the selection path is sync BY CONTRACT: an await here
-                # means a broker round-trip snuck into per-call routing
-                out.append(
-                    (path, node.lineno,
-                     f"{node.name}: selection-path function became async "
-                     "(no broker round-trips per routed call)")
-                )
-            for call in ast.walk(node):
-                if not isinstance(call, ast.Call):
-                    continue
-                fn = call.func
-                if isinstance(fn, ast.Name) and fn.id in _FLEET_BANNED_CALLS:
-                    out.append(
-                        (path, call.lineno,
-                         f"{node.name}: blocking/banned call {fn.id}()")
-                    )
-                elif isinstance(fn, ast.Attribute) and isinstance(
-                    fn.value, ast.Name
-                ):
-                    pair = (fn.value.id, fn.attr)
-                    if pair in _FLEET_BANNED_ATTR_CALLS:
-                        out.append(
-                            (path, call.lineno,
-                             f"{node.name}: {pair[0]}.{pair[1]}() on the "
-                             "selection path")
-                        )
-                    elif fn.value.id in BANNED_RECEIVERS:
-                        out.append(
-                            (path, call.lineno,
-                             f"{node.name}: {fn.value.id}.{fn.attr}() — no "
-                             "logging on the selection path")
-                        )
-        missing = wanted - found_names
-        if missing:
-            out.append(
-                (path, 0,
-                 f"guarded selection functions missing: {sorted(missing)} "
-                 "(update FLEET_SELECT_FUNCTIONS)")
-            )
-        # the unbounded-queue rule covers the whole fleet module set: a
-        # router buffering routed calls in an unbounded queue would
-        # rebuild exactly the silent-saturation failure ISSUE 5 killed
-        out.extend(_unbounded_queue_violations(tree, source, path))
-    return out
-
-
-# ---------------------------------------------------- unbounded queues
-# (ISSUE 5) a Queue/deque with no bound and no justification is exactly
-# how the pre-overload engine turned saturation into silent queue growth
-
-_QUEUE_NAMES = {"Queue", "deque", "LifoQueue", "PriorityQueue", "SimpleQueue"}
-_BOUND_KWARGS = {"maxsize", "maxlen"}
-_OK_MARK = "unbounded-ok:"
-
-
-def _queue_ctor_name(node: ast.AST) -> "str | None":
-    """'asyncio.Queue' / 'deque' when ``node`` references a queue type."""
-    if isinstance(node, ast.Name) and node.id in _QUEUE_NAMES:
-        return node.id
-    if (
-        isinstance(node, ast.Attribute)
-        and node.attr in _QUEUE_NAMES
-        and isinstance(node.value, ast.Name)
-        and node.value.id in ("asyncio", "collections", "queue")
-    ):
-        return f"{node.value.id}.{node.attr}"
-    return None
-
-
-def _bound_value_ok(node: ast.AST, is_deque: bool) -> bool:
-    """A bound expression counts unless it is statically, verifiably
-    unbounded: a literal ``None`` for either type, or a literal ``<= 0``
-    for Queue kinds (asyncio/queue treat ``maxsize<=0`` as UNLIMITED —
-    the exact regression the rule exists to catch — while a deque
-    ``maxlen=0`` is a real bound: an always-empty deque).  Non-literal
-    expressions pass; the lint cannot evaluate them."""
-    if not isinstance(node, ast.Constant):
-        return True
-    if node.value is None:
-        return False
-    if is_deque:
-        return True
-    return not (
-        isinstance(node.value, int)
-        and not isinstance(node.value, bool)
-        and node.value <= 0
-    )
-
-
-def _is_bounded_call(call: ast.Call) -> bool:
-    is_deque = _queue_ctor_name(call.func) in ("deque", "collections.deque")
-    for kw in call.keywords:
-        if kw.arg in _BOUND_KWARGS:
-            return _bound_value_ok(kw.value, is_deque)
-    # positional bound: deque(iterable, maxlen) / Queue(maxsize)
-    if is_deque:
-        return len(call.args) >= 2 and _bound_value_ok(call.args[1], True)
-    return bool(call.args) and _bound_value_ok(call.args[0], False)
-
-
-def _justified(lines: "list[str]", lineno: int) -> bool:
-    """``# unbounded-ok:`` on the construction line or anywhere in the
-    contiguous comment block immediately above it (multi-line
-    justifications sit above the statement)."""
-    if 1 <= lineno <= len(lines) and _OK_MARK in lines[lineno - 1]:
-        return True
-    n = lineno - 1
-    while 1 <= n <= len(lines) and lines[n - 1].lstrip().startswith("#"):
-        if _OK_MARK in lines[n - 1]:
-            return True
-        n -= 1
-    return False
-
-
-def _unbounded_queue_violations(
-    tree: ast.AST, source: str, where: Path
-) -> "list[tuple[Path, int, str]]":
-    lines = source.splitlines()
-    out: list[tuple[Path, int, str]] = []
-    for node in ast.walk(tree):
-        name = None
-        lineno = 0
-        if isinstance(node, ast.Call):
-            ctor = _queue_ctor_name(node.func)
-            if ctor is not None and not _is_bounded_call(node):
-                name, lineno = f"{ctor}()", node.lineno
-        elif isinstance(node, ast.keyword) and node.arg == "default_factory":
-            ctor = _queue_ctor_name(node.value)
-            if ctor is not None:
-                name, lineno = f"default_factory={ctor}", node.value.lineno
-        if name and not _justified(lines, lineno):
-            out.append(
-                (where, lineno,
-                 f"unbounded {name} without an '# {_OK_MARK} <why>' "
-                 "justification (name the admission bound / permit / "
-                 "reaper that bounds it)")
-            )
-    return out
-
-
-# ------------------------------------------------- simulator wall clock
-# (ISSUE 11) the determinism contract: every timestamp in the sim
-# package flows through the cancellation.wall_clock seam.  Any direct
-# host-clock read would leak real time into SIM.json and break the
-# byte-identical repeat-run guarantee the perf gate stands on.
-
-_SIM_BANNED_CLOCK_NAMES = {
-    "time", "monotonic", "perf_counter",
-    "time_ns", "monotonic_ns", "perf_counter_ns",
-    "now", "utcnow", "today",
-}
-# dotted suffixes: matches `time.time()`, `datetime.datetime.now()`,
-# `datetime.date.today()` — any attribute-chain call whose LAST segment
-# is a clock read and whose chain starts at the time/datetime modules
-_SIM_BANNED_CLOCK_ROOTS = {"time", "datetime", "date"}
-_SIM_OK_MARK = "wallclock-ok:"
-# the promoted chaos-test helpers that predate the simulator and run
-# only in REAL-time chaos tests (never inside a scenario's event loop):
-# resume_heartbeat re-arms the real tick loop's monotonic stamp
-_SIM_ALLOWED_FUNCTIONS = {"resume_heartbeat"}
-
-
-def _sim_violations() -> "list[tuple[Path, int, str]]":
-    out: list[tuple[Path, int, str]] = []
-    if not SIM_DIR.exists():
-        return [(SIM_DIR, 0, "sim package missing (update lint_hotpath)")]
-    checked = 0
-    for path in sorted(SIM_DIR.glob("*.py")):
-        source = path.read_text()
-        lines = source.splitlines()
-        tree = ast.parse(source, filename=str(path))
-        checked += 1
-        # map every call to its enclosing function name (for allowlist)
-        enclosing: dict[int, str] = {}
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for sub in ast.walk(node):
-                    if isinstance(sub, ast.Call):
-                        enclosing.setdefault(id(sub), node.name)
-        # from-imported clock names ("from time import monotonic") make
-        # bare-name calls bannable; without the import a local helper
-        # coincidentally named `time` stays legal
-        from_imported: set[str] = set()
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ImportFrom) and node.module in (
-                "time", "datetime"
-            ):
-                for alias in node.names:
-                    from_imported.add(alias.asname or alias.name)
-        for call in ast.walk(tree):
-            if not isinstance(call, ast.Call):
-                continue
-            dotted = _dotted_name(call.func)
-            banned = False
-            if dotted is not None:
-                parts = dotted.split(".")
-                if len(parts) == 1:
-                    # bare call: banned only when the name arrived via a
-                    # from-import of the time/datetime modules
-                    banned = (
-                        parts[0] in _SIM_BANNED_CLOCK_NAMES
-                        and parts[0] in from_imported
-                    )
-                else:
-                    banned = (
-                        parts[-1] in _SIM_BANNED_CLOCK_NAMES
-                        and parts[0] in _SIM_BANNED_CLOCK_ROOTS
-                    )
-            if not banned:
-                continue
-            if enclosing.get(id(call)) in _SIM_ALLOWED_FUNCTIONS:
-                continue
-            if _sim_justified(lines, call.lineno):
-                continue
-            out.append(
-                (path, call.lineno,
-                 f"sim wall-clock read {dotted}() — all "
-                 "timestamps must flow through cancellation.wall_clock "
-                 f"(or carry '# {_SIM_OK_MARK} <why>')")
-            )
-        out.extend(_unbounded_queue_violations(tree, source, path))
-    if checked == 0:
-        out.append(
-            (SIM_DIR, 0, "sim package empty (update lint_hotpath)")
-        )
-    return out
-
-
-def _dotted_name(node: ast.AST) -> "str | None":
-    """``a.b.c`` for a Name/Attribute chain; None for computed bases
-    (subscripts, calls) the lint cannot resolve statically."""
-    parts: list[str] = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if not isinstance(node, ast.Name):
-        return None
-    parts.append(node.id)
-    return ".".join(reversed(parts))
-
-
-def _sim_justified(lines: "list[str]", lineno: int) -> bool:
-    if 1 <= lineno <= len(lines) and _SIM_OK_MARK in lines[lineno - 1]:
-        return True
-    n = lineno - 1
-    while 1 <= n <= len(lines) and lines[n - 1].lstrip().startswith("#"):
-        if _SIM_OK_MARK in lines[n - 1]:
-            return True
-        n -= 1
-    return False
-
-
-def _leases_violations() -> "list[tuple[Path, int, str]]":
-    """The lease store's sweep-path reads (ISSUE 10): same no-blocking /
-    no-logging / no-time.time contract as the fleet selection path."""
-    out: list[tuple[Path, int, str]] = []
-    if not LEASES.exists():
-        return [(LEASES, 0, "leases module missing (update lint_hotpath)")]
-    tree = ast.parse(LEASES.read_text(), filename=str(LEASES))
-    found: set[str] = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if node.name not in LEASE_READ_FUNCTIONS:
-            continue
-        found.add(node.name)
-        for call in ast.walk(node):
-            if not isinstance(call, ast.Call):
-                continue
-            fn = call.func
-            if isinstance(fn, ast.Name) and fn.id in _FLEET_BANNED_CALLS:
-                out.append(
-                    (LEASES, call.lineno,
-                     f"{node.name}: blocking/banned call {fn.id}()")
-                )
-            elif isinstance(fn, ast.Attribute) and isinstance(
-                fn.value, ast.Name
-            ):
-                pair = (fn.value.id, fn.attr)
-                if pair in _FLEET_BANNED_ATTR_CALLS:
-                    out.append(
-                        (LEASES, call.lineno,
-                         f"{node.name}: {pair[0]}.{pair[1]}() on the "
-                         "orphan-sweep path")
-                    )
-                elif fn.value.id in BANNED_RECEIVERS:
-                    out.append(
-                        (LEASES, call.lineno,
-                         f"{node.name}: {fn.value.id}.{fn.attr}() — no "
-                         "logging on the orphan-sweep path")
-                    )
-    missing = LEASE_READ_FUNCTIONS - found
-    if missing:
-        out.append(
-            (LEASES, 0,
-             f"guarded lease functions missing: {sorted(missing)} "
-             "(update LEASE_READ_FUNCTIONS)")
-        )
-    return out
-
-
-def main() -> int:
-    source = ENGINE.read_text()
-    tree = ast.parse(source, filename=str(ENGINE))
-    found = _violations(tree)
-    found += _journal_site_violations(tree)
-    fr_tree = ast.parse(FLIGHTREC.read_text(), filename=str(FLIGHTREC))
-    fr_found = _append_body_violations(fr_tree)
-    if fr_found:
-        for line, message in sorted(fr_found):
-            print(f"{FLIGHTREC}:{line}: {message}")
-    dispatch_source = DISPATCH.read_text()
-    dispatch_tree = ast.parse(dispatch_source, filename=str(DISPATCH))
-    queue_found = _unbounded_queue_violations(tree, source, ENGINE)
-    queue_found += _unbounded_queue_violations(
-        dispatch_tree, dispatch_source, DISPATCH
-    )
-    queue_found += _fleet_violations()
-    queue_found += _leases_violations()
-    queue_found += _sim_violations()
-    if queue_found:
-        for path, line, message in sorted(queue_found):
-            print(f"{path}:{line}: {message}")
-    # the guarded function set must actually exist — a rename must break
-    # this lint loudly, not silently lint nothing
-    names = {
-        n.name
-        for n in ast.walk(tree)
-        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-    }
-    missing = {
-        "_decode_tick", "_record_token", "_note_dispatch",
-        "_launch_decode", "_land_decode", "_sync_host",
-        "_ragged_tick", "_launch_ragged", "_form_wave",
-        "_check_orphans", "_submit_lease",
-    } - names
-    if missing:
-        print(f"lint_hotpath: guarded functions missing from engine.py: "
-              f"{sorted(missing)} (update HOT_FUNCTIONS)")
-        return 1
-    if found or fr_found or queue_found:
-        for line, message in sorted(found):
-            print(f"{ENGINE}:{line}: {message}")
-        print(
-            f"lint_hotpath: {len(found) + len(fr_found) + len(queue_found)} "
-            "hot-path violation(s)"
-        )
-        return 1
-    journal_sites = sum(
-        isinstance(c, ast.Call) and _is_journal_append(c)
-        for c in ast.walk(tree)
-    )
-    fleet_guarded = sum(len(v) for v in FLEET_SELECT_FUNCTIONS.values())
-    sim_files = len(list(SIM_DIR.glob("*.py"))) if SIM_DIR.exists() else 0
-    print(
-        f"lint_hotpath: clean ({len(HOT_FUNCTIONS & names)} dispatch-loop "
-        f"functions, {journal_sites} journal-append sites, "
-        f"{fleet_guarded} fleet selection-path functions checked, "
-        f"{sim_files} sim modules wall-clock-free, "
-        "unbounded-queue rule enforced)"
-    )
-    return 0
-
+from meshlint.__main__ import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--chains", *sys.argv[1:]]))

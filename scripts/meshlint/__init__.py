@@ -1,0 +1,38 @@
+"""meshlint — call-graph-aware effect checker for calfkit-tpu (ISSUE 12).
+
+An AST-based, whole-package static analyzer, stdlib-only by design (the
+CI lint lane's pip footprint must not grow).  Three layers:
+
+1. :mod:`meshlint.callgraph` builds an intra-project call graph over the
+   scanned tree: import resolution (absolute, aliased, relative), method
+   dispatch through ``self.``/class attributes and simple local
+   ``var = ClassName()`` inference, and a conservative bare-name
+   fallback for receivers it cannot type.
+2. :mod:`meshlint.infer` infers per-function EFFECTS: blocking
+   primitives, logging, wall-clock and monotonic-clock reads, blocking
+   device→host syncs, unbounded queue construction, string formatting,
+   and await points — each tagged with any escape-comment waiver found
+   at the site.
+3. :mod:`meshlint.rules` propagates constraints declared at the
+   definition site (the no-op markers in ``calfkit_tpu/effects.py``:
+   ``@hotpath`` / ``@no_block`` / ``@no_wallclock`` / ``@no_log``)
+   through the transitive call closure and reports violations as full
+   call chains (``root → helper → offending file:line``), plus the
+   whole-package event-loop stall rule, the await-point atomicity rule,
+   and every rule migrated off the old ``scripts/lint_hotpath.py``
+   (journal-append formatting, FlightRecorder.append body, unbounded
+   queues, the simulator wall-clock ban, root-coverage loud-miss).
+
+Entry points: ``python -m meshlint [--chains] [--json PATH] [--root D]``
+(see :mod:`meshlint.__main__`), or programmatically::
+
+    from meshlint import analyze, default_config
+    report = analyze(default_config(repo_root))
+    report.ok  # True when the tree is clean
+"""
+
+from meshlint.config import Config, default_config
+from meshlint.report import Report, Violation
+from meshlint.run import analyze
+
+__all__ = ["Config", "default_config", "Report", "Violation", "analyze"]

@@ -1,0 +1,48 @@
+"""CLI: ``python -m meshlint [--root DIR] [--chains] [--json PATH]``.
+
+Exit 0 when the tree is clean; exit 1 with a violation listing (and,
+with ``--chains``, the full root → … → offending file:line call chain
+per finding) otherwise.  ``--json`` additionally writes the
+machine-readable report — CI uploads it as an artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from meshlint.config import default_config
+from meshlint.run import analyze
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="meshlint",
+        description="call-graph-aware effect checker for calfkit-tpu",
+    )
+    parser.add_argument(
+        "--root", default=None,
+        help="repo root (default: two levels above this package)",
+    )
+    parser.add_argument(
+        "--chains", action="store_true",
+        help="print the full call chain for every violation",
+    )
+    parser.add_argument(
+        "--json", default=None, metavar="PATH",
+        help="also write the machine-readable report to PATH",
+    )
+    args = parser.parse_args(argv)
+    root = Path(args.root) if args.root else (
+        Path(__file__).resolve().parent.parent.parent
+    )
+    report = analyze(default_config(root))
+    if args.json:
+        Path(args.json).write_text(report.to_json())
+    print(report.render(chains=args.chains))
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

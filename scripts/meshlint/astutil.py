@@ -1,0 +1,74 @@
+"""Shared AST helpers: dotted-name flattening and escape-comment scans."""
+
+from __future__ import annotations
+
+import ast
+
+
+def dotted_name(node: ast.AST) -> "str | None":
+    """``a.b.c`` for a Name/Attribute chain; None for computed bases
+    (subscripts, call results) that cannot be resolved statically."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def comment_waiver(lines: "list[str]", lineno: int, mark: str) -> "str | None":
+    """Return the waiver text when ``# <mark> <why>`` appears on
+    ``lineno`` or anywhere in the contiguous comment block immediately
+    above it (multi-line justifications sit above the statement).
+    ``lines`` is the file split by newlines; ``lineno`` is 1-based."""
+    def _scan(text: str) -> "str | None":
+        at = text.find(mark)
+        if at < 0:
+            return None
+        return text[at + len(mark):].strip() or "(no reason given)"
+
+    if 1 <= lineno <= len(lines):
+        found = _scan(lines[lineno - 1])
+        if found is not None:
+            return found
+    n = lineno - 1
+    while 1 <= n <= len(lines) and lines[n - 1].lstrip().startswith("#"):
+        found = _scan(lines[n - 1])
+        if found is not None:
+            return found
+        n -= 1
+    return None
+
+
+def walk_body(node: ast.AST):
+    """Walk ``node`` without descending into nested function/class
+    definitions — a nested ``def``'s body belongs to the nested
+    function's own record, not its parent's (a jit body builder must not
+    pollute the host function's effect set).  The nested def NODE itself
+    is still yielded (callers index it); lambdas are descended into —
+    they execute inline at their call site."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(node))
+    while stack:
+        child = stack.pop()
+        yield child
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(child))
+
+
+def decorator_markers(node: "ast.FunctionDef | ast.AsyncFunctionDef",
+                      known: "frozenset[str]") -> "set[str]":
+    """The effect-marker decorator names on ``node``: bare ``@hotpath``
+    or dotted ``@effects.hotpath`` both count; anything else is ignored."""
+    out: set[str] = set()
+    for dec in node.decorator_list:
+        name = dotted_name(dec)
+        if name is None:
+            continue
+        tail = name.rsplit(".", 1)[-1]
+        if tail in known:
+            out.add(tail)
+    return out

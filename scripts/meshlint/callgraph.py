@@ -1,0 +1,647 @@
+"""Intra-project call graph: modules, functions, and resolved call edges.
+
+Resolution strategy, most-precise first:
+
+1. module-local names (functions/classes defined in the same module);
+2. the module's import map (absolute, aliased, and relative imports,
+   resolved against the project module index — longest-prefix match so
+   ``import calfkit_tpu.fleet.policy`` resolves dotted calls through the
+   package path);
+3. ``self.method()`` through the enclosing class and its project base
+   classes (a static MRO walk over classes the project defines);
+4. ``var.method()`` where ``var = ClassName(...)`` is a simple local
+   single-assignment in the same function;
+5. conservative bare-name fallback: an unresolved attribute call links
+   to EVERY project function with that method name (capped, and skipped
+   for ubiquitous container/stdlib method names) — over-approximation is
+   the point: a helper two modules away must not escape the closure just
+   because its receiver's type is dynamic.
+
+Edges carry a KIND so rules can choose what propagates:
+
+- ``normal``   — plain synchronous (or awaited) call;
+- ``threaded`` — handed to another thread (``asyncio.to_thread`` /
+  ``run_in_executor`` / ``threading.Thread(target=...)``): blocking
+  there does not stall the caller;
+- ``deferred`` — scheduled onto the event loop (``call_soon*`` /
+  ``call_later`` / ``add_done_callback``): runs later, on the loop;
+- ``spawn``    — a new task (``create_task`` / ``ensure_future``): the
+  target coroutine is an ``async def`` and is independently rooted by
+  the event-loop stall rule, so these edges are never traversed.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from meshlint.astutil import decorator_markers, dotted_name, walk_body
+
+MARKER_NAMES = frozenset({"hotpath", "no_block", "no_wallclock", "no_log"})
+
+# attribute names too generic to fallback-link: every list/dict/set/str/
+# asyncio primitive carries them, and a graph where every ``.get()``
+# points at every project ``get`` is noise, not conservatism.
+FALLBACK_SKIP_ATTRS = frozenset({
+    "append", "appendleft", "add", "extend", "insert", "remove", "discard",
+    "clear", "pop", "popleft", "get", "put", "put_nowait", "get_nowait",
+    "update", "copy", "items", "keys", "values", "setdefault", "sort",
+    "index", "count", "join", "split", "strip", "lstrip", "rstrip",
+    "encode", "decode", "format", "lower", "upper", "startswith",
+    "endswith", "replace", "read", "write", "readline", "flush", "close",
+    "open", "result", "set_result", "set_exception", "done", "cancel",
+    "cancelled", "exception", "release", "acquire", "locked", "wait",
+    "wait_for", "notify", "notify_all", "set", "is_set", "sleep", "time",
+    "monotonic", "perf_counter", "task_done", "send", "throw", "info",
+    "debug", "warning", "error", "critical", "log", "observe", "inc",
+    "dec", "labels", "next", "popitem", "move_to_end", "total_seconds",
+    "item", "block_until_ready", "mkdir", "exists", "stat", "unlink",
+})
+FALLBACK_MAX_CANDIDATES = 8
+
+_THREADED_TAILS = frozenset({"to_thread"})
+_THREADED_ATTRS = frozenset({"run_in_executor"})
+_DEFERRED_ATTRS = frozenset({
+    "call_soon", "call_soon_threadsafe", "call_later", "call_at",
+    "add_done_callback",
+})
+_SPAWN_TAILS = frozenset({"create_task", "ensure_future"})
+
+
+@dataclass
+class EffectSite:
+    """One inferred effect occurrence inside a function body."""
+    kind: str          # BLOCK | LOG | WALLCLOCK | MONOTONIC | DEVICE_SYNC |
+    #                    UNBOUNDED_QUEUE | AWAIT
+    lineno: int
+    detail: str
+    waiver: "str | None" = None   # escape-comment reason when waived
+
+    @property
+    def waived(self) -> bool:
+        return self.waiver is not None
+
+
+@dataclass
+class CallEdge:
+    lineno: int
+    kind: str                 # normal | threaded | deferred | spawn
+    targets: "tuple[str, ...]"  # resolved callee qnames
+    via: str = ""             # the source text-ish name, for reports
+
+
+@dataclass
+class FunctionInfo:
+    qname: str
+    module: str
+    name: str
+    cls: "str | None"
+    path: Path
+    lineno: int
+    is_async: bool
+    markers: "set[str]" = field(default_factory=set)
+    node: "ast.AST | None" = None
+    effects: "list[EffectSite]" = field(default_factory=list)
+    edges: "list[CallEdge]" = field(default_factory=list)
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    path: Path
+    tree: ast.Module
+    lines: "list[str]"
+    is_package: bool = False  # an __init__ module: its name IS the package
+    imports: "dict[str, str]" = field(default_factory=dict)
+    functions: "dict[str, str]" = field(default_factory=dict)   # bare -> qname
+    classes: "dict[str, 'ClassInfo']" = field(default_factory=dict)
+    module_effects: "list[EffectSite]" = field(default_factory=list)
+
+
+@dataclass
+class ClassInfo:
+    qname: str
+    module: str
+    name: str
+    bases: "list[str]" = field(default_factory=list)   # base qnames (project)
+    methods: "dict[str, str]" = field(default_factory=dict)  # bare -> qname
+
+
+class Project:
+    """The parsed project: module index, function index, call edges."""
+
+    def __init__(self) -> None:
+        self.modules: dict[str, ModuleInfo] = {}
+        self.functions: dict[str, FunctionInfo] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.by_bare_name: dict[str, list[str]] = {}
+        self._closure_cache: dict[tuple[str, frozenset], set] = {}
+
+    # ------------------------------------------------------------ build
+    @classmethod
+    def build(cls, root: Path, scan: "list[str]") -> "Project":
+        project = cls()
+        files = _discover(root, scan)
+        for module_name, path in files:
+            source = path.read_text()
+            tree = ast.parse(source, filename=str(path))
+            project.modules[module_name] = ModuleInfo(
+                name=module_name, path=path, tree=tree,
+                lines=source.splitlines(),
+                is_package=path.name == "__init__.py",
+            )
+        for mod in project.modules.values():
+            _index_module(project, mod)
+        for mod in project.modules.values():
+            _resolve_module(project, mod)
+        project._index_bare_names()
+        for mod in project.modules.values():
+            _resolve_calls(project, mod)
+        return project
+
+    def _index_bare_names(self) -> None:
+        for qname, fn in self.functions.items():
+            # nested functions are only callable from their enclosing
+            # scope — never fallback candidates for a dynamic receiver
+            if ".<locals>." in qname:
+                continue
+            self.by_bare_name.setdefault(fn.name, []).append(qname)
+
+    # ---------------------------------------------------------- queries
+    def closure(self, root: str, edge_kinds: "frozenset[str]") -> "set[str]":
+        """Transitive callee closure of ``root`` (inclusive), traversing
+        only edges whose kind is in ``edge_kinds``."""
+        key = (root, edge_kinds)
+        cached = self._closure_cache.get(key)
+        if cached is not None:
+            return cached
+        seen: set[str] = set()
+        stack = [root]
+        while stack:
+            qname = stack.pop()
+            if qname in seen:
+                continue
+            seen.add(qname)
+            fn = self.functions.get(qname)
+            if fn is None:
+                continue
+            for edge in fn.edges:
+                if edge.kind not in edge_kinds:
+                    continue
+                for target in edge.targets:
+                    if target not in seen:
+                        stack.append(target)
+        self._closure_cache[key] = seen
+        return seen
+
+    def chain(self, root: str, target: str,
+              edge_kinds: "frozenset[str]") -> "list[tuple[str, int]]":
+        """Shortest call chain root → … → target as a list of
+        ``(qname, call_lineno)`` hops (the root's lineno entry is the
+        def line; each later entry carries the line of the call that
+        reached it)."""
+        if root == target:
+            fn = self.functions.get(root)
+            return [(root, fn.lineno if fn else 0)]
+        parent: dict[str, tuple[str, int]] = {}
+        seen = {root}
+        frontier = [root]
+        while frontier:
+            nxt: list[str] = []
+            for qname in frontier:
+                fn = self.functions.get(qname)
+                if fn is None:
+                    continue
+                for edge in fn.edges:
+                    if edge.kind not in edge_kinds:
+                        continue
+                    for callee in edge.targets:
+                        if callee in seen:
+                            continue
+                        seen.add(callee)
+                        parent[callee] = (qname, edge.lineno)
+                        if callee == target:
+                            return self._unwind(root, target, parent)
+                        nxt.append(callee)
+            frontier = nxt
+        return [(root, 0), (target, 0)]  # unreachable: defensive
+
+    def _unwind(self, root: str, target: str,
+                parent: "dict[str, tuple[str, int]]"
+                ) -> "list[tuple[str, int]]":
+        chain: list[tuple[str, int]] = []
+        at = target
+        while at != root:
+            up, lineno = parent[at]
+            chain.append((at, lineno))
+            at = up
+        fn = self.functions.get(root)
+        chain.append((root, fn.lineno if fn else 0))
+        chain.reverse()
+        return chain
+
+
+# ---------------------------------------------------------------- internal
+
+def _discover(root: Path, scan: "list[str]") -> "list[tuple[str, Path]]":
+    out: list[tuple[str, Path]] = []
+    for entry in scan:
+        path = root / entry
+        if path.is_dir():
+            for sub in sorted(path.rglob("*.py")):
+                if "__pycache__" in sub.parts:
+                    continue
+                out.append((_module_name(root, sub), sub))
+        elif path.is_file():
+            out.append((_module_name(root, path), path))
+    return out
+
+
+def _module_name(root: Path, path: Path) -> str:
+    rel = path.relative_to(root)
+    parts = list(rel.parts)
+    # scripts/ is not a package: scripts/perf_gate.py imports as perf_gate
+    if parts[0] == "scripts":
+        parts = parts[1:]
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][:-3]
+    return ".".join(parts)
+
+
+def _index_module(project: Project, mod: ModuleInfo) -> None:
+    for node in mod.tree.body:
+        _index_import(mod, node)
+    for node in mod.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _register_function(project, mod, node, cls=None)
+        elif isinstance(node, ast.ClassDef):
+            info = ClassInfo(
+                qname=f"{mod.name}.{node.name}", module=mod.name,
+                name=node.name,
+            )
+            mod.classes[node.name] = info
+            project.classes[info.qname] = info
+            for base in node.bases:
+                name = dotted_name(base)
+                if name:
+                    info.bases.append(name)  # resolved lazily against imports
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    fn = _register_function(project, mod, sub, cls=node.name)
+                    info.methods[sub.name] = fn.qname
+
+
+def _register_function(project: Project, mod: ModuleInfo, node,
+                       cls: "str | None") -> FunctionInfo:
+    qname = (f"{mod.name}.{cls}.{node.name}" if cls
+             else f"{mod.name}.{node.name}")
+    fn = FunctionInfo(
+        qname=qname, module=mod.name, name=node.name, cls=cls,
+        path=mod.path, lineno=node.lineno,
+        is_async=isinstance(node, ast.AsyncFunctionDef),
+        markers=decorator_markers(node, MARKER_NAMES),
+        node=node,
+    )
+    project.functions[qname] = fn
+    if cls is None:
+        mod.functions[node.name] = qname
+    _register_nested(project, mod, fn)
+    return fn
+
+
+def _register_nested(project: Project, mod: ModuleInfo,
+                     parent: FunctionInfo) -> None:
+    """Nested defs get their own records (``parent.<locals>.name``), so
+    a jit body builder's device code never pollutes the host function's
+    effect set — the parent only links to a nested def it actually
+    CALLS by name."""
+    for sub in walk_body(parent.node):
+        if not isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        nested_q = f"{parent.qname}.<locals>.{sub.name}"
+        if nested_q in project.functions:
+            continue
+        nested = FunctionInfo(
+            qname=nested_q, module=mod.name, name=sub.name,
+            cls=parent.cls, path=mod.path, lineno=sub.lineno,
+            is_async=isinstance(sub, ast.AsyncFunctionDef),
+            markers=decorator_markers(sub, MARKER_NAMES),
+            node=sub,
+        )
+        project.functions[nested_q] = nested
+        _register_nested(project, mod, nested)
+
+
+def _index_import(mod: ModuleInfo, node: ast.AST) -> None:
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            bound = alias.asname or alias.name.split(".")[0]
+            target = alias.name if alias.asname else alias.name.split(".")[0]
+            mod.imports[bound] = target
+    elif isinstance(node, ast.ImportFrom):
+        base = node.module or ""
+        if node.level:
+            parts = mod.name.split(".")
+            # for a module p.q.m, level=1 resolves against p.q — strip
+            # `level` trailing segments.  An __init__ module's name IS
+            # its package (p.q for p/q/__init__.py), so level=1 resolves
+            # against the name itself: strip one segment fewer.
+            strip = node.level - 1 if mod.is_package else node.level
+            if strip:
+                parts = parts[: len(parts) - strip]
+            base = ".".join(parts + ([node.module] if node.module else []))
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = alias.asname or alias.name
+            mod.imports[bound] = f"{base}.{alias.name}" if base else alias.name
+
+
+def _resolve_module(project: Project, mod: ModuleInfo) -> None:
+    """Resolve class base names against the import map so the static MRO
+    walk can cross modules."""
+    for cls in mod.classes.values():
+        resolved: list[str] = []
+        for base in cls.bases:
+            qname = _resolve_dotted(project, mod, base)
+            if qname and qname in project.classes:
+                resolved.append(qname)
+        cls.bases = resolved
+
+
+def _resolve_dotted(project: Project, mod: ModuleInfo,
+                    dotted: str) -> "str | None":
+    """Resolve a dotted reference in ``mod``'s namespace to a project
+    qname (function, class, or module)."""
+    parts = dotted.split(".")
+    head = parts[0]
+    if head in mod.imports:
+        full = mod.imports[head] + ("." + ".".join(parts[1:])
+                                    if len(parts) > 1 else "")
+    elif head in mod.functions and len(parts) == 1:
+        return mod.functions[head]
+    elif head in mod.classes:
+        cls = mod.classes[head]
+        if len(parts) == 1:
+            return cls.qname
+        return _class_attr(project, cls, parts[1]) if len(parts) == 2 else None
+    else:
+        full = f"{mod.name}.{dotted}"
+        if full not in project.functions and _prefix_module(
+            project, full
+        ) is None:
+            return None
+    if full in project.functions or full in project.classes:
+        return full
+    owner = _prefix_module(project, full)
+    if owner is None:
+        return None
+    rest = full[len(owner.name):].lstrip(".").split(".") if len(
+        full
+    ) > len(owner.name) else []
+    if not rest:
+        return owner.name
+    if len(rest) == 1:
+        if rest[0] in owner.functions:
+            return owner.functions[rest[0]]
+        if rest[0] in owner.classes:
+            return owner.classes[rest[0]].qname
+        return None
+    if len(rest) == 2 and rest[0] in owner.classes:
+        return _class_attr(project, owner.classes[rest[0]], rest[1])
+    return None
+
+
+def _prefix_module(project: Project, dotted: str) -> "ModuleInfo | None":
+    parts = dotted.split(".")
+    for cut in range(len(parts), 0, -1):
+        name = ".".join(parts[:cut])
+        if name in project.modules:
+            return project.modules[name]
+    return None
+
+
+def _class_attr(project: Project, cls: ClassInfo,
+                method: str) -> "str | None":
+    """Static MRO walk: the class, then its project bases, depth-first."""
+    seen: set[str] = set()
+    stack = [cls.qname]
+    while stack:
+        qname = stack.pop(0)
+        if qname in seen:
+            continue
+        seen.add(qname)
+        info = project.classes.get(qname)
+        if info is None:
+            continue
+        if method in info.methods:
+            return info.methods[method]
+        stack.extend(info.bases)
+    return None
+
+
+def _resolve_calls(project: Project, mod: ModuleInfo) -> None:
+    for qname, fn in list(project.functions.items()):
+        if fn.module != mod.name or fn.node is None:
+            continue
+        _resolve_function_calls(project, mod, fn)
+
+
+def _local_class_types(project: Project, mod: ModuleInfo,
+                       fn: FunctionInfo) -> "dict[str, str]":
+    """``var -> ClassQname`` for simple ``var = ClassName(...)`` local
+    single-assignments (reassignment to a different class drops the
+    binding — ambiguity resolves to the fallback path)."""
+    out: dict[str, str] = {}
+    dropped: set[str] = set()
+    assigns = [n for n in walk_body(fn.node) if isinstance(n, ast.Assign)]
+    # walk_body is LIFO, not source order — the reassignment-drops-binding
+    # law below needs statements in textual order
+    assigns.sort(key=lambda n: (n.lineno, n.col_offset))
+    for node in assigns:
+        if len(node.targets) != 1:
+            continue
+        target = node.targets[0]
+        if not isinstance(target, ast.Name):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        callee = dotted_name(node.value.func)
+        if callee is None:
+            continue
+        resolved = _resolve_dotted(project, mod, callee)
+        if resolved and resolved in project.classes:
+            if target.id in out and out[target.id] != resolved:
+                dropped.add(target.id)
+            out[target.id] = resolved
+        elif target.id in out:
+            dropped.add(target.id)
+    for name in dropped:
+        out.pop(name, None)
+    return out
+
+
+def _resolve_function_calls(project: Project, mod: ModuleInfo,
+                            fn: FunctionInfo) -> None:
+    local_types = _local_class_types(project, mod, fn)
+    nested_local = {
+        f.name: f.qname
+        for f in project.functions.values()
+        if f.qname.startswith(fn.qname + ".<locals>.")
+    }
+    # a spawn's coroutine argument (`create_task(self._bg())`) calls the
+    # coroutine FUNCTION only to build the coroutine object — the body
+    # runs on the spawned task, which the event-loop stall rule roots
+    # independently.  Suppress the inner Call's own edge so a spawned
+    # background coroutine's effects never leak into the spawner's
+    # closure as if called synchronously (argument EXPRESSIONS inside it
+    # still walk normally — they do evaluate at the spawn site).
+    spawned_calls: set[int] = set()
+    for node in walk_body(fn.node):
+        if isinstance(node, ast.Call) and _call_kind(node)[0] == "spawn":
+            for arg in node.args:
+                if isinstance(arg, ast.Call):
+                    spawned_calls.add(id(arg))
+    for node in walk_body(fn.node):
+        if not isinstance(node, ast.Call):
+            continue
+        if id(node) in spawned_calls:
+            continue
+        kind, ref = _call_kind(node)
+        if kind != "normal":
+            targets = _resolve_ref(project, mod, fn, ref, local_types,
+                                   nested_local) if ref is not None else ()
+            if targets:
+                fn.edges.append(CallEdge(
+                    lineno=node.lineno, kind=kind, targets=targets,
+                    via=dotted_name(ref) or "<ref>",
+                ))
+            continue
+        targets = _resolve_ref(project, mod, fn, node.func, local_types,
+                               nested_local)
+        if targets:
+            fn.edges.append(CallEdge(
+                lineno=node.lineno, kind="normal", targets=targets,
+                via=dotted_name(node.func) or _attr_tail(node.func) or "?",
+            ))
+
+
+def _attr_tail(node: ast.AST) -> "str | None":
+    return node.attr if isinstance(node, ast.Attribute) else None
+
+
+def _call_kind(call: ast.Call) -> "tuple[str, ast.AST | None]":
+    """Classify thread/loop handoffs.  Returns (kind, callable-ref):
+    the ref is the function REFERENCE being handed off (to_thread's
+    first arg, run_in_executor's second, Thread's target=...)."""
+    func = call.func
+    tail = func.attr if isinstance(func, ast.Attribute) else (
+        func.id if isinstance(func, ast.Name) else None
+    )
+    if tail in _THREADED_TAILS:
+        return "threaded", call.args[0] if call.args else None
+    if tail in _THREADED_ATTRS:
+        return "threaded", call.args[1] if len(call.args) > 1 else None
+    if tail == "Thread" or dotted_name(func) == "threading.Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                return "threaded", kw.value
+        return "threaded", None
+    if tail in _SPAWN_TAILS:
+        return "spawn", None
+    if tail in _DEFERRED_ATTRS:
+        return "deferred", call.args[0] if call.args else None
+    return "normal", None
+
+
+def _resolve_ref(project: Project, mod: ModuleInfo, fn: FunctionInfo,
+                 ref: ast.AST, local_types: "dict[str, str]",
+                 nested_local: "dict[str, str]") -> "tuple[str, ...]":
+    """Resolve a callable reference to project function qnames."""
+    # self.method() -> enclosing class MRO
+    if (isinstance(ref, ast.Attribute)
+            and isinstance(ref.value, ast.Name)
+            and ref.value.id in ("self", "cls") and fn.cls is not None):
+        cls = project.classes.get(f"{fn.module}.{fn.cls}")
+        if cls is not None:
+            hit = _class_attr(project, cls, ref.attr)
+            if hit:
+                return (hit,)
+        return _fallback(project, ref.attr, is_attr=True)
+    # var.method() with a locally-inferred class type
+    if (isinstance(ref, ast.Attribute)
+            and isinstance(ref.value, ast.Name)
+            and ref.value.id in local_types):
+        cls = project.classes.get(local_types[ref.value.id])
+        if cls is not None:
+            hit = _class_attr(project, cls, ref.attr)
+            if hit:
+                return (hit,)
+        return _fallback(project, ref.attr, is_attr=True)
+    # ClassName(...).method(): the receiver is a constructor call on a
+    # resolvable project class — dispatch precisely, not by fallback
+    if (isinstance(ref, ast.Attribute)
+            and isinstance(ref.value, ast.Call)):
+        ctor = dotted_name(ref.value.func)
+        if ctor is not None:
+            resolved = _resolve_dotted(project, mod, ctor)
+            if resolved and resolved in project.classes:
+                hit = _class_attr(project, project.classes[resolved],
+                                  ref.attr)
+                if hit:
+                    return (hit,)
+    dotted = dotted_name(ref)
+    if dotted is not None:
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            if parts[0] in nested_local:
+                return (nested_local[parts[0]],)
+            resolved = _resolve_dotted(project, mod, dotted)
+            if resolved and resolved in project.functions:
+                return (resolved,)
+            if resolved and resolved in project.classes:
+                init = _class_attr(project, project.classes[resolved],
+                                   "__init__")
+                return (init,) if init else ()
+            if parts[0] in mod.imports:
+                # imported from a known non-project module (e.g.
+                # ``from copy import deepcopy``): precisely resolved,
+                # just not ours — never a fallback candidate
+                return ()
+            return _fallback(project, parts[0], is_attr=False)
+        resolved = _resolve_dotted(project, mod, dotted)
+        if resolved and resolved in project.functions:
+            return (resolved,)
+        if resolved and resolved in project.classes:
+            init = _class_attr(project, project.classes[resolved], "__init__")
+            return (init,) if init else ()
+        if parts[0] in mod.imports or parts[0] in mod.classes:
+            # the receiver IS known (an imported module like ``asyncio``
+            # or a project class) — the attribute simply isn't a project
+            # function.  Falling back by bare name here would link
+            # ``asyncio.run`` to every project ``run``.
+            return ()
+        return _fallback(project, parts[-1], is_attr=True)
+    if isinstance(ref, ast.Attribute):
+        return _fallback(project, ref.attr, is_attr=True)
+    return ()
+
+
+def _fallback(project: Project, bare: str,
+              *, is_attr: bool) -> "tuple[str, ...]":
+    """Conservative name fallback: link to every project function with
+    this bare name, unless the name is in the ubiquitous-method skip set
+    or the candidate set is too large to be meaningful."""
+    if bare in FALLBACK_SKIP_ATTRS or bare.startswith("__"):
+        return ()
+    candidates = project.by_bare_name.get(bare, ())
+    if not candidates or len(candidates) > FALLBACK_MAX_CANDIDATES:
+        return ()
+    if not is_attr:
+        # a bare-name call can only reach module-level / nested functions
+        candidates = [q for q in candidates
+                      if project.functions[q].cls is None]
+    return tuple(candidates)

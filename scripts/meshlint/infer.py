@@ -1,0 +1,283 @@
+"""Per-function effect inference.
+
+Each function body is scanned once for the effect vocabulary; every site
+is checked for the matching escape comment so rules never have to touch
+source text again:
+
+==============  ====================================================
+effect          primitives
+==============  ====================================================
+BLOCK           ``time.sleep`` (incl. from-imported ``sleep``),
+                ``open``/``input``, ``subprocess.*``, ``os.system``/
+                ``os.popen``, ``socket.socket``/``create_connection``/
+                ``getaddrinfo``, ``select.select``
+LOG             ``print``, any ``logger.*``/``logging.*`` call,
+                ``warnings.warn``
+WALLCLOCK       ``time.time``/``time_ns``, ``datetime.now``/``utcnow``/
+                ``today`` (any ``datetime``-rooted chain)
+MONOTONIC       ``time.monotonic``/``perf_counter`` (+ ``_ns``)
+DEVICE_SYNC     ``np.asarray``/``np.array``/``jax.device_get``,
+                ``.block_until_ready()``/``.item()`` on any receiver
+UNBOUNDED_QUEUE ``asyncio.Queue()``/``deque()``/… with no bound and no
+                ``# unbounded-ok:`` justification (incl.
+                ``default_factory=``)
+AWAIT           any ``await`` expression (feeds the atomicity rule)
+==============  ====================================================
+
+Escape comments waive a SITE, never a function: ``# blocking-ok:`` for
+BLOCK/DEVICE_SYNC, ``# wallclock-ok:`` for WALLCLOCK/MONOTONIC,
+``# unbounded-ok:`` for UNBOUNDED_QUEUE.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from meshlint.astutil import comment_waiver, dotted_name, walk_body
+from meshlint.callgraph import (
+    EffectSite,
+    FunctionInfo,
+    ModuleInfo,
+    Project,
+)
+
+BLOCK = "BLOCK"
+LOG = "LOG"
+WALLCLOCK = "WALLCLOCK"
+MONOTONIC = "MONOTONIC"
+DEVICE_SYNC = "DEVICE_SYNC"
+UNBOUNDED_QUEUE = "UNBOUNDED_QUEUE"
+AWAIT = "AWAIT"
+
+WAIVER_MARKS = {
+    BLOCK: "blocking-ok:",
+    DEVICE_SYNC: "blocking-ok:",
+    # a log line is an I/O stall: same waiver family as blocking
+    LOG: "blocking-ok:",
+    WALLCLOCK: "wallclock-ok:",
+    MONOTONIC: "wallclock-ok:",
+    UNBOUNDED_QUEUE: "unbounded-ok:",
+}
+
+_BLOCK_DOTTED = {
+    "time.sleep", "os.system", "os.popen", "select.select",
+    "socket.socket", "socket.create_connection", "socket.getaddrinfo",
+}
+_BLOCK_ROOTS = {"subprocess"}
+_BLOCK_BARE = {"open", "input"}
+# from-imported bare names that become blocking calls
+_BLOCK_FROM = {"sleep": "time"}
+
+_LOG_RECEIVERS = {"logger", "logging"}
+_LOG_DOTTED = {"warnings.warn"}
+
+_WALLCLOCK_TAILS = {"time", "time_ns", "now", "utcnow", "today"}
+_WALLCLOCK_ROOTS = {"time", "datetime", "date"}
+_MONOTONIC_TAILS = {
+    "monotonic", "perf_counter", "monotonic_ns", "perf_counter_ns",
+}
+
+_SYNC_DOTTED = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "jax.device_get",
+}
+_SYNC_METHODS = {"block_until_ready", "item"}
+
+_QUEUE_NAMES = {"Queue", "deque", "LifoQueue", "PriorityQueue",
+                "SimpleQueue"}
+_QUEUE_MODULES = {"asyncio", "collections", "queue"}
+_BOUND_KWARGS = {"maxsize", "maxlen"}
+
+
+def infer_effects(project: Project) -> None:
+    """Fill ``FunctionInfo.effects`` for every function and
+    ``ModuleInfo.module_effects`` (module-/class-level queue
+    constructions and clock reads outside any function)."""
+    for mod in project.modules.values():
+        from_clocks = _from_imported_clocks(mod)
+        for fn in project.functions.values():
+            if fn.module != mod.name or fn.node is None:
+                continue
+            fn.effects = _scan(mod, fn.node, from_clocks)
+        mod.module_effects = _scan_module_level(mod, from_clocks)
+
+
+def _from_imported_clocks(mod: ModuleInfo) -> "dict[str, str]":
+    """Bare names that arrived via ``from time import monotonic`` style
+    imports, mapped to their effect kind."""
+    out: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ImportFrom) or node.module not in (
+            "time", "datetime"
+        ):
+            continue
+        for alias in node.names:
+            bound = alias.asname or alias.name
+            if alias.name in _MONOTONIC_TAILS:
+                out[bound] = MONOTONIC
+            elif alias.name in _WALLCLOCK_TAILS:
+                out[bound] = WALLCLOCK
+            elif alias.name in _BLOCK_FROM:
+                out[bound] = BLOCK
+    return out
+
+
+def _scan(mod: ModuleInfo, root: ast.AST,
+          from_clocks: "dict[str, str]") -> "list[EffectSite]":
+    out: list[EffectSite] = []
+    for node in walk_body(root):
+        out.extend(_node_effects(mod, node, from_clocks))
+    return out
+
+
+def _scan_module_level(mod: ModuleInfo,
+                       from_clocks: "dict[str, str]") -> "list[EffectSite]":
+    """Module- and class-body statements (incl. dataclass
+    ``field(default_factory=deque)``) — everything outside a def."""
+    out: list[EffectSite] = []
+    stack: list[ast.AST] = list(ast.iter_child_nodes(mod.tree))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        out.extend(_node_effects(mod, node, from_clocks))
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _site(mod: ModuleInfo, kind: str, lineno: int,
+          detail: str) -> EffectSite:
+    mark = WAIVER_MARKS.get(kind)
+    waiver = comment_waiver(mod.lines, lineno, mark) if mark else None
+    return EffectSite(kind=kind, lineno=lineno, detail=detail,
+                      waiver=waiver)
+
+
+def _node_effects(mod: ModuleInfo, node: ast.AST,
+                  from_clocks: "dict[str, str]") -> "list[EffectSite]":
+    out: list[EffectSite] = []
+    if isinstance(node, ast.Await):
+        out.append(EffectSite(kind=AWAIT, lineno=node.lineno,
+                              detail="await"))
+        return out
+    if isinstance(node, ast.keyword) and node.arg == "default_factory":
+        ctor = _queue_ctor_name(node.value)
+        if ctor is not None:
+            out.append(_site(mod, UNBOUNDED_QUEUE, node.value.lineno,
+                             f"default_factory={ctor}"))
+        return out
+    if not isinstance(node, ast.Call):
+        return out
+    ctor = _queue_ctor_name(node.func)
+    if ctor is not None and not _is_bounded_call(node):
+        out.append(_site(mod, UNBOUNDED_QUEUE, node.lineno, f"{ctor}()"))
+    fn = node.func
+    # .block_until_ready()/.item() block on ANY receiver — checked before
+    # dotted resolution so `arr.item()` and `self._k.block_until_ready()`
+    # both count
+    if isinstance(fn, ast.Attribute) and fn.attr in _SYNC_METHODS:
+        out.append(_site(mod, DEVICE_SYNC, node.lineno,
+                         f".{fn.attr}() [any receiver]"))
+    dotted = dotted_name(fn)
+    if dotted is not None:
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            bare = parts[0]
+            if bare in _BLOCK_BARE:
+                out.append(_site(mod, BLOCK, node.lineno, f"{bare}()"))
+            elif bare == "print":
+                out.append(_site(mod, LOG, node.lineno, "print()"))
+            elif bare in from_clocks:
+                out.append(_site(mod, from_clocks[bare], node.lineno,
+                                 f"{bare}() [from-imported]"))
+        else:
+            root, tail = parts[0], parts[-1]
+            if dotted in _BLOCK_DOTTED or root in _BLOCK_ROOTS:
+                out.append(_site(mod, BLOCK, node.lineno, f"{dotted}()"))
+            elif root in _LOG_RECEIVERS or dotted in _LOG_DOTTED:
+                out.append(_site(mod, LOG, node.lineno, f"{dotted}()"))
+            elif dotted in _SYNC_DOTTED:
+                out.append(_site(mod, DEVICE_SYNC, node.lineno,
+                                 f"{dotted}()"))
+            elif tail in _WALLCLOCK_TAILS and root in _WALLCLOCK_ROOTS:
+                out.append(_site(mod, WALLCLOCK, node.lineno,
+                                 f"{dotted}()"))
+            elif tail in _MONOTONIC_TAILS and root == "time":
+                out.append(_site(mod, MONOTONIC, node.lineno,
+                                 f"{dotted}()"))
+    return out
+
+
+# ------------------------------------------------ unbounded-queue lore
+# ported verbatim in spirit from lint_hotpath.py (ISSUE 5): asyncio/
+# queue treat maxsize<=0 as UNLIMITED (the exact regression the rule
+# catches) while deque(maxlen=0) is a real bound (an always-empty deque)
+
+def _queue_ctor_name(node: ast.AST) -> "str | None":
+    if isinstance(node, ast.Name) and node.id in _QUEUE_NAMES:
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and node.attr in _QUEUE_NAMES
+        and isinstance(node.value, ast.Name)
+        and node.value.id in _QUEUE_MODULES
+    ):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _bound_value_ok(node: ast.AST, is_deque: bool) -> bool:
+    if not isinstance(node, ast.Constant):
+        return True
+    if node.value is None:
+        return False
+    if is_deque:
+        return True
+    return not (
+        isinstance(node.value, int)
+        and not isinstance(node.value, bool)
+        and node.value <= 0
+    )
+
+
+def _is_bounded_call(call: ast.Call) -> bool:
+    is_deque = _queue_ctor_name(call.func) in ("deque", "collections.deque")
+    for kw in call.keywords:
+        if kw.arg in _BOUND_KWARGS:
+            return _bound_value_ok(kw.value, is_deque)
+    if is_deque:
+        return len(call.args) >= 2 and _bound_value_ok(call.args[1], True)
+    return bool(call.args) and _bound_value_ok(call.args[0], False)
+
+
+# ----------------------------------------------------- formatting scan
+# used by the journal-append rules (not a per-function effect: f-strings
+# are legal everywhere EXCEPT at flight-recorder append sites)
+
+def formatting_sites(root: ast.AST) -> "list[tuple[int, str]]":
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(root):
+        if isinstance(node, ast.JoinedStr):
+            out.append((node.lineno, "f-string"))
+        elif isinstance(node, (ast.Dict, ast.DictComp, ast.SetComp,
+                               ast.ListComp, ast.GeneratorExp)):
+            out.append((node.lineno, f"{type(node).__name__} construction"))
+        elif (
+            isinstance(node, ast.BinOp)
+            and isinstance(node.op, ast.Mod)
+            and isinstance(node.left, ast.Constant)
+            and isinstance(node.left.value, str)
+        ):
+            out.append((node.lineno, "%-formatting"))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "format"
+        ):
+            out.append((node.lineno, ".format() call"))
+    return out
+
+
+def function_effects(fn: FunctionInfo,
+                     kinds: "frozenset[str]") -> "list[EffectSite]":
+    return [e for e in fn.effects if e.kind in kinds and not e.waived]

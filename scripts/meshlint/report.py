@@ -1,0 +1,111 @@
+"""Violation records, chain rendering, and the machine-readable report."""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ChainHop:
+    qname: str
+    path: str       # file DEFINING this hop's function
+    lineno: int     # line of the call that reached it (root: its def line)
+    call_path: str = ""  # file containing that call (the caller's file)
+
+
+@dataclass
+class Violation:
+    rule: str
+    message: str
+    path: str
+    lineno: int
+    chain: "list[ChainHop]"
+    effect: str
+    detail: str
+
+    def sort_key(self) -> tuple:
+        return (self.path, self.lineno, self.rule, self.detail)
+
+
+@dataclass
+class Report:
+    violations: "list[Violation]"
+    stats: "dict[str, int]" = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    # -------------------------------------------------------- rendering
+    def render(self, *, chains: bool = False) -> str:
+        lines: list[str] = []
+        for v in sorted(self.violations, key=Violation.sort_key):
+            lines.append(f"{v.path}:{v.lineno}: [{v.rule}] {v.message}")
+            if chains and len(v.chain) > 1:
+                for depth, hop in enumerate(v.chain):
+                    head = "  " + "   " * depth
+                    if depth == 0:
+                        at = (f" ({hop.path}:{hop.lineno})"
+                              if hop.lineno else "")
+                        lines.append(f"{head}{hop.qname}{at}")
+                        continue
+                    where = hop.call_path or hop.path
+                    at = (f" (called at {where}:{hop.lineno})"
+                          if hop.lineno else "")
+                    lines.append(f"{head}-> {hop.qname}{at}")
+                last = "  " + "   " * len(v.chain)
+                lines.append(
+                    f"{last}!! {v.effect} `{v.detail}` at "
+                    f"{v.path}:{v.lineno}"
+                )
+        if self.violations:
+            lines.append(
+                f"meshlint: {len(self.violations)} violation(s) across "
+                f"{len({v.rule for v in self.violations})} rule(s)"
+            )
+        else:
+            lines.append(self.summary_line())
+        return "\n".join(lines)
+
+    def summary_line(self) -> str:
+        s = self.stats
+        return (
+            "meshlint: clean "
+            f"({s.get('modules', 0)} modules, "
+            f"{s.get('functions', 0)} functions, "
+            f"{s.get('edges', 0)} call edges, "
+            f"{s.get('roots', 0)} declared roots "
+            f"[{s.get('hotpath', 0)} hotpath / "
+            f"{s.get('no_wallclock', 0)} no_wallclock], "
+            f"{s.get('async_defs', 0)} async defs stall-checked, "
+            f"{s.get('waived', 0)} waived sites)"
+        )
+
+    # ------------------------------------------------------------- json
+    def to_json(self) -> str:
+        return json.dumps({
+            "version": 1,
+            "ok": self.ok,
+            "stats": self.stats,
+            "violations": [
+                {
+                    "rule": v.rule,
+                    "message": v.message,
+                    "path": v.path,
+                    "lineno": v.lineno,
+                    "effect": v.effect,
+                    "detail": v.detail,
+                    "chain": [
+                        # path = the hop's DEFINING file; lineno = the
+                        # call line that reached it, which lives in
+                        # call_path (the caller's file) — navigate with
+                        # call_path:lineno, like the text renderer
+                        {"qname": h.qname, "path": h.path,
+                         "lineno": h.lineno, "call_path": h.call_path}
+                        for h in v.chain
+                    ],
+                }
+                for v in sorted(self.violations, key=Violation.sort_key)
+            ],
+        }, indent=2, sort_keys=True) + "\n"

@@ -1,0 +1,457 @@
+"""The rule engine: constraint propagation and the whole-package rules.
+
+Every rule yields :class:`meshlint.report.Violation` records.  The root
+rules propagate a marker's forbidden effect set through the transitive
+call closure; the chain on each violation is the shortest call path from
+the declared root to the offending function, so a report reads
+``root → helper → offending file:line`` — the exact property the old
+per-body name lists could not give.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from meshlint import infer
+from meshlint.astutil import comment_waiver, dotted_name, walk_body
+from meshlint.callgraph import FunctionInfo, Project
+from meshlint.config import Config
+from meshlint.report import ChainHop, Violation
+
+# edge sets per propagation class: blocking stalls only the calling
+# thread, so thread handoffs break the chain; clock reads and logging
+# poison the property no matter which thread runs them.  Spawn edges are
+# never traversed — a spawned coroutine is an async def, independently
+# rooted by the event-loop stall rule.
+SYNC_EDGES = frozenset({"normal"})
+LOOP_EDGES = frozenset({"normal", "deferred"})
+ANY_THREAD_EDGES = frozenset({"normal", "threaded", "deferred"})
+
+# marker -> list of (forbidden effect kinds, edge filter)
+MARKER_CONSTRAINTS: "dict[str, list[tuple[frozenset, frozenset]]]" = {
+    "hotpath": [
+        (frozenset({infer.BLOCK, infer.DEVICE_SYNC}), SYNC_EDGES),
+        (frozenset({infer.LOG, infer.WALLCLOCK}), ANY_THREAD_EDGES),
+    ],
+    "no_block": [(frozenset({infer.BLOCK}), SYNC_EDGES)],
+    "no_wallclock": [
+        (frozenset({infer.WALLCLOCK, infer.MONOTONIC}), ANY_THREAD_EDGES),
+    ],
+    "no_log": [(frozenset({infer.LOG}), ANY_THREAD_EDGES)],
+}
+
+_ATOMICITY_MARK = "atomicity-ok:"
+
+
+def run_rules(project: Project, config: Config) -> "list[Violation]":
+    out: list[Violation] = []
+    out.extend(root_constraint_rule(project, config))
+    out.extend(async_stall_rule(project, config))
+    out.extend(await_atomicity_rule(project, config))
+    out.extend(unbounded_queue_rule(project, config))
+    out.extend(sim_wallclock_rule(project, config))
+    out.extend(journal_site_rule(project, config))
+    out.extend(flightrec_append_rule(project, config))
+    out.extend(coverage_rule(project, config))
+    return out
+
+
+# ------------------------------------------------------- root closures
+
+def _rel(config: Config, fn: FunctionInfo) -> str:
+    try:
+        return str(fn.path.relative_to(config.root))
+    except ValueError:
+        return str(fn.path)
+
+
+def _chain_hops(project: Project, config: Config, root: str, target: str,
+                edges: "frozenset[str]") -> "list[ChainHop]":
+    hops: "list[ChainHop]" = []
+    prev_path = ""
+    for qname, lineno in project.chain(root, target, edges):
+        fn = project.functions.get(qname)
+        path = _rel(config, fn) if fn else "?"
+        hops.append(ChainHop(
+            qname=qname, path=path, lineno=lineno, call_path=prev_path,
+        ))
+        prev_path = path
+    return hops
+
+
+def root_constraint_rule(project: Project,
+                         config: Config) -> "list[Violation]":
+    out: list[Violation] = []
+    for fn in project.functions.values():
+        if not fn.markers:
+            continue
+        if "hotpath" in fn.markers and fn.is_async:
+            out.append(Violation(
+                rule="hotpath-sync-shape",
+                message=(f"{fn.qname} is @hotpath but became `async def` — "
+                         "the dispatch/selection paths are sync by contract "
+                         "(no broker round-trips per routed call)"),
+                path=_rel(config, fn), lineno=fn.lineno,
+                chain=[ChainHop(fn.qname, _rel(config, fn), fn.lineno)],
+                effect="ASYNC_SHAPE", detail="async def",
+            ))
+        for marker in sorted(fn.markers):
+            for kinds, edges in MARKER_CONSTRAINTS.get(marker, ()):
+                out.extend(_propagate(project, config, fn, marker,
+                                      kinds, edges))
+    return out
+
+
+def _propagate(project: Project, config: Config, root: FunctionInfo,
+               marker: str, kinds: "frozenset[str]",
+               edges: "frozenset[str]") -> "list[Violation]":
+    out: list[Violation] = []
+    for qname in sorted(project.closure(root.qname, edges)):
+        callee = project.functions.get(qname)
+        if callee is None:
+            continue
+        for site in callee.effects:
+            if site.kind not in kinds or site.waived:
+                continue
+            mark = infer.WAIVER_MARKS.get(site.kind, "blocking-ok:")
+            out.append(Violation(
+                rule=marker,
+                message=(
+                    f"@{marker} root {root.qname} transitively reaches "
+                    f"{site.kind} effect `{site.detail}` in {callee.qname} "
+                    f"(waive the site with '# {mark} <why>' if legitimate)"
+                ),
+                path=_rel(config, callee), lineno=site.lineno,
+                chain=_chain_hops(project, config, root.qname, qname, edges),
+                effect=site.kind, detail=site.detail,
+            ))
+    return out
+
+
+# --------------------------------------------------- event-loop stalls
+
+def async_stall_rule(project: Project, config: Config) -> "list[Violation]":
+    """No ``async def`` anywhere in the package may transitively call a
+    blocking primitive outside a ``to_thread``/executor handoff: one
+    blocked coroutine stalls EVERY run on that worker's event loop."""
+    if not config.package_prefix:
+        return []
+    out: list[Violation] = []
+    seen_effects: set[tuple[str, int, str]] = set()
+    for fn in project.functions.values():
+        if not fn.is_async or not fn.module.startswith(
+            config.package_prefix
+        ):
+            continue
+        for qname in sorted(project.closure(fn.qname, LOOP_EDGES)):
+            callee = project.functions.get(qname)
+            if callee is None:
+                continue
+            for site in callee.effects:
+                if site.kind != infer.BLOCK or site.waived:
+                    continue
+                # report each offending SITE once, under its shortest
+                # async root — N async callers of one blocking helper
+                # are one bug, not N
+                key = (callee.qname, site.lineno, site.detail)
+                if key in seen_effects:
+                    continue
+                seen_effects.add(key)
+                out.append(Violation(
+                    rule="async-stall",
+                    message=(
+                        f"async {fn.qname} transitively calls blocking "
+                        f"`{site.detail}` in {callee.qname} — move it "
+                        "behind asyncio.to_thread / an executor, or waive "
+                        "the site with '# blocking-ok: <why>'"
+                    ),
+                    path=_rel(config, callee), lineno=site.lineno,
+                    chain=_chain_hops(project, config, fn.qname, qname,
+                                      LOOP_EDGES),
+                    effect=infer.BLOCK, detail=site.detail,
+                ))
+    return out
+
+
+# ----------------------------------------------- await-point atomicity
+
+def await_atomicity_rule(project: Project,
+                         config: Config) -> "list[Violation]":
+    """Flag read-then-write of the same ``self.<attr>`` across an
+    intervening ``await``: the loop may interleave another coroutine
+    between the read and the write, and the write then clobbers state
+    based on a stale read.  A fresh re-read after the last await (e.g.
+    ``self.x += 1``, or the write's RHS reading the attr) clears the
+    flag; legitimate check-then-act patterns carry
+    ``# atomicity-ok: <why>``."""
+    if not config.package_prefix:
+        return []
+    out: list[Violation] = []
+    for fn in project.functions.values():
+        if not fn.is_async or not fn.module.startswith(
+            config.package_prefix
+        ) or fn.node is None:
+            continue
+        out.extend(_atomicity_scan(project, config, fn))
+    return out
+
+
+def _atomicity_scan(project: Project, config: Config,
+                    fn: FunctionInfo) -> "list[Violation]":
+    reads: dict[str, list[tuple[int, int]]] = {}
+    awaits: list[tuple[int, int]] = []
+    # writes: (attr, stmt_start, stmt_end) — the span lets an RHS
+    # re-read on the write statement itself count as fresh
+    writes: list[tuple[str, tuple[int, int], tuple[int, int]]] = []
+    for node in walk_body(fn.node):
+        if isinstance(node, ast.Await):
+            awaits.append((node.lineno, node.col_offset))
+        elif isinstance(node, ast.AugAssign):
+            target = node.target
+            if not (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                continue
+            # read+write in one statement: the read is fresh by
+            # construction (asyncio interleaves only at awaits)
+            pos = (node.lineno, node.col_offset)
+            end = (node.end_lineno or node.lineno, node.end_col_offset or 0)
+            reads.setdefault(target.attr, []).append(end)
+            writes.append((target.attr, pos, end))
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else (
+                [node.target]
+            )
+            pos = (node.lineno, node.col_offset)
+            end = (node.end_lineno or node.lineno, node.end_col_offset or 0)
+            for target in targets:
+                for sub in ast.walk(target):
+                    if (isinstance(sub, ast.Attribute)
+                            and isinstance(sub.value, ast.Name)
+                            and sub.value.id == "self"
+                            and isinstance(sub.ctx, ast.Store)):
+                        writes.append((sub.attr, pos, end))
+        elif _self_attr(node) and isinstance(node.ctx, ast.Load):
+            reads.setdefault(node.attr, []).append(
+                (node.lineno, node.col_offset)
+            )
+    if not awaits:
+        return []
+    mod = project.modules.get(fn.module)
+    lines = mod.lines if mod else []
+    out: list[Violation] = []
+    flagged: set[tuple[str, int]] = set()
+    for attr, wpos, wend in writes:
+        attr_reads = reads.get(attr, [])
+        before = [a for a in awaits if a < wpos]
+        if not before:
+            continue
+        a_star = max(before)
+        if any(a_star < r <= wend for r in attr_reads):
+            continue  # fresh read after the last await
+        stale = [r for r in attr_reads if r < a_star]
+        if not stale:
+            continue
+        if (attr, wpos[0]) in flagged:
+            continue
+        flagged.add((attr, wpos[0]))
+        if (comment_waiver(lines, wpos[0], _ATOMICITY_MARK) is not None
+                or comment_waiver(lines, fn.lineno, _ATOMICITY_MARK)
+                is not None):
+            continue
+        read_line = max(stale)[0]
+        await_line = a_star[0]
+        out.append(Violation(
+            rule="await-atomicity",
+            message=(
+                f"{fn.qname}: `self.{attr}` read at line {read_line} may "
+                f"be stale by the write at line {wpos[0]} — the await at "
+                f"line {await_line} yields the event loop between them "
+                "(re-read after the await, or annotate the write with "
+                "'# atomicity-ok: <why>')"
+            ),
+            path=_rel(config, fn), lineno=wpos[0],
+            chain=[ChainHop(fn.qname, _rel(config, fn), fn.lineno)],
+            effect="STALE_WRITE", detail=f"self.{attr}",
+        ))
+    return out
+
+
+def _self_attr(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self")
+
+
+# ------------------------------------------------ module-scoped rules
+
+def _module_wide_effects(project: Project, prefix: str):
+    for mod in project.modules.values():
+        if not mod.name.startswith(prefix):
+            continue
+        for site in mod.module_effects:
+            yield mod, None, site
+        for fn in project.functions.values():
+            if fn.module != mod.name:
+                continue
+            for site in fn.effects:
+                yield mod, fn, site
+
+
+def unbounded_queue_rule(project: Project,
+                         config: Config) -> "list[Violation]":
+    out: list[Violation] = []
+    seen: set[tuple[str, int]] = set()
+    for prefix in config.queue_scope:
+        for mod, fn, site in _module_wide_effects(project, prefix):
+            if site.kind != infer.UNBOUNDED_QUEUE or site.waived:
+                continue
+            key = (mod.name, site.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            where = fn.qname if fn else mod.name
+            out.append(Violation(
+                rule="unbounded-queue",
+                message=(
+                    f"unbounded {site.detail} in {where} without an "
+                    "'# unbounded-ok: <why>' justification (name the "
+                    "admission bound / permit / reaper that bounds it)"
+                ),
+                path=str(mod.path.relative_to(config.root)),
+                lineno=site.lineno,
+                chain=[], effect=site.kind, detail=site.detail,
+            ))
+    return out
+
+
+def sim_wallclock_rule(project: Project,
+                       config: Config) -> "list[Violation]":
+    """ISSUE 11: NO direct host-clock read anywhere in the simulator —
+    byte-identical SIM.json per seed holds only while every timestamp
+    flows through the ``cancellation.wall_clock`` seam."""
+    if not config.sim_scope:
+        return []
+    out: list[Violation] = []
+    seen: set[tuple[str, int]] = set()
+    for mod, fn, site in _module_wide_effects(project, config.sim_scope):
+        if site.kind not in (infer.WALLCLOCK, infer.MONOTONIC):
+            continue
+        if site.waived:
+            continue
+        key = (mod.name, site.lineno)
+        if key in seen:
+            continue
+        seen.add(key)
+        where = fn.qname if fn else mod.name
+        out.append(Violation(
+            rule="sim-wallclock",
+            message=(
+                f"sim wall-clock read `{site.detail}` in {where} — all "
+                "timestamps must flow through cancellation.wall_clock "
+                "(or carry '# wallclock-ok: <why>')"
+            ),
+            path=str(mod.path.relative_to(config.root)),
+            lineno=site.lineno, chain=[], effect=site.kind,
+            detail=site.detail,
+        ))
+    return out
+
+
+# --------------------------------------------- flight-recorder rules
+
+def _is_journal_append(call: ast.Call) -> bool:
+    fn = call.func
+    return (
+        isinstance(fn, ast.Attribute)
+        and fn.attr == "append"
+        and isinstance(fn.value, ast.Attribute)
+        and fn.value.attr == "_journal"
+    )
+
+
+def journal_site_rule(project: Project, config: Config) -> "list[Violation]":
+    """Every ``*._journal.append(...)`` call site in the engine must pass
+    precomputed values only — the journal is on by default in production
+    and its O(1)-per-event promise starts at the call site."""
+    mod = project.modules.get(config.journal_module)
+    if mod is None:
+        return []
+    out: list[Violation] = []
+    for call in ast.walk(mod.tree):
+        if not (isinstance(call, ast.Call) and _is_journal_append(call)):
+            continue
+        for arg in [*call.args, *call.keywords]:
+            for lineno, what in infer.formatting_sites(arg):
+                out.append(Violation(
+                    rule="journal-append-site",
+                    message=f"journal append site: {what} — pass "
+                            "precomputed values only",
+                    path=str(mod.path.relative_to(config.root)),
+                    lineno=lineno, chain=[], effect="FORMAT", detail=what,
+                ))
+    return out
+
+
+def flightrec_append_rule(project: Project,
+                          config: Config) -> "list[Violation]":
+    if config.flightrec_append is None:
+        return []
+    mod_name, cls, method = config.flightrec_append
+    qname = f"{mod_name}.{cls}.{method}"
+    fn = project.functions.get(qname)
+    mod = project.modules.get(mod_name)
+    if fn is None or fn.node is None or mod is None:
+        return [Violation(
+            rule="flightrec-append",
+            message=f"{qname} not found (a rename must break this lint "
+                    "loudly, not silently lint nothing — update the "
+                    "meshlint config)",
+            path=str(mod.path.relative_to(config.root)) if mod else mod_name,
+            lineno=0, chain=[], effect="MISSING", detail=qname,
+        )]
+    out: list[Violation] = []
+    for lineno, what in infer.formatting_sites(fn.node):
+        out.append(Violation(
+            rule="flightrec-append",
+            message=f"{cls}.{method}: {what} — the O(1) lock-free append "
+                    "promise is why the journal may stay on in production",
+            path=str(mod.path.relative_to(config.root)), lineno=lineno,
+            chain=[], effect="FORMAT", detail=what,
+        ))
+    for site in fn.effects:
+        if site.kind in (infer.LOG, infer.WALLCLOCK) and not site.waived:
+            out.append(Violation(
+                rule="flightrec-append",
+                message=f"{cls}.{method}: {site.detail} — no logging or "
+                        "wall-clock syscalls in the append body",
+                path=str(mod.path.relative_to(config.root)),
+                lineno=site.lineno, chain=[], effect=site.kind,
+                detail=site.detail,
+            ))
+    return out
+
+
+# ------------------------------------------------------ loud-miss floor
+
+def coverage_rule(project: Project, config: Config) -> "list[Violation]":
+    out: list[Violation] = []
+    for req in config.required_roots:
+        count = sum(
+            1 for fn in project.functions.values()
+            if fn.module.startswith(req.module_prefix)
+            and req.marker in fn.markers
+        )
+        if count < req.min_count:
+            out.append(Violation(
+                rule="root-coverage",
+                message=(
+                    f"only {count} @{req.marker} roots under "
+                    f"{req.module_prefix} (need >= {req.min_count}): "
+                    f"{req.hint} — decorator coverage dropped, or the "
+                    "module moved out of the scan"
+                ),
+                path=req.module_prefix, lineno=0, chain=[],
+                effect="COVERAGE", detail=req.marker,
+            ))
+    return out

@@ -1,0 +1,41 @@
+"""Top-level driver: parse -> infer -> rules -> report."""
+
+from __future__ import annotations
+
+from meshlint import infer, rules
+from meshlint.callgraph import Project
+from meshlint.config import Config
+from meshlint.report import Report
+
+
+def analyze(config: Config) -> Report:
+    project = Project.build(config.root, config.scan)
+    infer.infer_effects(project)
+    violations = rules.run_rules(project, config)
+    waived = sum(
+        1
+        for fn in project.functions.values()
+        for site in fn.effects
+        if site.waived
+    ) + sum(
+        1
+        for mod in project.modules.values()
+        for site in mod.module_effects
+        if site.waived
+    )
+    stats = {
+        "modules": len(project.modules),
+        "functions": len(project.functions),
+        "edges": sum(len(f.edges) for f in project.functions.values()),
+        "roots": sum(1 for f in project.functions.values() if f.markers),
+        "hotpath": sum(1 for f in project.functions.values()
+                       if "hotpath" in f.markers),
+        "no_wallclock": sum(1 for f in project.functions.values()
+                            if "no_wallclock" in f.markers),
+        "async_defs": sum(
+            1 for f in project.functions.values()
+            if f.is_async and f.module.startswith(config.package_prefix)
+        ) if config.package_prefix else 0,
+        "waived": waived,
+    }
+    return Report(violations=violations, stats=stats)

@@ -57,6 +57,7 @@ if os.environ.get("PYTHONHASHSEED") != "0":
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+from calfkit_tpu.effects import no_wallclock  # noqa: E402
 from calfkit_tpu.fleet.registry import Replica  # noqa: E402
 from calfkit_tpu.sim import SimReport, SimRunner  # noqa: E402
 from calfkit_tpu.sim.report import strip_capture  # noqa: E402
@@ -171,6 +172,7 @@ async def run_suite(
     return report
 
 
+@no_wallclock
 def compare_to_baseline(
     report: SimReport, baseline: "dict[str, Any]"
 ) -> "list[str]":
@@ -220,6 +222,7 @@ def compare_to_baseline(
     return problems
 
 
+@no_wallclock
 def baseline_from(report: SimReport) -> "dict[str, Any]":
     scenarios: dict[str, Any] = {}
     for scenario in report.scenarios:
