@@ -32,6 +32,7 @@ def _register() -> None:
         ("calfkit_tpu.cli.obs", "fleet_command"),
         ("calfkit_tpu.cli.obs", "leases_command"),
         ("calfkit_tpu.cli.obs", "timeline_command"),
+        ("calfkit_tpu.cli.obs", "slo_command"),
         ("calfkit_tpu.cli.sim", "sim_command"),
     ):
         if find_spec(module_name) is None:

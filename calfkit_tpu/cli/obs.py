@@ -1,5 +1,5 @@
-"""``ck trace`` / ``ck stats`` / ``ck fleet`` / ``ck timeline`` — the
-operator surface.
+"""``ck trace`` / ``ck stats`` / ``ck fleet`` / ``ck timeline`` /
+``ck run`` / ``ck slo`` — the operator surface.
 
 ``ck trace <correlation-id>`` reads the compacted ``mesh.traces`` topic
 and prints the run's per-hop waterfall (trace_id equals the correlation
@@ -15,10 +15,18 @@ is answerable from the operator's chair.
 lifecycle — admission → waves → spec/overlap dispatches → retirement →
 frees — from an engine flight-recorder dump (same correlation id as the
 trace, so a fault report's id works for both commands).
+``ck run <run-id>`` (ISSUE 17) stitches ONE logical run's attempts —
+every retry/failover/hedge/resume placement recorded on the compacted
+``mesh.runs`` table — into a single run-level waterfall, joining each
+attempt's spans (``mesh.traces``) and flight-recorder events across
+replicas: the view ``ck trace``/``ck timeline`` cannot produce, because
+each attempt carries its own correlation id.  ``ck slo`` prints the
+per-agent windowed run-level SLO rollups from ``mesh.slo``.
 
 Rendering is split into pure functions (``render_waterfall`` /
-``render_stats_table`` / ``render_fleet_table`` / ``render_timeline``)
-so tests cover the formatting without a mesh.
+``render_stats_table`` / ``render_fleet_table`` / ``render_timeline`` /
+``render_run_timeline`` / ``render_slo_table``) so tests cover the
+formatting without a mesh.
 """
 
 from __future__ import annotations
@@ -36,6 +44,8 @@ from calfkit_tpu.fleet.registry import DEFAULT_STALE_AFTER
 from calfkit_tpu.models.records import (
     ControlPlaneRecord,
     EngineStatsRecord,
+    RunRecord,
+    SloRollupRecord,
     SpanRecord,
 )
 
@@ -105,7 +115,7 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
             "ACTIVE", "SLOTS",
             "DECODED", "TTFT P50/P99 MS", "GAP P99 MS", "WASTE",
             "SHED", "EXPIRED", "CANCELS", "ORPHANS", "FAILOVER/HEDGE",
-            "WEDGE", "FREC APP/DROP",
+            "RUNS/ATT", "WEDGE", "FREC APP/DROP",
         )
     ]
     for r in records:
@@ -145,6 +155,14 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
         # while tripped (requests are being faulted retriable), else
         # lifetime trips (requests faulted in parentheses)
         recovery = f"{r.failover_requests}/{r.hedge_requests}"
+        # run-scoped observability (ISSUE 17): run-level arrivals vs
+        # every linked placement, counted from the x-mesh-run header —
+        # ATT exceeding RUNS is the attempt amplification failover and
+        # hedging add on this replica ("-" = no linked arrivals yet)
+        runs_att = (
+            f"{r.run_requests}/{r.attempt_requests}"
+            if r.attempt_requests else "-"
+        )
         wedge = (
             "WEDGED!" if r.wedged
             else f"{r.watchdog_trips}({r.watchdog_faulted})"
@@ -192,6 +210,7 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
                 # reclaimed instead of burning TPU time to its deadline
                 str(r.orphaned_requests),
                 recovery,
+                runs_att,
                 wedge,
                 frec,
             )
@@ -546,3 +565,276 @@ def timeline_command(correlation_id: str, dump_path: str | None) -> None:
             "FREC APP/DROP column of `ck stats`)"
         )
     click.echo(render_timeline(selected, correlation_id))
+
+
+# --------------------------------------------------- run timeline (ISSUE 17)
+def _parse_run_record(
+    items: "dict[str, bytes]", run_id: str
+) -> "RunRecord | None":
+    value = items.get(run_id)
+    if value is None:
+        return None
+    try:
+        return RunRecord.from_wire(value)
+    except Exception:  # noqa: BLE001 - undecodable record = not found
+        return None
+
+
+def _parse_run_spans(
+    items: "dict[str, bytes]", correlation_ids: "Iterable[str]"
+) -> "list[SpanRecord]":
+    """Every span belonging to ANY of the run's attempts (span keys are
+    ``<trace_id>/<span_id>`` and trace_id == the attempt's correlation
+    id by client convention — the stitch needs no other join)."""
+    wanted = set(correlation_ids)
+    spans: "list[SpanRecord]" = []
+    for key, value in items.items():
+        if key.partition("/")[0] not in wanted:
+            continue
+        try:
+            spans.append(SpanRecord.from_wire(value))
+        except Exception:  # noqa: BLE001 - skip undecodable, keep the rest
+            continue
+    return spans
+
+
+def render_run_timeline(
+    record: "RunRecord",
+    spans: "list[SpanRecord]",
+    flight_events: "dict[str, list[dict]] | None" = None,
+) -> str:
+    """The stitched run-level waterfall (ISSUE 17): one timeline joining
+    every attempt's spans and (where a dump is available) flight-recorder
+    events, all positioned on the RUN's wall-clock window — so a
+    failover reads as attempt 0's bar ending where attempt 1's begins,
+    across replicas.  Pure: tests cover it without a mesh."""
+    flight_events = flight_events or {}
+    by_corr: "dict[str, list[SpanRecord]]" = {}
+    for s in spans:
+        by_corr.setdefault(s.trace_id, []).append(s)
+    starts = [s.start_s for s in spans]
+    ends = [s.start_s + s.duration_ms / 1000.0 for s in spans]
+    if record.started_at:
+        starts.append(record.started_at)
+    if record.finished_at:
+        ends.append(record.finished_at)
+    for rows in flight_events.values():
+        starts.extend(e.get("t_s", 0.0) for e in rows)
+        ends.extend(e.get("t_s", 0.0) for e in rows)
+    t0 = min(starts) if starts else 0.0
+    t1 = max(ends) if ends else t0
+    total_ms = max((t1 - t0) * 1000.0, 0.001)
+    recovery = "".join(
+        f", {n} {label}(s)"
+        for n, label in (
+            (record.failovers, "failover"),
+            (record.hedges, "hedge"),
+            (record.resumes, "resume"),
+            (record.sheds, "shed"),
+        )
+        if n
+    )
+    lines = [
+        f"run {record.run_id}  —  agent {record.agent or '?'}, "
+        f"outcome {record.outcome}"
+        + (f" ({record.error_type})" if record.error_type else "")
+        + f", {len(record.attempts)} attempt(s)"
+        + recovery
+        + (
+            f", {record.tokens_delivered} tokens"
+            if record.tokens_delivered else ""
+        )
+        + f", {total_ms:.1f} ms end-to-end"
+    ]
+    for attempt in sorted(record.attempts, key=lambda a: a.attempt_no):
+        off_ms = (
+            max(0.0, (attempt.started_at - t0) * 1000.0)
+            if attempt.started_at else 0.0
+        )
+        outcome = attempt.outcome + (
+            f"({attempt.error_type})" if attempt.error_type else ""
+        )
+        lines.append(
+            f"  attempt {attempt.attempt_no} [{attempt.kind}]  "
+            f"corr {attempt.correlation_id[:12] or '?'}  "
+            f"placement {attempt.placement or 'shared'}  "
+            f"{outcome}  +{off_ms:.1f}ms"
+            + (
+                f"  {attempt.tokens_delivered} tok"
+                if attempt.tokens_delivered else ""
+            )
+        )
+        attempt_spans = by_corr.get(attempt.correlation_id, [])
+        by_id = {s.span_id: s for s in attempt_spans}
+        for span in sorted(
+            attempt_spans, key=lambda s: (s.start_s, s.span_id)
+        ):
+            offset_ms = (span.start_s - t0) * 1000.0
+            left = max(0, min(
+                int(offset_ms / total_ms * _BAR_WIDTH), _BAR_WIDTH - 1
+            ))
+            width = max(
+                1,
+                int((offset_ms + span.duration_ms) / total_ms * _BAR_WIDTH)
+                - left,
+            )
+            bar = " " * left + "#" * min(width, _BAR_WIDTH - left)
+            indent = "  " * _depth_of(span, by_id)
+            flag = "" if span.status == "ok" else f"  !{span.status}"
+            lines.append(
+                f"  {offset_ms:9.1f}ms  [{bar:<{_BAR_WIDTH}}] "
+                f"{span.duration_ms:9.1f}ms  {indent}{span.name}"
+                f"  ({span.emitter or span.kind}){flag}"
+            )
+        for e in flight_events.get(attempt.correlation_id, []):
+            ev_off = (e.get("t_s", t0) - t0) * 1000.0
+            lines.append(
+                f"  {ev_off:9.1f}ms  [{'':<{_BAR_WIDTH}}] "
+                f"{'':>9}    · flightrec {e.get('event', '?')}"
+            )
+    return "\n".join(lines)
+
+
+def show_run_timeline(
+    run_id: str,
+    mesh_url: "str | None",
+    timeout: float,
+    dump_path: "str | None" = None,
+) -> None:
+    """The body of ``ck run <run-id>`` — dispatched from
+    :mod:`calfkit_tpu.cli.run` when the single argument is id-shaped
+    (32 hex chars; node specs always carry ``:`` / ``.py`` / dots).
+
+    Reads the run's record off ``mesh.runs``, every attempt's spans off
+    ``mesh.traces``, and joins flight-recorder events from the newest
+    local dump (or ``--dump``) where one exists — the flightrec join is
+    strictly best-effort: no dump, no engine events, timeline still
+    renders."""
+    from calfkit_tpu.observability import flightrec
+
+    async def read_tables() -> "tuple[RunRecord, list[SpanRecord]]":
+        mesh = resolve_mesh_for_cli(mesh_url, hosts_worker=False)
+        await mesh.start()
+        try:
+            reader = mesh.table_reader(protocol.RUNS_TOPIC)
+            await reader.start(timeout=timeout)
+            await reader.barrier(timeout=timeout)
+            record = _parse_run_record(reader.items(), run_id)
+            await reader.stop()
+            if record is None:
+                raise click.ClickException(
+                    f"no run record for {run_id!r} on "
+                    f"{protocol.RUNS_TOPIC} (run still in flight, aged "
+                    "out of compaction, or served by a pre-run-ledger "
+                    "client?)"
+                )
+            treader = mesh.table_reader(protocol.TRACES_TOPIC)
+            await treader.start(timeout=timeout)
+            await treader.barrier(timeout=timeout)
+            spans = _parse_run_spans(
+                treader.items(),
+                [a.correlation_id for a in record.attempts],
+            )
+            await treader.stop()
+        finally:
+            await mesh.stop()
+        return record, spans
+
+    record, spans = asyncio.run(read_tables())
+    # the flightrec join is a local-disk read — it runs OUTSIDE the
+    # event loop, and strictly best-effort: no dump, no engine events,
+    # the timeline still renders
+    flight: "dict[str, list[dict]]" = {}
+    path = dump_path or _newest_dump(flightrec.default_dump_dir())
+    if path is not None:
+        try:
+            with open(path) as f:
+                events = flightrec.parse_dump(f)
+            for a in record.attempts:
+                own = [
+                    e
+                    for e in flightrec.timeline_events(
+                        events, a.correlation_id
+                    )
+                    if e.get("corr") == a.correlation_id
+                ]
+                if own:
+                    flight[a.correlation_id] = own
+        except OSError:
+            pass
+    click.echo(render_run_timeline(record, spans, flight))
+
+
+# ------------------------------------------------------------ slo (ISSUE 17)
+def _parse_slo(items: "dict[str, bytes]") -> "list[SloRollupRecord]":
+    out: "list[SloRollupRecord]" = []
+    for value in items.values():
+        try:
+            wrapped = ControlPlaneRecord.from_wire(value)
+            out.append(SloRollupRecord.model_validate(wrapped.record))
+        except Exception:  # noqa: BLE001 - skip undecodable records
+            continue
+    return sorted(out, key=lambda r: (r.agent, r.node_id))
+
+
+def render_slo_table(records: "Iterable[SloRollupRecord]") -> str:
+    """The fleet SLO view (ISSUE 17): one row per per-agent rollup
+    advert — RUN-level numbers (what callers experienced), with the
+    attempt amplification failover/hedge adds shown separately.  BURN is
+    the window's error-budget burn: observed failure ratio over the
+    allowed ratio for the completion objective (>1 = burning ahead of
+    budget)."""
+    rows = [
+        (
+            "AGENT", "NODE", "WINDOW S", "RUNS", "OK", "RATIO",
+            "P50/P95/P99 S", "ATT AMP", "SHED", "FAILOVER", "ORPHAN",
+            "BURN",
+        )
+    ]
+    for r in records:
+        rows.append(
+            (
+                r.agent,
+                r.node_id or "-",
+                f"{r.window_s:.0f}",
+                str(r.runs),
+                str(r.completed),
+                f"{r.completion_ratio:.4f}",
+                f"{r.e2e_p50_s:.2f}/{r.e2e_p95_s:.2f}/{r.e2e_p99_s:.2f}",
+                f"{r.attempt_amplification:.2f}",
+                f"{r.shed_rate:.3f}",
+                f"{r.failover_rate:.3f}",
+                f"{r.orphan_rate:.3f}",
+                f"{r.error_budget_burn:.2f}",
+            )
+        )
+    if len(rows) == 1:
+        return (
+            "no SLO rollups (no worker with an agent is publishing, or "
+            "no finished runs have been folded yet)"
+        )
+    return _format_table(rows)
+
+
+@click.command(
+    "slo",
+    help="print per-agent run-level SLO rollups: completion ratio, "
+    "end-to-end percentiles, shed/failover/orphan rates, budget burn",
+)
+@click.option("--mesh", "mesh_url", default=None, help="mesh url (or $CALFKIT_MESH_URL)")
+@click.option("--timeout", default=15.0, show_default=True, help="catch-up timeout (s)")
+def slo_command(mesh_url: "str | None", timeout: float) -> None:
+    async def main() -> None:
+        mesh = resolve_mesh_for_cli(mesh_url, hosts_worker=False)
+        await mesh.start()
+        try:
+            reader = mesh.table_reader(protocol.SLO_TOPIC)
+            await reader.start(timeout=timeout)
+            await reader.barrier(timeout=timeout)
+            records = _parse_slo(reader.items())
+            await reader.stop()
+        finally:
+            await mesh.stop()
+        click.echo(render_slo_table(records))
+
+    asyncio.run(main())

@@ -1,4 +1,10 @@
-"""``ck run module:attr ...`` — serve nodes (reference: cli/run.py:37)."""
+"""``ck run module:attr ...`` — serve nodes (reference: cli/run.py:37).
+
+``ck run <run-id>`` (ISSUE 17) — when the single argument is id-shaped
+(hex run id, which node specs can never be: specs carry ``:`` or a
+path) the command instead prints the run's stitched cross-attempt
+timeline from ``mesh.runs`` + ``mesh.traces``.
+"""
 
 from __future__ import annotations
 
@@ -7,16 +13,45 @@ import click
 from calfkit_tpu.cli._common import load_nodes, resolve_mesh_for_cli
 
 
+def _is_run_id(spec: str) -> bool:
+    """True when a spec can only be a run id (32-hex ``new_id()``), never
+    a servable node spec.  Specs are ``module:attr`` or ``path.py:attr``
+    — always carrying ``:``, a dot, or a path separator — so a bare hex
+    token was previously a guaranteed load error, making this dispatch
+    regression-free."""
+    if len(spec) < 12 or any(c in spec for c in ":/.\\"):
+        return False
+    try:
+        int(spec, 16)
+    except ValueError:
+        return False
+    return True
+
+
 @click.command("run")
 @click.argument("specs", nargs=-1, required=True)
 @click.option("--mesh", "mesh_url", default=None, help="memory:// | tcp://host:port | kafka://host:port")
 @click.option("--max-workers", default=8, show_default=True)
 @click.option("--group-id", default=None, help="override per-node consumer groups")
+@click.option("--timeout", default=15.0, show_default=True,
+              help="catch-up timeout (s) for the run-timeline view")
+@click.option("--dump", "dump_path", default=None, type=click.Path(),
+              help="flight-recorder dump to join into the run timeline "
+              "(default: newest local dump)")
 @click.option("--reload", "reload_", is_flag=True,
               help="restart when watched .py files change (dev loop)")
 def run_command(specs: tuple[str, ...], mesh_url: str | None, max_workers: int,
-                group_id: str | None, reload_: bool) -> None:
-    """Serve the given nodes until interrupted."""
+                group_id: str | None, timeout: float,
+                dump_path: str | None, reload_: bool) -> None:
+    """Serve the given nodes until interrupted — or, given a single run
+    id, print that run's stitched cross-attempt timeline."""
+    if len(specs) == 1 and _is_run_id(specs[0]):
+        from calfkit_tpu.cli.obs import show_run_timeline
+
+        show_run_timeline(
+            specs[0], mesh_url, timeout, dump_path=dump_path
+        )
+        return
     if reload_:
         from calfkit_tpu.cli._reload import (
             reload_child_argv,

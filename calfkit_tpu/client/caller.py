@@ -46,7 +46,8 @@ from calfkit_tpu.models.session_context import (
 )
 from calfkit_tpu.models.state import State
 from calfkit_tpu.client.events import EventStream
-from calfkit_tpu.client.hub import Hub, InvocationHandle
+from calfkit_tpu.client.hub import Hub, InvocationHandle, RunCompleted
+from calfkit_tpu.observability.runledger import RunLedger, publish_runs_soon
 
 logger = logging.getLogger(__name__)
 
@@ -158,6 +159,13 @@ class Client:
         self._start_lock: asyncio.Lock | None = None
         self._mesh_view: Any = None
         self._span_tasks: set[asyncio.Task] = set()  # in-flight span exports
+        # run-scoped observability (ISSUE 17): the per-run attempt ledger
+        # — every start() placement records here under its run id, the
+        # execute()/stream() supervisors close runs with caller-visible
+        # outcomes, and closed runs export fire-and-forget to the
+        # compacted ``mesh.runs`` table (key = run_id)
+        self.run_ledger = RunLedger()
+        self._run_tasks: set[asyncio.Task] = set()  # in-flight run exports
         # in-flight fire-and-forget cancel publishes (hub._cancel_soon):
         # close() drains these too, or a caller exiting right after a
         # ClientTimeoutError would silently drop the mesh cancel
@@ -206,6 +214,12 @@ class Client:
                 return
             await self.mesh.start()
             await self.mesh.ensure_topics([self.inbox_topic])
+            # run-record export (ISSUE 17): compacted by run id so the
+            # latest (finished) record per run survives for `ck run` /
+            # the worker-side SLO fold
+            await self.mesh.ensure_topics(
+                [protocol.RUNS_TOPIC], compacted=True
+            )
             if self._lease_id is not None:
                 await self.mesh.ensure_topics(
                     [protocol.CALLER_LIVENESS_TOPIC], compacted=True
@@ -267,6 +281,115 @@ class Client:
         fire-and-forget handle) retires the run from the outstanding
         set — the beat loop goes quiet once the set empties."""
         self._lease_runs.pop(correlation_id, None)
+
+    # ------------------------------------------- run ledger (ISSUE 17)
+    # One run id per logical execute()/stream() call, minted once and
+    # carried verbatim across every retry/failover/hedge/resume
+    # placement.  The ledger is telemetry: every fold here is fail-open
+    # and first-signal-wins, and a lost export degrades to client-local
+    # ``handle.run_report()`` visibility only.
+
+    def _record_attempt_terminal(
+        self,
+        run_id: str,
+        correlation_id: str,
+        fut: "asyncio.Future",
+        *,
+        finish: bool = False,
+    ) -> None:
+        """Terminal-future hook: fold one attempt's terminal into the
+        ledger.  Typed mapping: return → ok; ``mesh.overloaded`` → shed;
+        ``mesh.cancelled``/``mesh.orphaned`` → cancelled; any other
+        fault → fault (with its error type).
+
+        ``finish=True`` means no supervisor owns this run (a bare
+        ``start()``/``send()`` minted the id itself): the attempt's
+        terminal IS the run's terminal, so close the run and export it
+        — otherwise an un-supervised run would sit ``pending`` forever
+        and never reach ``mesh.runs``."""
+        if fut.cancelled():
+            return
+        terminal = fut.result()
+        now = cancellation.wall_clock()
+        if isinstance(terminal, RunCompleted):
+            self.run_ledger.note_outcome(
+                run_id, correlation_id, outcome="ok", finished_at=now
+            )
+            if finish:
+                self._finish_run_soon(run_id, outcome="ok")
+            return
+        error_type = str(getattr(terminal.report, "error_type", "") or "")
+        if error_type == FaultTypes.OVERLOADED:
+            outcome = "shed"
+        elif error_type in (FaultTypes.CANCELLED, FaultTypes.ORPHANED):
+            outcome = "cancelled"
+        else:
+            outcome = "fault"
+        self.run_ledger.note_outcome(
+            run_id,
+            correlation_id,
+            outcome=outcome,
+            error_type=error_type,
+            finished_at=now,
+        )
+        if finish:
+            self._finish_run_soon(
+                run_id, outcome=outcome, error_type=error_type
+            )
+
+    def _note_attempt_superseded(
+        self, run_id: "str | None", handle: Any, reason: str
+    ) -> None:
+        """Supervisor verdict: this placement was abandoned (dead
+        replica, losing hedge) — its terminal may never arrive, so the
+        supervisor records the outcome itself.  First-signal-wins in the
+        ledger: if a real terminal already landed, this drops."""
+        if not run_id:
+            return
+        self.run_ledger.note_outcome(
+            run_id,
+            handle.correlation_id,
+            outcome="superseded",
+            error_type=reason,
+            finished_at=cancellation.wall_clock(),
+        )
+
+    def _finish_run_soon(
+        self, run_id: "str | None", *, outcome: str, error_type: str = ""
+    ) -> None:
+        """Close the run with its CALLER-visible outcome and export the
+        record to ``mesh.runs`` fire-and-forget (the span-export
+        pattern: close() drains stragglers briefly)."""
+        if not run_id:
+            return
+        self.run_ledger.finish_run(
+            run_id,
+            outcome=outcome,
+            error_type=error_type,
+            finished_at=cancellation.wall_clock(),
+        )
+        record = self.run_ledger.export_record(run_id)
+        if record is not None:
+            publish_runs_soon(self.mesh.publish, [record], self._run_tasks)
+
+    def _finish_run_exc(
+        self, run_id: "str | None", exc: BaseException
+    ) -> None:
+        """Close the run from the exception surfacing to the caller."""
+        if isinstance(exc, ClientTimeoutError):
+            self._finish_run_soon(run_id, outcome="timeout")
+        elif isinstance(exc, (asyncio.CancelledError, GeneratorExit)):
+            self._finish_run_soon(run_id, outcome="cancelled")
+        elif isinstance(exc, NodeFaultError):
+            self._finish_run_soon(
+                run_id,
+                outcome="fault",
+                error_type=str(exc.report.error_type or ""),
+            )
+        else:
+            self._finish_run_soon(
+                run_id, outcome="fault", error_type=type(exc).__name__
+            )
 
     def _prune_lease_runs(self) -> None:
         """Drop runs past their prune horizon — UNLESS the caller still
@@ -331,7 +454,7 @@ class Client:
         await self._release_lease()
         pending = {
             t
-            for t in (*self._span_tasks, *self._cancel_tasks)
+            for t in (*self._span_tasks, *self._run_tasks, *self._cancel_tasks)
             if not t.done()
         }
         if pending:
@@ -424,6 +547,7 @@ class Client:
         deps: dict[str, Any],
         deadline: float | None = None,
         attempt: str | None = None,
+        run: str | None = None,
     ) -> None:
         from calfkit_tpu.observability.trace import TRACER
 
@@ -474,6 +598,14 @@ class Client:
             # failure recovery (ISSUE 9): "failover" | "hedge" — this
             # placement only, counted by the serving agent's advert
             headers[protocol.HDR_ATTEMPT] = attempt
+        if run is not None:
+            # run identity (ISSUE 17): "<run_id>:<attempt_no>", minted
+            # once per logical execute()/stream() call and carried
+            # VERBATIM across retry/failover/hedge/resume re-dispatches;
+            # forwarded by every downstream hop (unlike x-mesh-attempt).
+            # A corrupt value degrades to an un-linked run — never
+            # faults delivery (the PR 5 law)
+            headers[protocol.HDR_RUN] = run
         try:
             await self.mesh.publish(
                 target_topic,
@@ -570,6 +702,9 @@ class AgentGateway(Generic[OutputT]):
         timeout: float | None = None,
         exclude_replicas: "frozenset[str]" = frozenset(),
         mark: "str | None" = None,
+        run_id: "str | None" = None,
+        attempt_no: int = 0,
+        attempt_kind: str = "first",
     ) -> InvocationHandle[OutputT]:
         """Begin a run; returns a handle (reference: gateway.py:70).
 
@@ -585,7 +720,13 @@ class AgentGateway(Generic[OutputT]):
         ``handle.routed_replica_key`` (None = shared topic).  ``mark``
         stamps the call's ``x-mesh-attempt`` header ("failover" |
         "hedge", ISSUE 9) so the serving replica's advert counts
-        recovery arrivals."""
+        recovery arrivals.
+
+        ``run_id``/``attempt_no``/``attempt_kind`` (ISSUE 17) are the
+        run identity: minted here for a bare ``start()``/``send()``,
+        passed in by the execute()/stream() supervisors so every
+        retry/failover/hedge/resume placement lands in ONE ledger entry
+        and carries the same ``x-mesh-run`` header."""
         client = self._client
         await client._ensure_started()
         correlation_id = new_id()
@@ -603,10 +744,9 @@ class AgentGateway(Generic[OutputT]):
             parts, correlation_id, exclude_replicas
         )
         routed_replica = routed.instance_id if routed is not None else None
+        now = cancellation.wall_clock()
         deadline = (
-            cancellation.wall_clock() + effective_timeout
-            if effective_timeout is not None
-            else None
+            now + effective_timeout if effective_timeout is not None else None
         )
 
         async def publish_cancel() -> None:
@@ -632,6 +772,37 @@ class AgentGateway(Generic[OutputT]):
         )
         handle.routed_replica = routed_replica
         handle.routed_replica_key = routed.key if routed is not None else None
+        # run ledger (ISSUE 17): record the attempt BEFORE publish (the
+        # terminal callback below may fire the moment the reply lands),
+        # and fold its terminal in when it does — first signal wins, so
+        # a supervisor's later "superseded" verdict never clobbers a
+        # real outcome (or vice versa)
+        # a bare start()/send() owns the run it mints; execute()/stream()
+        # supervisors pass run_id in and close the run themselves
+        owns_run = run_id is None
+        run_id = run_id or new_id()
+        client.run_ledger.begin_run(
+            run_id,
+            agent=self.name,
+            client_id=client.client_id,
+            started_at=now,
+        )
+        client.run_ledger.note_attempt(
+            run_id,
+            attempt_no=attempt_no,
+            correlation_id=correlation_id,
+            kind=attempt_kind,
+            placement=routed.key if routed is not None else "",
+            agent=self.name,
+            started_at=now,
+        )
+        handle.run_id = run_id
+        handle._run_ledger = client.run_ledger
+        channel.terminal.add_done_callback(
+            lambda f, r=run_id, c=correlation_id, fin=owns_run: (
+                client._record_attempt_terminal(r, c, f, finish=fin)
+            )
+        )
         router = client.router if routed is not None else None
         if router is not None:
             # least-request accounting, keyed by the FULL replica key
@@ -657,6 +828,7 @@ class AgentGateway(Generic[OutputT]):
                 deps=deps or {},
                 deadline=deadline,
                 attempt=mark,
+                run=protocol.format_run(run_id, attempt_no),
             )
         except BaseException:
             # the call never reached the mesh: no terminal will resolve,
@@ -719,46 +891,68 @@ class AgentGateway(Generic[OutputT]):
         terminal wins, the loser is cancelled."""
         policy = retry if retry is not None else self._client.retry
         fo = failover if failover is not None else self._client.failover
-        if fo is not None and self._client.router is not None:
-            return await self._execute_failover(
-                prompt,
-                message_history=message_history,
-                deps=deps,
-                route=route,
-                timeout=timeout,
-                policy=policy,
-                failover=fo,
-            )
+        client = self._client
+        # run identity (ISSUE 17): ONE run id for the whole logical call
+        # — every retry/failover/hedge placement below records into the
+        # same ledger entry and carries the same x-mesh-run header
+        run_id = new_id()
+        if fo is not None and client.router is not None:
+            try:
+                result = await self._execute_failover(
+                    prompt,
+                    message_history=message_history,
+                    deps=deps,
+                    route=route,
+                    timeout=timeout,
+                    policy=policy,
+                    failover=fo,
+                    run_id=run_id,
+                )
+            except BaseException as exc:
+                client._finish_run_exc(run_id, exc)
+                raise
+            client._finish_run_soon(run_id, outcome="ok")
+            return result
         attempts = policy.attempts if policy is not None else 1
         last: BaseException | None = None
         shed_sources: set[str] = set()
-        for attempt in range(max(1, attempts)):
-            if attempt:
-                await asyncio.sleep(policy.delay(attempt - 1))
-            handle = await self.start(
-                prompt,
-                message_history=message_history,
-                deps=deps,
-                route=route,
-                timeout=timeout,
-                exclude_replicas=frozenset(shed_sources),
-            )
-            try:
-                return await handle.result()
-            except NodeFaultError as exc:
-                if policy is None or not RetryPolicy.retriable(exc):
-                    raise
-                last = exc
-                if handle.routed_replica is not None:
-                    # EVERY retriable fault excludes the replica that
-                    # produced it, not just sheds: a hung replica
-                    # faulting mesh.timeout would otherwise be re-picked
-                    # deterministically (affinity re-homes there;
-                    # fail-fast keeps it the least-loaded minimum) while
-                    # a healthy replica sits idle
-                    shed_sources.add(handle.routed_replica)
-        assert last is not None
-        raise last
+        try:
+            for attempt in range(max(1, attempts)):
+                if attempt:
+                    await asyncio.sleep(policy.delay(attempt - 1))
+                handle = await self.start(
+                    prompt,
+                    message_history=message_history,
+                    deps=deps,
+                    route=route,
+                    timeout=timeout,
+                    exclude_replicas=frozenset(shed_sources),
+                    run_id=run_id,
+                    attempt_no=attempt,
+                    attempt_kind="first" if attempt == 0 else "retry",
+                )
+                try:
+                    result = await handle.result()
+                except NodeFaultError as exc:
+                    if policy is None or not RetryPolicy.retriable(exc):
+                        raise
+                    last = exc
+                    if handle.routed_replica is not None:
+                        # EVERY retriable fault excludes the replica that
+                        # produced it, not just sheds: a hung replica
+                        # faulting mesh.timeout would otherwise be re-picked
+                        # deterministically (affinity re-homes there;
+                        # fail-fast keeps it the least-loaded minimum) while
+                        # a healthy replica sits idle
+                        shed_sources.add(handle.routed_replica)
+                    continue
+                client._finish_run_soon(run_id, outcome="ok")
+                return result
+            assert last is not None
+            raise last
+        except BaseException as exc:
+            client._finish_run_exc(run_id, exc)
+            raise
 
     # ================================================== failure recovery
     # (ISSUE 9; laws in calfkit_tpu/fleet/failover.py, docs/robustness.md
@@ -845,6 +1039,7 @@ class AgentGateway(Generic[OutputT]):
         timeout: float | None,
         policy: "RetryPolicy | None",
         failover: "FailoverPolicy",
+        run_id: "str | None" = None,
     ) -> InvocationResult[OutputT]:
         """The supervised execute: one absolute budget, N placements.
 
@@ -867,6 +1062,8 @@ class AgentGateway(Generic[OutputT]):
         failovers = 0
         fault_attempts = 1  # terminals consumed (the original counts)
         max_fault_attempts = max(1, policy.attempts) if policy else 1
+        run_id = run_id or new_id()
+        attempt_no = 0  # ledger attempt counter (every placement)
 
         def remaining() -> "float | None":
             if deadline is None:
@@ -895,7 +1092,15 @@ class AgentGateway(Generic[OutputT]):
                     probe_interval=failover.probe_interval,
                     remaining=remaining,
                 )
-            return await self.start(
+            nonlocal attempt_no
+            # the ledger marker: the wire mark where one exists
+            # ("failover"/"hedge"), else first vs plain-retry
+            kind = (
+                mark
+                if mark is not None
+                else ("first" if attempt_no == 0 else "retry")
+            )
+            handle = await self.start(
                 prompt,
                 message_history=message_history,
                 deps=deps,
@@ -903,7 +1108,12 @@ class AgentGateway(Generic[OutputT]):
                 timeout=remaining(),
                 exclude_replicas=frozenset(exclude | set(extra_exclude)),
                 mark=mark,
+                run_id=run_id,
+                attempt_no=attempt_no,
+                attempt_kind=kind,
             )
+            attempt_no += 1
+            return handle
 
         primary = await dispatch(None)
         dispatched_at = cancellation.wall_clock()
@@ -922,6 +1132,9 @@ class AgentGateway(Generic[OutputT]):
                     if policy is None or not RetryPolicy.retriable(exc):
                         if loser is not None and loser is not winner:
                             await loser.cancel()
+                            client._note_attempt_superseded(
+                                run_id, loser, "hedge_lost"
+                            )
                         raise
                     if winner.routed_replica is not None:
                         exclude.add(winner.routed_replica)
@@ -943,6 +1156,9 @@ class AgentGateway(Generic[OutputT]):
                     # the ordinary cancel propagation (tombstone included
                     # — a zombie cannot execute the losing correlation)
                     await loser.cancel()
+                    client._note_attempt_superseded(
+                        run_id, loser, "hedge_lost"
+                    )
                 return result
 
             # ---- quiet probe tick: budget, then placement health
@@ -955,12 +1171,18 @@ class AgentGateway(Generic[OutputT]):
                     f"({failovers} failover(s) attempted)"
                 )
             if hedge is not None and hedge.routed_replica_key is not None:
-                if router.placement_verdict(hedge.routed_replica_key) != "alive":
+                hedge_verdict = router.placement_verdict(
+                    hedge.routed_replica_key
+                )
+                if hedge_verdict != "alive":
                     # a dead hedge is simply dropped (and its correlation
                     # tombstoned) — the primary is still supervised
                     if hedge.routed_replica is not None:
                         exclude.add(hedge.routed_replica)
                     await hedge.cancel()
+                    client._note_attempt_superseded(
+                        run_id, hedge, hedge_verdict
+                    )
                     # uncharge the corpse NOW: its terminal can never
                     # arrive, so the done-callback that normally clears
                     # the router's least-request entry never fires — the
@@ -980,6 +1202,9 @@ class AgentGateway(Generic[OutputT]):
                     if primary.routed_replica is not None:
                         exclude.add(primary.routed_replica)
                     await primary.cancel()
+                    client._note_attempt_superseded(
+                        run_id, primary, verdict
+                    )
                     # uncharge the corpse (see the dead-hedge branch):
                     # no terminal will ever clear this entry, and a
                     # healed replica must not carry phantom load.
@@ -1067,12 +1292,23 @@ class AgentGateway(Generic[OutputT]):
         client = self._client
         fo = failover if failover is not None else client.failover
         if fo is None or client.router is None:
+            run_id = new_id()
             handle = await self.start(
                 prompt, message_history=message_history, deps=deps,
-                route=route, timeout=timeout,
+                route=route, timeout=timeout, run_id=run_id,
             )
-            async for item in handle.stream():
-                yield item
+            try:
+                async for item in handle.stream():
+                    step = getattr(item, "step", None)
+                    if step is not None and getattr(step, "kind", "") == "token":
+                        client.run_ledger.add_tokens(
+                            run_id, handle.correlation_id, 1
+                        )
+                    yield item
+            except BaseException as exc:
+                client._finish_run_exc(run_id, exc)
+                raise
+            client._finish_run_soon(run_id, outcome="ok")
             return
         from calfkit_tpu.fleet.failover import StreamLedger
 
@@ -1091,141 +1327,181 @@ class AgentGateway(Generic[OutputT]):
 
         exclude: set[str] = set()
         failovers = 0
+        # run identity (ISSUE 17): ONE run id across the original
+        # placement and every failover/resume re-dispatch below — the
+        # whole try/except boundary closes the run with the outcome the
+        # CALLER observed (ok / timeout / fault / cancelled)
+        run_id = new_id()
+        attempt_no = 0
         # decode-from-offset resume is a SINGLE-TURN contract: the hint
         # seeds the re-attempt's first model turn, so a run that already
         # dispatched tool calls (its delivered text spans turns) must
         # replay wholly instead — the ledger's cumulative law keeps the
         # stream contiguous either way
         multi_turn = False
-        handle = await self.start(
-            prompt, message_history=message_history, deps=deps,
-            route=route, timeout=effective,
-        )
-        while True:
-            dead_reason: "str | None" = None
-            pending_exc: "NodeFaultError | None" = None
-            channel = handle._channel
-            step_task: asyncio.Task = asyncio.ensure_future(
-                channel.steps.get()
-            )
-            try:
-                while dead_reason is None:
-                    rem = remaining()
-                    if rem is not None and rem <= 0:
-                        handle._cancel_soon()
-                        raise ClientTimeoutError(
-                            f"stream produced no terminal within "
-                            f"{effective}s ({failovers} failover(s))"
-                        )
-                    tick = (
-                        fo.probe_interval if rem is None
-                        else min(fo.probe_interval, rem)
-                    )
-                    done, _ = await asyncio.wait(
-                        [step_task, channel.terminal],
-                        timeout=tick,
-                        return_when=asyncio.FIRST_COMPLETED,
-                    )
-                    if step_task in done:
-                        raw = step_task.result()
-                        if getattr(raw.step, "kind", "") in (
-                            "tool_call", "tool_result", "handoff"
-                        ):
-                            multi_turn = True
-                        event = self._filter_step(raw, ledger)
-                        if event is not None:
-                            yield event
-                        step_task = asyncio.ensure_future(
-                            channel.steps.get()
-                        )
-                        continue
-                    if channel.terminal.done():
-                        while not channel.steps.empty():
-                            event = self._filter_step(
-                                channel.steps.get_nowait(), ledger
-                            )
-                            if event is not None:
-                                yield event
-                        try:
-                            yield await handle.result()
-                        except NodeFaultError as exc:
-                            if not RetryPolicy.retriable(exc):
-                                raise
-                            # a retriable fault ends THIS attempt, not
-                            # the stream: re-dispatch and resume
-                            dead_reason = (
-                                f"fault:{exc.report.error_type}"
-                            )
-                            pending_exc = exc
-                            continue
-                        return
-                    # quiet tick: probe the placement
-                    if handle.routed_replica_key is not None:
-                        verdict = router.placement_verdict(
-                            handle.routed_replica_key
-                        )
-                        if verdict != "alive":
-                            dead_reason = verdict
-            finally:
-                step_task.cancel()
-            # ---- failover re-dispatch (dead placement / retriable fault)
-            failovers += 1
-            if failovers > fo.max_failovers:
-                if pending_exc is not None:
-                    raise pending_exc
-                raise self._no_placement_fault(dead_reason or "unknown")
-            if handle.routed_replica is not None:
-                exclude.add(handle.routed_replica)
-            # tombstone the orphan BEFORE the replacement publishes: a
-            # zombie that resumes consuming faults the old correlation
-            # at its admission gate instead of executing it
-            await handle.cancel()
-            ledger.begin_attempt()
-            rem = remaining()
-            if rem is not None and rem <= 0:
-                if pending_exc is not None:
-                    raise pending_exc
-                raise ClientTimeoutError(
-                    f"stream placement died ({dead_reason}) with no "
-                    "budget left to re-dispatch"
-                )
-            if pending_exc is None:
-                # DEATH re-dispatch: never fail open to the shared topic
-                # — the shared group may still count the corpse as a
-                # member — wait for an eligible replica instead
-                await self._await_placement(
-                    frozenset(exclude),
-                    probe_interval=fo.probe_interval,
-                    remaining=remaining,
-                )
-            else:
-                # FAULT re-dispatch: the replica is alive and answering
-                # (it shed/wedged us, typed) — a brief backoff, then
-                # fail-open placement is SAFE and required: on a fleet
-                # with no alternative replica, waiting on the exclusion
-                # set would burn the whole deadline for a transient shed
-                # that the shared topic (or the same replica, recovered)
-                # can absorb in milliseconds
-                rem = remaining()
-                await asyncio.sleep(
-                    fo.probe_interval if rem is None
-                    else min(fo.probe_interval, max(rem, 0.0))
-                )
-            resume_deps = dict(deps or {})
-            if ledger.text and not multi_turn:
-                # the continuation hint: prompt + already-delivered text.
-                # The agent's first model turn CONSUMES it (decode-from-
-                # offset, ISSUE 10); multi-turn runs omit it — delivered
-                # text spanning tool-call turns would corrupt the first
-                # turn's continuation — and replay wholly instead (the
-                # dedupe ledger guarantees contiguity either way)
-                resume_deps["calfkit.resume_text"] = ledger.text
+        try:
             handle = await self.start(
-                prompt,
-                message_history=message_history,
-                deps=resume_deps,
-                route=route,
-                timeout=remaining(),
-                exclude_replicas=frozenset(exclude),
-                mark="failover",
+                prompt, message_history=message_history, deps=deps,
+                route=route, timeout=effective,
+                run_id=run_id, attempt_no=attempt_no, attempt_kind="first",
             )
+            attempt_no += 1
+            while True:
+                dead_reason: "str | None" = None
+                pending_exc: "NodeFaultError | None" = None
+                channel = handle._channel
+                step_task: asyncio.Task = asyncio.ensure_future(
+                    channel.steps.get()
+                )
+                try:
+                    while dead_reason is None:
+                        rem = remaining()
+                        if rem is not None and rem <= 0:
+                            handle._cancel_soon()
+                            raise ClientTimeoutError(
+                                f"stream produced no terminal within "
+                                f"{effective}s ({failovers} failover(s))"
+                            )
+                        tick = (
+                            fo.probe_interval if rem is None
+                            else min(fo.probe_interval, rem)
+                        )
+                        done, _ = await asyncio.wait(
+                            [step_task, channel.terminal],
+                            timeout=tick,
+                            return_when=asyncio.FIRST_COMPLETED,
+                        )
+                        if step_task in done:
+                            raw = step_task.result()
+                            if getattr(raw.step, "kind", "") in (
+                                "tool_call", "tool_result", "handoff"
+                            ):
+                                multi_turn = True
+                            event = self._filter_step(raw, ledger)
+                            if event is not None:
+                                if getattr(event.step, "kind", "") == "token":
+                                    # delivered (post-dedupe) tokens only:
+                                    # a replayed prefix never double-counts
+                                    client.run_ledger.add_tokens(
+                                        run_id, handle.correlation_id, 1
+                                    )
+                                yield event
+                            step_task = asyncio.ensure_future(
+                                channel.steps.get()
+                            )
+                            continue
+                        if channel.terminal.done():
+                            while not channel.steps.empty():
+                                event = self._filter_step(
+                                    channel.steps.get_nowait(), ledger
+                                )
+                                if event is not None:
+                                    if getattr(event.step, "kind", "") == "token":
+                                        client.run_ledger.add_tokens(
+                                            run_id, handle.correlation_id, 1
+                                        )
+                                    yield event
+                            try:
+                                final = await handle.result()
+                            except NodeFaultError as exc:
+                                if not RetryPolicy.retriable(exc):
+                                    raise
+                                # a retriable fault ends THIS attempt, not
+                                # the stream: re-dispatch and resume
+                                dead_reason = (
+                                    f"fault:{exc.report.error_type}"
+                                )
+                                pending_exc = exc
+                                continue
+                            yield final
+                            client._finish_run_soon(run_id, outcome="ok")
+                            return
+                        # quiet tick: probe the placement
+                        if handle.routed_replica_key is not None:
+                            verdict = router.placement_verdict(
+                                handle.routed_replica_key
+                            )
+                            if verdict != "alive":
+                                dead_reason = verdict
+                finally:
+                    step_task.cancel()
+                # ---- failover re-dispatch (dead placement / retriable fault)
+                failovers += 1
+                if failovers > fo.max_failovers:
+                    if pending_exc is not None:
+                        raise pending_exc
+                    raise self._no_placement_fault(dead_reason or "unknown")
+                if handle.routed_replica is not None:
+                    exclude.add(handle.routed_replica)
+                # tombstone the orphan BEFORE the replacement publishes: a
+                # zombie that resumes consuming faults the old correlation
+                # at its admission gate instead of executing it
+                await handle.cancel()
+                # ledger verdict for the abandoned attempt (first signal
+                # wins: a fault that already landed keeps its outcome)
+                client._note_attempt_superseded(
+                    run_id, handle, dead_reason or "superseded"
+                )
+                ledger.begin_attempt()
+                rem = remaining()
+                if rem is not None and rem <= 0:
+                    if pending_exc is not None:
+                        raise pending_exc
+                    raise ClientTimeoutError(
+                        f"stream placement died ({dead_reason}) with no "
+                        "budget left to re-dispatch"
+                    )
+                if pending_exc is None:
+                    # DEATH re-dispatch: never fail open to the shared topic
+                    # — the shared group may still count the corpse as a
+                    # member — wait for an eligible replica instead
+                    await self._await_placement(
+                        frozenset(exclude),
+                        probe_interval=fo.probe_interval,
+                        remaining=remaining,
+                    )
+                else:
+                    # FAULT re-dispatch: the replica is alive and answering
+                    # (it shed/wedged us, typed) — a brief backoff, then
+                    # fail-open placement is SAFE and required: on a fleet
+                    # with no alternative replica, waiting on the exclusion
+                    # set would burn the whole deadline for a transient shed
+                    # that the shared topic (or the same replica, recovered)
+                    # can absorb in milliseconds
+                    rem = remaining()
+                    await asyncio.sleep(
+                        fo.probe_interval if rem is None
+                        else min(fo.probe_interval, max(rem, 0.0))
+                    )
+                resume_deps = dict(deps or {})
+                if ledger.text and not multi_turn:
+                    # the continuation hint: prompt + already-delivered text.
+                    # The agent's first model turn CONSUMES it (decode-from-
+                    # offset, ISSUE 10); multi-turn runs omit it — delivered
+                    # text spanning tool-call turns would corrupt the first
+                    # turn's continuation — and replay wholly instead (the
+                    # dedupe ledger guarantees contiguity either way)
+                    resume_deps["calfkit.resume_text"] = ledger.text
+                handle = await self.start(
+                    prompt,
+                    message_history=message_history,
+                    deps=resume_deps,
+                    route=route,
+                    timeout=remaining(),
+                    exclude_replicas=frozenset(exclude),
+                    mark="failover",
+                    run_id=run_id,
+                    attempt_no=attempt_no,
+                    # the ledger distinguishes a decode-from-offset
+                    # resume from a whole-replay failover; the wire mark
+                    # stays "failover" (x-mesh-attempt vocabulary)
+                    attempt_kind=(
+                        "resume"
+                        if "calfkit.resume_text" in resume_deps
+                        else "failover"
+                    ),
+                )
+                attempt_no += 1
+        except BaseException as exc:
+            client._finish_run_exc(run_id, exc)
+            raise

@@ -83,6 +83,21 @@ class InvocationHandle(Generic[OutputT]):
     # placement — what the failover supervisor's dead-placement probe
     # looks up in the registry (ISSUE 9); None = shared-topic placement
     routed_replica_key: "str | None" = None
+    # run-scoped observability (ISSUE 17): the run id this placement
+    # serves under — every retry/failover/hedge/resume placement of one
+    # logical call shares it — and the client's ledger, so
+    # ``run_report()`` answers from the handle
+    run_id: "str | None" = None
+    _run_ledger: Any = None
+
+    def run_report(self) -> Any:
+        """The run-level report (:class:`~calfkit_tpu.models.records.RunRecord`)
+        for this handle's run: every attempt with its placement, marker
+        kind, and typed outcome (ISSUE 17).  None when the client's
+        ledger no longer holds the run (LRU aged out)."""
+        if self._run_ledger is None or self.run_id is None:
+            return None
+        return self._run_ledger.run_report(self.run_id)
 
     def __init__(
         self,

@@ -35,10 +35,12 @@ class _Attached:
         publisher: ControlPlanePublisher,
         views: list[ControlPlaneView[Any]],
         liveness: Any = None,  # caller-liveness feed subscription
+        runs_feed: Any = None,  # mesh.runs feed subscription (ISSUE 17)
     ):
         self._publisher = publisher
         self._views = views
         self._liveness = liveness
+        self._runs_feed = runs_feed
 
     async def stop(self) -> None:
         await self._publisher.stop()  # tombstones first
@@ -47,11 +49,15 @@ class _Attached:
                 await view.stop()
             except Exception:  # noqa: BLE001
                 logger.debug("view stop failed", exc_info=True)
-        if self._liveness is not None:
-            try:
-                await self._liveness.stop()
-            except Exception:  # noqa: BLE001
-                logger.debug("liveness feed stop failed", exc_info=True)
+        for feed, label in (
+            (self._liveness, "liveness"),
+            (self._runs_feed, "runs"),
+        ):
+            if feed is not None:
+                try:
+                    await feed.stop()
+                except Exception:  # noqa: BLE001
+                    logger.debug("%s feed stop failed", label, exc_info=True)
 
 
 async def _fold_caller_liveness(record: Any) -> None:
@@ -62,6 +68,15 @@ async def _fold_caller_liveness(record: Any) -> None:
     from calfkit_tpu import leases
 
     leases.fold_liveness_record(record.key, record.value)
+
+
+async def _fold_run_record(record: Any) -> None:
+    """The ``mesh.runs`` feed handler (ISSUE 17): fold every finished
+    run record into the process-wide window store the SLO adverts read.
+    Fail-open by construction (the store drops undecodables)."""
+    from calfkit_tpu.observability.runledger import run_window_store
+
+    run_window_store().fold(record.key, record.value)
 
 
 class ControlPlane:
@@ -80,6 +95,33 @@ class ControlPlane:
                     instance_id=node.instance_id,
                     payload=card.model_dump(),
                     payload_fn=lambda n=node: n.agent_card().model_dump(),
+                )
+            )
+
+            # fleet SLO rollup (ISSUE 17): per-agent run-level window
+            # stats, re-derived from the worker's mesh.runs fold on
+            # every heartbeat tick — the per-host→per-zone rollup shape
+            # the autoscaler consumes, published compacted to mesh.slo
+            def slo_payload(n=node, agent=card.name):
+                from calfkit_tpu import cancellation
+                from calfkit_tpu.observability.runledger import (
+                    run_window_store,
+                )
+
+                return run_window_store().rollup_for(
+                    agent,
+                    window_end=cancellation.wall_clock(),
+                    node_id=n.instance_id,
+                ).model_dump()
+
+            adverts.append(
+                Advert(
+                    topic=protocol.SLO_TOPIC,
+                    node_name=card.name,
+                    node_kind=node.kind,
+                    instance_id=node.instance_id,
+                    payload=slo_payload(),
+                    payload_fn=slo_payload,
                 )
             )
         if hasattr(node, "capability_record"):
@@ -147,6 +189,8 @@ class ControlPlane:
                     protocol.ENGINE_STATS_TOPIC,
                     protocol.TRACES_TOPIC,
                     protocol.CALLER_LIVENESS_TOPIC,
+                    protocol.RUNS_TOPIC,
+                    protocol.SLO_TOPIC,
                 ],
                 compacted=True,
             )
@@ -155,6 +199,7 @@ class ControlPlane:
         # again — a failed attach must not orphan readers.
         started: list[ControlPlaneView[Any]] = []
         liveness = None
+        runs_feed = None
         try:
             for view in (capability_view, agents_view):
                 await view.start()
@@ -167,6 +212,17 @@ class ControlPlane:
             liveness = await transport.subscribe(
                 [protocol.CALLER_LIVENESS_TOPIC],
                 _fold_caller_liveness,
+                group_id=None,
+                from_latest=False,
+                ordered=False,
+            )
+
+            # runs feed (ISSUE 17): fold finished run records into the
+            # process window store behind the per-agent SLO adverts —
+            # same one-feed-per-worker shape as the liveness fold
+            runs_feed = await transport.subscribe(
+                [protocol.RUNS_TOPIC],
+                _fold_run_record,
                 group_id=None,
                 from_latest=False,
                 ordered=False,
@@ -190,17 +246,21 @@ class ControlPlane:
                     await view.stop()
                 except Exception:  # noqa: BLE001
                     logger.debug("view rollback stop failed", exc_info=True)
-            if liveness is not None:
-                try:
-                    await liveness.stop()
-                except Exception:  # noqa: BLE001
-                    logger.debug(
-                        "liveness rollback stop failed", exc_info=True
-                    )
+            for feed in (liveness, runs_feed):
+                if feed is not None:
+                    try:
+                        await feed.stop()
+                    except Exception:  # noqa: BLE001
+                        logger.debug(
+                            "feed rollback stop failed", exc_info=True
+                        )
             raise
         logger.info(
             "control plane attached: %d adverts, views live", len(adverts)
         )
         return _Attached(
-            publisher, [capability_view, agents_view], liveness=liveness
+            publisher,
+            [capability_view, agents_view],
+            liveness=liveness,
+            runs_feed=runs_feed,
         )

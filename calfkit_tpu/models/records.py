@@ -133,6 +133,15 @@ class EngineStatsRecord(BaseModel):
     watchdog_faulted: int = 0
     failover_requests: int = 0
     hedge_requests: int = 0
+    # run-scoped observability (ISSUE 17): arrivals counted from the
+    # x-mesh-run header by the serving agent — run_requests counts
+    # first attempts (attempt_no == 0), attempt_requests counts every
+    # linked placement, so ATTEMPTS/RUNS in `ck stats` is the attempt
+    # amplification failover/hedge re-dispatches add per replica.
+    # Corrupt/missing run headers count in NEITHER (un-linked degrade).
+    # Defaults read a pre-run-ledger record as zero, not unknown.
+    run_requests: int = 0
+    attempt_requests: int = 0
     # prefix-cache health (ISSUE 7): cached pages resident plus lifetime
     # hit/reuse counters — the signal prefix-affinity routing exists to
     # improve, surfaced per replica in `ck fleet` and ROUTER.json
@@ -149,6 +158,103 @@ class EngineStatsRecord(BaseModel):
     # per-heartbeat-interval deltas (EngineStats.snapshot_and_delta), so
     # directory readers see rates, not lifetime cumulative values
     window: dict[str, Any] | None = None
+
+
+class RunAttemptRecord(BaseModel):
+    """One placement of a supervised run (ISSUE 17): which replica got
+    the call, under which correlation id (== that attempt's trace id by
+    client convention — the ``ck run`` stitch key), how it was marked
+    (first | retry | failover | hedge | resume), and how it ended."""
+
+    attempt_no: int = 0
+    correlation_id: str = ""
+    # first | retry | failover | hedge | resume
+    kind: str = "first"
+    # replica key "<agent>@<instance>" ("" = shared-topic / unrouted)
+    placement: str = ""
+    agent: str = ""
+    started_at: float = 0.0  # wall_clock seam (virtual in sim)
+    finished_at: float = 0.0  # 0.0 = never finished (superseded/killed)
+    # ok | fault | shed | cancelled | superseded | pending
+    outcome: str = "pending"
+    error_type: str = ""  # typed fault code (x-mesh-error-type) if any
+    queue_wait_s: float = 0.0
+    tokens_delivered: int = 0
+    device_time_s: float = 0.0  # from engine counters where reported
+
+
+class RunRecord(BaseModel):
+    """One logical run's ledger entry, published compacted to
+    ``mesh.runs`` (key = ``run_id``) when the supervising client
+    finishes the run.  The run-level view the per-attempt trace and
+    flight-recorder timelines cannot give: one record spans every
+    retry/failover/hedge/resume placement."""
+
+    run_id: str
+    agent: str = ""
+    client_id: str = ""
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    # ok | fault | timeout | cancelled | pending
+    outcome: str = "pending"
+    error_type: str = ""
+    attempts: "list[RunAttemptRecord]" = Field(default_factory=list)
+    sheds: int = 0
+    failovers: int = 0
+    hedges: int = 0
+    resumes: int = 0
+    tokens_delivered: int = 0
+
+    def run_key(self) -> str:
+        """Compaction key: latest record per run survives."""
+        return self.run_id
+
+    def to_wire(self) -> bytes:
+        return self.model_dump_json().encode("utf-8")
+
+    @classmethod
+    def from_wire(cls, data: bytes | str) -> "RunRecord":
+        return cls.model_validate_json(data)
+
+
+class SloRollupRecord(BaseModel):
+    """Per-agent windowed run-level SLO rollup (ISSUE 17), re-derived on
+    the control-plane heartbeat cadence from folded ``mesh.runs``
+    records and published compacted to ``mesh.slo`` (key =
+    ``<agent>@<instance>`` of the publishing worker).  Run-level, not
+    attempt-level: completion ratio and latency percentiles describe
+    what callers experienced, with failover/hedge amplification visible
+    separately."""
+
+    agent: str
+    node_id: str = ""  # publishing worker's node@instance provenance
+    window_s: float = 300.0
+    window_end: float = 0.0  # wall_clock seam (virtual in sim)
+    runs: int = 0
+    completed: int = 0
+    completion_ratio: float = 1.0
+    e2e_p50_s: float = 0.0
+    e2e_p95_s: float = 0.0
+    e2e_p99_s: float = 0.0
+    attempts: int = 0
+    attempt_amplification: float = 1.0
+    shed_rate: float = 0.0
+    failover_rate: float = 0.0
+    orphan_rate: float = 0.0
+    # fraction of the window's error budget burned: observed failure
+    # ratio / allowed failure ratio against the completion objective
+    slo_completion_target: float = 0.999
+    error_budget_burn: float = 0.0
+
+    def slo_key(self) -> str:
+        return f"{self.agent}@{self.node_id}" if self.node_id else self.agent
+
+    def to_wire(self) -> bytes:
+        return self.model_dump_json().encode("utf-8")
+
+    @classmethod
+    def from_wire(cls, data: bytes | str) -> "SloRollupRecord":
+        return cls.model_validate_json(data)
 
 
 class SpanRecord(BaseModel):

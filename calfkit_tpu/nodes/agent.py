@@ -149,6 +149,13 @@ class BaseAgentNodeDef(BaseNodeDef):
         # `ck fleet` show which replicas are absorbing recovered work
         self._failover_requests = 0
         self._hedge_requests = 0
+        # run-scoped observability (ISSUE 17): arrivals counted from the
+        # x-mesh-run header — runs (attempt_no == 0) vs every linked
+        # placement, so ATTEMPTS/RUNS in `ck stats` is the amplification
+        # failover/hedge re-dispatches add per replica.  Corrupt or
+        # missing headers count in NEITHER (un-linked degrade, PR 5 law)
+        self._run_requests = 0
+        self._attempt_requests = 0
 
     # --------------------------------------------------------- decorators
     def instructions_fn(self, fn: Callable[[NodeRunContext], str]) -> Callable:
@@ -230,6 +237,8 @@ class BaseAgentNodeDef(BaseNodeDef):
                 draining=bool(getattr(worker, "draining", False)),
                 failover_requests=self._failover_requests,
                 hedge_requests=self._hedge_requests,
+                run_requests=self._run_requests,
+                attempt_requests=self._attempt_requests,
                 **snapshot,
             ).model_dump()
         except Exception:  # noqa: BLE001 - metrics must never fault serving
@@ -286,6 +295,16 @@ class BaseAgentNodeDef(BaseNodeDef):
                 self._failover_requests += 1
             elif attempt == "hedge":
                 self._hedge_requests += 1
+            # run accounting (ISSUE 17): parse_run returns None for a
+            # corrupt/missing header — such arrivals count in neither
+            # bucket (they are un-linked, not a shared bogus run id)
+            parsed_run = protocol.parse_run(
+                ctx.headers.get(protocol.HDR_RUN)
+            )
+            if parsed_run is not None:
+                self._attempt_requests += 1
+                if parsed_run[1] == 0:
+                    self._run_requests += 1
         for _ in range(self._MAX_REJECTED_LOOPS):
             try:
                 return await self._run_one_turn(ctx)

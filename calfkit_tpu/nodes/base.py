@@ -1116,6 +1116,14 @@ class BaseNodeDef(RegistryMixin):
         incoming_lease = ctx.headers.get(protocol.HDR_LEASE)
         if incoming_lease:
             headers[protocol.HDR_LEASE] = incoming_lease
+        # run-identity propagation (ISSUE 17): forwarded VERBATIM like
+        # the deadline/lease — downstream hops serve the same logical
+        # run, so their spans stitch into its `ck run` timeline.  Note
+        # the contrast with x-mesh-attempt, which is this-placement-only
+        # and deliberately NOT forwarded
+        incoming_run = ctx.headers.get(protocol.HDR_RUN)
+        if incoming_run:
+            headers[protocol.HDR_RUN] = incoming_run
         if ctx.trace is not None:
             # downstream hops parent to THIS hop's span
             headers.update(ctx.trace.headers())

@@ -15,6 +15,10 @@ Four pieces:
 - :mod:`~calfkit_tpu.observability.http` — the optional asyncio endpoint:
   ``/metrics``, ``/healthz`` (liveness), ``/readyz`` (readiness probe),
   ``/flightrec``.
+- :mod:`~calfkit_tpu.observability.runledger` — run-scoped observability
+  (ISSUE 17): the client-side per-run attempt ledger behind
+  ``handle.run_report()`` and the compacted ``mesh.runs`` export, plus
+  the pure SLO rollup fold behind ``mesh.slo`` / ``ck slo``.
 
 Everything here is fail-open: telemetry errors never fault serving.
 """
@@ -36,9 +40,19 @@ from calfkit_tpu.observability.trace import (
 )
 from calfkit_tpu.observability.flightrec import FlightRecorder
 from calfkit_tpu.observability.http import MetricsServer
+from calfkit_tpu.observability.runledger import (
+    RunLedger,
+    RunWindowStore,
+    rollup_window,
+    run_window_store,
+)
 
 __all__ = [
     "FlightRecorder",
+    "RunLedger",
+    "RunWindowStore",
+    "rollup_window",
+    "run_window_store",
     "REGISTRY",
     "Counter",
     "Gauge",

@@ -74,6 +74,16 @@ HDR_ATTEMPT: Final = "x-mesh-attempt"
 # the server-side half of failure recovery, covering fire-and-forget
 # ``send()`` that no client-side supervisor can.
 HDR_LEASE: Final = "x-mesh-lease"
+# run identity (ISSUE 17): "<run_id>:<attempt_no>" — the run_id is minted
+# ONCE per logical ``execute()``/``stream()`` call and carried VERBATIM
+# across retries, failover re-dispatches, hedge duplicates, and
+# decode-from-offset resumes; the attempt counter beside it increments
+# per placement.  Forwarded by every hop (like the deadline and the
+# lease: downstream tool calls belong to the same logical run), unlike
+# ``x-mesh-attempt`` which describes one placement only.  A corrupt
+# header degrades to an UN-LINKED run — never a shared bogus run id,
+# never a delivery fault (the PR 5 law).
+HDR_RUN: Final = "x-mesh-run"
 
 ALL_HEADERS: Final = (
     HDR_EMITTER,
@@ -88,6 +98,7 @@ ALL_HEADERS: Final = (
     HDR_DEADLINE,
     HDR_ATTEMPT,
     HDR_LEASE,
+    HDR_RUN,
 )
 
 # --------------------------------------------------------------------------- #
@@ -173,6 +184,31 @@ def parse_lease(value: "bytes | str | None") -> "tuple[str, float] | None":
     if ttl != ttl or ttl in (float("inf"), float("-inf")) or ttl <= 0:
         return None
     return (lease_id, ttl) if lease_id else None
+
+
+def format_run(run_id: str, attempt: int) -> str:
+    """Encode run identity for the wire: ``<run_id>:<attempt_no>`` (run
+    ids are hex — never contain the separator)."""
+    return f"{run_id}:{attempt:d}"
+
+
+def parse_run(value: "bytes | str | None") -> "tuple[str, int] | None":
+    """Decode an ``x-mesh-run`` header to ``(run_id, attempt_no)``; None
+    for a missing or malformed header (a corrupt run header degrades to
+    an UN-LINKED run — never a shared bogus run id, never a delivery
+    fault)."""
+    s = decode_header_str(value)
+    if not s or ":" not in s:
+        return None
+    run_id, _, raw_attempt = s.rpartition(":")
+    # int(), not float(): "1.5", "nan", "inf" are not attempt counters
+    try:
+        attempt = int(raw_attempt)
+    except ValueError:
+        return None
+    if attempt < 0:
+        return None
+    return (run_id, attempt) if run_id else None
 
 
 def emitter_header(node_kind: str, node_name: str) -> str:
@@ -297,6 +333,15 @@ TRACES_TOPIC: Final = "mesh.traces"
 # the compact beat JSON (calfkit_tpu.leases.beat_payload); tombstone =
 # clean caller departure (outstanding leased runs orphan immediately)
 CALLER_LIVENESS_TOPIC: Final = "mesh.caller_liveness"
+# run-scoped observability (ISSUE 17): compacted per-run records (key =
+# run_id, value = RunRecord JSON — every attempt's placement/outcome/
+# markers), published by the supervising client when a run finishes, and
+# compacted per-agent SLO rollups (key = <agent>@<instance>, value =
+# SloRollupRecord JSON) re-derived on the control-plane heartbeat
+# cadence.  Like mesh.traces, run keys are one-shot: production clusters
+# should pair compaction with time retention to bound growth.
+RUNS_TOPIC: Final = "mesh.runs"
+SLO_TOPIC: Final = "mesh.slo"
 
 
 def fanout_state_topic(node_id: str) -> str:

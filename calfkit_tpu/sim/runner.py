@@ -366,6 +366,7 @@ class SimRunner:
                 kill_ledger=kill_ledger,
                 leases_minted=leases_minted[0],
                 leases_released=leases_released[0],
+                run_records=client.run_ledger.finished_records(),
             )
             await client.close()
             await router.stop()
@@ -436,6 +437,7 @@ class SimRunner:
         kill_ledger: "list[dict[str, Any]]",
         leases_minted: int,
         leases_released: int,
+        run_records: "list[Any] | None" = None,
     ) -> ScenarioReport:
         scenario = self.scenario
         served = [m.replies for m in models]
@@ -482,6 +484,48 @@ class SimRunner:
         }
         if healed:
             metrics["routing"]["delivered_after_heal"] = delivered_after_heal
+        if run_records is not None:
+            # run-level metrics off the client's run ledger (ISSUE 17),
+            # computed through the SAME pure rollup fold the SLO adverts
+            # use — the sim gates what callers experienced per RUN
+            # (virtual seconds end-to-end across every failover/retry),
+            # not per attempt.  Window = the whole scenario.
+            from calfkit_tpu.observability.runledger import rollup_window
+
+            entries = [
+                {
+                    "started_at": r.started_at,
+                    "finished_at": r.finished_at,
+                    "outcome": r.outcome,
+                    "error_type": r.error_type,
+                    "attempts": len(r.attempts),
+                    "sheds": r.sheds,
+                    "failovers": r.failovers,
+                }
+                for r in run_records
+            ]
+            rollup = rollup_window(
+                entries,
+                agent=topo.name,
+                window_end=clock.now,
+                window_s=max(clock.now - start_at, 1.0) + 1.0,
+            )
+            metrics["runs"] = {
+                "finished": rollup.runs,
+                "completed": rollup.completed,
+                "completion_ratio": round(rollup.completion_ratio, 6),
+                "e2e_p50_s": round(rollup.e2e_p50_s, 6),
+                "e2e_p95_s": round(rollup.e2e_p95_s, 6),
+                "e2e_p99_s": round(rollup.e2e_p99_s, 6),
+                "attempts": rollup.attempts,
+                "attempt_amplification": round(
+                    rollup.attempt_amplification, 6
+                ),
+                "shed_rate": round(rollup.shed_rate, 6),
+                "failover_rate": round(rollup.failover_rate, 6),
+                "orphan_rate": round(rollup.orphan_rate, 6),
+                "error_budget_burn": round(rollup.error_budget_burn, 6),
+            }
         metrics.update({
             "prefix": {
                 "lookups": prefix_lookups,

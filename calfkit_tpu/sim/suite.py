@@ -2,7 +2,7 @@
 runs on every PR and what SIM.json / SIM_BASELINE.json are captured
 from.
 
-Six geometries, each exercising a different fleet claim through the
+Seven geometries, each exercising a different fleet claim through the
 real mesh → worker → router path (see docs/simulation.md for the full
 metric definitions and the reasoning behind every bound):
 
@@ -21,6 +21,12 @@ metric definitions and the reasoning behind every bound):
   docs/simulation.md "Determinism").
 - **partition_heal** — two replicas partition away and heal: traffic
   completes throughout, the healed replicas serve again.
+- **run_ledger_failover** — two replicas die mid-traffic under failover
+  supervision, and the gate moves to the RUN level (ISSUE 17): every
+  run the caller launched closes ``ok`` in the client's run ledger
+  (``runs.completion_ratio`` exactly 1.0 — same numbers the SLO rollup
+  publishes, same pure fold), run-level end-to-end p95 stays bounded
+  across failover stale-out waits, attempt amplification stays sane.
 - **lease_churn** — 20k synthetic caller leases churn against the real
   compacted liveness table while traffic flows: the lapse law and the
   store cap hold at fleet scale.
@@ -201,6 +207,51 @@ PARTITION_HEAL = Scenario(
 )
 
 
+RUN_LEDGER = Scenario(
+    name="run_ledger_failover",
+    replicas=10,
+    seed=79,
+    phases=(LoadPhase(duration_s=180.0, rate_rps=2.5),),
+    policy="least-loaded",
+    service=ServiceSpec(base_s=1.2, per_token_s=0.04, slots=2),
+    failover=True,
+    max_failovers=4,
+    retry_attempts=4,
+    heartbeat_every_s=5.0,
+    stale_after_s=15.0,
+    events=(
+        ReplicaEvent(at_s=45.0, action="kill", replica=3),
+        ReplicaEvent(at_s=100.0, action="kill", replica=7),
+    ),
+    per_replica_report=False,
+    checks=(
+        Check("all_complete", "requests.completion_ratio", "==", 1.0),
+        Check("no_faults", "requests.failed", "==", 0.0),
+        # the run-ledger claim (ISSUE 17): every RUN the caller
+        # launched — including the ones whose first placement died
+        # mid-flight — closes "ok" in the ledger, and the run-level
+        # end-to-end p95 (virtual seconds, ACROSS failover stale-out
+        # waits) stays bounded.  These are the same numbers the SLO
+        # rollup publishes, computed through the same pure fold.
+        Check("every_run_ok", "runs.completion_ratio", "==", 1.0),
+        Check("ledger_closed_runs", "runs.finished", ">=", 30.0),
+        Check("failover_in_ledger", "runs.failover_rate", ">", 0.0),
+        Check("run_p95_bounded", "runs.e2e_p95_s", "<=", 20.0),
+        Check(
+            "amplification_bounded",
+            "runs.attempt_amplification", "<=", 3.0,
+        ),
+    ),
+    gated=(
+        "requests.completed",
+        "runs.completion_ratio",
+        "runs.finished",
+        "runs.e2e_p95_s",
+        "runs.attempt_amplification",
+    ),
+)
+
+
 LEASE_CHURN = Scenario(
     name="lease_churn",
     replicas=6,
@@ -240,13 +291,14 @@ PINNED_SUITE: "tuple[Scenario, ...]" = (
     HOTSPOT,
     CASCADE,
     PARTITION_HEAL,
+    RUN_LEDGER,
     LEASE_CHURN,
 )
 
 
 
 def scaled_suite(factor: float) -> "tuple[Scenario, ...]":
-    """The same six geometries, proportionally smaller — the tier-1
+    """The same seven geometries, proportionally smaller — the tier-1
     determinism tests' fast path (arrival rates scale with the fleet so
     per-replica load, and therefore every verdict, is preserved)."""
     return tuple(s.scaled(factor) for s in PINNED_SUITE)

@@ -82,6 +82,16 @@ def default_config(root: "Path | str") -> Config:
                 "rooted",
             ),
             RequiredRoots(
+                "calfkit_tpu.observability.runledger", "hotpath", 5,
+                "the run ledger's O(1) append promise (ISSUE 17: begin/"
+                "attempt/outcome/tokens/finish) must stay rooted",
+            ),
+            RequiredRoots(
+                "calfkit_tpu.observability.runledger", "no_wallclock", 2,
+                "the SLO rollup fold (ISSUE 17) is gated by the sim — it "
+                "must never read host time",
+            ),
+            RequiredRoots(
                 "perf_gate", "no_wallclock", 1,
                 "the gate's metric compare must never read host time "
                 "(ISSUE 11)",

@@ -20,6 +20,13 @@ artifact:
   level.
 - ``journal_implied_delta_pct``: the flight-recorder share alone
   (measured appends-per-dispatch × directly-timed append cost).
+- ``ledger_implied_delta_pct`` (ISSUE 17): the run-ledger share — the
+  full per-supervised-call append bundle (begin → attempt → token →
+  outcome → finish, including LRU eviction at cap) timed directly,
+  amortized over the call's dispatches.  The ledger rides the CLIENT
+  supervisor path, so this is the honest ledger-on/off delta: its cost
+  is exactly these appends (there is no other ledger work on the hot
+  path), and it folds into the same gated ``implied_delta_pct`` bar.
 - ``ab_delta_pct`` / ``journal_ab_delta_pct`` (evidence, not gated):
   best-of-N tok/s with observability on vs off, and with the journal on
   (``flightrec_events`` default) vs off (0).  On a shared-CPU container,
@@ -197,6 +204,37 @@ def _journal_append_us(iters: int = 100000) -> float:
     return samples[2]
 
 
+def _ledger_call_us(iters: int = 50000) -> float:
+    """Median-of-5 timing of one supervised call's ENTIRE run-ledger
+    bundle (ISSUE 17): begin_run + note_attempt + add_tokens +
+    note_outcome + finish_run, with a fresh run id per call so the LRU
+    eviction at cap is billed too — the steady state of a long-lived
+    client."""
+    from calfkit_tpu.observability.runledger import RunLedger
+
+    ledger = RunLedger(cap=1024)
+    samples = []
+    for rep in range(5):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            run_id = "r%05d-%d" % (i, rep)
+            ledger.begin_run(
+                run_id, agent="svc", client_id="c", started_at=1.0
+            )
+            ledger.note_attempt(
+                run_id, attempt_no=0, correlation_id="c0", kind="first",
+                placement="svc@i0", agent="svc", started_at=1.0,
+            )
+            ledger.add_tokens(run_id, "c0", 1)
+            ledger.note_outcome(
+                run_id, "c0", outcome="ok", finished_at=2.0
+            )
+            ledger.finish_run(run_id, outcome="ok", finished_at=2.0)
+        samples.append((time.perf_counter() - t0) / iters * 1e6)
+    samples.sort()
+    return samples[2]
+
+
 async def run() -> dict:
     # one discarded warmup rep: jit tracing / allocator warmup must not be
     # billed to either mode
@@ -241,10 +279,18 @@ async def run() -> dict:
     bundle_us = _instrumentation_bundle_us()
     append_us = _journal_append_us()
     journal_us = append_us * appends_per_dispatch
+    # run ledger (ISSUE 17): the per-call append bundle amortizes over
+    # the call's dispatches (NEW_TOKENS tokens / STEPS per dispatch)
+    ledger_call_us = _ledger_call_us()
+    dispatches_per_call = max(1.0, NEW_TOKENS / STEPS)
+    ledger_us = ledger_call_us / dispatches_per_call
     tokens_per_dispatch = BS * STEPS
     host_us_per_dispatch = tokens_per_dispatch / best_on * 1e6
     journal_implied_delta_pct = journal_us / host_us_per_dispatch * 100.0
-    implied_delta_pct = (bundle_us + journal_us) / host_us_per_dispatch * 100.0
+    ledger_implied_delta_pct = ledger_us / host_us_per_dispatch * 100.0
+    implied_delta_pct = (
+        (bundle_us + journal_us + ledger_us) / host_us_per_dispatch * 100.0
+    )
     ok = implied_delta_pct < DELTA_BAR_PCT
     return {
         "metric": f"obs_overhead[host-stub bs={BS} steps={STEPS}]",
@@ -257,6 +303,9 @@ async def run() -> dict:
         "journal_appends_per_dispatch": round(appends_per_dispatch, 3),
         "journal_us_per_dispatch": round(journal_us, 3),
         "journal_implied_delta_pct": round(journal_implied_delta_pct, 4),
+        "ledger_call_us": round(ledger_call_us, 3),
+        "ledger_us_per_dispatch": round(ledger_us, 3),
+        "ledger_implied_delta_pct": round(ledger_implied_delta_pct, 4),
         "host_us_per_dispatch": round(host_us_per_dispatch, 1),
         "tok_s_observability_on": round(best_on, 1),
         "tok_s_observability_off": round(best_off, 1),

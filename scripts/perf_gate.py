@@ -74,6 +74,9 @@ DEFAULT_ABS_TOL = 2.0
 EXACT_METRICS = {
     "requests.completed",
     "routing.delivered_while_dead",
+    # run-level completion (ISSUE 17): a run the ledger lost or failed
+    # to close is a correctness bug, never drift
+    "runs.completion_ratio",
 }
 
 

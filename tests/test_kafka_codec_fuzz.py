@@ -124,6 +124,44 @@ class TestRoundTripProperties:
                 assert ctx.trace_id == raw.decode("utf-8")
 
 
+    def test_fuzzed_run_headers_parse_or_unlink(self):
+        """ISSUE 17 satellite fuzz: arbitrary ``x-mesh-run`` bytes
+        survive the codec byte-exactly, and ``parse_run`` either yields
+        the exact round-trip identity or None (un-linked) — never
+        raises, and a corrupt value can never alias two requests onto a
+        shared bogus run id: whatever parses echoes the value's OWN
+        prefix."""
+        from calfkit_tpu import protocol
+
+        rng = random.Random(99)
+        for _ in range(200):
+            if rng.random() < 0.5:
+                run_id = "%032x" % rng.getrandbits(128)
+                attempt = rng.randint(0, 12)
+                raw = protocol.format_run(run_id, attempt).encode()
+                expect = (run_id, attempt)
+            else:
+                raw = rng.randbytes(rng.randint(0, 48))
+                expect = None  # fuzz bytes: parse is allowed either way
+            blob = encode_record_batch(
+                [(b"k", b"v", [(protocol.HDR_RUN, raw)])], 1
+            )
+            [(_o, _t, _k, _v, decoded)] = decode_record_batches(blob)
+            assert dict(decoded)[protocol.HDR_RUN] == raw  # byte-exact
+            parsed = protocol.parse_run(
+                protocol.header_map(dict(decoded)).get(protocol.HDR_RUN)
+            )
+            if expect is not None:
+                assert parsed == expect
+            elif parsed is not None:
+                # an accepted fuzz value must carry its own identity:
+                # non-empty run id that IS this value's prefix, and a
+                # non-negative integer attempt — no shared constant
+                run_id, attempt = parsed
+                assert run_id and attempt >= 0
+                assert raw.decode("utf-8").startswith(run_id + ":")
+
+
 class TestCorruption:
     def test_truncation_at_every_boundary(self):
         """A truncated record_set never raises a raw error: the trailing

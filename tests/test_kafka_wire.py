@@ -117,6 +117,47 @@ class TestWireCodec:
         assert protocol.HDR_TRACE not in normalized
         assert TraceContext.from_headers(normalized) is None
 
+    def test_run_header_round_trip_through_record_batch(self):
+        """ISSUE 17 satellite: the ``x-mesh-run`` header (run identity
+        carried verbatim across retries/failover/hedges) survives
+        encode/decode and parses back to the exact (run_id, attempt)."""
+        from calfkit_tpu import protocol
+
+        value = protocol.format_run("a1b2c3d4e5f60718", 3)
+        blob = encode_record_batch(
+            [(b"k", b"v", [(protocol.HDR_RUN, value.encode("utf-8"))])], 99
+        )
+        [(_o, _t, _k, _v, decoded)] = decode_record_batches(blob)
+        normalized = protocol.header_map(dict(decoded))
+        assert protocol.parse_run(normalized.get(protocol.HDR_RUN)) == (
+            "a1b2c3d4e5f60718",
+            3,
+        )
+
+    def test_corrupt_run_header_degrades_to_unlinked(self):
+        """A corrupt ``x-mesh-run`` value degrades to an UN-LINKED run
+        (parse_run → None) — never a shared bogus run id, never a
+        delivery fault (the PR 5 corrupt-header law)."""
+        from calfkit_tpu import protocol
+
+        for raw in (
+            b"\xff\xfe\xfd",  # undecodable utf-8
+            b"no-separator",
+            b"run:1.5",  # float is not an attempt counter
+            b"run:nan",
+            b"run:-1",
+            b":7",  # empty run id
+            b"",
+        ):
+            blob = encode_record_batch(
+                [(b"k", b"v", [(protocol.HDR_RUN, raw)])], 1
+            )
+            [(_o, _t, _k, _v, decoded)] = decode_record_batches(blob)
+            normalized = protocol.header_map(dict(decoded))
+            assert (
+                protocol.parse_run(normalized.get(protocol.HDR_RUN)) is None
+            )
+
     def test_range_assign_splits_evenly(self):
         members = {"m-1": ["a"], "m-2": ["a"]}
         partitions = {"a": [0, 1, 2, 3, 4]}

@@ -1,0 +1,509 @@
+"""Run-scoped observability suite (ISSUE 17).
+
+Four pure-law groups and one end-to-end acceptance drill:
+
+- the ``x-mesh-run`` header laws (round trip; corrupt → un-linked run,
+  never a shared bogus id — the PR 5 degrade-never-fault law);
+- :class:`RunLedger` unit laws (idempotent begin, LRU cap,
+  first-signal-wins outcome writes, token accounting, derived counters);
+- the pure SLO rollup fold (window filtering, nearest-rank percentiles,
+  error-budget burn, orphan classification) and the worker-side
+  :class:`RunWindowStore` fail-open fold;
+- the ``ck run`` / ``ck slo`` render functions (no mesh required);
+- THE acceptance scenario: a replica hard-killed mid-stream fails over,
+  and the ONE logical run's ledger lists both attempts with typed
+  outcomes, exports to ``mesh.runs``, and renders as one stitched
+  cross-replica timeline.
+"""
+
+import pytest
+
+from calfkit_tpu import protocol
+from calfkit_tpu.cli.obs import (
+    _parse_run_record,
+    _parse_run_spans,
+    _parse_slo,
+    render_run_timeline,
+    render_slo_table,
+)
+from calfkit_tpu.models.records import (
+    RunAttemptRecord,
+    RunRecord,
+    SloRollupRecord,
+    SpanRecord,
+)
+from calfkit_tpu.observability.runledger import (
+    RunLedger,
+    RunWindowStore,
+    rollup_window,
+    run_percentile,
+)
+
+
+# ------------------------------------------------------------ header laws
+class TestRunHeaderLaws:
+    def test_round_trip(self):
+        value = protocol.format_run("a1b2c3d4e5f60718", 0)
+        assert value == "a1b2c3d4e5f60718:0"
+        assert protocol.parse_run(value) == ("a1b2c3d4e5f60718", 0)
+        assert protocol.parse_run(value.encode()) == ("a1b2c3d4e5f60718", 0)
+        # attempts survive multi-digit and the id may contain colons
+        # only via rpartition (ids are hex, but the parser must not care)
+        assert protocol.parse_run("a:b:7") == ("a:b", 7)
+
+    @pytest.mark.parametrize(
+        "raw",
+        [
+            None,
+            b"",
+            "",
+            "no-separator",
+            "run:1.5",  # int(), not float(): floats are not counters
+            "run:nan",
+            "run:inf",
+            "run:-1",  # negative attempts are corruption
+            "run:",  # empty attempt
+            ":3",  # empty run id
+            b"\xff\xfe\xfd",  # undecodable utf-8
+        ],
+    )
+    def test_corrupt_degrades_to_unlinked(self, raw):
+        assert protocol.parse_run(raw) is None
+
+    def test_run_header_is_forwarded_authority(self):
+        # the header is in the protocol authority list, so hop-by-hop
+        # normalization keeps it (contrast: ad-hoc headers get dropped)
+        assert protocol.HDR_RUN in protocol.ALL_HEADERS
+
+
+# ------------------------------------------------------------- ledger unit
+class TestRunLedgerUnit:
+    def test_begin_idempotent_and_attempt_recording(self):
+        ledger = RunLedger()
+        ledger.begin_run("r1", agent="svc", client_id="c1", started_at=10.0)
+        ledger.note_attempt(
+            "r1", attempt_no=0, correlation_id="corr0", kind="first",
+            placement="svc@i0", agent="svc", started_at=10.0,
+        )
+        # a resumed supervisor pass re-begins: recorded attempts survive
+        ledger.begin_run("r1", agent="svc", client_id="c1", started_at=11.0)
+        record = ledger.run_report("r1")
+        assert record is not None
+        assert record.started_at == 10.0
+        assert [a.correlation_id for a in record.attempts] == ["corr0"]
+        # unknown runs: report None, appends are no-ops (never a fault)
+        assert ledger.run_report("missing") is None
+        ledger.note_attempt("missing", attempt_no=0, correlation_id="x")
+        ledger.note_outcome("missing", "x", outcome="ok")
+
+    def test_first_signal_wins(self):
+        """A zombie's late terminal must not overwrite the supervisor's
+        ``superseded`` verdict — and vice versa: whichever signal landed
+        first is what the caller experienced."""
+        ledger = RunLedger()
+        ledger.begin_run("r1", agent="svc")
+        ledger.note_attempt("r1", attempt_no=0, correlation_id="corr0")
+        ledger.note_outcome(
+            "r1", "corr0", outcome="superseded", error_type="dead:stale",
+            finished_at=12.0,
+        )
+        ledger.note_outcome(
+            "r1", "corr0", outcome="ok", finished_at=13.0
+        )  # the zombie's late reply: dropped
+        [attempt] = ledger.run_report("r1").attempts
+        assert attempt.outcome == "superseded"
+        assert attempt.error_type == "dead:stale"
+        assert attempt.finished_at == 12.0
+
+    def test_tokens_and_derived_counters(self):
+        ledger = RunLedger()
+        ledger.begin_run("r1", agent="svc", started_at=1.0)
+        ledger.note_attempt(
+            "r1", attempt_no=0, correlation_id="c0", kind="first"
+        )
+        ledger.note_attempt(
+            "r1", attempt_no=1, correlation_id="c1", kind="failover"
+        )
+        ledger.note_attempt(
+            "r1", attempt_no=2, correlation_id="c2", kind="resume"
+        )
+        ledger.add_tokens("r1", "c0", 2)
+        ledger.add_tokens("r1", "c2", 3)
+        ledger.note_outcome(
+            "r1", "c0", outcome="shed", error_type="mesh.overloaded"
+        )
+        ledger.note_outcome("r1", "c1", outcome="superseded")
+        ledger.note_outcome("r1", "c2", outcome="ok")
+        ledger.finish_run("r1", outcome="ok", finished_at=4.0)
+        record = ledger.run_report("r1")
+        assert record.outcome == "ok"
+        assert record.sheds == 1
+        assert record.failovers == 1
+        assert record.resumes == 1
+        assert record.hedges == 0
+        assert record.tokens_delivered == 5
+        assert [a.tokens_delivered for a in record.attempts] == [2, 0, 3]
+
+    def test_lru_cap_evicts_oldest(self):
+        ledger = RunLedger(cap=2)
+        for i in range(3):
+            ledger.begin_run(f"r{i}", agent="svc")
+        assert ledger.run_ids() == ["r1", "r2"]
+        assert ledger.run_report("r0") is None
+
+    def test_finished_records_excludes_pending(self):
+        ledger = RunLedger()
+        ledger.begin_run("open", agent="svc")
+        ledger.begin_run("done", agent="svc")
+        ledger.finish_run("done", outcome="fault", error_type="X")
+        records = ledger.finished_records()
+        assert [r.run_id for r in records] == ["done"]
+        assert records[0].error_type == "X"
+
+
+# ------------------------------------------------------------ rollup laws
+class TestRollupLaws:
+    def test_nearest_rank_percentile(self):
+        assert run_percentile([], 0.95) == 0.0
+        values = [float(v) for v in range(1, 11)]
+        assert run_percentile(values, 0.50) == 6.0
+        assert run_percentile(values, 0.95) == 10.0
+        assert run_percentile(values, 0.0) == 1.0
+
+    def _entry(self, *, finished_at, started_at=0.0, outcome="ok", **kw):
+        entry = {
+            "started_at": started_at,
+            "finished_at": finished_at,
+            "outcome": outcome,
+            "error_type": "",
+            "attempts": 1,
+            "sheds": 0,
+            "failovers": 0,
+        }
+        entry.update(kw)
+        return entry
+
+    def test_window_filters_and_ratio(self):
+        entries = [
+            self._entry(started_at=90.0, finished_at=100.0),
+            self._entry(
+                started_at=95.0, finished_at=101.0, outcome="fault",
+                error_type="mesh.orphaned", attempts=3, failovers=2,
+            ),
+            # outside the window: ignored entirely
+            self._entry(started_at=1.0, finished_at=2.0, outcome="fault"),
+        ]
+        rollup = rollup_window(
+            entries, agent="svc", window_end=101.0, window_s=10.0,
+            target=0.9,
+        )
+        assert rollup.runs == 2
+        assert rollup.completed == 1
+        assert rollup.completion_ratio == 0.5
+        assert rollup.orphan_rate == 0.5
+        assert rollup.failover_rate == 0.5
+        assert rollup.attempts == 4
+        assert rollup.attempt_amplification == 2.0
+        assert rollup.e2e_p50_s == pytest.approx(10.0)
+        # burn: failing 50% of runs against a 10% budget = 5x burn
+        assert rollup.error_budget_burn == pytest.approx(5.0)
+
+    def test_empty_window_is_healthy(self):
+        rollup = rollup_window(
+            [], agent="svc", window_end=100.0, window_s=10.0
+        )
+        assert rollup.runs == 0
+        assert rollup.completion_ratio == 1.0
+        assert rollup.error_budget_burn == 0.0
+
+    def test_window_store_fold_fail_open(self):
+        store = RunWindowStore(cap=2)
+        good = RunRecord(
+            run_id="r1", agent="svc", started_at=1.0, finished_at=2.0,
+            outcome="ok",
+            attempts=[
+                RunAttemptRecord(attempt_no=0, correlation_id="c0"),
+            ],
+        )
+        store.fold(b"r1", good.to_wire())
+        store.fold(b"junk", b"\x00not json")  # dropped, never raises
+        store.fold(b"tomb", None)  # tombstone: skipped
+        pending = RunRecord(run_id="r2", agent="svc", outcome="pending")
+        store.fold(b"r2", pending.to_wire())  # pending: skipped
+        agentless = RunRecord(run_id="r3", outcome="ok")
+        store.fold(b"r3", agentless.to_wire())  # no agent: skipped
+        assert store.agents() == ["svc"]
+        rollup = store.rollup_for("svc", window_end=5.0, window_s=10.0)
+        assert rollup.runs == 1 and rollup.completed == 1
+        # the per-agent deque cap holds no matter how many runs fold
+        for i in range(5):
+            more = good.model_copy(update={"run_id": f"m{i}"})
+            store.fold(f"m{i}", more.to_wire())
+        assert store.rollup_for("svc", window_end=5.0, window_s=10.0).runs == 2
+
+
+# ---------------------------------------------------------------- renders
+class TestRunRenderers:
+    def _record(self):
+        return RunRecord(
+            run_id="a" * 32, agent="svc", client_id="c1",
+            started_at=100.0, finished_at=100.5, outcome="ok",
+            attempts=[
+                RunAttemptRecord(
+                    attempt_no=0, correlation_id="corr0", kind="first",
+                    placement="svc@i0", agent="svc", started_at=100.0,
+                    finished_at=100.2, outcome="superseded",
+                    error_type="dead:stale",
+                ),
+                RunAttemptRecord(
+                    attempt_no=1, correlation_id="corr1", kind="failover",
+                    placement="svc@i1", agent="svc", started_at=100.2,
+                    finished_at=100.5, outcome="ok", tokens_delivered=4,
+                ),
+            ],
+            failovers=1, tokens_delivered=4,
+        )
+
+    def test_run_timeline_stitches_attempts(self):
+        spans = [
+            SpanRecord(
+                trace_id="corr0", span_id="s0", name="agent.svc",
+                kind="agent", emitter="agent/svc", start_s=100.0,
+                duration_ms=200.0, status="cancelled",
+            ),
+            SpanRecord(
+                trace_id="corr1", span_id="s1", name="agent.svc",
+                kind="agent", emitter="agent/svc", start_s=100.2,
+                duration_ms=300.0,
+            ),
+        ]
+        out = render_run_timeline(
+            self._record(), spans,
+            {"corr1": [{"t_s": 100.25, "event": "ADMIT", "seq": 1}]},
+        )
+        # one header + both attempts, each with its placement and typed
+        # outcome, spans positioned on the RUN window, flightrec joined
+        assert "1 failover(s)" in out
+        assert "attempt 0 [first]" in out and "svc@i0" in out
+        assert "superseded(dead:stale)" in out
+        assert "attempt 1 [failover]" in out and "svc@i1" in out
+        assert "flightrec ADMIT" in out
+        assert "500.0 ms end-to-end" in out
+
+    def test_run_timeline_without_spans_or_flightrec(self):
+        # the stitch is best-effort: a run record alone still renders
+        out = render_run_timeline(self._record(), [], None)
+        assert "attempt 0" in out and "attempt 1" in out
+
+    def test_parse_helpers(self):
+        record = self._record()
+        items = {record.run_id: record.to_wire(), "other": b"junk"}
+        assert _parse_run_record(items, record.run_id) is not None
+        assert _parse_run_record(items, "missing") is None
+        assert _parse_run_record({"x": b"\x00"}, "x") is None
+        span = SpanRecord(trace_id="corr0", span_id="s0")
+        spans = _parse_run_spans(
+            {"corr0/s0": span.to_wire(), "zzz/s1": span.to_wire(),
+             "corr0/bad": b"\x00"},
+            ["corr0", "corr1"],
+        )
+        assert [s.span_id for s in spans] == ["s0"]
+
+    def test_slo_table(self):
+        from calfkit_tpu.models.records import (
+            ControlPlaneRecord,
+            ControlPlaneStamp,
+        )
+
+        rollup = SloRollupRecord(
+            agent="svc", node_id="i0", runs=40, completed=39,
+            completion_ratio=0.975, e2e_p50_s=0.4, e2e_p95_s=0.9,
+            e2e_p99_s=1.2, attempts=44, attempt_amplification=1.1,
+            failover_rate=0.05, error_budget_burn=25.0, window_end=50.0,
+        )
+        wrapped = ControlPlaneRecord(
+            stamp=ControlPlaneStamp(
+                node_name="svc", node_kind="agent", instance_id="i0",
+                heartbeat_at=50.0,
+            ),
+            record=rollup.model_dump(),
+        )
+        records = _parse_slo({"svc@i0": wrapped.to_wire(), "bad": b"\x00"})
+        assert len(records) == 1
+        out = render_slo_table(records)
+        assert "0.9750" in out and "0.40/0.90/1.20" in out
+        assert "25.00" in out
+        assert "no SLO rollups" in render_slo_table([])
+
+
+# ------------------------------------------------------------- end to end
+class TestRunLedgerE2E:
+    async def test_failover_run_has_one_ledger_two_attempts(self):
+        """THE ISSUE 17 acceptance drill: hard-kill a replica mid-stream
+        under failover supervision.  The caller sees one contiguous
+        answer; the run LEDGER sees one run with two attempts — the
+        victim's typed non-ok terminal and the survivor's ``ok`` — the
+        record exports to ``mesh.runs``, and the CLI parse + stitch
+        renders both placements in one timeline."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.fleet import FailoverPolicy, FleetRouter
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.models.node_result import InvocationResult
+        from tests._chaos import (
+            FleetTopology,
+            StreamingStubModel,
+            settle,
+            virtual_clock,
+        )
+
+        with virtual_clock() as clock:
+            mesh = InMemoryMesh()
+            models = [
+                StreamingStubModel(text="alpha beta gamma")
+                for _ in range(2)
+            ]
+            async with FleetTopology(
+                mesh, models, agent_kwargs={"stream_tokens": True}
+            ) as fleet:
+                low = fleet.index_of_lowest_key()
+                models[1 - low].release.set()  # only the victim pauses
+                router = FleetRouter(
+                    mesh, "least-loaded",
+                    stale_after=fleet.config.stale_after,
+                )
+                client = Client.connect(
+                    mesh, router=router,
+                    failover=FailoverPolicy(
+                        probe_interval=0.02, max_failovers=2
+                    ),
+                )
+                await router.start()
+                await settle(
+                    lambda: len(router.registry.eligible("svc")) == 2,
+                    message="fleet never became routable",
+                )
+                tokens = []
+                result = None
+                killed = False
+                async for item in client.agent("svc").stream(
+                    "tell me a story", timeout=60
+                ):
+                    if isinstance(item, InvocationResult):
+                        result = item
+                        continue
+                    if getattr(item.step, "kind", "") != "token":
+                        continue
+                    tokens.append(item.step.text)
+                    if not killed:
+                        killed = True
+                        fleet.kill(low)
+                        clock.advance(fleet.config.stale_after + 1)
+                assert killed and result is not None
+                assert "".join(tokens) == result.output
+
+                # ---- the ledger half: ONE run, both attempts, typed
+                [run_id] = client.run_ledger.run_ids()
+                record = client.run_ledger.run_report(run_id)
+                assert record.outcome == "ok"
+                assert len(record.attempts) == 2
+                first, second = sorted(
+                    record.attempts, key=lambda a: a.attempt_no
+                )
+                assert first.kind == "first"
+                # the victim's terminal is typed non-ok (the supervisor's
+                # superseded verdict or the cancel's terminal — whichever
+                # signal landed first)
+                assert first.outcome in ("superseded", "cancelled")
+                # tokens were already delivered when the replica died,
+                # so the re-dispatch is a decode-from-offset RESUME in
+                # the ledger's kind vocabulary (the wire mark stays
+                # "failover" — that header's vocabulary is placement
+                # accounting, the ledger's is run history)
+                assert second.kind == "resume"
+                assert second.outcome == "ok"
+                # distinct placements = the stitch spans both replicas
+                assert first.placement != second.placement
+                assert first.correlation_id != second.correlation_id
+                # delivered-token accounting survives the replayed
+                # prefix dedupe: total == what the caller actually saw
+                assert record.tokens_delivered == len(tokens)
+                assert record.resumes == 1
+
+                await client.close()  # drains the mesh.runs export
+
+                # ---- the export + CLI half: parse off the compacted
+                # table and render the stitched timeline
+                reader = mesh.table_reader(protocol.RUNS_TOPIC)
+                published = _parse_run_record(reader.items(), run_id)
+                assert published is not None
+                assert published.outcome == "ok"
+                assert len(published.attempts) == 2
+                out = render_run_timeline(published, [])
+                assert first.placement in out
+                assert second.placement in out
+                assert "attempt 0 [first]" in out
+                assert "attempt 1 [resume]" in out
+            await mesh.stop()
+
+    async def test_bare_start_closes_run_on_terminal(self):
+        """A bare ``start()`` (no execute()/stream() supervisor) owns the
+        run it mints: the attempt's terminal closes the run and exports
+        it to ``mesh.runs`` — an un-supervised run must not sit
+        ``pending`` forever (the quickstart idiom is start()+result())."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+        from calfkit_tpu.sim.stubs import ServingStubModel
+        from tests._chaos import virtual_clock
+
+        with virtual_clock():
+            mesh = InMemoryMesh()
+            async with Worker(
+                [Agent("svc", model=ServingStubModel(text="done"))],
+                mesh=mesh,
+            ):
+                client = Client.connect(mesh)
+                handle = await client.agent("svc").start("hi", timeout=30)
+                await handle.result()
+                report = handle.run_report()
+                assert report is not None
+                assert report.outcome == "ok"
+                [attempt] = report.attempts
+                assert attempt.kind == "first"
+                assert attempt.outcome == "ok"
+                await client.close()  # drains the mesh.runs export
+                reader = mesh.table_reader(protocol.RUNS_TOPIC)
+                assert _parse_run_record(reader.items(), handle.run_id)
+            await mesh.stop()
+
+    async def test_execute_fault_closes_run_typed(self):
+        """A run that ends in a typed fault closes the ledger with that
+        type — and a shed attempt is marked ``shed``, not ``fault``."""
+        from calfkit_tpu.client import Client
+        from calfkit_tpu.exceptions import NodeFaultError
+        from calfkit_tpu.mesh import InMemoryMesh
+        from calfkit_tpu.nodes import Agent
+        from calfkit_tpu.worker import Worker
+        from tests._chaos import virtual_clock
+
+        class Exploder:
+            async def request(self, messages, settings=None, params=None):
+                raise RuntimeError("boom")
+
+        with virtual_clock():
+            mesh = InMemoryMesh()
+            async with Worker(
+                [Agent("svc", model=Exploder())], mesh=mesh
+            ):
+                client = Client.connect(mesh)
+                with pytest.raises(NodeFaultError):
+                    await client.agent("svc").execute("hi", timeout=30)
+                records = client.run_ledger.finished_records()
+                assert len(records) == 1
+                assert records[0].outcome == "fault"
+                assert records[0].error_type  # typed, never empty
+                [attempt] = records[0].attempts
+                assert attempt.outcome == "fault"
+                await client.close()
+            await mesh.stop()
