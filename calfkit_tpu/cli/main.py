@@ -33,6 +33,7 @@ def _register() -> None:
         ("calfkit_tpu.cli.obs", "leases_command"),
         ("calfkit_tpu.cli.obs", "timeline_command"),
         ("calfkit_tpu.cli.obs", "slo_command"),
+        ("calfkit_tpu.cli.obs", "capacity_command"),
         ("calfkit_tpu.cli.sim", "sim_command"),
     ):
         if find_spec(module_name) is None:

@@ -22,11 +22,18 @@ attempt's spans (``mesh.traces``) and flight-recorder events across
 replicas: the view ``ck trace``/``ck timeline`` cannot produce, because
 each attempt carries its own correlation id.  ``ck slo`` prints the
 per-agent windowed run-level SLO rollups from ``mesh.slo``.
+``ck capacity [agent]`` (ISSUE 19) is the HBM page view: per-replica
+pool/headroom scalars from the same adverts, then the occupancy
+timeline (unicode sparklines) and the page-attribution owner breakdown
+from the newest local capacity dump — "who holds this replica's HBM,
+and could an admission fit right now".
 
 Rendering is split into pure functions (``render_waterfall`` /
 ``render_stats_table`` / ``render_fleet_table`` / ``render_timeline`` /
-``render_run_timeline`` / ``render_slo_table``) so tests cover the
-formatting without a mesh.
+``render_run_timeline`` / ``render_slo_table`` /
+``render_capacity_table`` / ``render_capacity_timeline`` /
+``render_capacity_breakdown``) so tests cover the formatting without a
+mesh.
 """
 
 from __future__ import annotations
@@ -238,7 +245,10 @@ def render_fleet_table(
     there are being failed over, not just new runs routed away.
     SHED/EXPIRED prefer the per-heartbeat-interval delta (``+n``) over
     lifetime values: what matters for routing is whether a replica is
-    shedding NOW."""
+    shedding NOW.  HEADROOM (ISSUE 19) is the pages an admission could
+    still obtain — free-list plus evictable zero-ref cache pages —
+    straight from :attr:`~calfkit_tpu.fleet.registry.Replica.
+    headroom_pages`, ``-`` when the replica advertises no page pool."""
     from calfkit_tpu import cancellation
     from calfkit_tpu.fleet.failover import placement_verdict
     from calfkit_tpu.fleet.registry import eligibility_verdict
@@ -249,7 +259,7 @@ def render_fleet_table(
         (
             "MODEL", "NODE", "INSTANCE", "ROUTE", "READY", "DRAIN",
             "HB AGE S", "DEPTH", "ACTIVE", "PENDING", "SLOTS",
-            "SHED", "EXPIRED", "TOK/S", "PREFIX HIT",
+            "HEADROOM", "SHED", "EXPIRED", "TOK/S", "PREFIX HIT",
         )
     ]
     for r in replicas:
@@ -286,6 +296,13 @@ def render_fleet_table(
                 str(s.pending_requests),
                 f"{s.max_batch_size - s.free_slots}/{s.max_batch_size}"
                 if s.max_batch_size else "-",
+                # pages an admission could still obtain (ISSUE 19) —
+                # "-" when the replica advertises no page pool (dense
+                # layout, pre-capacity record): no signal must not read
+                # as a full replica
+                str(r.headroom_pages)
+                if getattr(r, "headroom_pages", None) is not None
+                else "-",
                 shed,
                 expired,
                 f"{tok_s:.1f}",
@@ -838,3 +855,239 @@ def slo_command(mesh_url: "str | None", timeout: float) -> None:
         click.echo(render_slo_table(records))
 
     asyncio.run(main())
+
+
+# ----------------------------------------------------- capacity (ISSUE 19)
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def sparkline(values: "Iterable[float]", *, width: int = 60) -> str:
+    """Pure unicode sparkline of the LAST ``width`` values, scaled
+    against the series max.  An all-zero series renders as a flat floor
+    of ``▁`` — a drained pool must look flat, not invisible."""
+    vals = [float(v) for v in values][-width:]
+    if not vals:
+        return ""
+    hi = max(vals)
+    top = len(_SPARK_CHARS) - 1
+    if hi <= 0:
+        return _SPARK_CHARS[0] * len(vals)
+    return "".join(
+        _SPARK_CHARS[min(top, int(v / hi * top + 0.5))] for v in vals
+    )
+
+
+def render_capacity_table(replicas: "Iterable") -> str:
+    """Per-replica page-pool scalars, straight from the adverts: the
+    fleet-wide "could an admission fit" view.  ``-`` across the page
+    columns marks a replica with no pool signal (dense layout or a
+    pre-capacity advert) — the same None semantics as
+    :attr:`~calfkit_tpu.fleet.registry.Replica.headroom_pages`.
+    EVICT is the per-heartbeat-interval eviction delta where the advert
+    carries a window, else lifetime."""
+    rows = [
+        (
+            "MODEL", "NODE", "INSTANCE", "PAGES", "IN USE", "RESIDENT",
+            "HEADROOM", "EVICT", "STALLS",
+        )
+    ]
+    for r in replicas:
+        s = r.stats
+        if s.pages_total <= 0:
+            rows.append(
+                (
+                    s.model_name, s.node_id, r.instance_id,
+                    "-", "-", "-", "-", "-", "-",
+                )
+            )
+            continue
+        rows.append(
+            (
+                s.model_name,
+                s.node_id,
+                r.instance_id,
+                str(s.pages_total),
+                str(s.pages_in_use),
+                str(s.prefix_resident_pages),
+                str(max(0, s.pages_total - s.pages_in_use)),
+                str(s.evictions_window),
+                str(s.alloc_stalls),
+            )
+        )
+    if len(rows) == 1:
+        return (
+            "no advertised replicas (is a worker with a local model "
+            "running, and the control plane enabled?)"
+        )
+    return _format_table(rows)
+
+
+def render_capacity_breakdown(breakdown: "dict") -> str:
+    """The page-attribution ledger view: one summary line (the in-use
+    identity ``private + shared = in use``), then the top page owners
+    (correlation id / run / lane), the per-lane totals, and the hottest
+    shared prefix chains by refcount."""
+    lines = [
+        f"pages {breakdown.get('pages_in_use', 0)}"
+        f"/{breakdown.get('pages_total', 0)} in use"
+        f"  (private {breakdown.get('private_pages', 0)}"
+        f" + shared {breakdown.get('shared_referenced_pages', 0)};"
+        f" resident {breakdown.get('prefix_resident_pages', 0)})"
+        f"  headroom {breakdown.get('headroom_pages', 0)}"
+        f"  evicted {breakdown.get('evicted_pages', 0)}"
+        f"  stalls {breakdown.get('alloc_stalls', 0)}"
+    ]
+    owners = breakdown.get("by_owner") or []
+    if owners:
+        rows = [("OWNER", "RUN", "LANE", "PAGES")]
+        for o in owners:
+            rows.append(
+                (
+                    str(o.get("corr") or "-"),
+                    str(o.get("run") or "-"),
+                    str(o.get("lane") or "-"),
+                    str(o.get("pages", 0)),
+                )
+            )
+        other = breakdown.get("by_owner_other_pages", 0)
+        if other:
+            rows.append(("(other)", "-", "-", str(other)))
+        lines.append(_format_table(rows))
+    lanes = breakdown.get("by_lane") or {}
+    if lanes:
+        lines.append(
+            "lanes   "
+            + "  ".join(f"{k}={v}" for k, v in sorted(lanes.items()))
+        )
+    chains = breakdown.get("by_chain") or []
+    if chains:
+        parts = [
+            f"{str(c.get('chain', '?'))[:12]}×{c.get('refs', 0)}"
+            for c in chains
+        ]
+        other = breakdown.get("by_chain_other_pages", 0)
+        if other:
+            parts.append(f"(other)×{other}")
+        lines.append("chains  " + "  ".join(parts))
+    return "\n".join(lines)
+
+
+def render_capacity_timeline(
+    meta: "dict | None", samples: "list[dict]"
+) -> str:
+    """The occupancy timeline from one capacity dump: a sparkline per
+    sampled field (occupancy, free pool, resident prefix pages, batch
+    fill, queue, dispatch size, the analytic HBM bytes/token), each with
+    its min/max/last so the glyphs have units.  Pure: tests cover it
+    without an engine."""
+    if not samples:
+        return "no capacity samples (is RuntimeConfig.capacity_samples 0?)"
+    # capacity.parse_dump hands back the header's inner capacity object
+    cap = meta or {}
+    header = (
+        f"capacity {cap.get('label', '?')}  —  {len(samples)} samples"
+    )
+    if "appended" in cap:
+        header += (
+            f" (ring appended {cap.get('appended', 0)},"
+            f" dropped {cap.get('dropped', 0)})"
+        )
+    lines = [header]
+    for field in (
+        "pages_in_use",
+        "pages_free",
+        "prefix_resident_pages",
+        "active_slots",
+        "pending",
+        "tokens_per_dispatch",
+        "hbm_bytes_per_token",
+    ):
+        vals = [float(s.get(field, 0)) for s in samples]
+        lines.append(
+            f"  {field:<22} {sparkline(vals)}"
+            f"  min {min(vals):g}  max {max(vals):g}  last {vals[-1]:g}"
+        )
+    return "\n".join(lines)
+
+
+def _newest_capacity_dump(directory: str) -> "str | None":
+    # capacity dumps share the flight-recorder directory but carry their
+    # own prefix — a plain *.jsonl glob would hand back a flightrec dump
+    paths = glob.glob(os.path.join(directory, "capacity-*.jsonl"))
+    return max(paths, key=os.path.getmtime) if paths else None
+
+
+@click.command(
+    "capacity",
+    help="print page-grain HBM capacity: per-replica pool/headroom from "
+    "the adverts, plus the occupancy timeline and owner breakdown from "
+    "the newest local capacity dump",
+)
+@click.argument("agent", required=False, default=None)
+@click.option("--mesh", "mesh_url", default=None, help="mesh url (or $CALFKIT_MESH_URL)")
+@click.option("--timeout", default=15.0, show_default=True, help="catch-up timeout (s)")
+@click.option(
+    "--dump",
+    "dump_path",
+    default=None,
+    help="capacity dump file (default: newest capacity-*.jsonl in "
+    "$CALFKIT_FLIGHTREC_DIR / the fault-dump directory); with --dump "
+    "the mesh is not read at all",
+)
+def capacity_command(
+    agent: "str | None",
+    mesh_url: "str | None",
+    timeout: float,
+    dump_path: "str | None",
+) -> None:
+    from calfkit_tpu.fleet.registry import parse_replicas
+    from calfkit_tpu.observability import capacity, flightrec
+
+    if dump_path is None:
+        # fleet half: the advert scalars every replica heartbeats
+        async def read_adverts() -> "list":
+            mesh = resolve_mesh_for_cli(mesh_url, hosts_worker=False)
+            await mesh.start()
+            try:
+                reader = mesh.table_reader(protocol.ENGINE_STATS_TOPIC)
+                await reader.start(timeout=timeout)
+                await reader.barrier(timeout=timeout)
+                out = parse_replicas(reader.items())
+                await reader.stop()
+            finally:
+                await mesh.stop()
+            return out
+
+        replicas = asyncio.run(read_adverts())
+        if agent is not None:
+            replicas = [
+                r
+                for r in replicas
+                if r.agent_name == agent or r.node_id == agent
+            ]
+            if not replicas:
+                raise click.ClickException(
+                    f"no advertised replicas for agent {agent!r}"
+                )
+        replicas.sort(key=lambda r: (r.model_name, r.key))
+        click.echo(render_capacity_table(replicas))
+        # local half, strictly best-effort (same contract as ck run's
+        # flightrec join): the timeline/breakdown live in a local dump —
+        # co-located operators get them, remote ones still get the table
+        path = _newest_capacity_dump(flightrec.default_dump_dir())
+        if path is None:
+            return
+        click.echo(f"reading {path}", err=True)
+    else:
+        path = dump_path
+    try:
+        with open(path) as f:
+            meta, samples = capacity.parse_dump(f)
+    except OSError as exc:
+        if dump_path is None:
+            return  # the best-effort join must never fail the table
+        raise click.ClickException(f"cannot read dump: {exc}") from exc
+    click.echo(render_capacity_timeline(meta, samples))
+    bd = (meta or {}).get("breakdown")
+    if bd:
+        click.echo(render_capacity_breakdown(bd))

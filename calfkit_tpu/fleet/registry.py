@@ -109,6 +109,19 @@ class Replica:
         zero latency — and all-unknown ties fall to the stable key."""
         return self.stats.dispatch_ewma_ms
 
+    @property
+    def headroom_pages(self) -> "int | None":
+        """Pages an admission could obtain on this replica right now
+        (ISSUE 19): the advert's pages_total minus live-owner pages —
+        free-list pages plus evictable zero-ref cached pages.  None when
+        the replica advertises no page pool (dense layout or a
+        pre-capacity record): no signal must not read as zero headroom,
+        or a density-aware policy would starve every legacy replica."""
+        total = self.stats.pages_total
+        if total <= 0:
+            return None
+        return max(0, total - self.stats.pages_in_use)
+
     def age(self, now: "float | None" = None) -> float:
         if now is None:
             now = cancellation.wall_clock()

@@ -42,6 +42,7 @@ from calfkit_tpu.models.messages import (
     UserPart,
 )
 from calfkit_tpu.models.payload import render_parts_as_text
+from calfkit_tpu.observability import capacity as _capacity
 
 ToolCallParser = Callable[[str], tuple[str, list[ToolCallOutput]]]
 
@@ -356,6 +357,25 @@ class JaxLocalModelClient(ModelClient):
                 "watchdog_trips": 0,
                 "watchdog_faulted": 0,
                 "flightrec": {"appended": 0, "dropped": 0, "dumped": 0},
+                # capacity observatory (ISSUE 19): same key set as the
+                # live branch — the CONFIGURED pool shape, zero occupancy
+                "pages_total": (
+                    runtime.pool_pages() - 1
+                    if runtime.kv_layout == "paged"
+                    else 0
+                ),
+                "pages_in_use": 0,
+                "prefix_resident_pages": 0,
+                "evictions_window": 0,
+                "alloc_stalls": 0,
+                "capacity": _capacity.PageLedger(
+                    runtime.pool_pages() - 1
+                    if runtime.kv_layout == "paged"
+                    else 0
+                ).breakdown(),
+                "capacity_samples": {
+                    "appended": 0, "dropped": 0, "dumped": 0,
+                },
             }
         import jax
 
@@ -415,6 +435,18 @@ class JaxLocalModelClient(ModelClient):
             # flight-recorder ring accounting: overflow (dropped) must be
             # an observable signal, never silent truncation
             "flightrec": engine._journal.counts(),
+            # capacity observatory (ISSUE 19): the advert's headroom
+            # scalars (top-level so **snapshot reaches EngineStatsRecord)
+            # + the full by-owner/by-chain attribution breakdown and the
+            # sampler's ring accounting.  evictions_window is refined to
+            # the heartbeat interval below when window=True.
+            "pages_total": engine._ledger.pages_total,
+            "pages_in_use": engine._ledger.pages_in_use,
+            "prefix_resident_pages": engine._ledger.prefix_resident_pages,
+            "evictions_window": stats.prefix_evictions,
+            "alloc_stalls": stats.alloc_stalls,
+            "capacity": engine._ledger.breakdown(),
+            "capacity_samples": engine._sampler.counts(),
         }
         try:
             # latency percentiles ride the advert for free: the registry's
@@ -438,6 +470,11 @@ class JaxLocalModelClient(ModelClient):
             # only when the single designated consumer asks
             if window:
                 snapshot["window"] = engine.stats.snapshot_and_delta()[1]
+                # the advert's eviction signal is PER-INTERVAL (lifetime
+                # cumulative flattens toward the mean as uptime grows)
+                snapshot["evictions_window"] = snapshot["window"].get(
+                    "prefix_evictions", 0
+                )
         except Exception:  # noqa: BLE001 - telemetry stays best-effort
             pass
         if rt.speculative is not None:
@@ -639,6 +676,9 @@ class JaxLocalModelClient(ModelClient):
             # the flight recorder joins on the same id the trace does, so
             # ``ck timeline <correlation-id>`` works from any log line
             corr=trace_parent.trace_id if trace_parent is not None else None,
+            # run identity (ISSUE 19): the node kernel's x-mesh-run
+            # contextvar, so the page ledger attributes HBM by run
+            run=_capacity.current_run.get(),
             deadline=current_deadline.get(),
             lease=leases.current_lease.get(),
         )

@@ -214,6 +214,17 @@ class RuntimeConfig:
     # endpoint; appends are O(1) lock-free (< the 2% telemetry bar, see
     # OBS_OVERHEAD.json).  0 disables recording entirely.
     flightrec_events: int = 4096
+    # capacity observatory (ISSUE 19): capacity (samples) of the engine's
+    # occupancy timeline ring — one numeric sample per dispatch landing
+    # (pages in use/free, prefix residency, active/pending, tokens per
+    # dispatch, analytic HBM bytes/token).  Rounds up to a power of two;
+    # dumps to JSONL next to flight-recorder dumps and serves the
+    # /capacity endpoint; appends are O(1) lock-free.  0 (the default)
+    # disables the sampler entirely — page ATTRIBUTION (the ledger behind
+    # stats_snapshot()["capacity"] and the advert's headroom fields) is
+    # always on for paged engines: it rides the existing alloc/free/evict
+    # sites at O(1) and stays under the 2% bar (OBS_OVERHEAD.json).
+    capacity_samples: int = 0
     # weight-only quantization: "int8" halves decode HBM traffic and fits
     # Llama-3-8B on one 16 GB chip; "int4" (packed nibbles, group-128
     # scales) halves the weight stream again (~4 GB for 8B — margin for

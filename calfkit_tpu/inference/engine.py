@@ -50,7 +50,7 @@ from calfkit_tpu.exceptions import (
 )
 from calfkit_tpu.inference import model as M
 from calfkit_tpu.inference.config import ModelConfig, RuntimeConfig
-from calfkit_tpu.observability import flightrec
+from calfkit_tpu.observability import capacity, flightrec
 from calfkit_tpu.observability.metrics import (
     INTER_TOKEN_BUCKETS_MS,
     REGISTRY,
@@ -347,6 +347,12 @@ class GenRequest:
     # reconstruct this request's lifecycle from a dump.  Precomputed
     # string: journal appends never format.
     corr: "str | None" = None
+    # the logical run this request serves (ISSUE 19): the node kernel's
+    # run-identity contextvar (x-mesh-run) captured at submit, so the
+    # page ledger can attribute HBM by run, not just by attempt.  None =
+    # un-linked (direct engine use, pre-run emitters).  Precomputed
+    # string, like corr: ledger appends never format.
+    run: "str | None" = None
     started_at: float = field(default_factory=time.perf_counter)
     # the request's live _retire_heap entry ([bound, seq, request] list);
     # cleared at retirement so the heap stops pinning this object's
@@ -411,6 +417,12 @@ class EngineStats:
     # failed over instead of burning their deadlines)
     watchdog_trips: int = 0
     watchdog_faulted: int = 0
+    # capacity observatory (ISSUE 19): pages reclaimed from the prefix
+    # cache under allocation pressure, and admissions whose page alloc
+    # came up short on the first try (evictable shortfall or not) — the
+    # advert's density-pressure signals, windowed like every counter
+    prefix_evictions: int = 0
+    alloc_stalls: int = 0
     # EWMA of decode-dispatch latency (ms) — the advert's tiebreak signal
     # for many-router coherence (ISSUE 10 satellite): N independent
     # routers seeing identical queue depths between heartbeat beats stop
@@ -433,6 +445,7 @@ class EngineStats:
         "cancel_propagated", "delivery_stalled", "orphaned_requests",
         "prefill_absorbed_tokens", "unified_dispatches",
         "watchdog_trips", "watchdog_faulted",
+        "prefix_evictions", "alloc_stalls",
     )
 
     # EWMA smoothing for dispatch_ewma_ms: ~5-dispatch memory — fresh
@@ -670,6 +683,11 @@ class InferenceEngine:
             self._v = jax.device_put(pool_v, pool_sh)
             self._tables = jnp.zeros((B, rt.pages_per_seq()), jnp.int32)
             self._page_alloc = PageAllocator(n_pages)
+            # capacity observatory (ISSUE 19): the page-ownership mirror —
+            # maintained O(1) at every alloc/free/evict site below, always
+            # on for paged engines (attribution is the headroom advert's
+            # substrate; the SAMPLER below is the opt-in part)
+            self._ledger = capacity.PageLedger(n_pages - 1)
             self._prefix: Any = None
             if rt.prefix_cache:
                 if not rt.chunked_prefill:
@@ -837,6 +855,25 @@ class InferenceEngine:
         self._journal = flightrec.FlightRecorder(
             rt.flightrec_events, label=config.name
         )
+        # capacity observatory (ISSUE 19): the occupancy timeline ring —
+        # one sample per dispatch landing, flightrec's ring discipline
+        # (capacity_samples=0 makes append a single attribute check).
+        # Dense engines get a pool-less ledger so the snapshot/advert
+        # keys exist with zeros everywhere.
+        if not self._paged:
+            self._ledger = capacity.PageLedger(0)
+        self._sampler = capacity.CapacitySampler(
+            rt.capacity_samples, label=config.name, ledger=self._ledger
+        )
+        # one precomputed bool so the per-dispatch guard is a single
+        # attribute read (capacity_samples=0 must stay effectively free)
+        self._capacity_on = self._sampler.capacity > 0
+        # the sampler's analytic HBM roofline constants, precomputed once
+        # (bench's _perf_model formula; mean context = half the window)
+        self._hbm_constants = capacity.hbm_constants(
+            config, rt.quantization
+        )
+        self._hbm_ctx = rt.max_seq_len / 2.0
         # mesh cancel fan-out: a `cancel` record arriving at any node in
         # the process reaches this engine's request abandonment
         cancellation.register_cancel_target(self)
@@ -1523,6 +1560,7 @@ class InferenceEngine:
         sampling: SamplingParams | None = None,
         seed: int | None = None,
         corr: str | None = None,
+        run: str | None = None,
         deadline: float | None = None,
         lease: "tuple[str, float] | None" = None,
     ) -> AsyncIterator[int]:
@@ -1533,7 +1571,9 @@ class InferenceEngine:
         (row-wise sampling state).  Abandoning the iterator cancels the
         request: its slot is reclaimed at the next scheduler tick.
         ``corr`` tags the request's flight-recorder events with its
-        trace/correlation id (``ck timeline``'s join key).
+        trace/correlation id (``ck timeline``'s join key).  ``run`` is
+        the logical run id (x-mesh-run), when present — the capacity
+        ledger attributes the request's HBM pages to it (ISSUE 19).
 
         ``deadline`` is the request's ABSOLUTE wall-clock deadline (epoch
         seconds on :func:`calfkit_tpu.cancellation.wall_clock`): an
@@ -1607,6 +1647,7 @@ class InferenceEngine:
             sampling=sampling,
             seed=seed,
             corr=corr,
+            run=run,
             deadline=deadline,
         )
         if lease is not None:
@@ -2372,22 +2413,34 @@ class InferenceEngine:
                 len(request.shared_pages),
             )
             self._prefix.release(request.shared_pages)
+            self._ledger.release(request.shared_pages)
         request.reuse_len = 0
         request.shared_pages = []
 
-    def _alloc_with_eviction(self, slot: int, n: int) -> "list[int] | None":
+    def _alloc_with_eviction(
+        self, slot: int, n: int, corr: "str | None" = None
+    ) -> "list[int] | None":
         pages = self._page_alloc.alloc(slot, n)
+        if pages is None:
+            # density pressure is an advert signal whether or not the
+            # cache can cover the shortfall (ISSUE 19)
+            self._ledger.note_stall()
+            self.stats.alloc_stalls += 1
         if pages is None and self._prefix is not None:
             # idle cache entries are reclaimable capacity, not a leak;
             # the journal records the SHORTFALL (what evict is asked to
-            # reclaim), not the whole allocation request
+            # reclaim), not the whole allocation request — tagged with
+            # the REQUESTING owner, so `ck timeline` explains whose
+            # admission forced the eviction
             self._journal.append(
-                flightrec.EV_PAGE_EVICT, None, slot,
+                flightrec.EV_PAGE_EVICT, corr, slot,
                 n - self._page_alloc.free_pages,
             )
-            self._prefix.evict(
-                n - self._page_alloc.free_pages, self._page_alloc
+            freed = self._prefix.evict(
+                n - self._page_alloc.free_pages, self._page_alloc,
+                ledger=self._ledger,
             )
+            self.stats.prefix_evictions += freed
             pages = self._page_alloc.alloc(slot, n)
         return pages
 
@@ -2423,6 +2476,7 @@ class InferenceEngine:
             # must never reclaim pages an earlier-planned member still
             # needs (acquired pages are not evictable)
             self._prefix.acquire(wave[0].shared_pages)
+            self._ledger.acquire(wave[0].shared_pages)
             self._journal.append(
                 flightrec.EV_PREFIX_ACQ, wave[0].corr, -1,
                 len(wave[0].shared_pages),
@@ -2448,6 +2502,7 @@ class InferenceEngine:
                     : head_reuse // self.runtime.page_size
                 ]
                 self._prefix.acquire(peeked.shared_pages)
+                self._ledger.acquire(peeked.shared_pages)
                 self._journal.append(
                     flightrec.EV_PREFIX_ACQ, peeked.corr, -1,
                     len(peeked.shared_pages),
@@ -2472,7 +2527,7 @@ class InferenceEngine:
                 need = self._reserve_pages(request, wave_bucket)
                 shared = request.shared_pages  # acquired at formation
                 need -= len(shared)
-                pages = self._alloc_with_eviction(slot, need)
+                pages = self._alloc_with_eviction(slot, need, request.corr)
                 if pages is None:
                     self._free.append(slot)
                     # EVERY carried member's acquisition must be undone,
@@ -2488,6 +2543,10 @@ class InferenceEngine:
                     flightrec.EV_PAGE_ALLOC, request.corr, slot,
                     len(request.pages), len(shared),
                 )
+                self._ledger.alloc(
+                    slot, len(pages), request.corr, request.run,
+                    capacity.lane_kind(request.history),
+                )
                 granted.append(request)
             wave = granted
             if not wave:
@@ -2501,6 +2560,7 @@ class InferenceEngine:
                     flightrec.EV_PAGE_FREE, request.corr, request.slot
                 )
                 self._page_alloc.free(request.slot)
+                self._ledger.free(request.slot)
                 self._free.append(request.slot)
                 request.slot = -1
                 request.pages = []
@@ -3259,10 +3319,12 @@ class InferenceEngine:
             request.page_hashes = chain_hashes(request.prompt, ps)
         reused = len(request.shared_pages)
         fresh: list[int] = []
+        fresh_hashes: list = []
         for i in range(reused, full):
             page = request.pages[i]
             if self._prefix.register(request.page_hashes[i], page):
                 fresh.append(page)
+                fresh_hashes.append(request.page_hashes[i])
             # else: another request registered this chain position first;
             # this duplicate page stays private (slot-held, freed at
             # retire) — but LATER positions must still register: agent
@@ -3275,6 +3337,10 @@ class InferenceEngine:
         if fresh:
             self._page_alloc.transfer_out(request.slot, fresh)
             self._prefix.acquire(fresh)
+            # ownership transition mirrored in the ledger: the fresh
+            # pages leave the slot's private count and enter chain
+            # ownership at refcount 1 (this request's own reference)
+            self._ledger.transfer(request.slot, fresh, fresh_hashes)
             request.shared_pages = request.shared_pages + fresh
 
     @hotpath
@@ -3511,17 +3577,19 @@ class InferenceEngine:
         was in flight.  Deferred to the landing so an in-flight dispatch
         can never write through a freshly-reallocated page (and shared
         prefix pages stay referenced while a dispatch still reads them)."""
-        for slot, shared in pend["deferred"]:
+        for slot, shared, corr in pend["deferred"]:
             if self._prefix is not None and shared:
                 self._journal.append(
-                    flightrec.EV_PREFIX_REL, None, slot, len(shared)
+                    flightrec.EV_PREFIX_REL, corr, slot, len(shared)
                 )
                 self._prefix.release(shared)
+                self._ledger.release(shared)
             if self._paged:
-                self._journal.append(flightrec.EV_PAGE_FREE, None, slot)
+                self._journal.append(flightrec.EV_PAGE_FREE, corr, slot)
                 self._page_alloc.free(slot)
+                self._ledger.free(slot)
             self._free.append(slot)
-            self._journal.append(flightrec.EV_SLOT_FREE, None, slot)
+            self._journal.append(flightrec.EV_SLOT_FREE, corr, slot)
 
     @hotpath
     def _decode_tick_lockstep(self) -> None:
@@ -3618,6 +3686,21 @@ class InferenceEngine:
         # latency is dispatch wall over tokens-per-row, never a per-token
         # loop (the hot-path allocation budget is zero)
         denom = tokens_per_row if tokens_per_row else clock_steps
+        # capacity timeline (ISSUE 19): one numeric sample per dispatch
+        # landing — every input is an O(1) attribute read or two
+        # multiply-adds (the analytic HBM roofline), appended lock-free
+        if self._capacity_on:
+            self._sampler.append(
+                self._ledger.pages_in_use,
+                self._page_alloc.free_pages if self._paged else 0,
+                self._ledger.prefix_resident_pages,
+                rows,
+                len(self._pending),
+                float(denom) * rows,
+                capacity.hbm_bytes_per_token(
+                    self._hbm_constants, self._hbm_ctx, max(rows, 1)
+                ),
+            )
         self._observe("decode_dispatch_ms", elapsed * 1000.0)
         # the advert's many-router tiebreak signal (ISSUE 10 satellite):
         # one multiply-add per dispatch, folded here so both lanes and
@@ -3821,7 +3904,12 @@ class InferenceEngine:
                 flightrec.EV_RETIRE_DEFER, request.corr, request.slot,
                 request.generated,
             )
-            pend["deferred"].append((request.slot, request.shared_pages))
+            # the deferred tuple carries the OWNER (corr): the landing's
+            # frees must attribute to the request whose pages they are,
+            # in the journal and the capacity ledger alike (ISSUE 19)
+            pend["deferred"].append(
+                (request.slot, request.shared_pages, request.corr)
+            )
             request.shared_pages = []
             request.slot = -1
             self._untrack_retirement(request)
@@ -3839,11 +3927,13 @@ class InferenceEngine:
                     len(request.shared_pages),
                 )
                 self._prefix.release(request.shared_pages)
+                self._ledger.release(request.shared_pages)
                 request.shared_pages = []
             self._journal.append(
                 flightrec.EV_PAGE_FREE, request.corr, request.slot
             )
             self._page_alloc.free(request.slot)
+            self._ledger.free(request.slot)
         self._free.append(request.slot)
         self._journal.append(
             flightrec.EV_SLOT_FREE, request.corr, request.slot

@@ -167,11 +167,16 @@ class PrefixCache:
         self._refs[page] = 0
         return True
 
-    def evict(self, need: int, allocator: PageAllocator) -> int:
+    def evict(
+        self, need: int, allocator: PageAllocator, *, ledger=None
+    ) -> int:
         """Pop up to ``need`` zero-reference entries (oldest released
         first) back into the allocator's free list.  Evicting a chain's
         middle page strands its suffix entries (unreachable by lookup);
-        they drain through this same LRU once released."""
+        they drain through this same LRU once released.  ``ledger`` is
+        the capacity observatory's per-page hook (ISSUE 19): only the
+        cache knows WHICH pages the LRU picked, so attribution must be
+        told here, at the reclaim itself."""
         freed = 0
         while freed < need and self._lru:
             h, _ = self._lru.popitem(last=False)
@@ -179,6 +184,8 @@ class PrefixCache:
             del self._hash_of[page]
             del self._refs[page]
             allocator.give_back([page])
+            if ledger is not None:
+                ledger.evicted(page)
             freed += 1
         return freed
 

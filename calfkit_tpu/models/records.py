@@ -148,6 +148,22 @@ class EngineStatsRecord(BaseModel):
     prefix_cached_pages: int = 0
     prefix_hits: int = 0
     prefix_reused_tokens: int = 0
+    # capacity observatory (ISSUE 19): the headroom advert.  pages_total
+    # is the allocatable pool (pool minus the trash page; 0 = dense
+    # layout, no page signal); pages_in_use counts live-owner pages only
+    # (slot-held private + referenced prefix pages — zero-ref cached
+    # pages are evictable-on-demand and therefore headroom, not use);
+    # prefix_resident_pages is cache residency regardless of refcount;
+    # evictions_window is pages reclaimed under pressure THIS heartbeat
+    # interval; alloc_stalls counts admissions whose page alloc came up
+    # short (lifetime).  The registry derives headroom_pages =
+    # pages_total - pages_in_use.  Defaults read a pre-capacity record
+    # as a dense/no-signal replica, not as a full one.
+    pages_total: int = 0
+    pages_in_use: int = 0
+    prefix_resident_pages: int = 0
+    evictions_window: int = 0
+    alloc_stalls: int = 0
     # flight-recorder ring accounting ({"appended", "dropped", "dumped"}):
     # None for records from engines predating the journal
     flightrec: dict[str, int] | None = None

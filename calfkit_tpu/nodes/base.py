@@ -345,6 +345,19 @@ class BaseNodeDef(RegistryMixin):
         # (the control plane sets the resource flag): a worker that
         # cannot receive beats must not orphan a LIVE caller's run one
         # TTL after admission — fail-safe, the pre-lease behavior.
+        # ---- run identity (ISSUE 19): the x-mesh-run header rides a
+        # contextvar like the deadline/lease, so the in-process engine's
+        # capacity ledger attributes HBM pages to the logical RUN this
+        # delivery serves (not just the per-attempt correlation id)
+        from calfkit_tpu.observability import capacity as _capacity
+
+        parsed_run = protocol.parse_run(headers.get(protocol.HDR_RUN))
+        run_token = (
+            _capacity.current_run.set(parsed_run[0])
+            if parsed_run is not None
+            else None
+        )
+
         lease = protocol.parse_lease(headers.get(protocol.HDR_LEASE))
         lease_token = None
         if lease is not None and self.resources.get(CALLER_LIVENESS_FEED_KEY):
@@ -444,6 +457,8 @@ class BaseNodeDef(RegistryMixin):
         finally:
             if deadline_token is not None:
                 cancellation.current_deadline.reset(deadline_token)
+            if run_token is not None:
+                _capacity.current_run.reset(run_token)
             if lease_token is not None:
                 leases.current_lease.reset(lease_token)
             await self._flush_steps(ctx)

@@ -14,7 +14,11 @@ responder serving:
   no probe registered it reports ``503`` — "unknown" must never read as
   "ready";
 - ``GET /flightrec`` — on-demand JSONL dump of every registered engine
-  flight recorder (:mod:`calfkit_tpu.observability.flightrec`).
+  flight recorder (:mod:`calfkit_tpu.observability.flightrec`);
+- ``GET /capacity`` — on-demand JSONL dump of every registered capacity
+  sampler (:mod:`calfkit_tpu.observability.capacity`): the occupancy
+  timeline ring plus the live page-attribution breakdown in the meta
+  header.
 
 This is an OPTIONAL operator convenience — nothing in the serving path
 depends on it — so every failure mode closes the offending connection and
@@ -132,6 +136,17 @@ class MetricsServer:
             if not text:
                 return (
                     b"no flight recorders registered\n",
+                    "404 Not Found",
+                    "text/plain",
+                )
+            return text.encode("utf-8"), "200 OK", "application/x-ndjson"
+        if path == "/capacity":
+            from calfkit_tpu.observability import capacity
+
+            text = capacity.dump_all_text(reason="http")
+            if not text:
+                return (
+                    b"no capacity samplers registered\n",
                     "404 Not Found",
                     "text/plain",
                 )

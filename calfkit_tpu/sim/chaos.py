@@ -175,3 +175,13 @@ def assert_engine_drained(
             f"leaked pages: {engine._page_alloc.free_pages} free of "
             f"{total_free_pages}"
         )
+    # the attribution oracle (ISSUE 19): a drained engine's page ledger
+    # attributes every page to NO owner — a nonzero count here is an
+    # attribution leak (a missed free/release mirror), even if the
+    # allocator itself balanced
+    ledger = getattr(engine, "_ledger", None)
+    if ledger is not None:
+        assert ledger.pages_in_use == 0, (
+            f"ledger attributes {ledger.pages_in_use} page(s) to live "
+            f"owners on a drained engine: {ledger.breakdown(top=4)}"
+        )

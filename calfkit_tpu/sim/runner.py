@@ -564,6 +564,34 @@ class SimRunner:
                 "heartbeats": beats_fired,
             },
         })
+        if scenario.service.pool_pages > 0:
+            # capacity observatory metrics (ISSUE 19), summed off the
+            # same PageLedger/CapacitySampler a paged engine drives.
+            # residual_pages_in_use is the leak oracle at fleet scale:
+            # a drained fleet must attribute every page to no owner.
+            ledgers = [m.ledger for m in models if m.ledger is not None]
+            metrics["capacity"] = {
+                "pages_total": sum(led.pages_total for led in ledgers),
+                "evicted_pages": sum(led.evicted_pages for led in ledgers),
+                "alloc_stalls": sum(led.alloc_stalls for led in ledgers),
+                "prefix_resident_pages": sum(
+                    led.prefix_resident_pages for led in ledgers
+                ),
+                "headroom_pages": sum(
+                    led.headroom_pages for led in ledgers
+                ),
+                "residual_pages_in_use": sum(
+                    led.pages_in_use for led in ledgers
+                ),
+                "peak_pages_in_use": max(
+                    (m.peak_pages_in_use for m in models), default=0
+                ),
+                "samples": sum(
+                    m.sampler.counts()["appended"]
+                    for m in models
+                    if m.sampler is not None
+                ),
+            }
         if scenario.per_replica_report:
             metrics["routing"].update(
                 {

@@ -96,7 +96,18 @@ class ServiceSpec:
     admitted-but-unfinished depth past which the stub sheds with the
     REAL typed ``EngineOverloadedError`` (None = never shed).  ``skew``
     multiplies per replica (cycled), modeling a slow host in the fleet.
-    """
+
+    ``pool_pages`` (ISSUE 19) gives every replica a virtual KV page pool
+    of that size: the stub then maintains the REAL
+    :class:`~calfkit_tpu.observability.capacity.PageLedger` and
+    :class:`~calfkit_tpu.observability.capacity.CapacitySampler` (ring
+    capacity ``capacity_samples``) through the same alloc / transfer /
+    acquire / release / evict transitions a paged engine drives, with
+    page counts derived deterministically from the prompt and prefix
+    model.  ``0`` (the default) models no pool — pre-capacity scenarios
+    are untouched.  Pool size is per replica and intentionally NOT
+    scaled by :meth:`Scenario.scaled`: per-replica page pressure is the
+    thing the capacity scenario pins."""
 
     base_s: float = 0.2
     per_token_s: float = 0.01
@@ -106,6 +117,8 @@ class ServiceSpec:
     slots: int = 4
     shed_above: "int | None" = None
     skew: "tuple[float, ...]" = ()
+    pool_pages: int = 0
+    capacity_samples: int = 0
 
     def multiplier(self, replica_index: int) -> float:
         if not self.skew:

@@ -22,7 +22,13 @@ host scheduler — and the simulator promises byte-identical reports.
   timelines.  It sheds with the real typed ``EngineOverloadedError``,
   models a page-aligned prefix cache (hits skip the prefill term), and
   advertises the same counters a real engine heartbeats
-  (depth, EWMA dispatch latency, prefix hits, tokens/dispatch).
+  (depth, EWMA dispatch latency, prefix hits, tokens/dispatch).  With
+  ``ServiceSpec.pool_pages`` set it also models a bounded KV page pool,
+  driving the REAL :class:`~calfkit_tpu.observability.capacity.
+  PageLedger` / ``CapacitySampler`` through the engine's ownership
+  transitions — so capacity attribution, occupancy timelines, and the
+  headroom advert are provable at fleet scale on virtual time
+  (ISSUE 19).
 """
 
 from __future__ import annotations
@@ -32,6 +38,7 @@ from typing import Any
 
 from calfkit_tpu.exceptions import EngineOverloadedError
 from calfkit_tpu.fleet.selection import page_aligned_prefix
+from calfkit_tpu.observability import capacity
 from calfkit_tpu.sim.clock import VirtualClock
 from calfkit_tpu.sim.scenario import ServiceSpec
 
@@ -45,6 +52,16 @@ __all__ = [
 
 def _estimate_tokens(messages: Any) -> int:
     return sum(len(str(m)) // 4 for m in messages)
+
+
+# the sim's virtual KV page geometry (ISSUE 19): tokens per page for the
+# stub's deterministic page math — fixed, like the debug preset's
+# page_size, so scenario page counts are a pure function of the prompts
+SIM_PAGE_TOKENS = 16
+
+
+def _pages_for(tokens: int) -> int:
+    return max(1, -(-int(tokens) // SIM_PAGE_TOKENS))
 
 
 def _prompt_text(messages: Any) -> str:
@@ -207,6 +224,38 @@ class SimEngineModel:
         # (the report's makespan reads the fleet max — the horizon
         # no-op event must not inflate it)
         self.last_done_at = 0.0
+        # page-pool model (ISSUE 19): when the scenario gives replicas a
+        # virtual KV pool, the stub drives the REAL PageLedger and
+        # CapacitySampler — the same attribution/occupancy code a paged
+        # engine runs — through the same transitions (alloc at admission,
+        # transfer at first prefix registration, acquire/release around
+        # reuse, evict under pressure).  wall_anchor=False keeps sampler
+        # timestamps virtual; every append passes t=clock.now.
+        if self.service.pool_pages > 0:
+            self.ledger: "capacity.PageLedger | None" = (
+                capacity.PageLedger(self.service.pool_pages)
+            )
+            self.sampler: "capacity.CapacitySampler | None" = (
+                capacity.CapacitySampler(
+                    self.service.capacity_samples,
+                    label=f"sim-r{index}",
+                    ledger=self.ledger,
+                    wall_anchor=False,
+                )
+            )
+        else:
+            self.ledger = None
+            self.sampler = None
+        self._free_pool = max(0, self.service.pool_pages)
+        self._next_page = 0
+        # chain key -> resident page ids; insertion order IS the LRU
+        # order (zero-ref chains re-append on release), so eviction pops
+        # from the front exactly like PrefixCache's LRU
+        self._chain_pages: "dict[bytes, tuple[int, ...]]" = {}
+        # chain key -> in-flight reference count (a referenced chain is
+        # never evictable, mirroring the zero-ref eviction law)
+        self._chain_held: "dict[bytes, int]" = {}
+        self.peak_pages_in_use = 0
 
     @property
     def model_name(self) -> str:
@@ -224,7 +273,7 @@ class SimEngineModel:
 
     def stats_snapshot(self, *, window: bool = False) -> dict:
         in_service = self._in_service()
-        return {
+        snapshot = {
             "model_name": self.model_name,
             "platform": "sim",
             "active_requests": in_service,
@@ -243,6 +292,46 @@ class SimEngineModel:
                 else 0.0
             ),
         }
+        if self.ledger is not None:
+            # the capacity scalars a paged engine heartbeats (ISSUE 19),
+            # read off the same ledger — sim adverts carry real headroom
+            snapshot["pages_total"] = self.ledger.pages_total
+            snapshot["pages_in_use"] = self.ledger.pages_in_use
+            snapshot["prefix_resident_pages"] = (
+                self.ledger.prefix_resident_pages
+            )
+            snapshot["evictions_window"] = self.ledger.evicted_pages
+            snapshot["alloc_stalls"] = self.ledger.alloc_stalls
+        return snapshot
+
+    # -------------------------------------------------------------- pages
+    def _reserve_pages(self, need: int) -> int:
+        """Deterministic pool pressure: take ``need`` pages from the free
+        pool, evicting zero-ref LRU chains through the REAL ledger hook
+        when short.  A still-short reservation counts a stall and clamps
+        — page accounting is telemetry; virtual service proceeds
+        regardless, exactly the never-fault-serving contract."""
+        assert self.ledger is not None
+        if need > self._free_pool:
+            for chain in list(self._chain_pages):
+                if need <= self._free_pool:
+                    break
+                if self._chain_held.get(chain):
+                    continue  # referenced — not evictable
+                pages = self._chain_pages.pop(chain)
+                self._chain_held.pop(chain, None)
+                # an evicted chain must re-miss (and re-prefill) later:
+                # churn is allowed to cost hit rate, and the scenario
+                # measures exactly that
+                self._prefix_seen.discard(chain)
+                for page in pages:
+                    self.ledger.evicted(page)
+                self._free_pool += len(pages)
+            if need > self._free_pool:
+                self.ledger.note_stall()
+                need = self._free_pool
+        self._free_pool -= need
+        return need
 
     # ------------------------------------------------------------ serving
     async def request(
@@ -295,6 +384,34 @@ class SimEngineModel:
         self._next_run += 1
         self._inflight[run_id] = (start_at, done_at)
 
+        shared: "tuple[int, ...]" = ()
+        granted = 0
+        if self.ledger is not None:
+            if prefix_hit and key is not None and key in self._chain_pages:
+                # reuse granted: reference the chain's resident pages
+                # (registration may still be in flight on a racing first
+                # request — then there is nothing to reference yet)
+                shared = self._chain_pages[key]
+                self.ledger.acquire(list(shared))
+                self._chain_held[key] = self._chain_held.get(key, 0) + 1
+            granted = self._reserve_pages(
+                _pages_for(
+                    spec.new_tokens + (0 if prefix_hit else input_tokens)
+                )
+            )
+            self.ledger.alloc(
+                run_id,
+                granted,
+                f"sim-r{self.index}-{run_id}",
+                # the REAL run-identity seam: the node kernel set this
+                # from the x-mesh-run header before calling the model
+                capacity.current_run.get(),
+                "decode",
+            )
+            self.peak_pages_in_use = max(
+                self.peak_pages_in_use, self.ledger.pages_in_use
+            )
+
         done = asyncio.Event()
         self.clock.schedule(done_at, done.set)
         await done.wait()
@@ -315,6 +432,45 @@ class SimEngineModel:
             if self.dispatch_ewma_ms == 0.0
             else 0.8 * self.dispatch_ewma_ms + 0.2 * per_dispatch_ms
         )
+        if self.ledger is not None:
+            # retirement: drop the shared reference, register the chain
+            # off the first finisher's private pages (transfer at
+            # refcount 1, then this request's own release — leaving the
+            # chain zero-ref resident, evictable), free the rest
+            if shared:
+                self.ledger.release(list(shared))
+                held = self._chain_held.get(key, 1) - 1
+                if held <= 0:
+                    self._chain_held.pop(key, None)
+                    if key in self._chain_pages:
+                        # zero-ref again: re-append = move to LRU tail
+                        self._chain_pages[key] = self._chain_pages.pop(key)
+                else:
+                    self._chain_held[key] = held
+            elif key is not None and key not in self._chain_pages and granted:
+                moved = min(_pages_for(len(key) // 4), granted)
+                pages = tuple(
+                    range(self._next_page, self._next_page + moved)
+                )
+                self._next_page += moved
+                self.ledger.transfer(run_id, list(pages), [key] * moved)
+                self.ledger.release(list(pages))
+                self._chain_pages[key] = pages
+                granted -= moved
+            self.ledger.free(run_id)
+            self._free_pool += granted
+            if self.sampler is not None:
+                in_service = self._in_service()
+                self.sampler.append(
+                    self.ledger.pages_in_use,
+                    self._free_pool,
+                    self.ledger.prefix_resident_pages,
+                    in_service,
+                    len(self._inflight) - in_service,
+                    round(spec.new_tokens / dispatches, 6),
+                    0.0,  # no analytic HBM model for the virtual device
+                    t=self.clock.now,
+                )
         return ModelResponse(
             parts=[TextOutput(text=f"sim:r{self.index}:{self.replies}")],
             usage=Usage(

@@ -2,7 +2,7 @@
 runs on every PR and what SIM.json / SIM_BASELINE.json are captured
 from.
 
-Seven geometries, each exercising a different fleet claim through the
+Eight geometries, each exercising a different fleet claim through the
 real mesh → worker → router path (see docs/simulation.md for the full
 metric definitions and the reasoning behind every bound):
 
@@ -30,6 +30,14 @@ metric definitions and the reasoning behind every bound):
 - **lease_churn** — 20k synthetic caller leases churn against the real
   compacted liveness table while traffic flows: the lapse law and the
   store cap hold at fleet scale.
+- **capacity_churn** — the hotspot geometry with every replica given a
+  page pool SMALLER than its session working set (ISSUE 19): the real
+  :class:`~calfkit_tpu.observability.capacity.PageLedger` must show
+  eviction churn under pressure, the occupancy timeline must sample,
+  and a drained fleet must attribute every page to no owner
+  (``capacity.residual_pages_in_use == 0`` — the leak oracle at fleet
+  scale).  Gates eviction volume, alloc stalls, peak occupancy, and
+  the churn-degraded prefix hit rate.
 
 Scenario *definitions* are data: the tier-1 tests run
 ``scaled_suite(0.1)`` for speed; the perf gate runs ``PINNED_SUITE``
@@ -285,6 +293,54 @@ LEASE_CHURN = Scenario(
 )
 
 
+CAPACITY_CHURN = Scenario(
+    name="capacity_churn",
+    replicas=16,
+    seed=97,
+    phases=(LoadPhase(duration_s=600.0, rate_rps=4.0),),
+    policy="prefix-affinity",
+    tenants=(
+        TenantSpec("hot", weight=6.0, sessions=24),
+        TenantSpec("t1", weight=1.0, sessions=16),
+        TenantSpec("t2", weight=1.0, sessions=16),
+        TenantSpec("t3", weight=1.0, sessions=16),
+    ),
+    # the hotspot service shape, with a per-replica page pool sized just
+    # UNDER the steady-state session working set (~4-5 resident chains x
+    # 4 pages each, plus in-flight private pages): prefix registration
+    # and fresh admissions must fight for pages, so the zero-ref LRU
+    # eviction path — and its hit-rate cost — actually runs.  pool_pages
+    # is per replica and survives Scenario.scaled untouched, so the
+    # tier-1 scaled run sees the same per-replica pressure.
+    service=ServiceSpec(
+        base_s=0.4, per_token_s=0.02, prefill_per_token_s=0.01, slots=2,
+        pool_pages=24, capacity_samples=256,
+    ),
+    heartbeat_every_s=5.0,
+    stale_after_s=15.0,
+    checks=(
+        Check("all_complete", "requests.completion_ratio", "==", 1.0),
+        Check("no_faults", "requests.failed", "==", 0.0),
+        # the pool is undersized by construction — if nothing evicts,
+        # the pressure model is broken, not the fleet healthy
+        Check("pool_bites", "capacity.evicted_pages", ">=", 1.0),
+        Check("pool_pressured", "capacity.peak_pages_in_use", ">=", 6.0),
+        Check("timeline_sampled", "capacity.samples", ">=", 1.0),
+        # the leak oracle at fleet scale: after the fleet drains, every
+        # page is attributed to no owner
+        Check("no_page_leak", "capacity.residual_pages_in_use", "==", 0.0),
+    ),
+    gated=(
+        "requests.completed",
+        "capacity.evicted_pages",
+        "capacity.alloc_stalls",
+        "capacity.peak_pages_in_use",
+        "capacity.prefix_resident_pages",
+        "prefix.hit_rate",
+    ),
+)
+
+
 PINNED_SUITE: "tuple[Scenario, ...]" = (
     STEADY_STATE,
     DIURNAL,
@@ -293,12 +349,13 @@ PINNED_SUITE: "tuple[Scenario, ...]" = (
     PARTITION_HEAL,
     RUN_LEDGER,
     LEASE_CHURN,
+    CAPACITY_CHURN,
 )
 
 
 
 def scaled_suite(factor: float) -> "tuple[Scenario, ...]":
-    """The same seven geometries, proportionally smaller — the tier-1
+    """The same eight geometries, proportionally smaller — the tier-1
     determinism tests' fast path (arrival rates scale with the fleet so
     per-replica load, and therefore every verdict, is preserved)."""
     return tuple(s.scaled(factor) for s in PINNED_SUITE)

@@ -92,6 +92,18 @@ def default_config(root: "Path | str") -> Config:
                 "must never read host time",
             ),
             RequiredRoots(
+                "calfkit_tpu.observability.capacity", "hotpath", 7,
+                "the page ledger's O(1) mutation promise (ISSUE 19: "
+                "alloc/free/transfer/acquire/release/evicted + sampler "
+                "append) must stay rooted",
+            ),
+            RequiredRoots(
+                "calfkit_tpu.observability.capacity", "no_wallclock", 2,
+                "the capacity rollup math (ISSUE 19: breakdown, the "
+                "analytic HBM model) is gated by the sim — it must never "
+                "read host time",
+            ),
+            RequiredRoots(
                 "perf_gate", "no_wallclock", 1,
                 "the gate's metric compare must never read host time "
                 "(ISSUE 11)",

@@ -27,6 +27,16 @@ artifact:
   supervisor path, so this is the honest ledger-on/off delta: its cost
   is exactly these appends (there is no other ledger work on the hot
   path), and it folds into the same gated ``implied_delta_pct`` bar.
+- ``capacity_implied_delta_pct`` (ISSUE 19): the page-attribution
+  share — the ledger's per-request steady-state mutation bundle
+  (alloc → acquire → release → free, the O(1) mirrors at the engine's
+  existing page sites) timed directly and amortized over the call's
+  dispatches.  Attribution is ALWAYS on for paged engines, so this
+  folds into the gated ``implied_delta_pct``.  The occupancy sampler is
+  opt-in (``capacity_samples=0`` default — one attribute check per
+  dispatch); its per-append cost is reported separately as
+  ``capacity_sampler_implied_delta_pct`` and NOT folded into the gated
+  value, matching the shipped default.
 - ``ab_delta_pct`` / ``journal_ab_delta_pct`` (evidence, not gated):
   best-of-N tok/s with observability on vs off, and with the journal on
   (``flightrec_events`` default) vs off (0).  On a shared-CPU container,
@@ -235,6 +245,53 @@ def _ledger_call_us(iters: int = 50000) -> float:
     return samples[2]
 
 
+def _capacity_ledger_us(iters: int = 50000) -> float:
+    """Median-of-5 timing of one request's ENTIRE page-attribution
+    bundle (ISSUE 19): the steady-state mirrors the engine pays per
+    admission/retirement on the paged path — alloc (private pages to the
+    slot) → acquire (the shared prefix chain) → release → free.  The
+    chain-registration ``transfer`` happens once per NEW chain, not per
+    request, so it is not billed here; attribution has no other hot-path
+    work."""
+    from calfkit_tpu.observability.capacity import PageLedger
+
+    ledger = PageLedger(4096)
+    shared = list(range(4000, 4004))
+    ledger.transfer(999, shared, [b"chain-%d" % p for p in shared])
+    ledger.release(shared)
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            slot = i & 63
+            ledger.alloc(slot, 4, "corr-%05d" % slot, "run-%05d" % slot,
+                         "decode")
+            ledger.acquire(shared)
+            ledger.release(shared)
+            ledger.free(slot)
+        samples.append((time.perf_counter() - t0) / iters * 1e6)
+    samples.sort()
+    return samples[2]
+
+
+def _capacity_sampler_us(iters: int = 100000) -> float:
+    """Median-of-5 timing of one occupancy-timeline append — the exact
+    call ``_note_dispatch`` pays per landing when ``capacity_samples``
+    is nonzero (the opt-in path; at 0 the cost is a single attribute
+    check and this estimator does not apply)."""
+    from calfkit_tpu.observability.capacity import CapacitySampler
+
+    sampler = CapacitySampler(4096, label="bench")
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            sampler.append(512, 512, 128, BS, 0, float(STEPS), 0.0)
+        samples.append((time.perf_counter() - t0) / iters * 1e6)
+    samples.sort()
+    return samples[2]
+
+
 async def run() -> dict:
     # one discarded warmup rep: jit tracing / allocator warmup must not be
     # billed to either mode
@@ -284,12 +341,24 @@ async def run() -> dict:
     ledger_call_us = _ledger_call_us()
     dispatches_per_call = max(1.0, NEW_TOKENS / STEPS)
     ledger_us = ledger_call_us / dispatches_per_call
+    # page attribution (ISSUE 19): the per-request mutation bundle
+    # amortizes the same way; always on for paged engines, so it joins
+    # the gated sum.  The occupancy sampler is opt-in (capacity_samples=0
+    # default) — reported, not gated.
+    capacity_call_us = _capacity_ledger_us()
+    capacity_us = capacity_call_us / dispatches_per_call
+    sampler_append_us = _capacity_sampler_us()
     tokens_per_dispatch = BS * STEPS
     host_us_per_dispatch = tokens_per_dispatch / best_on * 1e6
     journal_implied_delta_pct = journal_us / host_us_per_dispatch * 100.0
     ledger_implied_delta_pct = ledger_us / host_us_per_dispatch * 100.0
+    capacity_implied_delta_pct = capacity_us / host_us_per_dispatch * 100.0
+    capacity_sampler_implied_delta_pct = (
+        sampler_append_us / host_us_per_dispatch * 100.0
+    )
     implied_delta_pct = (
-        (bundle_us + journal_us + ledger_us) / host_us_per_dispatch * 100.0
+        (bundle_us + journal_us + ledger_us + capacity_us)
+        / host_us_per_dispatch * 100.0
     )
     ok = implied_delta_pct < DELTA_BAR_PCT
     return {
@@ -306,6 +375,13 @@ async def run() -> dict:
         "ledger_call_us": round(ledger_call_us, 3),
         "ledger_us_per_dispatch": round(ledger_us, 3),
         "ledger_implied_delta_pct": round(ledger_implied_delta_pct, 4),
+        "capacity_call_us": round(capacity_call_us, 3),
+        "capacity_us_per_dispatch": round(capacity_us, 3),
+        "capacity_implied_delta_pct": round(capacity_implied_delta_pct, 4),
+        "capacity_sampler_append_us": round(sampler_append_us, 4),
+        "capacity_sampler_implied_delta_pct": round(
+            capacity_sampler_implied_delta_pct, 4
+        ),
         "host_us_per_dispatch": round(host_us_per_dispatch, 1),
         "tok_s_observability_on": round(best_on, 1),
         "tok_s_observability_off": round(best_off, 1),

@@ -1,0 +1,496 @@
+"""Capacity observatory suite (ISSUE 19).
+
+Five law groups and one end-to-end acceptance drill:
+
+- :class:`PageLedger` attribution laws: the in-use identity
+  (``private + shared = in use``), transfer/acquire/release refcount
+  mirroring, eviction accounting, and the never-fault tolerance for
+  pages the ledger has not seen;
+- :class:`CapacitySampler` ring laws (flightrec's discipline: power-of
+  two capacity, counted overflow, 0=off) plus the JSONL dump/parse
+  round trip and the ``GET /capacity`` endpoint;
+- the ``ck capacity`` render functions and the fleet table's HEADROOM
+  column (pure, no mesh required);
+- the advert half: :attr:`Replica.headroom_pages` None-vs-zero
+  semantics;
+- THE acceptance drill: a REAL debug paged engine serves requests with
+  sampling on, ``stats_snapshot()["capacity"]`` attributes live pages,
+  the dump renders a timeline + breakdown through the CLI renderers,
+  and after drain the ledger attributes every page to NO owner
+  (:func:`assert_engine_drained`'s attribution oracle);
+- the sim half: the ``capacity_churn`` geometry under pressure — pool
+  bites (evictions), no page leak, deterministic capacity metrics.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+from calfkit_tpu.cli.obs import (
+    render_capacity_breakdown,
+    render_capacity_table,
+    render_capacity_timeline,
+    render_fleet_table,
+    sparkline,
+)
+from calfkit_tpu.models.records import EngineStatsRecord
+from calfkit_tpu.observability import capacity
+from calfkit_tpu.observability.capacity import (
+    SAMPLE_FIELDS,
+    CapacitySampler,
+    PageLedger,
+    hbm_bytes_per_token,
+    hbm_constants,
+    lane_kind,
+)
+
+
+# -------------------------------------------------------------- ledger laws
+class TestPageLedgerLaws:
+    def test_alloc_free_balance_and_idempotence(self):
+        ledger = PageLedger(64)
+        ledger.alloc(3, 5, "corr-a", "run-a", "decode")
+        ledger.alloc(7, 2, "corr-b", None, "spec")
+        assert ledger.pages_in_use == 7
+        assert ledger.headroom_pages == 57
+        # re-alloc of a live slot REPLACES its grant (admission retry),
+        # never double-counts
+        ledger.alloc(3, 4, "corr-a2", None, "decode")
+        assert ledger.pages_in_use == 6
+        ledger.free(3)
+        ledger.free(3)  # idempotent, like PageAllocator.free
+        ledger.free(99)  # unknown slot: tolerated, never a fault
+        ledger.free(7)
+        assert ledger.pages_in_use == 0
+
+    def test_transfer_moves_private_to_chain_at_refcount_one(self):
+        ledger = PageLedger(32)
+        ledger.alloc(0, 6, "corr-a", "run-a", "decode")
+        ledger.transfer(0, [10, 11, 12], [b"h1", b"h1", b"h1"])
+        # in-use total unchanged: the registering request still holds the
+        # pages, just as shared instead of private
+        assert ledger.pages_in_use == 6
+        assert ledger.prefix_resident_pages == 3
+        bd = ledger.breakdown()
+        assert bd["private_pages"] == 3
+        assert bd["shared_referenced_pages"] == 3
+        # release to zero-ref: resident but NOT in use (evictable on
+        # demand = headroom)
+        ledger.release([10, 11, 12])
+        ledger.free(0)
+        assert ledger.pages_in_use == 0
+        assert ledger.prefix_resident_pages == 3
+        assert ledger.headroom_pages == 32
+
+    def test_acquire_release_refcounts_and_tolerance(self):
+        ledger = PageLedger(16)
+        ledger.alloc(0, 2, "c", None, "decode")
+        ledger.transfer(0, [1, 2], [b"h", b"h"])
+        ledger.release([1, 2])
+        assert ledger.pages_in_use == 0
+        ledger.acquire([1, 2, 999])  # 999 not chain-owned: skipped
+        assert ledger.pages_in_use == 2
+        ledger.acquire([1])
+        ledger.release([1])
+        assert ledger.pages_in_use == 2  # still one holder of page 1
+        ledger.release([1, 2])
+        ledger.release([1, 2])  # below zero: clamped, never negative
+        assert ledger.pages_in_use == 0
+
+    def test_eviction_accounting(self):
+        ledger = PageLedger(8)
+        ledger.alloc(0, 3, "c", None, "decode")
+        ledger.transfer(0, [5, 6, 7], [b"x", b"x", b"x"])
+        ledger.release([5, 6, 7])
+        ledger.evicted(5)
+        ledger.evicted(5)  # already gone: tolerated, counted once
+        ledger.evicted(42)  # never chain-owned: tolerated
+        assert ledger.evicted_pages == 1
+        assert ledger.prefix_resident_pages == 2
+        ledger.note_stall()
+        assert ledger.alloc_stalls == 1
+        # evicting a REFERENCED page (forced reclaim) drops in-use too
+        ledger.acquire([6])
+        assert ledger.pages_in_use == 1
+        ledger.evicted(6)
+        assert ledger.pages_in_use == 0
+
+    def test_breakdown_caps_rows_and_counts_remainder(self):
+        ledger = PageLedger(128)
+        for slot in range(10):
+            ledger.alloc(slot, slot + 1, f"corr-{slot}", None, "decode")
+        bd = ledger.breakdown(top=3)
+        # top owners by pages desc, remainder summed — never silent
+        assert [o["pages"] for o in bd["by_owner"]] == [10, 9, 8]
+        assert bd["by_owner_other_pages"] == sum(range(1, 8))
+        assert bd["pages_in_use"] == sum(range(1, 11))
+        assert bd["by_lane"]["decode"] == bd["pages_in_use"]
+
+    def test_breakdown_lane_and_chain_rollups(self):
+        ledger = PageLedger(64)
+        ledger.alloc(0, 4, "c0", "run-a", "long")
+        ledger.alloc(1, 2, "c1", None, "spec")
+        ledger.transfer(0, [1, 2], [b"\xaa\xbb", b"\xaa\xbb"])
+        bd = ledger.breakdown()
+        assert bd["by_lane"] == {"long": 2, "spec": 2, "shared": 2}
+        assert bd["by_chain"][0]["chain"] == "aabb"  # bytes render hex
+        assert bd["by_chain"][0]["refs"] == 1
+        # owner rows carry the run tag for `ck capacity` attribution
+        assert any(o["run"] == "run-a" for o in bd["by_owner"])
+
+    def test_lane_kind_vocabulary(self):
+        assert lane_kind() == "decode"
+        assert lane_kind(history=object()) == "spec"
+        assert lane_kind(long_lane=True) == "long"
+
+    def test_hbm_model_agrees_with_roofline_shape(self):
+        class M:
+            param_count = 1_000_000
+            n_layers = 4
+            n_kv_heads = 2
+            head_dim = 64
+
+        weight_bytes, kv_per_token = hbm_constants(M())
+        assert weight_bytes == 2_000_000.0  # bf16
+        assert hbm_constants(M(), "int8")[0] == 1_000_000.0
+        assert kv_per_token == 2.0 * 4 * 2 * 64 * 2.0
+        # amortization law: doubling the batch halves the weight share
+        one = hbm_bytes_per_token((weight_bytes, kv_per_token), 128.0, 1.0)
+        two = hbm_bytes_per_token((weight_bytes, kv_per_token), 128.0, 2.0)
+        assert one - kv_per_token * 128.0 == pytest.approx(
+            2 * (two - kv_per_token * 128.0)
+        )
+
+
+# ------------------------------------------------------------- sampler ring
+class TestCapacitySamplerRing:
+    def test_capacity_rounds_to_power_of_two_and_overflow_counts(self):
+        sampler = CapacitySampler(10, label="ring")
+        assert sampler.capacity == 16
+        for i in range(36):
+            sampler.append(i, 0, 0, 0, 0, 0.0, 0.0, t=float(i))
+        counts = sampler.counts()
+        assert counts["appended"] == 36
+        assert counts["dropped"] == 20  # overwritten, counted — not silent
+        # the ring keeps the NEWEST samples, ordered by sequence
+        assert [e[0] for e in sampler.snapshot()] == list(range(20, 36))
+
+    def test_zero_capacity_disables_and_stays_unregistered(self):
+        sampler = CapacitySampler(0, label="off")
+        sampler.append(1, 2, 3, 4, 5, 6.0, 7.0)
+        assert sampler.snapshot() == []
+        assert sampler.counts() == {"appended": 0, "dropped": 0, "dumped": 0}
+        assert sampler not in capacity.samplers()
+
+    def test_negative_capacity_rejected(self):
+        with pytest.raises(ValueError):
+            CapacitySampler(-1)
+
+    def test_dump_parse_round_trip_with_breakdown(self):
+        ledger = PageLedger(32)
+        ledger.alloc(0, 4, "corr-x", "run-x", "decode")
+        sampler = CapacitySampler(
+            8, label="rt", ledger=ledger, wall_anchor=False
+        )
+        sampler.append(4, 28, 0, 1, 0, 32.0, 1.5, t=10.0)
+        sampler.append(6, 26, 2, 2, 1, 32.0, 1.5, t=11.0)
+        meta, samples = capacity.parse_dump(
+            sampler.dump_lines(reason="test")
+        )
+        assert meta["label"] == "rt" and meta["reason"] == "test"
+        assert meta["fields"] == list(SAMPLE_FIELDS)
+        assert meta["appended"] == 2 and meta["dropped"] == 0
+        # the attached ledger's attribution snapshot rides the header
+        assert meta["breakdown"]["pages_in_use"] == 4
+        assert [s["pages_in_use"] for s in samples] == [4, 6]
+        assert samples[0]["t_s"] == 10.0  # wall_anchor=False: virtual time
+        assert samples[1]["hbm_bytes_per_token"] == 1.5
+
+    def test_parse_dump_skips_garbage(self):
+        good = {"seq": 1, "t_s": 1.0, "pages_in_use": 3}
+        meta, samples = capacity.parse_dump(
+            ["not json", "", "[1,2]", json.dumps({"capacity": {"label": "x"}}),
+             json.dumps({"seq": "no"}), json.dumps(good)]
+        )
+        assert meta == {"label": "x"}
+        assert [s["pages_in_use"] for s in samples] == [3]
+
+    def test_dump_writes_capacity_prefixed_file(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CALFKIT_FLIGHTREC_DIR", str(tmp_path))
+        sampler = CapacitySampler(4, label="disk")
+        sampler.append(1, 3, 0, 1, 0, 8.0, 0.0)
+        path = sampler.dump(reason="test")
+        assert os.path.basename(path).startswith("capacity-disk-")
+        with open(path) as f:
+            meta, samples = capacity.parse_dump(f)
+        assert meta["label"] == "disk" and len(samples) == 1
+        assert sampler.counts()["dumped"] == 1
+
+    def test_dump_all_text_concatenates_registered(self):
+        a = CapacitySampler(4, label="all-a")
+        b = CapacitySampler(4, label="all-b")
+        a.append(1, 0, 0, 0, 0, 0.0, 0.0, t=1.0)
+        b.append(2, 0, 0, 0, 0, 0.0, 0.0, t=1.0)
+        text = capacity.dump_all_text(reason="test")
+        labels = {
+            json.loads(line)["capacity"]["label"]
+            for line in text.splitlines()
+            if '"capacity"' in line and "all-" in line
+        }
+        assert {"all-a", "all-b"} <= labels
+        assert a.dumped == 1 and b.dumped == 1
+
+    async def test_capacity_endpoint_serves_ndjson(self):
+        from calfkit_tpu.observability.http import MetricsServer
+
+        sampler = CapacitySampler(8, label="http-cap")
+        sampler.append(3, 5, 1, 2, 0, 16.0, 0.0, t=1.0)
+
+        async def get(port: int, path: str) -> tuple[str, str]:
+            reader, writer = await asyncio.open_connection("127.0.0.1", port)
+            writer.write(f"GET {path} HTTP/1.0\r\n\r\n".encode())
+            await writer.drain()
+            raw = await reader.read(262144)
+            writer.close()
+            head, _, body = raw.decode().partition("\r\n\r\n")
+            return head.splitlines()[0], body
+
+        async with MetricsServer(port=0) as server:
+            status, body = await get(server.port, "/capacity")
+        assert status == "HTTP/1.0 200 OK"
+        ours = [
+            line for line in body.splitlines() if '"http-cap"' in line
+        ]
+        assert ours, "endpoint body missing our sampler's header"
+        assert sampler.dumped == 1
+
+
+# ---------------------------------------------------------------- renders
+def _replica(pages_total=0, pages_in_use=0, **stats_kw):
+    """A minimal Replica via the real record (the renderer's input)."""
+    from calfkit_tpu.fleet.registry import Replica
+
+    stats = EngineStatsRecord(
+        node_id="agent.svc", model_name="debug", instance_id="i0",
+        pages_total=pages_total, pages_in_use=pages_in_use, **stats_kw,
+    )
+    return Replica(
+        key="agent.svc@i0", node_id="agent.svc", instance_id="i0",
+        heartbeat_at=100.0, stats=stats,
+    )
+
+
+class TestCapacityRenderers:
+    def test_sparkline_laws(self):
+        assert sparkline([]) == ""
+        assert sparkline([0, 0, 0]) == "▁▁▁"  # drained = flat, not empty
+        line = sparkline([0, 4, 8])
+        assert line[-1] == "█" and line[0] == "▁"
+        assert len(sparkline(range(100), width=60)) == 60
+
+    def test_capacity_table_rows_and_dense_dashes(self):
+        paged = _replica(
+            pages_total=64, pages_in_use=40, prefix_resident_pages=12,
+            evictions_window=3, alloc_stalls=1,
+        )
+        dense = _replica()  # no pool: dashes, never zeros
+        out = render_capacity_table([paged, dense])
+        assert "HEADROOM" in out and "STALLS" in out
+        row = next(line for line in out.splitlines() if " 64 " in line)
+        assert " 40 " in row and " 24 " in row and " 12 " in row
+        assert any(
+            line.count("-") >= 6 for line in out.splitlines()
+        ), "dense replica must render dashes across the page columns"
+        assert "no advertised replicas" in render_capacity_table([])
+
+    def test_fleet_table_headroom_column(self):
+        out = render_fleet_table(
+            [
+                _replica(pages_total=64, pages_in_use=40, ready=True),
+                _replica(ready=True),
+            ],
+            stale_after=15.0,
+            now=100.0,
+        )
+        lines = out.splitlines()
+        # the table is column-aligned: slice each row at the header's
+        # HEADROOM offset (multi-word headers make split() unusable)
+        idx = lines[0].index("HEADROOM")
+        assert lines[1][idx:].split()[0] == "24"
+        assert lines[2][idx:].split()[0] == "-"  # no pool ≠ zero headroom
+
+    def test_breakdown_render(self):
+        ledger = PageLedger(32)
+        ledger.alloc(0, 5, "corr-aaa", "run-bbb", "decode")
+        ledger.transfer(0, [1, 2], [b"\xab\xcd", b"\xab\xcd"])
+        ledger.note_stall()
+        out = render_capacity_breakdown(ledger.breakdown())
+        assert "pages 5/32 in use" in out
+        assert "(private 3 + shared 2; resident 2)" in out
+        assert "headroom 27" in out and "stalls 1" in out
+        assert "corr-aaa" in out and "run-bbb" in out
+        assert "lanes" in out and "shared=2" in out
+        assert "abcd×1" in out
+
+    def test_timeline_render(self):
+        sampler = CapacitySampler(8, label="tl", wall_anchor=False)
+        sampler.append(4, 28, 0, 1, 0, 32.0, 0.0, t=1.0)
+        sampler.append(8, 24, 2, 2, 1, 32.0, 0.0, t=2.0)
+        meta, samples = capacity.parse_dump(sampler.dump_lines())
+        out = render_capacity_timeline(meta, samples)
+        assert "capacity tl" in out and "2 samples" in out
+        for field in SAMPLE_FIELDS:
+            assert field in out
+        assert "max 8" in out and "last 8" in out
+        assert "no capacity samples" in render_capacity_timeline(None, [])
+
+    def test_newest_dump_ignores_flightrec_files(self, tmp_path):
+        from calfkit_tpu.cli.obs import _newest_capacity_dump
+
+        (tmp_path / "flightrec-engine-1.jsonl").write_text("{}\n")
+        assert _newest_capacity_dump(str(tmp_path)) is None
+        target = tmp_path / "capacity-engine-1.jsonl"
+        target.write_text("{}\n")
+        assert _newest_capacity_dump(str(tmp_path)) == str(target)
+
+
+# ------------------------------------------------------------- end to end
+class TestCapacityEngineE2E:
+    def _engine(self, **overrides):
+        from calfkit_tpu.inference.config import RuntimeConfig, preset
+        from calfkit_tpu.inference.engine import InferenceEngine
+
+        rt = RuntimeConfig(
+            max_batch_size=4, max_seq_len=256, kv_layout="paged",
+            chunked_prefill=True, prefill_chunk=32, page_size=16,
+            decode_steps_per_dispatch=4, **overrides,
+        )
+        return InferenceEngine(preset("debug"), rt)
+
+    async def test_live_attribution_then_drained_attributes_nothing(
+        self, tmp_path, monkeypatch
+    ):
+        """THE ISSUE 19 acceptance drill: a REAL debug engine with
+        sampling on serves concurrent requests; mid-flight the snapshot
+        attributes pages to live owners; the dump renders a timeline +
+        breakdown through the `ck capacity` renderers; after drain the
+        ledger attributes every page to NO owner."""
+        from calfkit_tpu.inference.client import JaxLocalModelClient
+        from calfkit_tpu.sim.chaos import assert_engine_drained
+
+        monkeypatch.setenv("CALFKIT_FLIGHTREC_DIR", str(tmp_path))
+        engine = self._engine(capacity_samples=64)
+        client = JaxLocalModelClient(engine=engine)
+        await engine.start()
+        peak = {"pages": 0, "snap": None}
+
+        async def one(i: int) -> int:
+            n = 0
+            async for _ in engine.generate(
+                list(range(1, 24)), max_new_tokens=12, corr=f"req-{i}"
+            ):
+                n += 1
+                if engine._ledger.pages_in_use > peak["pages"]:
+                    peak["pages"] = engine._ledger.pages_in_use
+                    peak["snap"] = client.stats_snapshot()
+            return n
+
+        outs = await asyncio.gather(*[one(i) for i in range(3)])
+        assert all(n == 12 for n in outs)
+
+        # ---- mid-flight: pages attributed to live request owners
+        assert peak["pages"] > 0
+        snap = peak["snap"]
+        assert snap["pages_total"] == engine._ledger.pages_total > 0
+        assert snap["pages_in_use"] > 0
+        bd = snap["capacity"]
+        assert bd["pages_in_use"] == snap["pages_in_use"]
+        owners = {o["corr"] for o in bd["by_owner"]}
+        assert any(corr and corr.startswith("req-") for corr in owners)
+
+        # ---- the sampler recorded one sample per dispatch landing
+        # (read AFTER the gather: the landing's append can race the
+        # consumer's mid-stream snapshot by one tick)
+        assert client.stats_snapshot()["capacity_samples"]["appended"] > 0
+        path = engine._sampler.dump(reason="test")
+        await engine.stop()
+        with open(path) as f:
+            meta, samples = capacity.parse_dump(f)
+        assert samples, "dump carried no samples"
+        assert max(s["pages_in_use"] for s in samples) > 0
+        out = render_capacity_timeline(meta, samples)
+        assert "pages_in_use" in out and "▁" in out or "█" in out
+        assert render_capacity_breakdown(meta["breakdown"])
+
+        # ---- drained: every page back, attributed to no one
+        assert_engine_drained(engine)
+        assert engine._ledger.pages_in_use == 0
+        final = client.stats_snapshot()
+        assert final["pages_in_use"] == 0
+        assert final["capacity"]["by_owner"] == []
+
+    async def test_sampling_off_is_default_and_records_nothing(self):
+        engine = self._engine()  # capacity_samples defaults to 0
+        await engine.start()
+        async for _ in engine.generate([1, 2, 3], max_new_tokens=4):
+            pass
+        assert engine._sampler.counts()["appended"] == 0
+        # attribution still runs (always on for paged): the ledger saw
+        # the request come and go
+        assert engine._ledger.pages_in_use == 0
+        assert engine._ledger.pages_total > 0
+        await engine.stop()
+
+    async def test_cold_snapshot_carries_capacity_keys(self):
+        from calfkit_tpu.inference.client import JaxLocalModelClient
+        from calfkit_tpu.inference.config import RuntimeConfig
+
+        cold = JaxLocalModelClient(
+            config="debug",
+            runtime=RuntimeConfig(
+                max_batch_size=4, max_seq_len=256, kv_layout="paged",
+                page_size=16,
+            ),
+        )
+        snap = cold.stats_snapshot()
+        assert snap["pages_total"] > 0 and snap["pages_in_use"] == 0
+        assert snap["capacity"]["headroom_pages"] == snap["pages_total"]
+        assert snap["capacity_samples"] == {
+            "appended": 0, "dropped": 0, "dumped": 0,
+        }
+        # the advert record accepts the snapshot wholesale
+        record = EngineStatsRecord(node_id="agent.x", **snap)
+        assert record.pages_total == snap["pages_total"]
+
+    def test_capacity_churn_scaled_pressures_pool_without_leaking(self):
+        """The sim half: the pinned geometry under 0.15 scale still
+        bites the pool (evictions observed), stays leak-free (residual
+        attribution zero after drain), samples the timeline, and its
+        capacity metrics are deterministic."""
+        from calfkit_tpu.sim import SimRunner
+        from calfkit_tpu.sim.suite import CAPACITY_CHURN
+
+        scenario = CAPACITY_CHURN.scaled(0.15)
+
+        def run():
+            return asyncio.run(SimRunner(scenario).run())
+
+        a, b = run(), run()
+        assert a.passed, [c for c in a.checks if not c.ok]
+        cap = a.metrics["capacity"]
+        assert cap["pages_total"] > 0
+        assert cap["evicted_pages"] >= 1  # the pool actually churned
+        assert cap["peak_pages_in_use"] >= 1
+        assert cap["residual_pages_in_use"] == 0  # the leak oracle
+        assert cap["samples"] >= 1
+        # prefix churn is VISIBLE: evictions cost hit rate by design
+        assert a.metrics["prefix"]["hit_rate"] < 0.95
+        assert a.metrics["capacity"] == b.metrics["capacity"]
