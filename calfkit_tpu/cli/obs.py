@@ -121,7 +121,8 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
             "NODE", "MODEL", "TOK/S", "OCC", "BATCH OCC", "TOK/DISP",
             "ACTIVE", "SLOTS",
             "DECODED", "TTFT P50/P99 MS", "GAP P99 MS", "WASTE",
-            "SHED", "EXPIRED", "CANCELS", "ORPHANS", "FAILOVER/HEDGE",
+            "QUEUE I/B", "SHED", "EXPIRED", "CANCELS", "ORPHANS",
+            "FAILOVER/HEDGE",
             "RUNS/ATT", "WEDGE", "FREC APP/DROP",
         )
     ]
@@ -149,8 +150,22 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
         # overload-protection health: admission sheds (bounded queues are
         # DOING THEIR JOB — a growing SHED under load beats silent
         # queue-wait growth), deadline expiries, and reaped cancels with
-        # the mesh-propagated subset in parentheses
+        # the mesh-propagated subset in parentheses.  Once any per-class
+        # counter is nonzero (ISSUE 20) the cell splits i/b — under the
+        # shed-order law the interactive share should stay 0 while batch
+        # work remains sheddable, and this column is where that shows
         shed = str(r.shed_requests) if r.max_pending else "off"
+        if r.interactive_shed or r.batch_shed:
+            shed = f"i{r.interactive_shed}/b{r.batch_shed}"
+        expired = str(r.expired_requests)
+        if r.interactive_expired or r.batch_expired:
+            expired = f"i{r.interactive_expired}/b{r.batch_expired}"
+        # per-class queued depth: "-" until either class queues (pre-QoS
+        # adverts and idle engines render identically quiet)
+        queue_split = (
+            f"i{r.interactive_pending}/b{r.batch_pending}"
+            if r.interactive_pending or r.batch_pending else "-"
+        )
         cancels = (
             f"{r.cancelled_requests}({r.cancel_propagated})"
             if r.cancel_propagated
@@ -208,8 +223,9 @@ def render_stats_table(records: "Iterable[EngineStatsRecord]") -> str:
                 ttft,
                 gap,
                 waste,
+                queue_split,
                 shed,
-                str(r.expired_requests),
+                expired,
                 cancels,
                 # caller liveness (ISSUE 10): runs the server-side
                 # reaper abandoned because their caller's lease lapsed —
@@ -442,7 +458,14 @@ def render_leases_table(
     compacted ``mesh.caller_liveness`` table — lease id, beat age, TTL,
     and the verdict the engines' orphan reaper would reach RIGHT NOW
     (``live`` / ``lapsed``), computed by the same lapse law
-    (``age > ttl``) so the operator table cannot drift from reaping."""
+    (``age > ttl``) so the operator table cannot drift from reaping.
+
+    Rows sort by beat age DESCENDING (ISSUE 20): the silent leases rank
+    first — under overload they are exactly the callers the engine's
+    lease-aware shed evicts first, so the top of this table is the shed
+    order.  A still-live lease past 80% of its TTL is flagged
+    ``live (lapsing)``: one more missed beat window and its runs are
+    orphan-reap candidates.  Undecodable rows sink to the bottom."""
     import json as _json
 
     from calfkit_tpu import cancellation
@@ -450,23 +473,27 @@ def render_leases_table(
     if now is None:
         now = cancellation.wall_clock()
     rows = [("LEASE", "BEAT AGE S", "TTL S", "VERDICT")]
+    parsed: "list[tuple[float, tuple[str, str, str, str]]]" = []
+    undecodable: "list[tuple[str, str, str, str]]" = []
     for key in sorted(items):
         try:
             body = _json.loads(items[key])
             beat_at = float(body["beat_at"])
             ttl = float(body["ttl_s"])
         except (ValueError, KeyError, TypeError):
-            rows.append((key, "?", "?", "undecodable"))
+            undecodable.append((key, "?", "?", "undecodable"))
             continue
         age = max(0.0, now - beat_at)
-        rows.append(
-            (
-                key,
-                f"{age:.1f}",
-                f"{ttl:.1f}",
-                "lapsed" if age > ttl else "live",
-            )
-        )
+        if age > ttl:
+            verdict = "lapsed"
+        elif ttl > 0 and age > 0.8 * ttl:
+            verdict = "live (lapsing)"
+        else:
+            verdict = "live"
+        parsed.append((age, (key, f"{age:.1f}", f"{ttl:.1f}", verdict)))
+    parsed.sort(key=lambda entry: (-entry[0], entry[1][0]))
+    rows.extend(row for _, row in parsed)
+    rows.extend(undecodable)
     if len(rows) == 1:
         return (
             "no caller leases (no leased client is running, or none has "
@@ -800,14 +827,23 @@ def render_slo_table(records: "Iterable[SloRollupRecord]") -> str:
     attempt amplification failover/hedge adds shown separately.  BURN is
     the window's error-budget burn: observed failure ratio over the
     allowed ratio for the completion objective (>1 = burning ahead of
-    budget)."""
+    budget).  INTERACTIVE/BATCH (ISSUE 20) split the window per class —
+    ``ok/runs@p95s`` each — so degraded batch completion under overload
+    is visible next to the interactive tail it protects (``-`` = no runs
+    of that class in the window, including every pre-QoS rollup)."""
     rows = [
         (
             "AGENT", "NODE", "WINDOW S", "RUNS", "OK", "RATIO",
-            "P50/P95/P99 S", "ATT AMP", "SHED", "FAILOVER", "ORPHAN",
-            "BURN",
+            "P50/P95/P99 S", "INTERACTIVE", "BATCH", "ATT AMP", "SHED",
+            "FAILOVER", "ORPHAN", "BURN",
         )
     ]
+
+    def class_cell(completed: int, runs: int, p95_s: float) -> str:
+        if not runs:
+            return "-"
+        return f"{completed}/{runs}@{p95_s:.2f}s"
+
     for r in records:
         rows.append(
             (
@@ -818,6 +854,11 @@ def render_slo_table(records: "Iterable[SloRollupRecord]") -> str:
                 str(r.completed),
                 f"{r.completion_ratio:.4f}",
                 f"{r.e2e_p50_s:.2f}/{r.e2e_p95_s:.2f}/{r.e2e_p99_s:.2f}",
+                class_cell(
+                    r.interactive_completed, r.interactive_runs,
+                    r.interactive_p95_s,
+                ),
+                class_cell(r.batch_completed, r.batch_runs, r.batch_p95_s),
                 f"{r.attempt_amplification:.2f}",
                 f"{r.shed_rate:.3f}",
                 f"{r.failover_rate:.3f}",

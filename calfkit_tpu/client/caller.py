@@ -108,9 +108,17 @@ class Client:
         router: Any = None,  # FleetRouter | policy name | None
         failover: "FailoverPolicy | None" = None,
         lease_ttl: "float | None" = None,
+        priority: "str | None" = None,
     ):
         self.mesh = mesh
         self.client_id = client_id or uuid.uuid4().hex[:12]
+        # multi-tenant QoS (ISSUE 20): this client's default priority
+        # class ("interactive" | "batch"), stamped on every call as
+        # x-mesh-priority unless a per-call class overrides it.  None =
+        # no header — receivers resolve the absent class to the mesh
+        # DEFAULT (interactive); "batch" is the explicit opt-in to
+        # shed/reap/rate-limit FIRST under overload.
+        self.priority = priority if priority in protocol.PRIORITY_CLASSES else None
         self.inbox_topic = protocol.client_inbox_topic(self.client_id)
         self.default_timeout = default_timeout
         # opt-in bounded retry for execute(): None = single attempt (the
@@ -183,6 +191,7 @@ class Client:
         router: Any = None,
         failover: "FailoverPolicy | None" = None,
         lease_ttl: "float | None" = None,
+        priority: "str | None" = None,
     ) -> "Client":
         """Lazy constructor: performs no I/O (reference: caller.py:102).
 
@@ -197,7 +206,7 @@ class Client:
         client = cls(
             transport, client_id=client_id, default_timeout=default_timeout,
             retry=retry, router=router, failover=failover,
-            lease_ttl=lease_ttl,
+            lease_ttl=lease_ttl, priority=priority,
         )
         client._owns_mesh = owned
         return client
@@ -548,6 +557,7 @@ class Client:
         deadline: float | None = None,
         attempt: str | None = None,
         run: str | None = None,
+        priority: str | None = None,
     ) -> None:
         from calfkit_tpu.observability.trace import TRACER
 
@@ -606,6 +616,12 @@ class Client:
             # A corrupt value degrades to an un-linked run — never
             # faults delivery (the PR 5 law)
             headers[protocol.HDR_RUN] = run
+        if priority in protocol.PRIORITY_CLASSES:
+            # priority class (ISSUE 20): forwarded by every hop like the
+            # deadline/lease — downstream work degrades as THIS caller's
+            # class.  Absent = the mesh default; corrupt parses degrade,
+            # never fault (the PR 5 law)
+            headers[protocol.HDR_PRIORITY] = protocol.format_priority(priority)
         try:
             await self.mesh.publish(
                 target_topic,
@@ -705,6 +721,7 @@ class AgentGateway(Generic[OutputT]):
         run_id: "str | None" = None,
         attempt_no: int = 0,
         attempt_kind: str = "first",
+        priority: "str | None" = None,
     ) -> InvocationHandle[OutputT]:
         """Begin a run; returns a handle (reference: gateway.py:70).
 
@@ -781,11 +798,20 @@ class AgentGateway(Generic[OutputT]):
         # supervisors pass run_id in and close the run themselves
         owns_run = run_id is None
         run_id = run_id or new_id()
+        # the run's EFFECTIVE class (per-call override, else the client
+        # default, else the default class): stamped on the wire header
+        # below AND on the run record, so `ck slo` can fold per class
+        effective_priority = (
+            priority
+            if priority in protocol.PRIORITY_CLASSES
+            else client.priority
+        )
         client.run_ledger.begin_run(
             run_id,
             agent=self.name,
             client_id=client.client_id,
             started_at=now,
+            priority=effective_priority or protocol.DEFAULT_PRIORITY,
         )
         client.run_ledger.note_attempt(
             run_id,
@@ -829,6 +855,7 @@ class AgentGateway(Generic[OutputT]):
                 deadline=deadline,
                 attempt=mark,
                 run=protocol.format_run(run_id, attempt_no),
+                priority=effective_priority,
             )
         except BaseException:
             # the call never reached the mesh: no terminal will resolve,
@@ -849,11 +876,13 @@ class AgentGateway(Generic[OutputT]):
         message_history: list[ModelMessage] | None = None,
         deps: dict[str, Any] | None = None,
         route: str = "run",
+        priority: "str | None" = None,
     ) -> str:
         """Fire-and-forget; returns the correlation id (reference:
         gateway.py 'send' — the fire token)."""
         handle = await self.start(
-            prompt, message_history=message_history, deps=deps, route=route
+            prompt, message_history=message_history, deps=deps, route=route,
+            priority=priority,
         )
         return handle.correlation_id
 
@@ -867,6 +896,7 @@ class AgentGateway(Generic[OutputT]):
         timeout: float | None = None,
         retry: "RetryPolicy | None" = None,
         failover: "FailoverPolicy | None" = None,
+        priority: "str | None" = None,
     ) -> InvocationResult[OutputT]:
         """Run to a typed result.  With a :class:`RetryPolicy` (here or on
         the client), faults typed retriable — overload sheds, draining
@@ -907,6 +937,7 @@ class AgentGateway(Generic[OutputT]):
                     policy=policy,
                     failover=fo,
                     run_id=run_id,
+                    priority=priority,
                 )
             except BaseException as exc:
                 client._finish_run_exc(run_id, exc)
@@ -930,6 +961,7 @@ class AgentGateway(Generic[OutputT]):
                     run_id=run_id,
                     attempt_no=attempt,
                     attempt_kind="first" if attempt == 0 else "retry",
+                    priority=priority,
                 )
                 try:
                     result = await handle.result()
@@ -1040,6 +1072,7 @@ class AgentGateway(Generic[OutputT]):
         policy: "RetryPolicy | None",
         failover: "FailoverPolicy",
         run_id: "str | None" = None,
+        priority: "str | None" = None,
     ) -> InvocationResult[OutputT]:
         """The supervised execute: one absolute budget, N placements.
 
@@ -1111,6 +1144,7 @@ class AgentGateway(Generic[OutputT]):
                 run_id=run_id,
                 attempt_no=attempt_no,
                 attempt_kind=kind,
+                priority=priority,
             )
             attempt_no += 1
             return handle
@@ -1275,6 +1309,7 @@ class AgentGateway(Generic[OutputT]):
         route: str = "run",
         timeout: float | None = None,
         failover: "FailoverPolicy | None" = None,
+        priority: "str | None" = None,
     ) -> "AsyncIterator[Any]":
         """Stream a run's step events live, ending with the typed result
         — ``handle.stream()`` with in-flight failure recovery (ISSUE 9).
@@ -1296,6 +1331,7 @@ class AgentGateway(Generic[OutputT]):
             handle = await self.start(
                 prompt, message_history=message_history, deps=deps,
                 route=route, timeout=timeout, run_id=run_id,
+                priority=priority,
             )
             try:
                 async for item in handle.stream():
@@ -1344,6 +1380,7 @@ class AgentGateway(Generic[OutputT]):
                 prompt, message_history=message_history, deps=deps,
                 route=route, timeout=effective,
                 run_id=run_id, attempt_no=attempt_no, attempt_kind="first",
+                priority=priority,
             )
             attempt_no += 1
             while True:
@@ -1500,6 +1537,7 @@ class AgentGateway(Generic[OutputT]):
                         if "calfkit.resume_text" in resume_deps
                         else "failover"
                     ),
+                    priority=priority,
                 )
                 attempt_no += 1
         except BaseException as exc:

@@ -37,6 +37,7 @@ __all__ = [
     "DeadlineExceededError",
     "RunCancelledError",
     "RunOrphanedError",
+    "TenantRateLimitedError",
     "FAULT_TYPE_BY_EXCEPTION",
     "RETRIABLE_FAULT_TYPES",
     "error_type_for",
@@ -180,6 +181,28 @@ class RunOrphanedError(CalfkitError):
         super().__init__(message)
 
 
+class TenantRateLimitedError(CalfkitError):
+    """The node kernel's per-tenant token bucket refused this call
+    (ISSUE 20): the tenant (lease id where present, else caller client
+    id) spent its admission budget.  Refused BEFORE the engine's queues
+    — nothing was admitted, no slot or page was held.  Typed and
+    RETRIABLE by contract: the bucket refills on the deadline clock's
+    schedule, so ``retry_after_s`` is an honest backoff hint (unlike a
+    deadline fault, where the budget is gone forever).
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        tenant_id: str = "",
+        retry_after_s: float = 0.0,
+    ):
+        self.tenant_id = tenant_id
+        self.retry_after_s = retry_after_s
+        super().__init__(message)
+
+
 # --------------------------------------------------------------------------- #
 # the authoritative x-mesh-error-type ↔ exception-class table
 # --------------------------------------------------------------------------- #
@@ -195,6 +218,7 @@ FAULT_TYPE_BY_EXCEPTION: dict[type[BaseException], str] = {
     DeadlineExceededError: FaultTypes.DEADLINE_EXCEEDED,
     RunCancelledError: FaultTypes.CANCELLED,
     RunOrphanedError: FaultTypes.ORPHANED,
+    TenantRateLimitedError: FaultTypes.RATE_LIMITED,
     ClientTimeoutError: FaultTypes.TIMEOUT,
     DeserializationError: FaultTypes.DESERIALIZATION_ERROR,
     InferenceError: FaultTypes.MODEL_ERROR,
@@ -217,6 +241,10 @@ RETRIABLE_FAULT_TYPES: frozenset[str] = frozenset(
         # (the watchdog faults before any terminal): the call is whole and
         # another replica can serve it — failover territory (ISSUE 9)
         FaultTypes.WEDGED,
+        # a rate-limit refusal (ISSUE 20) happens at the node kernel's
+        # gate, before any queue or slot — the token bucket refills on a
+        # known schedule, so backoff-and-retry is exactly right
+        FaultTypes.RATE_LIMITED,
     }
 )
 

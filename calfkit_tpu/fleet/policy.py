@@ -75,21 +75,31 @@ class RoutingPolicy(Protocol):
 
 @hotpath
 def _least(candidates: Sequence[Replica]) -> "Replica | None":
-    # ties break FIRST on the advert's EWMA dispatch latency (ISSUE 10:
-    # between heartbeat beats N routers see identical depths — breaking
-    # the tie on which replica actually dispatches faster spreads the
-    # herd), THEN on the stable replica key, never on list order: two
-    # routers looking at the same directory must still agree.  A 0.0
-    # EWMA means NO SIGNAL (pre-EWMA advert in a rolling upgrade, or an
-    # engine that never dispatched) and ranks LAST among ties — sorting
-    # it first would deterministically herd ALL tied traffic onto the
-    # one replica nobody has latency evidence for, the exact failure
-    # this tiebreak exists to kill.  All-unknown ties fall through to
-    # the stable key, the pre-EWMA law.
+    # ties break FIRST on the advertised BATCH queue share, descending
+    # (ISSUE 20: at equal total depth, a batch-heavy backlog is the
+    # cheaper home — its queued work is exactly what priority shedding
+    # evicts if an interactive arrival needs the slot, so interactive
+    # latency there is bounded by sheds, not by the whole queue; with no
+    # batch traffic anywhere, and on pre-QoS adverts, every replica
+    # reports 0 and the tiebreak is exactly neutral — single-class
+    # timelines are unchanged), THEN on the advert's EWMA dispatch
+    # latency (ISSUE 10: between heartbeat beats N routers see identical
+    # depths — breaking the tie on which replica actually dispatches
+    # faster spreads the herd), THEN on the stable replica key, never on
+    # list order: two routers looking at the same directory must still
+    # agree.  A 0.0 EWMA means NO SIGNAL (pre-EWMA advert in a rolling
+    # upgrade, or an engine that never dispatched) and ranks LAST among
+    # ties — sorting it first would deterministically herd ALL tied
+    # traffic onto the one replica nobody has latency evidence for, the
+    # exact failure this tiebreak exists to kill.  All-unknown ties fall
+    # through to the stable key, the pre-EWMA law.
     return min(
         candidates,
         key=lambda r: (
-            r.queue_depth, r.dispatch_ewma or float("inf"), r.key
+            r.queue_depth,
+            -r.batch_depth,
+            r.dispatch_ewma or float("inf"),
+            r.key,
         ),
         default=None,
     )

@@ -98,6 +98,18 @@ class Replica:
         )
 
     @property
+    def batch_depth(self) -> int:
+        """The batch-class share of the advertised QUEUE (ISSUE 20):
+        queued batch requests per the last heartbeat.  The routing
+        tiebreak signal — at equal total depth, prefer the replica whose
+        backlog is batch-heavy, because its queued work is exactly what
+        priority shedding will evict if an interactive arrival needs the
+        slot.  Zero everywhere when no batch traffic exists (and on
+        pre-QoS adverts), so the tiebreak is exactly neutral for
+        single-class fleets — pinned pre-QoS timelines are unchanged."""
+        return self.stats.batch_pending
+
+    @property
     def dispatch_ewma(self) -> float:
         """EWMA decode-dispatch latency (ms) from the advert — the
         many-router coherence tiebreak (ISSUE 10): when queue depths tie

@@ -345,6 +345,16 @@ class JaxLocalModelClient(ModelClient):
                 "max_pending": runtime.max_pending,
                 "shed_requests": 0,
                 "expired_requests": 0,
+                # multi-tenant QoS (ISSUE 20): per-class splits of the
+                # shed/expired counters plus per-class queued depth — the
+                # routing tiebreak and `ck stats` per-class columns; same
+                # key set as the live branch
+                "interactive_shed": 0,
+                "batch_shed": 0,
+                "interactive_expired": 0,
+                "batch_expired": 0,
+                "interactive_pending": 0,
+                "batch_pending": 0,
                 "cancelled_requests": 0,
                 "cancel_propagated": 0,
                 "delivery_stalled": 0,
@@ -381,6 +391,17 @@ class JaxLocalModelClient(ModelClient):
 
         stats = engine.stats
         rt = engine.runtime
+        # multi-tenant QoS (ISSUE 20): per-class QUEUED depth for the
+        # advert (cancelled entries excluded — a flagged shed victim
+        # still sits in the deque until reaped, and advertising it as
+        # depth would double-penalize the replica that just made room)
+        queued = [*engine._pending, *engine._carry, *engine._long_pending]
+        interactive_pending = sum(
+            1 for r in queued if not r.cancelled and r.priority != "batch"
+        )
+        batch_pending = sum(
+            1 for r in queued if not r.cancelled and r.priority == "batch"
+        )
         snapshot = {
             "model_name": engine.config.name,
             "platform": jax.devices()[0].platform,
@@ -417,6 +438,14 @@ class JaxLocalModelClient(ModelClient):
             "max_pending": rt.max_pending,
             "shed_requests": stats.shed_requests,
             "expired_requests": stats.expired_requests,
+            # multi-tenant QoS (ISSUE 20): per-class shed/expired splits
+            # and the per-class queued depth computed above
+            "interactive_shed": stats.interactive_shed,
+            "batch_shed": stats.batch_shed,
+            "interactive_expired": stats.interactive_expired,
+            "batch_expired": stats.batch_expired,
+            "interactive_pending": interactive_pending,
+            "batch_pending": batch_pending,
             "cancelled_requests": stats.cancelled_requests,
             "cancel_propagated": stats.cancel_propagated,
             "delivery_stalled": stats.delivery_stalled,
@@ -662,7 +691,7 @@ class JaxLocalModelClient(ModelClient):
         # admission, reap on expiry) with no per-layer arithmetic; the
         # caller's liveness lease (ISSUE 10) rides the identical channel
         # so the engine registers this run for the orphan reaper
-        from calfkit_tpu import leases
+        from calfkit_tpu import leases, qos
         from calfkit_tpu.cancellation import current_deadline
 
         if resume_tokens:
@@ -681,6 +710,10 @@ class JaxLocalModelClient(ModelClient):
             run=_capacity.current_run.get(),
             deadline=current_deadline.get(),
             lease=leases.current_lease.get(),
+            # priority class (ISSUE 20): the node kernel's x-mesh-priority
+            # contextvar — generate() resolves None/corrupt to the default
+            # class via the one degradation law (qos.resolve_priority)
+            priority=qos.current_priority.get(),
         )
         stream_exc: BaseException | None = None
         try:

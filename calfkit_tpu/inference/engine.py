@@ -38,7 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from calfkit_tpu import cancellation, leases
+from calfkit_tpu import cancellation, leases, qos
 from calfkit_tpu.effects import hotpath
 from calfkit_tpu.inference import ragged as ragged_math
 from calfkit_tpu.exceptions import (
@@ -332,6 +332,18 @@ class GenRequest:
     # deadline_entry so the heap never pins a finished request's memory
     lease_entry: "list | None" = None
     orphaned: bool = False
+    # multi-tenant QoS (ISSUE 20): the caller's priority class
+    # ("interactive" | "batch"), resolved at submit — under overload,
+    # batch sheds first, reaps first at equal expiry.  ``shed`` marks a
+    # QUEUED request evicted by priority-ordered shedding (an arriving
+    # interactive request claimed its place at a full lane) so
+    # _raise_terminal raises the typed retriable EngineOverloadedError;
+    # ``shed_detail`` carries the (lane, pending, limit) observed at the
+    # eviction so the typed fault reports the same detail as a
+    # shed-at-submit (the ISSUE 20 drive-by's uniformity law).
+    priority: str = "interactive"
+    shed: bool = False
+    shed_detail: "tuple[str, int, int] | None" = None
     # the dispatch-progress watchdog faulted this request (ISSUE 9): the
     # consumer's _consume raises a typed RETRIABLE EngineWedgedError so
     # the caller fails over to another replica instead of timing out
@@ -423,6 +435,17 @@ class EngineStats:
     # advert's density-pressure signals, windowed like every counter
     prefix_evictions: int = 0
     alloc_stalls: int = 0
+    # multi-tenant QoS (ISSUE 20): the per-class split of the shed and
+    # expiry counters above (shed_requests/expired_requests stay the
+    # totals).  The advert carries these so RoutingPolicy can tie-break
+    # on interactive pressure and `ck stats` can show WHO degradation
+    # actually hit — the shed-fairness gate law (zero interactive sheds
+    # while any batch request is sheddable) is only auditable with the
+    # split visible.
+    interactive_shed: int = 0
+    batch_shed: int = 0
+    interactive_expired: int = 0
+    batch_expired: int = 0
     # EWMA of decode-dispatch latency (ms) — the advert's tiebreak signal
     # for many-router coherence (ISSUE 10 satellite): N independent
     # routers seeing identical queue depths between heartbeat beats stop
@@ -446,6 +469,8 @@ class EngineStats:
         "prefill_absorbed_tokens", "unified_dispatches",
         "watchdog_trips", "watchdog_faulted",
         "prefix_evictions", "alloc_stalls",
+        "interactive_shed", "batch_shed",
+        "interactive_expired", "batch_expired",
     )
 
     # EWMA smoothing for dispatch_ewma_ms: ~5-dispatch memory — fresh
@@ -1563,6 +1588,7 @@ class InferenceEngine:
         run: str | None = None,
         deadline: float | None = None,
         lease: "tuple[str, float] | None" = None,
+        priority: "str | None" = None,
     ) -> AsyncIterator[int]:
         """Submit a prompt; yields generated token ids as they decode.
 
@@ -1591,7 +1617,16 @@ class InferenceEngine:
         heartbeats lapse past the TTL (typed :class:`RunOrphanedError`
         on the stream).  A lease already lapsed at submit is refused
         before any device work, like an expired deadline.
+
+        ``priority`` is the caller's QoS class (ISSUE 20):
+        ``"interactive"`` | ``"batch"``; anything else (including None)
+        resolves to the mesh default.  Under overload batch-class work
+        degrades FIRST: an interactive submit at a full lane evicts a
+        queued batch request (oldest lease beat first) instead of being
+        shed, and the deadline/orphan reapers take batch before
+        interactive at equal expiry.
         """
+        req_priority = qos.resolve_priority(priority)
         if not self._running:
             raise InferenceError("engine not started")
         if self._wedged:
@@ -1611,6 +1646,7 @@ class InferenceEngine:
                 # expired on arrival: record the fault fast — admitting it
                 # would burn prefill + decode dispatches for a dead caller
                 self.stats.expired_requests += 1
+                self._count_expired_class(req_priority)
                 self._journal.append(
                     flightrec.EV_EXPIRE, corr, -1, int(overdue * 1000)
                 )
@@ -1649,6 +1685,7 @@ class InferenceEngine:
             corr=corr,
             run=run,
             deadline=deadline,
+            priority=req_priority,
         )
         if lease is not None:
             request.lease_id, request.lease_ttl = lease
@@ -1723,8 +1760,14 @@ class InferenceEngine:
                     f"request needs {reserve} KV pages but the pool only has "
                     f"{usable}; lower max_new_tokens or raise num_kv_pages"
                 )
+        # the short-lane count includes _admitting (requests parked in the
+        # chunked-admission window): they hold queue slots and page
+        # reservations exactly like _pending entries, and excluding them
+        # let a wave-heavy engine under-report pending in its shed replies
         self._shed_if_full(
-            "short", len(self._pending) + len(self._carry), request
+            "short",
+            len(self._pending) + len(self._carry) + len(self._admitting),
+            request,
         )
         self._pending.append(request)
         self._submit_deadline(request)
@@ -1740,17 +1783,89 @@ class InferenceEngine:
             await inner.aclose()
 
     # ------------------------------------------------- overload protection
+    def _count_shed_class(self, priority: str) -> None:
+        if qos.class_rank(priority):
+            self.stats.batch_shed += 1
+        else:
+            self.stats.interactive_shed += 1
+
+    def _count_expired_class(self, priority: str) -> None:
+        if qos.class_rank(priority):
+            self.stats.batch_expired += 1
+        else:
+            self.stats.interactive_expired += 1
+
+    @hotpath
+    def _shed_victim(self, lane: str) -> "GenRequest | None":
+        """Priority-ordered shed selection (ISSUE 20): the QUEUED
+        batch-class request to evict so an arriving interactive request
+        can take its place at a full lane.  Lease-aware ordering: among
+        batch candidates, the one whose caller lease has the OLDEST
+        beat sheds first — a leased-but-silent caller is the weakest
+        claim on the queue, an actively-beating one the strongest.
+        Un-leased (or never-beaten) requests read age 0.0 = most alive,
+        so they shed last among batch.  Only queued entries are
+        candidates — evicting an ACTIVE slot would discard paid prefill
+        work.  None = no batch request queued (the incoming request
+        sheds instead, whatever its class)."""
+        queued = (
+            self._long_pending
+            if lane == "long"
+            else (*self._carry, *self._pending, *self._admitting)
+        )
+        victim: "GenRequest | None" = None
+        victim_age = -1.0
+        for r in queued:
+            if r.cancelled or not qos.class_rank(r.priority):
+                continue
+            age = leases.lease_age(r.lease_id)
+            age = 0.0 if age is None else age
+            if age > victim_age:
+                victim, victim_age = r, age
+        return victim
+
+    def _shed_queued(
+        self, victim: GenRequest, lane: str, pending: int, limit: int
+    ) -> None:
+        """Evict one queued batch request through the ordinary
+        cancellation path: the reap frees its place, the consumer's
+        _raise_terminal surfaces the same typed retriable
+        EngineOverloadedError (with the same lane/pending/limit detail)
+        a shed-at-submit would have."""
+        victim.shed = True
+        victim.shed_detail = (lane, pending, limit)
+        victim.cancelled = True
+        self._cancel_dirty = True
+        self.stats.shed_requests += 1
+        self._count_shed_class(victim.priority)
+        self._journal.append(
+            flightrec.EV_SHED, victim.corr, -1, pending, limit
+        )
+        self._wake.set()
+
     def _shed_if_full(
         self, lane: str, pending: int, request: GenRequest
     ) -> None:
-        """Bounded admission (ISSUE 5): refuse the submit with a typed,
-        retriable error when the lane's queue is at ``max_pending`` —
-        O(1), before any device work, so saturation is a fast rejection
-        instead of silent queue-wait growth."""
+        """Bounded admission (ISSUE 5), priority-ordered (ISSUE 20):
+        when the lane's queue is at ``max_pending``, batch-class work
+        sheds FIRST — an interactive submit evicts a queued batch
+        request (oldest lease beat first) and is admitted in its place;
+        only when no batch request is sheddable is the incoming request
+        itself refused with a typed, retriable error.  Still O(queued)
+        at worst and only on the full-lane path — the un-loaded submit
+        stays the ISSUE 5 O(1) check — and the gate law holds
+        structurally: an interactive request is never shed while any
+        batch request is sheddable."""
         limit = self.runtime.max_pending
         if not limit or pending < limit:
             return
+        if not qos.class_rank(request.priority):
+            victim = self._shed_victim(lane)
+            if victim is not None:
+                self._shed_queued(victim, lane, pending, limit)
+                return  # admitted in the victim's place
         self.stats.shed_requests += 1
+        self._count_shed_class(request.priority)
         self._journal.append(
             flightrec.EV_SHED, request.corr, -1, pending, limit
         )
@@ -1760,11 +1875,26 @@ class InferenceEngine:
             lane=lane, pending=pending, limit=limit,
         )
 
+    @hotpath
+    def _reap_order(self, request: GenRequest, seq: int) -> "tuple[int, int]":
+        """Class-weighted reap tiebreak (ISSUE 20): the heap-entry key
+        between expiry and the request.  At EQUAL expiry (common under
+        the sim's quantized clock, and whenever a storm's arrivals share
+        a deadline) the batch-class entry sorts FIRST, so both reapers
+        take batch before interactive — degradation stays ordered even
+        at the reap.  Expiry itself is untouched: class never reaps a
+        request before its actual deadline/lapse."""
+        return (1 - qos.class_rank(request.priority), seq)
+
     def _submit_deadline(self, request: GenRequest) -> None:
         """Register a deadlined request for the scheduler's expiry reap."""
         if request.deadline is None:
             return
-        entry = [request.deadline, next(self._deadline_seq), request]
+        entry = [
+            request.deadline,
+            self._reap_order(request, next(self._deadline_seq)),
+            request,
+        ]
         request.deadline_entry = entry
         heapq.heappush(self._deadline_heap, entry)
 
@@ -1821,6 +1951,7 @@ class InferenceEngine:
             request.cancelled = True
             self._cancel_dirty = True
             self.stats.expired_requests += 1
+            self._count_expired_class(request.priority)
             self._journal.append(
                 flightrec.EV_EXPIRE, request.corr, request.slot,
                 int((now - request.deadline) * 1000),
@@ -1833,7 +1964,7 @@ class InferenceEngine:
     # same slot/page/prefix accounting — with a typed, NON-retriable
     # ``mesh.orphaned`` terminal.  Precedence law (shared with
     # _raise_terminal; pinned in tests): wedged > expired > orphaned >
-    # stalled > plain cancel — exactly ONE typed error per run, checked
+    # shed > stalled > plain cancel — exactly ONE typed error per run, checked
     # in the same order on both schedulers (ragged and bifurcated reap
     # through the same _reap_cancelled/_consume pair).
 
@@ -1849,7 +1980,9 @@ class InferenceEngine:
             # itself is proof of life — the kernel stamps admission, but
             # direct engine callers may not)
             expiry = cancellation.wall_clock() + request.lease_ttl
-        entry = [expiry, next(self._lease_seq), request]
+        entry = [
+            expiry, self._reap_order(request, next(self._lease_seq)), request,
+        ]
         request.lease_entry = entry
         heapq.heappush(self._lease_heap, entry)
 
@@ -1905,7 +2038,11 @@ class InferenceEngine:
             if expiry > now:
                 # the caller beat since registration: re-arm at the
                 # fresh expiry and keep serving
-                fresh = [expiry, next(self._lease_seq), request]
+                fresh = [
+                    expiry,
+                    self._reap_order(request, next(self._lease_seq)),
+                    request,
+                ]
                 request.lease_entry = fresh
                 heapq.heappush(heap, fresh)
                 continue
@@ -2060,10 +2197,11 @@ class InferenceEngine:
         self._drop_lease(request)
         if (
             request.expired or request.stalled or request.wedged
-            or request.orphaned
+            or request.orphaned or request.shed
         ):
             # wedge-faulted requests were journaled/counted at the trip;
-            # orphans at the reaper's EV_ORPHAN
+            # orphans at the reaper's EV_ORPHAN; priority-shed victims
+            # at _shed_queued's EV_SHED
             return
         self._journal.append(flightrec.EV_CANCEL, request.corr, request.slot)
         self.stats.cancelled_requests += 1
@@ -2120,13 +2258,17 @@ class InferenceEngine:
         THE precedence law (ISSUE 10 satellite; pinned for BOTH
         schedulers in tests — the ragged and bifurcated lanes share this
         one copy, so agreement is structural): **wedged > expired >
-        orphaned > stalled** — a run that is simultaneously several of
-        these faults with exactly ONE typed error.  Wedged first because
-        it is the only RETRIABLE code (a live caller must fail over, not
-        eat a dead-end fault); expired before orphaned because the
-        deadline is the caller's own contract while orphanhood is the
-        server's inference about the caller; stalled last — a stalled
-        consumer that also expired/orphaned already has a truer cause."""
+        orphaned > shed > stalled** — a run that is simultaneously
+        several of these faults with exactly ONE typed error.  Wedged
+        first because a live caller must fail over, not eat a dead-end
+        fault; expired before orphaned because the deadline is the
+        caller's own contract while orphanhood is the server's inference
+        about the caller; a priority shed (ISSUE 20) after the
+        non-retriable causes — a victim that also expired/orphaned has a
+        truer, terminal cause, and surfacing the retriable shed instead
+        would invite a retry for a spent budget; stalled last — a
+        stalled consumer that also expired/orphaned/shed already has a
+        truer cause."""
         if request.wedged:
             # checked FIRST: a wedged request may also look expired by the
             # time its consumer resumes, but the watchdog faulted it so
@@ -2147,6 +2289,22 @@ class InferenceEngine:
                 "caller lease lapsed; the run was reaped after "
                 f"{request.generated} generated tokens",
                 lease_id=request.lease_id or "",
+            )
+        if request.shed:
+            # priority-ordered shedding (ISSUE 20): this queued
+            # batch-class request was evicted to admit interactive work
+            # at a full lane — the same typed RETRIABLE code (and the
+            # same lane/pending/limit detail) as a shed-at-submit, so
+            # callers back off identically whichever side of the queue
+            # the shed landed on
+            lane, pending, limit = request.shed_detail or (
+                "short", 0, self.runtime.max_pending or 0
+            )
+            raise EngineOverloadedError(
+                f"queued batch-class request was shed from the {lane} "
+                f"lane to admit interactive work (pending={pending}, "
+                f"max_pending={limit}); retry with backoff",
+                lane=lane, pending=pending, limit=limit,
             )
         if request.stalled:
             raise EngineOverloadedError(

@@ -230,9 +230,13 @@ def lease_lapsed(lease_id: "str | None", now: "float | None" = None) -> bool:
     return now > expiry
 
 
+@hotpath
 def lease_age(lease_id: "str | None", now: "float | None" = None) -> "float | None":
     """Seconds since the lease's last beat (None = never seen).  The
-    ``ck leases`` / ``ck stats`` rendering read."""
+    ``ck leases`` rendering read AND the engine's lease-aware shed
+    ordering signal (ISSUE 20): under overload, the batch victim with
+    the OLDEST beat sheds first — leased-but-silent callers give way
+    before actively-beating ones."""
     if not lease_id:
         return None
     with _LOCK:

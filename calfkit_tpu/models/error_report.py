@@ -60,6 +60,12 @@ class FaultTypes:
     # RETRIABLE by contract: nothing was delivered to the caller, and a
     # different replica can serve the same call (ISSUE 9)
     WEDGED = "mesh.wedged"
+    # multi-tenant QoS (ISSUE 20): the node kernel's per-tenant token
+    # bucket refused the call — the tenant's admission budget is spent.
+    # RETRIABLE by contract: the bucket refills on a known schedule, so
+    # backing off and retrying is exactly the right caller response
+    # (unlike a deadline, which is gone forever)
+    RATE_LIMITED = "mesh.rate_limited"
     # the run's CALLER liveness lease lapsed (heartbeats stopped past the
     # lease TTL, or the caller released the lease on clean close) and the
     # server-side orphan reaper abandoned the run (ISSUE 10) — NOT

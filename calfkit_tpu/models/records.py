@@ -109,6 +109,16 @@ class EngineStatsRecord(BaseModel):
     max_pending: int = 0
     shed_requests: int = 0
     expired_requests: int = 0
+    # multi-tenant QoS (ISSUE 20): per-class splits of the shed/expired
+    # counters and per-class QUEUED depth — `ck stats` class columns and
+    # the routing policy's interactive-depth tiebreak.  Defaults 0 so a
+    # pre-QoS record reads as "no class signal", not unknown.
+    interactive_shed: int = 0
+    batch_shed: int = 0
+    interactive_expired: int = 0
+    batch_expired: int = 0
+    interactive_pending: int = 0
+    batch_pending: int = 0
     cancelled_requests: int = 0
     cancel_propagated: int = 0
     delivery_stalled: int = 0
@@ -214,6 +224,10 @@ class RunRecord(BaseModel):
     # ok | fault | timeout | cancelled | pending
     outcome: str = "pending"
     error_type: str = ""
+    # priority class (ISSUE 20): the run's effective class as the
+    # supervising client resolved it.  Default = the default class, so
+    # a pre-QoS record folds as interactive, never as a third bucket.
+    priority: str = "interactive"
     attempts: "list[RunAttemptRecord]" = Field(default_factory=list)
     sheds: int = 0
     failovers: int = 0
@@ -261,6 +275,15 @@ class SloRollupRecord(BaseModel):
     # ratio / allowed failure ratio against the completion objective
     slo_completion_target: float = 0.999
     error_budget_burn: float = 0.0
+    # per-class sub-rollups (ISSUE 20): the `ck slo` class split.  A
+    # pre-QoS rollup reports zeros — "no class signal", not "no runs"
+    # (the totals above stay authoritative).
+    interactive_runs: int = 0
+    interactive_completed: int = 0
+    interactive_p95_s: float = 0.0
+    batch_runs: int = 0
+    batch_completed: int = 0
+    batch_p95_s: float = 0.0
 
     def slo_key(self) -> str:
         return f"{self.agent}@{self.node_id}" if self.node_id else self.agent

@@ -37,7 +37,7 @@ from typing import Any, ClassVar, Sequence
 
 from pydantic import ValidationError
 
-from calfkit_tpu import cancellation, leases, protocol
+from calfkit_tpu import cancellation, leases, protocol, qos
 from calfkit_tpu.exceptions import NodeFaultError, error_type_for
 from calfkit_tpu.keying import partition_key
 from calfkit_tpu.mesh.transport import MeshTransport, Record
@@ -77,6 +77,10 @@ logger = logging.getLogger(__name__)
 # cycle): truthy once the worker's caller-liveness feed is consuming;
 # the kernel only ENFORCES leases where beats can actually arrive
 CALLER_LIVENESS_FEED_KEY = "caller_liveness_feed"
+
+# node-resource key for the per-tenant admission token bucket
+# (ISSUE 20): a qos.TenantRateLimiter; absent or disabled = no limiting
+QOS_LIMITER_KEY = "qos_limiter"
 
 _REENTRY_KEY = "fanout_reentry"
 
@@ -369,6 +373,19 @@ class BaseNodeDef(RegistryMixin):
                     leases.note_admission(*lease)
             lease_token = leases.current_lease.set(lease)
 
+        # ---- priority class (ISSUE 20): rides a contextvar like the
+        # deadline/lease, so the in-process engine's class-aware shed and
+        # reap ordering see the caller's class with no per-layer
+        # plumbing.  A corrupt header parses to None and the contextvar
+        # stays at its default — readers resolve that to the DEFAULT
+        # class; delivery never faults (the PR 5 law).
+        priority = protocol.parse_priority(headers.get(protocol.HDR_PRIORITY))
+        priority_token = (
+            qos.current_priority.set(priority)
+            if priority is not None
+            else None
+        )
+
         # ---- tracing: one HOP SPAN per traced delivery.  A missing trace
         # header is legal (pre-trace emitters, external producers) — the
         # hop simply runs untraced.  Everything here is fail-open.
@@ -461,6 +478,8 @@ class BaseNodeDef(RegistryMixin):
                 _capacity.current_run.reset(run_token)
             if lease_token is not None:
                 leases.current_lease.reset(lease_token)
+            if priority_token is not None:
+                qos.current_priority.reset(priority_token)
             await self._flush_steps(ctx)
             if hop_span is not None:
                 if ctx.fault_error_type is not None:
@@ -620,6 +639,41 @@ class BaseNodeDef(RegistryMixin):
                     route=ctx.route,
                 )
             )
+        # per-tenant admission budget (ISSUE 20): only runs ENTERING the
+        # mesh spend a token — continuation calls are the tail of an
+        # already-admitted run, and rate-limiting them mid-run would
+        # strand slots and pages the run already holds (same exemption
+        # as the drain gate above).  Tenant identity is the caller's
+        # lease id where present (one lease per caller process — the
+        # natural tenant grain), else the caller's emitter id.
+        limiter = self.resources.get(QOS_LIMITER_KEY)
+        if limiter is not None and getattr(limiter, "enabled", False):
+            emitter = ctx.headers.get(protocol.HDR_EMITTER, "")
+            if emitter.split("/", 1)[0] not in self._CONTINUATION_EMITTERS:
+                lease = protocol.parse_lease(
+                    ctx.headers.get(protocol.HDR_LEASE)
+                )
+                if lease is not None:
+                    tenant = lease[0]
+                else:
+                    _, emitter_id = protocol.parse_emitter(emitter)
+                    tenant = emitter_id or emitter
+                retry_after = limiter.admit(tenant)
+                if retry_after is not None:
+                    raise NodeFaultError(
+                        ErrorReport.build_safe(
+                            FaultTypes.RATE_LIMITED,
+                            f"tenant {tenant!r} exceeded its admission "
+                            f"budget at {self.node_id}; retry after "
+                            f"{retry_after:.3f}s",
+                            node=self.node_id,
+                            route=ctx.route,
+                            data={
+                                "tenant_id": tenant,
+                                "retry_after_s": f"{retry_after:.3f}",
+                            },
+                        )
+                    )
 
     # =====================================================================
     # stages
@@ -1131,6 +1185,13 @@ class BaseNodeDef(RegistryMixin):
         incoming_lease = ctx.headers.get(protocol.HDR_LEASE)
         if incoming_lease:
             headers[protocol.HDR_LEASE] = incoming_lease
+        # priority-class propagation (ISSUE 20): forwarded VERBATIM like
+        # the deadline/lease — downstream tool calls run on the ORIGINAL
+        # caller's behalf, so they degrade as the caller's class, not as
+        # the forwarding node's
+        incoming_priority = ctx.headers.get(protocol.HDR_PRIORITY)
+        if incoming_priority:
+            headers[protocol.HDR_PRIORITY] = incoming_priority
         # run-identity propagation (ISSUE 17): forwarded VERBATIM like
         # the deadline/lease — downstream hops serve the same logical
         # run, so their spans stitch into its `ck run` timeline.  Note

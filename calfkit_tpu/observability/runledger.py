@@ -92,6 +92,7 @@ class RunLedger:
         agent: str = "",
         client_id: str = "",
         started_at: float = 0.0,
+        priority: str = "interactive",
     ) -> None:
         """O(1): open a run entry (idempotent — a resumed stream's second
         supervisor pass must not wipe recorded attempts)."""
@@ -106,6 +107,9 @@ class RunLedger:
             "finished_at": 0.0,
             "outcome": "pending",
             "error_type": "",
+            # priority class (ISSUE 20): the run's EFFECTIVE class as the
+            # supervising client resolved it — the `ck slo` per-class fold
+            "priority": priority,
             "attempts": [],
         }
         while len(self._runs) > self._cap:
@@ -253,6 +257,7 @@ def _build_record(run_id: str, run: "dict[str, Any]") -> RunRecord:
         finished_at=run["finished_at"],
         outcome=run["outcome"],
         error_type=run["error_type"],
+        priority=run.get("priority", "interactive"),
         attempts=attempts,
         sheds=sum(1 for a in attempts if a.outcome == "shed"),
         failovers=sum(1 for a in attempts if a.kind == "failover"),
@@ -342,20 +347,32 @@ def rollup_window(
     failovers = 0
     orphans = 0
     durations: "list[float]" = []
+    # per-class sub-folds (ISSUE 20): entries predating the QoS ledger
+    # carry no priority and count as the default class
+    class_runs = {"interactive": 0, "batch": 0}
+    class_completed = {"interactive": 0, "batch": 0}
+    class_durations: "dict[str, list[float]]" = {
+        "interactive": [], "batch": [],
+    }
     for e in entries:
         if e["finished_at"] < lo:
             continue
         runs += 1
         attempts += max(1, int(e.get("attempts", 1)))
+        cls = "batch" if e.get("priority") == "batch" else "interactive"
+        class_runs[cls] += 1
         if e.get("outcome") == "ok":
             completed += 1
+            class_completed[cls] += 1
         if e.get("sheds", 0):
             sheds += 1
         if e.get("failovers", 0):
             failovers += 1
         if e.get("error_type") == "mesh.orphaned":
             orphans += 1
-        durations.append(max(0.0, e["finished_at"] - e.get("started_at", 0.0)))
+        duration = max(0.0, e["finished_at"] - e.get("started_at", 0.0))
+        durations.append(duration)
+        class_durations[cls].append(duration)
     ratio = (completed / runs) if runs else 1.0
     allowed = 1.0 - target
     burn = ((1.0 - ratio) / allowed) if (runs and allowed > 0.0) else 0.0
@@ -377,6 +394,12 @@ def rollup_window(
         orphan_rate=(orphans / runs) if runs else 0.0,
         slo_completion_target=target,
         error_budget_burn=burn,
+        interactive_runs=class_runs["interactive"],
+        interactive_completed=class_completed["interactive"],
+        interactive_p95_s=run_percentile(class_durations["interactive"], 0.95),
+        batch_runs=class_runs["batch"],
+        batch_completed=class_completed["batch"],
+        batch_p95_s=run_percentile(class_durations["batch"], 0.95),
     )
 
 
@@ -411,6 +434,7 @@ class RunWindowStore:
                 "finished_at": record.finished_at,
                 "outcome": record.outcome,
                 "error_type": record.error_type,
+                "priority": record.priority,
                 "attempts": len(record.attempts),
                 "sheds": record.sheds,
                 "failovers": record.failovers,

@@ -74,6 +74,15 @@ HDR_ATTEMPT: Final = "x-mesh-attempt"
 # the server-side half of failure recovery, covering fire-and-forget
 # ``send()`` that no client-side supervisor can.
 HDR_LEASE: Final = "x-mesh-lease"
+# priority class (ISSUE 20): "interactive" | "batch" — the caller's QoS
+# class, minted by the client and forwarded by every hop (downstream
+# tool calls run on the original caller's behalf, so they inherit its
+# class).  Under overload the mesh degrades SELECTIVELY: batch-class
+# work sheds first, reaps first, and rate-limits first.  A corrupt or
+# missing header degrades to the DEFAULT class (interactive — batch is
+# an explicit opt-in to lower priority; legacy callers must not be
+# demoted) and never faults delivery (the PR 5 law).
+HDR_PRIORITY: Final = "x-mesh-priority"
 # run identity (ISSUE 17): "<run_id>:<attempt_no>" — the run_id is minted
 # ONCE per logical ``execute()``/``stream()`` call and carried VERBATIM
 # across retries, failover re-dispatches, hedge duplicates, and
@@ -98,8 +107,15 @@ ALL_HEADERS: Final = (
     HDR_DEADLINE,
     HDR_ATTEMPT,
     HDR_LEASE,
+    HDR_PRIORITY,
     HDR_RUN,
 )
+
+# the QoS class vocabulary (ISSUE 20), ordered best-first; everything
+# that ranks, sheds, or renders by class iterates THIS tuple so the
+# order is defined in exactly one place
+PRIORITY_CLASSES: Final = ("interactive", "batch")
+DEFAULT_PRIORITY: Final = "interactive"
 
 # --------------------------------------------------------------------------- #
 # kind vocabularies
@@ -209,6 +225,23 @@ def parse_run(value: "bytes | str | None") -> "tuple[str, int] | None":
     if attempt < 0:
         return None
     return (run_id, attempt) if run_id else None
+
+
+def format_priority(priority: str) -> str:
+    """Encode a priority class for the wire (identity today; the single
+    authority exists so a future vocabulary change has one mint site)."""
+    return priority
+
+
+def parse_priority(value: "bytes | str | None") -> "str | None":
+    """Decode an ``x-mesh-priority`` header to a class name; ``None``
+    for a missing, undecodable, or out-of-vocabulary value (a corrupt
+    class degrades to the DEFAULT class downstream — it must never fault
+    delivery, and it must never invent a third class)."""
+    s = decode_header_str(value)
+    if s in PRIORITY_CLASSES:
+        return s
+    return None
 
 
 def emitter_header(node_kind: str, node_name: str) -> str:

@@ -1,0 +1,168 @@
+"""Multi-tenant QoS (ISSUE 20) — priority classes and per-tenant
+token budgets.
+
+PR 5's bounded admission made overload SURVIVABLE (typed sheds instead
+of queue collapse) but degraded every caller with equal probability:
+one runaway batch tenant could starve every interactive agent on the
+engine.  This module makes degradation SELECTIVE, in two layers:
+
+- the **priority class** — ``interactive`` | ``batch``
+  (:data:`calfkit_tpu.protocol.PRIORITY_CLASSES`), minted by the client
+  as the ``x-mesh-priority`` header and forwarded by every hop
+  (downstream tool calls run on the original caller's behalf, so they
+  inherit its class).  Under overload the mesh sheds batch first,
+  reaps batch first, and the router avoids interactive-deep replicas.
+  A corrupt or missing header degrades to the DEFAULT class
+  (interactive — batch is an explicit opt-in to LOWER priority; legacy
+  callers must not be demoted) and never faults delivery (the PR 5
+  law).  :data:`current_priority` carries the class through the
+  in-process call chain exactly like ``leases.current_lease`` carries
+  the lease: the node kernel sets it from the delivery's header, the
+  engine reads it with no per-layer plumbing.
+- the **per-tenant token bucket** (:class:`TenantRateLimiter`) — an
+  admission-time budget at the NODE KERNEL, upstream of the engine's
+  queues, so a storming tenant is refused before it occupies
+  ``max_pending`` slots that well-behaved tenants need.  The tenant
+  identity is the caller's lease id where present (one lease per
+  caller process — the natural tenant grain), else the caller's client
+  emitter id.  Refill rides THE deadline clock
+  (:func:`calfkit_tpu.cancellation.wall_clock`), so the chaos virtual
+  clock drives refill deterministically in the sim.  Refusals are the
+  typed RETRIABLE ``mesh.rate_limited`` fault: the budget refills on a
+  known schedule, so backoff-and-retry is exactly the right caller
+  response (unlike a deadline, which is gone forever).
+
+Only ENTERING work is budgeted: continuation deliveries (agent → tool,
+tool results, consumer legs) are the tail of an already-admitted run —
+rate-limiting them mid-run would strand slots and pages the admitted
+run already holds.  This mirrors the drain gate's exemption in the
+node kernel.
+
+Everything here is fail-open advisory state, like the lease store: the
+limiter defaults to DISABLED (``rate_per_s <= 0``), an unknown tenant
+starts with a full burst, and the bucket table is capped — eviction
+costs one free burst for a returning tenant, never correctness.
+"""
+
+from __future__ import annotations
+
+from calfkit_tpu.effects import hotpath
+
+import threading
+from collections import OrderedDict
+from contextvars import ContextVar
+
+from calfkit_tpu import cancellation
+from calfkit_tpu.protocol import DEFAULT_PRIORITY, PRIORITY_CLASSES
+
+__all__ = [
+    "current_priority",
+    "resolve_priority",
+    "class_rank",
+    "TenantRateLimiter",
+]
+
+# the current delivery's priority class, set by the node kernel from the
+# x-mesh-priority header for the duration of one delivery — None outside
+# any delivery (same channel shape as leases.current_lease); readers go
+# through resolve_priority() so the missing/corrupt → default law has
+# exactly one copy
+current_priority: "ContextVar[str | None]" = ContextVar(
+    "calfkit_caller_priority", default=None
+)
+
+
+def resolve_priority(value: "str | None" = None) -> str:
+    """THE class-degradation law: an unknown/absent class is the
+    DEFAULT class.  With no argument, resolves the current delivery's
+    contextvar."""
+    if value is None:
+        value = current_priority.get()
+    if value in PRIORITY_CLASSES:
+        return value
+    return DEFAULT_PRIORITY
+
+
+@hotpath
+def class_rank(priority: "str | None") -> int:
+    """Shed/reap ordering key: HIGHER rank degrades FIRST (batch=1
+    before interactive=0).  One copy, shared by the engine's victim
+    selection, the reaper scan weighting, and the sim's model — the
+    zero-interactive-sheds-while-batch-remains gate law is only as
+    strong as this ordering being identical everywhere."""
+    if priority == PRIORITY_CLASSES[-1]:  # "batch"
+        return 1
+    return 0
+
+
+# bucket table cap, same scale (and same rationale) as leases._BEAT_CAP:
+# eviction is cheap here — a returning tenant restarts with a full
+# burst, which under-throttles for one burst rather than over-throttling
+_BUCKET_CAP = 4096
+
+
+class TenantRateLimiter:
+    """Per-tenant token bucket: ``rate_per_s`` tokens/second refill up
+    to ``burst``; each entering call spends one token.  ``admit``
+    returns None to admit, else the seconds until a token exists — the
+    retry hint carried in the ``mesh.rate_limited`` fault.
+
+    Construction is cheap and the disabled form (``rate_per_s <= 0``,
+    the default) is a no-op, so nodes can carry a limiter resource
+    unconditionally and operators opt in per deployment.
+    """
+
+    def __init__(self, rate_per_s: float = 0.0, burst: float = 1.0):
+        self.rate_per_s = float(rate_per_s)
+        self.burst = max(1.0, float(burst))
+        # tenant_id -> (tokens, stamped_at); LRU-capped
+        self._buckets: "OrderedDict[str, tuple[float, float]]" = (
+            OrderedDict()
+        )
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return self.rate_per_s > 0
+
+    @hotpath
+    def admit(
+        self, tenant_id: str, now: "float | None" = None
+    ) -> "float | None":
+        """Spend one token for ``tenant_id``.  None = admitted;
+        otherwise the seconds until the bucket next holds a whole
+        token (the caller's backoff hint).  Runs on the node kernel's
+        per-delivery admission path — one dict probe, no allocation
+        beyond the bucket tuple."""
+        if self.rate_per_s <= 0 or not tenant_id:
+            return None
+        if now is None:
+            now = cancellation.wall_clock()
+        with self._lock:
+            entry = self._buckets.get(tenant_id)
+            if entry is None:
+                tokens = self.burst
+            else:
+                tokens, stamped = entry
+                if now > stamped:
+                    tokens = min(
+                        self.burst,
+                        tokens + (now - stamped) * self.rate_per_s,
+                    )
+            if tokens >= 1.0:
+                self._buckets[tenant_id] = (tokens - 1.0, now)
+                self._buckets.move_to_end(tenant_id)
+                if len(self._buckets) > _BUCKET_CAP:
+                    self._buckets.popitem(last=False)
+                return None
+            # refusal does NOT restamp with drained tokens: a storming
+            # tenant must not push its own refill horizon forward
+            self._buckets[tenant_id] = (tokens, now)
+            self._buckets.move_to_end(tenant_id)
+            return max(0.0, (1.0 - tokens) / self.rate_per_s)
+
+    def snapshot(self) -> "dict[str, float]":
+        """tenant_id -> tokens remaining (no refill applied) — debug
+        and test surface, not a hot read."""
+        with self._lock:
+            return {k: v[0] for k, v in self._buckets.items()}

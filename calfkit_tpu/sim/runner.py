@@ -136,12 +136,15 @@ class SimRunner:
         self._policy_override = policy
 
     # ------------------------------------------------------------ helpers
-    def _prompt(
-        self, rng: random.Random, tenants: "tuple[TenantSpec, ...]",
-        index: int,
-    ) -> str:
+    def _pick_tenant(
+        self, rng: random.Random, tenants: "tuple[TenantSpec, ...]"
+    ) -> TenantSpec:
         weights = [t.weight for t in tenants]
-        tenant = rng.choices(tenants, weights=weights, k=1)[0]
+        return rng.choices(tenants, weights=weights, k=1)[0]
+
+    def _prompt(
+        self, rng: random.Random, tenant: TenantSpec, index: int
+    ) -> str:
         session = rng.randrange(max(1, tenant.sessions))
         head = f"[{tenant.name}#s{session:04d}] simulated agent session "
         head = (head + "context " * 32)[:SESSION_PREFIX_CHARS]
@@ -236,7 +239,11 @@ class SimRunner:
             completed = [0]
 
             def launch(index: int) -> None:
-                prompt = self._prompt(tenant_rng, scenario.tenants, index)
+                # tenant pick then session pick: the SAME rng consumption
+                # order as before the QoS split — pre-QoS timelines are
+                # byte-identical
+                tenant = self._pick_tenant(tenant_rng, scenario.tenants)
+                prompt = self._prompt(tenant_rng, tenant, index)
 
                 async def one() -> None:
                     try:
@@ -245,6 +252,7 @@ class SimRunner:
                             timeout=scenario.timeout_s,
                             retry=retry,
                             failover=failover,
+                            priority=tenant.priority,
                         )
                         completed[0] += 1
                     except Exception as exc:  # noqa: BLE001 - harvested
@@ -501,6 +509,7 @@ class SimRunner:
                     "attempts": len(r.attempts),
                     "sheds": r.sheds,
                     "failovers": r.failovers,
+                    "priority": r.priority,
                 }
                 for r in run_records
             ]
@@ -526,6 +535,46 @@ class SimRunner:
                 "orphan_rate": round(rollup.orphan_rate, 6),
                 "error_budget_burn": round(rollup.error_budget_burn, 6),
             }
+            if any(t.priority == "batch" for t in scenario.tenants):
+                # multi-tenant QoS metrics (ISSUE 20), emitted ONLY when
+                # the scenario actually runs mixed classes — single-class
+                # scenario reports stay byte-identical to their pre-QoS
+                # baselines.  Per-run numbers come off the same rollup
+                # fold as metrics["runs"]; shed counts come off the stub
+                # engines, split by the VICTIM's class — the fairness
+                # ratio (batch share of all sheds) is the gate input.
+                interactive_sheds = sum(m.interactive_sheds for m in models)
+                batch_sheds = sum(m.batch_sheds for m in models)
+                total_sheds = interactive_sheds + batch_sheds
+                metrics["qos"] = {
+                    "interactive": {
+                        "runs": rollup.interactive_runs,
+                        "completed": rollup.interactive_completed,
+                        "completion_ratio": round(
+                            rollup.interactive_completed
+                            / rollup.interactive_runs,
+                            6,
+                        ) if rollup.interactive_runs else 1.0,
+                        "e2e_p95_s": round(rollup.interactive_p95_s, 6),
+                        "sheds": interactive_sheds,
+                        "replies": sum(
+                            m.interactive_replies for m in models
+                        ),
+                    },
+                    "batch": {
+                        "runs": rollup.batch_runs,
+                        "completed": rollup.batch_completed,
+                        "completion_ratio": round(
+                            rollup.batch_completed / rollup.batch_runs, 6
+                        ) if rollup.batch_runs else 1.0,
+                        "e2e_p95_s": round(rollup.batch_p95_s, 6),
+                        "sheds": batch_sheds,
+                        "replies": sum(m.batch_replies for m in models),
+                    },
+                    "shed_fairness_ratio": round(
+                        batch_sheds / total_sheds, 6
+                    ) if total_sheds else 1.0,
+                }
         metrics.update({
             "prefix": {
                 "lookups": prefix_lookups,

@@ -78,11 +78,24 @@ class TenantSpec:
     long-lived sessions its traffic collapses into — each session keeps
     one page-aligned prompt prefix, which is what prefix-affinity
     routing keys on.  A hotspot tenant is just a tenant whose weight
-    dwarfs the rest."""
+    dwarfs the rest.
+
+    ``priority`` (ISSUE 20) is the class the tenant's calls carry on
+    ``x-mesh-priority``: ``"interactive"`` (default) or ``"batch"``.
+    Under overload the stub engines shed batch-class arrivals first —
+    the mixed_priority_storm scenario gates exactly that ordering."""
 
     name: str
     weight: float = 1.0
     sessions: int = 4
+    priority: str = "interactive"
+
+    def __post_init__(self) -> None:
+        if self.priority not in ("interactive", "batch"):
+            raise ValueError(
+                f"unknown tenant priority {self.priority!r} "
+                "(one of: interactive, batch)"
+            )
 
 
 @dataclass(frozen=True)

@@ -36,6 +36,7 @@ from __future__ import annotations
 import asyncio
 from typing import Any
 
+from calfkit_tpu import qos
 from calfkit_tpu.exceptions import EngineOverloadedError
 from calfkit_tpu.fleet.selection import page_aligned_prefix
 from calfkit_tpu.observability import capacity
@@ -204,15 +205,23 @@ class SimEngineModel:
         self._mult = self.service.multiplier(index)
         # per-virtual-server busy-until horizon (absolute virtual time)
         self._busy: "list[float]" = [0.0] * max(1, self.service.slots)
-        # (start_at, done_at) of admitted-unfinished requests, for the
-        # pending-vs-active split the heartbeat advertises
-        self._inflight: "dict[int, tuple[float, float]]" = {}
+        # admitted-unfinished requests: run_id -> {"start", "done",
+        # "slot", "service_s", "priority", "event", "shed"} — the
+        # pending-vs-active split the heartbeat advertises, and the
+        # priority-shed victim pool (ISSUE 20)
+        self._inflight: "dict[int, dict[str, Any]]" = {}
         self._next_run = 0
         # prefix model: page-aligned prefixes this replica has served
         self._prefix_seen: "set[bytes]" = set()
         # counters (everything the heartbeat / report harvests)
         self.replies = 0
         self.sheds = 0
+        # per-class splits (ISSUE 20): sheds by the VICTIM's class,
+        # completions by the finisher's class — the fairness-gate inputs
+        self.interactive_sheds = 0
+        self.batch_sheds = 0
+        self.interactive_replies = 0
+        self.batch_replies = 0
         self.prefix_lookups = 0
         self.prefix_hits = 0
         self.prefix_reused_tokens = 0
@@ -269,10 +278,18 @@ class SimEngineModel:
 
     def _in_service(self) -> int:
         now = self.clock.now
-        return sum(1 for start, _ in self._inflight.values() if start <= now)
+        return sum(
+            1 for r in self._inflight.values() if r["start"] <= now
+        )
 
     def stats_snapshot(self, *, window: bool = False) -> dict:
         in_service = self._in_service()
+        now = self.clock.now
+        queued_batch = sum(
+            1
+            for r in self._inflight.values()
+            if r["start"] > now and r["priority"] == "batch"
+        )
         snapshot = {
             "model_name": self.model_name,
             "platform": "sim",
@@ -282,6 +299,15 @@ class SimEngineModel:
             "decode_tokens": self.decode_tokens,
             "decode_dispatches": self.decode_dispatches,
             "shed_requests": self.sheds,
+            # per-class advert keys (ISSUE 20): the same split a real
+            # engine heartbeats, so the router's interactive-depth
+            # tiebreak works against sim adverts too
+            "interactive_shed": self.interactive_sheds,
+            "batch_shed": self.batch_sheds,
+            "interactive_pending": (
+                len(self._inflight) - in_service - queued_batch
+            ),
+            "batch_pending": queued_batch,
             "dispatch_ewma_ms": round(self.dispatch_ewma_ms, 6),
             "prefix_hits": self.prefix_hits,
             "prefix_reused_tokens": self.prefix_reused_tokens,
@@ -333,6 +359,42 @@ class SimEngineModel:
         self._free_pool -= need
         return need
 
+    # ---------------------------------------------------------- qos shed
+    def _preempt_victim(self) -> "int | None":
+        """The queued batch request whose eviction reclaims slot horizon
+        EXACTLY: it must not have started (``start > now`` — active work
+        is never preempted) and must be the tail of its slot
+        (``done == busy[slot]``) so subtracting its service time leaves
+        no stale downstream reservation.  Among candidates pick the one
+        finishing latest (most horizon reclaimed); ties break to the
+        earliest-admitted via dict insertion order — deterministic."""
+        now = self.clock.now
+        best: "int | None" = None
+        best_done = -1.0
+        for run_id, record in self._inflight.items():
+            if record["priority"] != "batch":
+                continue
+            if record["start"] <= now:
+                continue
+            if record["done"] != self._busy[record["slot"]]:
+                continue
+            if record["done"] > best_done:
+                best = run_id
+                best_done = record["done"]
+        return best
+
+    def _shed_inflight(self, run_id: int) -> None:
+        """Evict a queued batch victim: reclaim its slot horizon, count
+        the shed against the VICTIM's class, and wake its coroutine —
+        which observes the flag, undoes its page accounting, and raises
+        the retriable shed fault (the caller's RetryPolicy re-drives)."""
+        record = self._inflight.pop(run_id)
+        record["shed"] = True
+        self._busy[record["slot"]] -= record["service_s"]
+        self.sheds += 1
+        self.batch_sheds += 1
+        record["event"].set()
+
     # ------------------------------------------------------------ serving
     async def request(
         self, messages: Any, settings: Any = None, params: Any = None
@@ -344,17 +406,32 @@ class SimEngineModel:
         )
 
         spec = self.service
+        # priority class (ISSUE 20): the node kernel set the contextvar
+        # from x-mesh-priority before calling the model — the sim runs
+        # the REAL delivery path, so the one degradation law applies
+        priority = qos.resolve_priority()
         if (
             spec.shed_above is not None
             and len(self._inflight) >= spec.shed_above
         ):
-            self.sheds += 1
-            raise EngineOverloadedError(
-                "sim engine overloaded",
-                lane="sim",
-                pending=len(self._inflight),
-                limit=spec.shed_above,
+            victim_id = (
+                self._preempt_victim() if priority != "batch" else None
             )
+            if victim_id is None:
+                # shed the ARRIVAL: batch always; interactive only when
+                # no queued batch victim exists — the shed-order law
+                self.sheds += 1
+                if priority == "batch":
+                    self.batch_sheds += 1
+                else:
+                    self.interactive_sheds += 1
+                raise EngineOverloadedError(
+                    "sim engine overloaded",
+                    lane="sim",
+                    pending=len(self._inflight),
+                    limit=spec.shed_above,
+                )
+            self._shed_inflight(victim_id)
 
         prompt = _prompt_text(messages)
         input_tokens = max(1, len(prompt) // 4)
@@ -382,7 +459,17 @@ class SimEngineModel:
         self._busy[slot] = done_at
         run_id = self._next_run
         self._next_run += 1
-        self._inflight[run_id] = (start_at, done_at)
+        done = asyncio.Event()
+        self._inflight[run_id] = {
+            "start": start_at,
+            "done": done_at,
+            "slot": slot,
+            "service_s": service_s,
+            "priority": priority,
+            "event": done,
+            "shed": False,
+        }
+        record = self._inflight[run_id]
 
         shared: "tuple[int, ...]" = ()
         granted = 0
@@ -412,12 +499,47 @@ class SimEngineModel:
                 self.peak_pages_in_use, self.ledger.pages_in_use
             )
 
-        done = asyncio.Event()
         self.clock.schedule(done_at, done.set)
         await done.wait()
 
+        if record["shed"]:
+            # victim path: a later interactive arrival preempted this
+            # queued batch request (``_shed_inflight`` already removed
+            # it, reclaimed the slot horizon, and counted the shed).
+            # Undo the page accounting this request never consummated,
+            # then surface the REAL retriable shed fault so the caller's
+            # RetryPolicy re-drives the work.
+            if self.ledger is not None:
+                if shared:
+                    self.ledger.release(list(shared))
+                    held = self._chain_held.get(key, 1) - 1
+                    if held <= 0:
+                        self._chain_held.pop(key, None)
+                        if key in self._chain_pages:
+                            self._chain_pages[key] = self._chain_pages.pop(
+                                key
+                            )
+                    else:
+                        self._chain_held[key] = held
+                self.ledger.free(run_id)
+                self._free_pool += granted
+            if key is not None and not prefix_hit:
+                # this request introduced the prefix but never prefilled
+                # it to completion — it must re-miss (and re-prefill)
+                self._prefix_seen.discard(key)
+            raise EngineOverloadedError(
+                "sim engine preempted batch request",
+                lane="sim",
+                pending=len(self._inflight),
+                limit=spec.shed_above,
+            )
+
         self._inflight.pop(run_id, None)
         self.replies += 1
+        if priority == "batch":
+            self.batch_replies += 1
+        else:
+            self.interactive_replies += 1
         self.last_done_at = max(self.last_done_at, done_at)
         dispatches = max(
             1,

@@ -45,6 +45,7 @@ class Worker(LifecycleHookMixin):
         control_plane: Any = None,
         fanout: Any = None,  # FanoutConfig | None
         provisioning: Any = None,  # ProvisioningConfig | None
+        qos: Any = None,  # qos.TenantRateLimiter | None
     ):
         super().__init__()
         if not nodes:
@@ -96,6 +97,18 @@ class Worker(LifecycleHookMixin):
                 f"True/False or None, got {type(control_plane).__name__}"
             )
         self.control_plane = control_plane
+        # multi-tenant QoS (ISSUE 20): an opt-in per-tenant admission
+        # token bucket shared by every node this worker hosts — the node
+        # kernel's admission gate spends one token per ENTERING run and
+        # refuses over-budget tenants with a typed, retriable
+        # ``mesh.rate_limited`` fault before any queue or slot is held
+        from calfkit_tpu.qos import TenantRateLimiter
+
+        if qos is not None and not isinstance(qos, TenantRateLimiter):
+            raise LifecycleConfigError(
+                f"qos must be a TenantRateLimiter, got {type(qos).__name__}"
+            )
+        self.qos = qos
         self.resources: dict[str, Any] = {}
         self._subscriptions: list[Subscription] = []
         self._stores: list[KtablesFanoutBatchStore] = []
@@ -169,6 +182,10 @@ class Worker(LifecycleHookMixin):
         for node in self.nodes:
             node.bind(self.mesh)
             node.resources.setdefault("worker", self)
+            if self.qos is not None:
+                from calfkit_tpu.nodes.base import QOS_LIMITER_KEY
+
+                node.resources.setdefault(QOS_LIMITER_KEY, self.qos)
             for key, value in self.resources.items():
                 node.resources.setdefault(key, value)
             if FANOUT_STORE_KEY not in node.resources:

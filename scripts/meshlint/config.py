@@ -64,8 +64,10 @@ def default_config(root: "Path | str") -> Config:
         ),
         required_roots=[
             RequiredRoots(
-                "calfkit_tpu.inference.engine", "hotpath", 6,
-                "the decode dispatch loop (ISSUE 2/3/6) must stay rooted",
+                "calfkit_tpu.inference.engine", "hotpath", 8,
+                "the decode dispatch loop (ISSUE 2/3/6) and the "
+                "priority-shed selection / class-weighted reap ordering "
+                "(ISSUE 20) must stay rooted",
             ),
             RequiredRoots(
                 "calfkit_tpu.fleet", "hotpath", 8,
@@ -73,8 +75,14 @@ def default_config(root: "Path | str") -> Config:
                 "rooted",
             ),
             RequiredRoots(
-                "calfkit_tpu.leases", "hotpath", 4,
-                "the orphan-reaper sweep reads (ISSUE 10) must stay rooted",
+                "calfkit_tpu.leases", "hotpath", 5,
+                "the orphan-reaper sweep reads (ISSUE 10) and the "
+                "shed-order beat-age read (ISSUE 20) must stay rooted",
+            ),
+            RequiredRoots(
+                "calfkit_tpu.qos", "hotpath", 2,
+                "the per-delivery admission token-bucket check and the "
+                "class-rank ordering key (ISSUE 20) must stay rooted",
             ),
             RequiredRoots(
                 "calfkit_tpu.observability.flightrec", "hotpath", 1,

@@ -78,6 +78,16 @@ EXACT_METRICS = {
     # to close is a correctness bug, never drift
     "runs.completion_ratio",
 }
+# ratio-valued gated metrics (ISSUE 20): the default 2.0 absolute slack
+# would swallow the whole [0, 1] range — band them on an absolute ratio
+# delta instead (wide enough for legitimate rng-order drift, narrow
+# enough that a class-ordering regression cannot hide)
+RATIO_METRICS = {
+    "qos.interactive.completion_ratio",
+    "qos.batch.completion_ratio",
+    "qos.shed_fairness_ratio",
+}
+RATIO_ABS_TOL = 0.05
 
 
 class _WorstLoaded:
@@ -233,6 +243,12 @@ def baseline_from(report: SimReport) -> "dict[str, Any]":
         for metric, value in scenario.gated_metrics().items():
             if metric in EXACT_METRICS:
                 entry[metric] = {"value": value, "rel_tol": 0.0, "abs_tol": 0.0}
+            elif metric in RATIO_METRICS:
+                entry[metric] = {
+                    "value": value,
+                    "rel_tol": 0.0,
+                    "abs_tol": RATIO_ABS_TOL,
+                }
             else:
                 entry[metric] = {
                     "value": value,

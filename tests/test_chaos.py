@@ -338,6 +338,174 @@ class TestOversubscription:
         await engine.stop()
 
 
+class TestMultiTenantQos:
+    """ISSUE 20 chaos drills: the rate-limit admission gate and the
+    priority-ordered shed, each with the three robustness invariants
+    (typed faults, bounded resource free, auditable decision trail)."""
+
+    async def test_rate_limited_tenant_storm_typed_and_drained(self, params):
+        """A single tenant storms past its admission budget: the excess
+        is refused at the NODE KERNEL with the typed RETRIABLE
+        ``mesh.rate_limited`` fault (carrying tenant id + retry hint),
+        the admitted calls complete in full, and the engine drains with
+        zero leaked slots or pages — a refused call never touched the
+        engine at all."""
+        from calfkit_tpu.qos import TenantRateLimiter
+
+        runtime = _rt(max_batch_size=4, max_pending=8)
+        engine = InferenceEngine(CFG, runtime, params=params)
+        model = JaxLocalModelClient(
+            config=CFG, runtime=runtime, engine=engine, max_new_tokens=8
+        )
+        mesh = InMemoryMesh()
+        # negligible refill over the test's wall time: exactly the burst
+        # (2 calls) is admitted, everything after is refused
+        limiter = TenantRateLimiter(rate_per_s=0.0001, burst=2)
+        async with Worker(
+            [Agent("svc", model=model)], mesh=mesh, owns_transport=True,
+            qos=limiter,
+        ):
+            client = Client.connect(mesh)
+            results = await asyncio.gather(
+                *[
+                    client.agent("svc").execute(f"p{i}", timeout=120)
+                    for i in range(6)
+                ],
+                return_exceptions=True,
+            )
+            served = [r for r in results if not isinstance(r, BaseException)]
+            faults = [r for r in results if isinstance(r, BaseException)]
+            assert len(served) == 2, "burst admitted more than its budget"
+            assert len(faults) == 4, "storm excess was not refused"
+            for exc in faults:
+                assert isinstance(exc, NodeFaultError), repr(exc)
+                assert exc.report.error_type == FaultTypes.RATE_LIMITED, (
+                    exc.report.error_type
+                )
+                # the budget refills on a known schedule: backoff-and-
+                # retry is the right caller response, so the fault MUST
+                # classify retriable
+                assert RetryPolicy.retriable(exc)
+                assert exc.report.data.get("tenant_id") == client.client_id
+                assert float(exc.report.data["retry_after_s"]) > 0.0
+            # a refused call never reached the engine: no shed, no
+            # journal entry, and the engine drains clean
+            assert engine.stats.shed_requests == 0
+            await settle(
+                lambda: _drained(engine), message="engine never drained"
+            )
+            assert_engine_drained(engine)
+            await client.close()
+        await engine.stop()
+
+    async def test_interactive_preempts_queued_batch_never_reverse(
+        self, params
+    ):
+        """The shed-order law, end to end at the engine: with the short
+        lane full of batch work, arriving interactive submits evict
+        QUEUED batch requests (typed retriable EngineOverloadedError
+        with the full lane/pending/limit detail) and run in their
+        place.  Zero interactive sheds while any batch request was
+        sheddable — and the journal carries one SHED per eviction."""
+        runtime = _rt(
+            max_batch_size=2, max_pending=2, overlap_dispatch=True,
+            flightrec_events=1 << 12,
+        )
+        engine = InferenceEngine(CFG, runtime, params=params)
+        await engine.start()
+        try:
+            batch = [
+                asyncio.ensure_future(
+                    _collect(
+                        engine, [1 + i], 32,
+                        corr=f"bulk-{i}", priority="batch",
+                    )
+                )
+                for i in range(2)
+            ]
+            # stage the backlog: let the first pair claim the slots
+            # BEFORE queueing the next pair, or all four race into the
+            # queue and bounded admission sheds the tail at submit
+            await settle(
+                lambda: len(engine._active) == 2,
+                message="batch pair never went active",
+            )
+            batch += [
+                asyncio.ensure_future(
+                    _collect(
+                        engine, [3 + i], 32,
+                        corr=f"bulk-{2 + i}", priority="batch",
+                    )
+                )
+                for i in range(2)
+            ]
+            # 2 batch active, 2 batch queued — the victim pool
+            await settle(
+                lambda: len(engine._pending) == 2,
+                message="batch backlog never queued",
+            )
+            interactive = await asyncio.gather(
+                *[
+                    _collect(
+                        engine, [9 + i], 8,
+                        corr=f"chat-{i}", priority="interactive",
+                    )
+                    for i in range(2)
+                ],
+                return_exceptions=True,
+            )
+            batch_results = await asyncio.gather(
+                *batch, return_exceptions=True
+            )
+            # every interactive request completed — none were shed
+            for stream in interactive:
+                assert isinstance(stream, list), repr(stream)
+                assert len(stream) == 8
+            victims = [
+                r for r in batch_results
+                if isinstance(r, EngineOverloadedError)
+            ]
+            assert len(victims) == 2, (
+                "each interactive arrival must evict one queued batch "
+                f"request, got {batch_results!r}"
+            )
+            for exc in victims:
+                # the eviction carries the SAME typed detail a
+                # shed-at-submit would (the drive-by uniformity law)
+                assert exc.lane == "short"
+                assert exc.limit == 2
+                assert exc.pending >= 2
+                # crossing the mesh this types as mesh.overloaded, which
+                # is retriable — the caller's RetryPolicy re-drives the
+                # preempted batch work
+                from calfkit_tpu.exceptions import (
+                    FAULT_TYPE_BY_EXCEPTION,
+                    RETRIABLE_FAULT_TYPES,
+                )
+
+                assert (
+                    FAULT_TYPE_BY_EXCEPTION[type(exc)]
+                    in RETRIABLE_FAULT_TYPES
+                )
+            assert engine.stats.shed_requests == 2
+            assert engine.stats.batch_shed == 2
+            assert engine.stats.interactive_shed == 0, (
+                "an interactive request was shed while batch work was "
+                "sheddable — the shed-order law is broken"
+            )
+            sheds = [
+                e for e in _journal_events(engine) if e["event"] == "SHED"
+            ]
+            assert len(sheds) == 2
+            assert {e["corr"] for e in sheds} <= {f"bulk-{i}" for i in range(4)}
+            await settle(
+                lambda: _drained(engine), message="engine never drained"
+            )
+            assert_engine_drained(engine)
+        finally:
+            await engine.stop()
+
+
 class TestMidStreamFault:
     async def test_injected_dispatch_fault_dumps_and_terminates(
         self, params, tmp_path, monkeypatch

@@ -162,6 +162,41 @@ class TestRoundTripProperties:
                 assert raw.decode("utf-8").startswith(run_id + ":")
 
 
+    def test_fuzzed_priority_headers_parse_or_default(self):
+        """ISSUE 20 satellite fuzz: arbitrary ``x-mesh-priority`` bytes
+        survive the codec byte-exactly, and the receiving resolve law
+        (``parse_priority`` → ``qos.resolve_priority``) always yields a
+        class FROM THE VOCABULARY — never raises, never a third class,
+        and a valid class always round-trips exactly."""
+        from calfkit_tpu import protocol, qos
+
+        rng = random.Random(99)
+        for _ in range(200):
+            if rng.random() < 0.5:
+                cls = rng.choice(protocol.PRIORITY_CLASSES)
+                raw = protocol.format_priority(cls).encode()
+                expect = cls
+            else:
+                raw = rng.randbytes(rng.randint(0, 32))
+                expect = None  # fuzz bytes: whatever parses must be exact
+            blob = encode_record_batch(
+                [(b"k", b"v", [(protocol.HDR_PRIORITY, raw)])], 1
+            )
+            [(_o, _t, _k, _v, decoded)] = decode_record_batches(blob)
+            assert dict(decoded)[protocol.HDR_PRIORITY] == raw  # byte-exact
+            parsed = protocol.parse_priority(
+                protocol.header_map(dict(decoded)).get(protocol.HDR_PRIORITY)
+            )
+            resolved = qos.resolve_priority(parsed)
+            assert resolved in protocol.PRIORITY_CLASSES
+            if expect is not None:
+                assert parsed == expect and resolved == expect
+            elif parsed is not None:
+                # an accepted fuzz value can only be an exact vocabulary
+                # word — parse_priority never normalizes or guesses
+                assert raw.decode("utf-8") == parsed
+
+
 class TestCorruption:
     def test_truncation_at_every_boundary(self):
         """A truncated record_set never raises a raw error: the trailing

@@ -158,6 +158,50 @@ class TestWireCodec:
                 protocol.parse_run(normalized.get(protocol.HDR_RUN)) is None
             )
 
+    def test_priority_header_round_trip_through_record_batch(self):
+        """ISSUE 20 satellite: the ``x-mesh-priority`` class header
+        survives encode/decode and parses back to the exact class, for
+        every class in the vocabulary."""
+        from calfkit_tpu import protocol
+
+        for cls in protocol.PRIORITY_CLASSES:
+            value = protocol.format_priority(cls)
+            blob = encode_record_batch(
+                [(b"k", b"v", [(protocol.HDR_PRIORITY, value.encode("utf-8"))])],
+                42,
+            )
+            [(_o, _t, _k, _v, decoded)] = decode_record_batches(blob)
+            normalized = protocol.header_map(dict(decoded))
+            assert (
+                protocol.parse_priority(normalized.get(protocol.HDR_PRIORITY))
+                == cls
+            )
+
+    def test_corrupt_priority_header_degrades_to_default(self):
+        """A corrupt ``x-mesh-priority`` value parses to None — the
+        receiver resolves it to the DEFAULT class (qos.resolve_priority)
+        — never a delivery fault, never a third class, and never a
+        demotion below the default (the PR 5 corrupt-header law)."""
+        from calfkit_tpu import protocol, qos
+
+        for raw in (
+            b"\xff\xfe\xfd",  # undecodable utf-8
+            b"urgent",  # out-of-vocabulary
+            b"INTERACTIVE",  # case matters: the vocabulary is exact
+            b"batch ",  # trailing junk
+            b"",
+        ):
+            blob = encode_record_batch(
+                [(b"k", b"v", [(protocol.HDR_PRIORITY, raw)])], 1
+            )
+            [(_o, _t, _k, _v, decoded)] = decode_record_batches(blob)
+            normalized = protocol.header_map(dict(decoded))
+            parsed = protocol.parse_priority(
+                normalized.get(protocol.HDR_PRIORITY)
+            )
+            assert parsed is None
+            assert qos.resolve_priority(parsed) == protocol.DEFAULT_PRIORITY
+
     def test_range_assign_splits_evenly(self):
         members = {"m-1": ["a"], "m-2": ["a"]}
         partitions = {"a": [0, 1, 2, 3, 4]}
